@@ -1,0 +1,50 @@
+"""horovod_tpu — a TPU-native distributed training framework with the
+capabilities of Horovod (reference: ``Tixxx/horovod``; see SURVEY.md).
+
+Data-parallel (and beyond) training for JAX over TPU meshes: the
+reference's NCCL/MPI/Gloo collectives become XLA AllReduce/AllGather/
+AllToAll HLO over ICI/DCN; its C++ background coordinator becomes XLA's
+static SPMD schedule; its launcher becomes ``jax.distributed``.
+
+Canonical usage (mirrors ``import horovod.torch as hvd``)::
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    g_avg = hvd.allreduce(grads_stack)              # default op=Average
+    outs = hvd.grouped_allreduce([a, b], op=hvd.Sum)
+
+(The optimizer layer — ``DistributedOptimizer``, ``make_train_step``,
+``broadcast_parameters`` — lives in ``horovod_tpu.optim`` and is
+re-exported here once imported.)
+"""
+
+from .basics import (  # noqa: F401
+    init, shutdown, is_initialized,
+    rank, size, local_rank, local_size, cross_rank, cross_size,
+    is_homogeneous,
+    mpi_built, nccl_built, gloo_built, ccl_built, cuda_built, rocm_built,
+    xla_built, mpi_threads_supported,
+    config, global_mesh, start_timeline, stop_timeline,
+    NotInitializedError,
+)
+from .config import Config  # noqa: F401
+from .process_sets import (  # noqa: F401
+    ProcessSet, add_process_set, remove_process_set, global_process_set,
+)
+from .ops import (  # noqa: F401
+    Sum, Average, Adasum, Min, Max, Product,
+    allreduce, allreduce_async, grouped_allreduce, grouped_allreduce_async,
+    allgather, allgather_async, grouped_allgather,
+    broadcast, broadcast_async,
+    alltoall, alltoall_async,
+    reducescatter, reducescatter_async, grouped_reducescatter,
+    barrier, synchronize, poll, join,
+    Compression, Handle,
+)
+from .functions import (  # noqa: F401
+    broadcast_object, allgather_object, broadcast_parameters,
+    broadcast_optimizer_state,
+)
+from . import ops  # noqa: F401
+from .version import __version__  # noqa: F401

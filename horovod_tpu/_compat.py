@@ -1,0 +1,17 @@
+"""Version compatibility shims for the evolving JAX API surface."""
+
+from __future__ import annotations
+
+try:  # jax >= 0.8: jax.shard_map with check_vma
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check: bool = False):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=check)
+
+except ImportError:  # older jax: experimental module with check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map  # type: ignore
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check: bool = False):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check)

@@ -1,0 +1,303 @@
+"""Process model: init / shutdown / rank / size / local_rank / cross_rank.
+
+Mirrors the reference's ``HorovodBasics`` Python façade over the C core
+(``horovod/common/basics.py`` + ``horovod_init`` in
+``horovod/common/operations.cc`` — paths per SURVEY.md §2.1/§2.4, reference
+mount empty, unverified).
+
+TPU-native redesign
+-------------------
+The reference starts a C++ background coordinator thread per process and
+bootstraps an MPI/Gloo controller.  On TPU none of that machinery is needed:
+
+* **Process bootstrap** is ``jax.distributed.initialize()`` (coordination
+  service over DCN) — replacing mpirun/Gloo-HTTP rendezvous.
+* **Slot model:** the reference runs one *process per accelerator*; a JAX
+  controller process may own many chips.  We therefore distinguish
+
+  - ``size()``      — number of *slots* (= global device count).  This is
+    the world size every collective reduces over, matching the reference's
+    one-GPU-per-rank worldview.
+  - ``rank()``      — the calling process's *first* slot index.  Inside an
+    SPMD region each slot observes its own rank via
+    :func:`horovod_tpu.ops.rank` (``lax.axis_index``).
+  - ``local_size()``/``local_rank()`` — slots on this host / first local slot.
+  - ``cross_size()``/``cross_rank()`` — number of controller processes /
+    this process's index (the reference defines cross_* per-host; on TPU
+    host == controller process).
+
+* **The coordinator thread is gone.**  XLA's SPMD compilation already
+  guarantees what the reference's rank-0 consensus protocol establishes at
+  runtime — that every rank executes the same collectives in the same
+  order.  The response cache is subsumed by jit tracing (same graph every
+  step); the background cycle loop by XLA's static schedule.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+from typing import Optional
+
+import jax
+import numpy as np
+
+from .config import Config
+from .utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class NotInitializedError(RuntimeError):
+    """Raised when the API is used before :func:`init` (reference raises
+    ``ValueError('Horovod has not been initialized; use hvd.init()')``)."""
+
+    def __init__(self) -> None:
+        super().__init__(
+            "horovod_tpu has not been initialized; call horovod_tpu.init() first."
+        )
+
+
+class _GlobalState:
+    """Singleton runtime state (reference: ``HorovodGlobalState`` in
+    ``horovod/common/global_state.h``, unverified)."""
+
+    def __init__(self) -> None:
+        self.initialized: bool = False
+        self.config: Optional[Config] = None
+        self.mesh = None            # horovod_tpu.mesh.GlobalMesh
+        self.process_sets = None    # horovod_tpu.process_sets.ProcessSetTable
+        self.timeline = None        # horovod_tpu.utils.timeline.Timeline
+        self.stall_inspector = None
+        self.parameter_manager = None
+        self.lock = threading.Lock()
+
+
+_state = _GlobalState()
+
+
+def _maybe_init_distributed() -> None:
+    """Bring up the multi-process coordination service when launched by
+    ``horovodrun``-style tooling (env contract) or a cloud TPU pod.
+
+    Replaces the reference's MPI_Init / Gloo HTTP-KV rendezvous
+    (``horovod/common/gloo/gloo_context.cc``, unverified).
+    """
+    coordinator = os.environ.get("HVD_TPU_COORDINATOR_ADDR")
+    num_processes = os.environ.get("HVD_TPU_NUM_PROCESSES")
+    process_id = os.environ.get("HVD_TPU_PROCESS_ID")
+    if not (coordinator and num_processes and int(num_processes) > 1):
+        return
+    # NOTE: jax.distributed.initialize must run before anything touches a
+    # backend (jax.devices()/process_count() would initialize XLA and make
+    # it fail), so detect "already initialized" via the distributed client
+    # state, not via backend queries.
+    from jax._src import distributed as _jd
+
+    if getattr(_jd.global_state, "client", None) is not None:
+        return  # already initialized by the platform or the user
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=int(num_processes),
+        process_id=int(process_id or 0),
+    )
+    logger.info(
+        "jax.distributed initialized: process %d/%s via %s",
+        int(process_id or 0), num_processes, coordinator,
+    )
+
+
+def init(config: Optional[Config] = None) -> None:
+    """Initialize the framework (reference: ``hvd.init()``).
+
+    Idempotent, like the reference.  Accepts an explicit :class:`Config`
+    for tests; otherwise reads the environment.
+    """
+    from . import process_sets as _ps
+    from .mesh import GlobalMesh
+    from .utils.timeline import Timeline
+    from .utils.stall import StallInspector
+
+    with _state.lock:
+        if _state.initialized:
+            return
+        _maybe_init_distributed()
+        cfg = config or Config.from_env()
+        _state.config = cfg
+        _state.mesh = GlobalMesh.build(axis_name=cfg.mesh_axis_name)
+        _state.process_sets = _ps.ProcessSetTable(_state.mesh)
+        _state.timeline = Timeline(cfg.timeline, mark_cycles=cfg.timeline_mark_cycles)
+        _state.stall_inspector = StallInspector(
+            enabled=not cfg.stall_check_disable,
+            warn_after_s=cfg.stall_check_time_seconds,
+            shutdown_after_s=cfg.stall_shutdown_time_seconds,
+        )
+        _state.initialized = True
+        logger.info(
+            "horovod_tpu initialized: %d slot(s) on %d process(es), platform=%s",
+            _state.mesh.size, jax.process_count(), jax.default_backend(),
+        )
+
+
+def shutdown() -> None:
+    """Tear down (reference: ``hvd.shutdown()`` → joins the background
+    thread; here: flush the timeline, drop state)."""
+    with _state.lock:
+        if not _state.initialized:
+            return
+        if _state.timeline is not None:
+            _state.timeline.close()
+        if _state.stall_inspector is not None:
+            _state.stall_inspector.stop()
+        _state.initialized = False
+        # Compiled-collective caches hold the old mesh; drop them so a
+        # re-init (elastic restart, tests) rebuilds against the new mesh.
+        from .ops import collectives as _c
+
+        for fn in (_c._allreduce_fn, _c._grouped_allreduce_fn, _c._allgather_fn,
+                   _c._broadcast_fn, _c._alltoall_fn, _c._reducescatter_fn):
+            fn.cache_clear()
+        _state.mesh = None
+        _state.process_sets = None
+        _state.timeline = None
+        _state.stall_inspector = None
+
+
+atexit.register(shutdown)
+
+
+def is_initialized() -> bool:
+    """Reference: ``hvd.is_initialized()``."""
+    return _state.initialized
+
+
+def _require_init() -> _GlobalState:
+    if not _state.initialized:
+        raise NotInitializedError()
+    return _state
+
+
+def size() -> int:
+    """World size in *slots* (accelerator chips) — the reduction width of
+    every collective.  Reference: ``hvd.size()`` (one process per GPU)."""
+    return _require_init().mesh.size
+
+
+def rank() -> int:
+    """This controller process's first slot index.  Reference:
+    ``hvd.rank()``.  Per-slot rank inside SPMD code: ``ops.rank(axis)``."""
+    return _require_init().mesh.process_first_slot
+
+
+def local_size() -> int:
+    """Slots attached to this process.  Reference: ``hvd.local_size()``."""
+    return _require_init().mesh.local_size
+
+
+def local_rank() -> int:
+    """Index of this process's first slot among local slots — 0 unless
+    several controller processes share a host.  Reference:
+    ``hvd.local_rank()``."""
+    return _require_init().mesh.local_rank
+
+
+def cross_size() -> int:
+    """Number of controller processes.  Reference: ``hvd.cross_size()``
+    (number of hosts)."""
+    _require_init()
+    return jax.process_count()
+
+
+def cross_rank() -> int:
+    """This controller process's index.  Reference: ``hvd.cross_rank()``."""
+    _require_init()
+    return jax.process_index()
+
+
+def is_homogeneous() -> bool:
+    """True when every process drives the same number of slots.
+    Reference: ``hvd.is_homogeneous()``."""
+    st = _require_init()
+    counts = st.mesh.slots_per_process
+    return len(set(counts)) <= 1
+
+
+# --- feature matrix (reference: hvd.mpi_built()/nccl_built()/… and
+#     `horovodrun --check-build`) -------------------------------------------
+
+def mpi_built() -> bool:
+    """Always False: there is no MPI in the TPU stack."""
+    return False
+
+
+def nccl_built() -> int:
+    """Always 0: collectives run as XLA HLO over ICI, not NCCL."""
+    return 0
+
+
+def gloo_built() -> bool:
+    """Always False (see :func:`mpi_built`)."""
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
+def xla_built() -> bool:
+    """True: XLA *is* the collective backend here."""
+    return True
+
+
+def mpi_threads_supported() -> bool:
+    """Reference API parity; meaningless without MPI."""
+    return False
+
+
+def config() -> Config:
+    """The resolved :class:`Config` (no reference analogue as an object;
+    the reference exposes knobs only as env vars)."""
+    return _require_init().config
+
+
+def global_mesh():
+    """The framework-owned global 1-D device mesh (TPU-native concept;
+    replaces the reference's global MPI/Gloo communicator)."""
+    return _require_init().mesh
+
+
+def timeline():
+    return _require_init().timeline
+
+
+def stall_inspector():
+    return _require_init().stall_inspector
+
+
+def start_timeline(path: str, mark_cycles: bool = False) -> None:
+    """Reference: ``hvd.start_timeline()`` (dynamic timeline activation)."""
+    from .utils.timeline import Timeline
+
+    st = _require_init()
+    if st.timeline is not None:
+        st.timeline.close()
+    st.timeline = Timeline(path, mark_cycles=mark_cycles)
+
+
+def stop_timeline() -> None:
+    """Reference: ``hvd.stop_timeline()``."""
+    st = _require_init()
+    if st.timeline is not None:
+        st.timeline.close()
+    from .utils.timeline import Timeline
+
+    st.timeline = Timeline(None)

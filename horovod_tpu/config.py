@@ -1,0 +1,135 @@
+"""Typed configuration backed by environment variables.
+
+The reference framework's entire configuration surface is environment
+variables parsed in C++ (``horovod/common/utils/env_parser.cc``, path per
+SURVEY.md §5 — reference mount was empty, unverified).  We keep the same
+model: every knob has a ``HOROVOD_*`` name (accepted verbatim for
+drop-in compatibility) plus an ``HVD_TPU_*`` alias, parsed once into a
+typed, frozen ``Config`` object at :func:`horovod_tpu.init` time.
+
+Unlike the reference there is no C++ side to hand these to — the values
+feed the fusion planner, timeline, stall inspector, autotuner and elastic
+driver directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+_TRUE = {"1", "true", "yes", "on"}
+_FALSE = {"0", "false", "no", "off", ""}
+
+
+def _env(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Look up ``HOROVOD_<name>`` then ``HVD_TPU_<name>``."""
+    for prefix in ("HOROVOD_", "HVD_TPU_"):
+        val = os.environ.get(prefix + name)
+        if val is not None:
+            return val
+    return default
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    val = _env(name)
+    if val is None:
+        return default
+    if val.strip().lower() in _TRUE:
+        return True
+    if val.strip().lower() in _FALSE:
+        return False
+    raise ValueError(f"Boolean env var {name!r} has unparseable value {val!r}")
+
+
+def _env_int(name: str, default: int) -> int:
+    val = _env(name)
+    if val is None:
+        return default
+    try:
+        return int(val)
+    except ValueError as e:
+        raise ValueError(f"Integer env var {name!r} has unparseable value {val!r}") from e
+
+
+def _env_float(name: str, default: float) -> float:
+    val = _env(name)
+    if val is None:
+        return default
+    try:
+        return float(val)
+    except ValueError as e:
+        raise ValueError(f"Float env var {name!r} has unparseable value {val!r}") from e
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """All runtime knobs, resolved once at init.
+
+    Field names follow the reference env vars (``HOROVOD_FUSION_THRESHOLD``
+    → ``fusion_threshold`` etc.; see reference ``docs/tensor-fusion.rst``,
+    unverified).
+    """
+
+    # --- tensor fusion (reference: fusion_buffer_manager.cc) ---
+    fusion_threshold: int = 64 * 1024 * 1024  # bytes; HOROVOD_FUSION_THRESHOLD
+    cycle_time_ms: float = 1.0                # HOROVOD_CYCLE_TIME (latency knob)
+
+    # --- collectives ---
+    hierarchical_allreduce: bool = False      # HOROVOD_HIERARCHICAL_ALLREDUCE
+    hierarchical_allgather: bool = False      # HOROVOD_HIERARCHICAL_ALLGATHER
+    batch_d2d_memcopies: bool = True          # HOROVOD_BATCH_D2D_MEMCOPIES
+
+    # --- observability ---
+    timeline: Optional[str] = None            # HOROVOD_TIMELINE (trace file path)
+    timeline_mark_cycles: bool = False        # HOROVOD_TIMELINE_MARK_CYCLES
+    log_level: str = "warning"                # HOROVOD_LOG_LEVEL
+
+    # --- stall detection (reference: stall_inspector.cc) ---
+    stall_check_disable: bool = False         # HOROVOD_STALL_CHECK_DISABLE
+    stall_check_time_seconds: float = 60.0    # HOROVOD_STALL_CHECK_TIME_SECONDS
+    stall_shutdown_time_seconds: float = 0.0  # HOROVOD_STALL_SHUTDOWN_TIME_SECONDS
+
+    # --- autotune (reference: parameter_manager.cc) ---
+    autotune: bool = False                    # HOROVOD_AUTOTUNE
+    autotune_log: Optional[str] = None        # HOROVOD_AUTOTUNE_LOG
+    autotune_warmup_samples: int = 3          # HOROVOD_AUTOTUNE_WARMUP_SAMPLES
+    autotune_steps_per_sample: int = 10       # HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE
+
+    # --- elastic (reference: runner/elastic/) ---
+    elastic_timeout_seconds: float = 600.0    # HOROVOD_ELASTIC_TIMEOUT
+    reset_limit: int = 0                      # HOROVOD_ELASTIC_RESET_LIMIT (0 = unlimited)
+
+    # --- cache (reference: response_cache.cc) ---
+    cache_capacity: int = 1024                # HOROVOD_CACHE_CAPACITY
+
+    # --- TPU-specific (no reference analogue) ---
+    mesh_axis_name: str = "hvd"               # HVD_TPU_MESH_AXIS_NAME
+    use_native_planner: bool = True           # HVD_TPU_USE_NATIVE_PLANNER (C++ fusion planner)
+
+    @staticmethod
+    def from_env() -> "Config":
+        timeline = _env("TIMELINE")
+        autotune_log = _env("AUTOTUNE_LOG")
+        return Config(
+            fusion_threshold=_env_int("FUSION_THRESHOLD", 64 * 1024 * 1024),
+            cycle_time_ms=_env_float("CYCLE_TIME", 1.0),
+            hierarchical_allreduce=_env_bool("HIERARCHICAL_ALLREDUCE", False),
+            hierarchical_allgather=_env_bool("HIERARCHICAL_ALLGATHER", False),
+            batch_d2d_memcopies=_env_bool("BATCH_D2D_MEMCOPIES", True),
+            timeline=timeline or None,
+            timeline_mark_cycles=_env_bool("TIMELINE_MARK_CYCLES", False),
+            log_level=(_env("LOG_LEVEL", "warning") or "warning").lower(),
+            stall_check_disable=_env_bool("STALL_CHECK_DISABLE", False),
+            stall_check_time_seconds=_env_float("STALL_CHECK_TIME_SECONDS", 60.0),
+            stall_shutdown_time_seconds=_env_float("STALL_SHUTDOWN_TIME_SECONDS", 0.0),
+            autotune=_env_bool("AUTOTUNE", False),
+            autotune_log=autotune_log or None,
+            autotune_warmup_samples=_env_int("AUTOTUNE_WARMUP_SAMPLES", 3),
+            autotune_steps_per_sample=_env_int("AUTOTUNE_STEPS_PER_SAMPLE", 10),
+            elastic_timeout_seconds=_env_float("ELASTIC_TIMEOUT", 600.0),
+            reset_limit=_env_int("ELASTIC_RESET_LIMIT", 0),
+            cache_capacity=_env_int("CACHE_CAPACITY", 1024),
+            mesh_axis_name=_env("MESH_AXIS_NAME", "hvd") or "hvd",
+            use_native_planner=_env_bool("USE_NATIVE_PLANNER", True),
+        )

@@ -1,0 +1,106 @@
+"""Global device mesh — the TPU-native replacement for the reference's
+MPI/Gloo/NCCL communicator contexts (``horovod/common/mpi/mpi_context.cc``,
+``gloo/gloo_context.cc``, ``nccl_operations.cc`` communicator bootstrap —
+paths per SURVEY.md, reference mount empty, unverified).
+
+Where the reference builds an ``MPI_COMM_WORLD`` plus per-process-set
+sub-communicators and distributes ``ncclUniqueId``s, we build a single 1-D
+:class:`jax.sharding.Mesh` over all addressable devices; process sets are
+sub-meshes (see :mod:`horovod_tpu.process_sets`).  XLA then lowers
+``psum``/``all_gather``/… over the mesh axis to ICI collectives within a
+slice and DCN collectives across slices — the analogue of the reference's
+hierarchical NCCL+MPI allreduce, chosen by the compiler instead of the
+``HOROVOD_HIERARCHICAL_ALLREDUCE`` env var.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class GlobalMesh:
+    """A 1-D mesh over every slot (device) plus host-side bookkeeping."""
+
+    mesh: Mesh
+    axis_name: str
+    devices: Tuple[jax.Device, ...]
+
+    @staticmethod
+    def build(axis_name: str = "hvd") -> "GlobalMesh":
+        devices = tuple(jax.devices())
+        mesh = Mesh(np.asarray(devices, dtype=object), (axis_name,))
+        return GlobalMesh(mesh=mesh, axis_name=axis_name, devices=devices)
+
+    # --- slot arithmetic ---------------------------------------------------
+
+    @property
+    def size(self) -> int:
+        return len(self.devices)
+
+    @property
+    def local_devices(self) -> List[jax.Device]:
+        return [d for d in self.devices if d.process_index == jax.process_index()]
+
+    @property
+    def local_size(self) -> int:
+        return len(self.local_devices)
+
+    @property
+    def process_first_slot(self) -> int:
+        """Global index of this process's first device — the process's
+        "rank" in the reference's one-slot-per-process worldview."""
+        pid = jax.process_index()
+        for i, d in enumerate(self.devices):
+            if d.process_index == pid:
+                return i
+        return 0
+
+    @property
+    def local_rank(self) -> int:
+        """Index of this process's first device among devices on the same
+        host (≠0 only when several processes share a host)."""
+        pid = jax.process_index()
+        first = self.local_devices[0] if self.local_devices else None
+        if first is None:
+            return 0
+        # Devices on this physical host, across processes, ordered by id.
+        host_devices = [d for d in self.devices if getattr(d, "host_id", d.process_index) == getattr(first, "host_id", pid)]
+        host_devices.sort(key=lambda d: d.id)
+        return host_devices.index(first)
+
+    @property
+    def slots_per_process(self) -> List[int]:
+        counts = [0] * jax.process_count()
+        for d in self.devices:
+            counts[d.process_index] += 1
+        return counts
+
+    # --- sharding helpers --------------------------------------------------
+
+    def sharding(self, *spec) -> NamedSharding:
+        """NamedSharding over this mesh: ``mesh.sharding('hvd')`` shards the
+        leading axis across slots; ``mesh.sharding()`` replicates."""
+        return NamedSharding(self.mesh, P(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def shard_leading(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(self.axis_name))
+
+    def device_put_sharded(self, x) -> jax.Array:
+        """Place a host array with leading dim == size so slot *i* holds
+        slice ``x[i]`` — the canonical way tests materialise "each rank has
+        its own tensor" in a single controller."""
+        x = np.asarray(x)
+        if x.shape[0] != self.size:
+            raise ValueError(
+                f"Leading dim {x.shape[0]} must equal world size {self.size}"
+            )
+        return jax.device_put(x, self.shard_leading())

@@ -1,0 +1,24 @@
+"""Collective operations.
+
+Layering (TPU-native redesign of reference ``horovod/common/ops/`` — SURVEY.md §2.2):
+
+* :mod:`.collectives` — the op layer.  SPMD-tier functions (inside
+  ``shard_map``) lower straight to XLA collective HLO over ICI/DCN; the
+  host-tier API reproduces the reference's ``hvd.allreduce(...)`` surface.
+* :mod:`.fusion` — tensor-fusion bucketing (reference fusion buffer).
+* :mod:`.compression` — wire compression (reference ``compression.py``).
+* :mod:`.adasum` — adaptive summation (reference ``common/ops/adasum``).
+"""
+
+from .collectives import (  # noqa: F401
+    Sum, Average, Adasum, Min, Max, Product,
+    allreduce, allreduce_async, grouped_allreduce, grouped_allreduce_async,
+    allgather, allgather_async, grouped_allgather,
+    broadcast, broadcast_async,
+    alltoall, alltoall_async,
+    reducescatter, reducescatter_async, grouped_reducescatter,
+    barrier, synchronize, poll, join,
+    Handle,
+)
+from . import spmd  # noqa: F401
+from .compression import Compression  # noqa: F401

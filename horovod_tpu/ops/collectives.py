@@ -1,0 +1,508 @@
+"""Host-tier collective API — reference parity with ``hvd.allreduce`` etc.
+
+Reference surface (``horovod/torch/mpi_ops.py`` + ``horovod/tensorflow/
+mpi_ops.py``, paths per SURVEY.md §2.4, mount empty, unverified):
+``allreduce[_async]``, ``grouped_allreduce``, ``allgather``, ``broadcast``,
+``alltoall``, ``reducescatter``, ``barrier``, ``join``, with args ``op``
+(Sum/Average/Adasum/Min/Max/Product), ``prescale_factor``,
+``postscale_factor``, ``compression``, ``process_set``, ``name``; async
+variants return handles consumed by ``synchronize``/``poll``.
+
+TPU-native redesign
+-------------------
+The reference's eager path enqueues each tensor to a background C++ thread
+that negotiates readiness across ranks and calls NCCL.  Here, an eager
+collective is a **cached jit-compiled XLA program over the global mesh**,
+written as ordinary array math on the per-slot stack (a masked ``jnp.sum``
+over the sharded slot axis, a chunk transpose, …) with the output sharding
+declaring the result layout — XLA's SPMD partitioner then inserts the
+actual AllReduce/AllGather/AllToAll HLO over ICI/DCN.  Dispatch is already
+asynchronous (XLA's async runtime plays the role of the background
+thread), and re-dispatch of the same shape hits jit's executable cache
+(playing the role of the response cache).  Only Adasum — an algorithm, not
+an HLO — uses an explicit ``shard_map`` (see :mod:`.adasum`).
+
+Slot model for inputs (single-controller JAX owns many chips — see
+``basics.py``): each collective takes the *per-slot stack*: an array of
+shape ``[size, *S]`` where row *i* is slot *i*'s contribution — either an
+already-sharded ``jax.Array``, a host array (sharded on entry), or, in
+multi-process deployments, a process-local ``[local_size, *S]`` block
+(lifted via ``jax.make_array_from_process_local_data``).  With one slot
+per process — the reference's deployment — a plain ``[*S]`` local tensor
+is accepted exactly like ``hvd.allreduce(tensor)``.
+
+Process sets: membership is static (a numpy mask / index list baked into
+the compiled program), so restricted collectives cost one masked
+allreduce — no sub-communicators to bootstrap.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from .compression import Compression
+from . import adasum as adasum_mod
+from . import fusion as fusion_mod
+from .._compat import shard_map
+
+# --- reduction-op constants (reference: hvd.Sum / hvd.Average / ...) --------
+Average = "average"
+Sum = "sum"
+Adasum = "adasum"
+Min = "min"
+Max = "max"
+Product = "product"
+
+_REDUCE_OPS = (Average, Sum, Adasum, Min, Max, Product)
+
+
+def _st():
+    from .. import basics
+
+    return basics._require_init()
+
+
+def _members_key(process_set) -> Optional[Tuple[int, ...]]:
+    """Static member tuple for a process set (None for the global set)."""
+    if process_set is None:
+        return None
+    if process_set.process_set_id is None:
+        raise ValueError(f"Process set {process_set} is not registered")
+    if process_set.size() == _st().mesh.size:
+        return None
+    return process_set.ranks
+
+
+def _heartbeat(name: str) -> None:
+    st = _st()
+    if st.stall_inspector is not None:
+        st.stall_inspector.record_activity(name)
+
+
+def _lift(x, name: str = "tensor") -> jax.Array:
+    """Normalize input to a ``[size, *S]`` array sharded over the mesh."""
+    st = _st()
+    gm = st.mesh
+    if isinstance(x, jax.Array):
+        if jax.process_count() > 1 and not x.is_fully_addressable:
+            return x  # already a global array laid out over the mesh
+        if x.ndim >= 1 and x.shape[0] == gm.size:
+            return jax.device_put(x, gm.shard_leading())
+        raise ValueError(
+            f"{name}: expected per-slot stack of shape [size={gm.size}, ...]; "
+            f"got {tuple(x.shape)}. Each row is one slot's contribution."
+        )
+    local = np.asarray(x)
+    if jax.process_count() > 1:
+        # Process-local contribution: [local_size, *S] (or [*S] when this
+        # process drives one slot — the reference's calling convention).
+        if gm.local_size == 1 and (local.ndim == 0 or local.shape[0] != 1):
+            local = local[None]
+        if local.shape[0] != gm.local_size:
+            raise ValueError(
+                f"{name}: expected leading dim {gm.local_size} (local slots) "
+                f"or an unbatched per-slot tensor; got shape {local.shape}"
+            )
+        global_shape = (gm.size,) + tuple(local.shape[1:])
+        return jax.make_array_from_process_local_data(
+            gm.shard_leading(), local, global_shape
+        )
+    if local.ndim == 0 or local.shape[0] != gm.size:
+        raise ValueError(
+            f"{name}: expected per-slot stack of shape [size={gm.size}, ...]; "
+            f"got {tuple(local.shape)}. Each row is one slot's contribution."
+        )
+    return jax.device_put(local, gm.shard_leading())
+
+
+class Handle:
+    """Async handle (reference: the int handle from ``allreduce_async_``
+    resolved by the ``HandleManager`` in ``horovod/torch/handle_manager.cc``).
+    XLA dispatch is already async, so the handle simply wraps the
+    not-yet-materialized output array(s)."""
+
+    def __init__(self, value: Any, name: str = ""):
+        self._value = value
+        self.name = name
+
+    def result(self) -> Any:
+        jax.block_until_ready(self._value)
+        return self._value
+
+    def done(self) -> bool:
+        leaves = jax.tree.leaves(self._value)
+        return all(getattr(l, "is_ready", lambda: True)() for l in leaves)
+
+
+def synchronize(handle: Handle) -> Any:
+    """Reference: ``hvd.synchronize(handle)``."""
+    return handle.result()
+
+
+def poll(handle: Handle) -> bool:
+    """Reference: ``hvd.poll(handle)`` — non-blocking completion check."""
+    return handle.done()
+
+
+# --- reduction bodies (traced under jit) ------------------------------------
+
+def _mask_for(members: Optional[Sequence[int]], size: int, neutral, x):
+    """Replace non-member rows by the op's neutral element (no gather —
+    lowers to a pure masked AllReduce)."""
+    if members is None:
+        return x
+    mask = np.zeros((size,) + (1,) * (x.ndim - 1), dtype=bool)
+    mask[list(members)] = True
+    return jnp.where(jnp.asarray(mask), x, jnp.asarray(neutral, dtype=x.dtype))
+
+
+def _reduce_stack(x, op: str, members: Optional[Sequence[int]],
+                  prescale: float, postscale: float, compression):
+    size = x.shape[0]
+    n = len(members) if members is not None else size
+    if prescale != 1.0:
+        x = x * jnp.asarray(prescale, dtype=x.dtype)
+    if op in (Sum, Average):
+        orig_dtype = x.dtype
+        x = _mask_for(members, size, 0, x)
+        wire, ctx = compression.compress(x)
+        r = jnp.sum(wire, axis=0)
+        r = compression.decompress(r, ctx)
+        if op == Average:
+            if jnp.issubdtype(orig_dtype, jnp.floating):
+                r = (r / n).astype(orig_dtype)
+            else:
+                r = r // n
+    elif op == Min:
+        big = jnp.finfo(x.dtype).max if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).max
+        r = jnp.min(_mask_for(members, size, big, x), axis=0)
+    elif op == Max:
+        small = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        r = jnp.max(_mask_for(members, size, small, x), axis=0)
+    elif op == Product:
+        r = jnp.prod(_mask_for(members, size, 1, x), axis=0)
+    else:
+        raise ValueError(f"Unknown reduction op: {op!r}")
+    if postscale != 1.0:
+        r = r * jnp.asarray(postscale, dtype=r.dtype)
+    return r
+
+
+# --- compiled-program cache --------------------------------------------------
+# jit caches per input shape/dtype; we memoize one jitted callable per
+# (kind, op, members, scale factors, compression) so repeated steps are
+# pure cache hits — the role of the reference's ResponseCache.
+
+@functools.lru_cache(maxsize=512)
+def _allreduce_fn(op: str, members: Optional[Tuple[int, ...]], prescale: float,
+                  postscale: float, compression, axis: str):
+    if op == Adasum:
+        def adasum_fn(x):
+            gm = _st().mesh
+
+            def per_slot(xb):  # [1, *S]
+                groups = [list(members)] if members else None
+                v = xb[0]
+                if prescale != 1.0:
+                    v = v * jnp.asarray(prescale, dtype=v.dtype)
+                v = adasum_mod.adasum_allreduce(v, axis=axis, groups=groups)
+                if postscale != 1.0:
+                    v = v * jnp.asarray(postscale, dtype=v.dtype)
+                return v[None]
+
+            body = shard_map(per_slot, mesh=gm.mesh, in_specs=P(axis),
+                             out_specs=P(axis), check=False)
+            out_row = members[0] if members else 0
+            return body(x)[out_row]
+
+        gm = _st().mesh
+        return jax.jit(adasum_fn, out_shardings=gm.replicated())
+
+    def fn(x):
+        return _reduce_stack(x, op, members, prescale, postscale, compression)
+
+    gm = _st().mesh
+    return jax.jit(fn, out_shardings=gm.replicated())
+
+
+def allreduce(tensor, *, op: str = Average, process_set=None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              compression=Compression.none, name: str = "allreduce"):
+    """Reduce per-slot contributions; returns the reduced tensor ``[*S]``,
+    replicated on every slot (reference: ``hvd.allreduce``)."""
+    if op not in _REDUCE_OPS:
+        raise ValueError(f"Unknown op {op!r}; expected one of {_REDUCE_OPS}")
+    st = _st()
+    _heartbeat(name)
+    with st.timeline.activity(name, "ENQUEUE", {"op": op}):
+        x = _lift(tensor, name)
+        fn = _allreduce_fn(op, _members_key(process_set),
+                           float(prescale_factor), float(postscale_factor),
+                           compression, st.config.mesh_axis_name)
+    with st.timeline.activity(name, "EXECUTE", {"op": op}):
+        return fn(x)
+
+
+def allreduce_async(tensor, **kwargs) -> Handle:
+    """Reference: ``hvd.allreduce_async`` — returns a :class:`Handle`."""
+    return Handle(allreduce(tensor, **kwargs), kwargs.get("name", "allreduce"))
+
+
+@functools.lru_cache(maxsize=512)
+def _grouped_allreduce_fn(op: str, members: Optional[Tuple[int, ...]],
+                          prescale: float, postscale: float, compression,
+                          threshold: int, nleaves: int):
+    def fn(xs):
+        def collective(stack):  # [size, N] fused bucket -> [N]
+            return _reduce_stack(stack, op, members, prescale, postscale,
+                                 compression)
+
+        # Fuse along the feature axis, keeping the slot axis (lead_ndim=1):
+        # each leaf [size, *S_i] flattens to [size, n_i]; one reduction per
+        # bucket consumes the slot axis.
+        return tuple(fusion_mod.fused_apply(list(xs), collective, threshold,
+                                            lead_ndim=1))
+
+    gm = _st().mesh
+    return jax.jit(fn, out_shardings=(gm.replicated(),) * nleaves)
+
+
+def grouped_allreduce(tensors: Sequence[Any], *, op: str = Average,
+                      process_set=None, prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0,
+                      compression=Compression.none,
+                      name: str = "grouped_allreduce") -> List[Any]:
+    """Fused allreduce of a list of tensors as one logical operation
+    (reference: ``hvd.grouped_allreduce`` + the GroupTable, which
+    guarantees a declared group completes atomically — here trivially
+    true: the group is one XLA program)."""
+    if op not in _REDUCE_OPS:
+        raise ValueError(f"Unknown op {op!r}; expected one of {_REDUCE_OPS}")
+    st = _st()
+    _heartbeat(name)
+    xs = tuple(_lift(t, f"{name}[{i}]") for i, t in enumerate(tensors))
+    if op == Adasum:
+        # Adasum's dot products are per-tensor: no flat-buffer fusion
+        # (same constraint as the reference; see ops/adasum.py).
+        return [allreduce(x, op=op, process_set=process_set,
+                          prescale_factor=prescale_factor,
+                          postscale_factor=postscale_factor,
+                          name=f"{name}[{i}]") for i, x in enumerate(xs)]
+    fn = _grouped_allreduce_fn(op, _members_key(process_set),
+                               float(prescale_factor), float(postscale_factor),
+                               compression, st.config.fusion_threshold, len(xs))
+    with st.timeline.activity(name, "EXECUTE", {"op": op, "ntensors": len(xs)}):
+        return list(fn(xs))
+
+
+def grouped_allreduce_async(tensors, **kwargs) -> Handle:
+    return Handle(grouped_allreduce(tensors, **kwargs),
+                  kwargs.get("name", "grouped_allreduce"))
+
+
+@functools.lru_cache(maxsize=128)
+def _allgather_fn(members: Optional[Tuple[int, ...]]):
+    def fn(x):  # [size, k, *T] -> [(n_members or size)*k, *T]
+        if members is not None:
+            x = x[np.array(members)]
+        return x.reshape((-1,) + x.shape[2:])
+
+    gm = _st().mesh
+    return jax.jit(fn, out_shardings=gm.replicated())
+
+
+def allgather(tensor, *, process_set=None, name: str = "allgather"):
+    """Concatenate per-slot contributions along dim 0, result replicated
+    (reference: ``hvd.allgather``).  Input ``[size, k, *T]`` → output
+    ``[size·k, *T]``.  Ragged contributions are an object-level concern:
+    see ``horovod_tpu.functions.allgather_object``."""
+    st = _st()
+    _heartbeat(name)
+    x = _lift(tensor, name)
+    if x.ndim < 2:
+        raise ValueError(
+            f"{name}: per-slot contributions must be at least rank-1; "
+            f"use shape [size, k, ...]"
+        )
+    fn = _allgather_fn(_members_key(process_set))
+    with st.timeline.activity(name, "EXECUTE"):
+        return fn(x)
+
+
+def allgather_async(tensor, **kwargs) -> Handle:
+    return Handle(allgather(tensor, **kwargs), kwargs.get("name", "allgather"))
+
+
+def grouped_allgather(tensors: Sequence[Any], *, process_set=None,
+                      name: str = "grouped_allgather") -> List[Any]:
+    """Reference: ``hvd.grouped_allgather``."""
+    return [allgather(t, process_set=process_set, name=f"{name}[{i}]")
+            for i, t in enumerate(tensors)]
+
+
+@functools.lru_cache(maxsize=128)
+def _broadcast_fn(root_rank: int):
+    def fn(x):
+        return x[root_rank]
+
+    gm = _st().mesh
+    return jax.jit(fn, out_shardings=gm.replicated())
+
+
+def broadcast(tensor, root_rank: int = 0, *, process_set=None,
+              name: str = "broadcast"):
+    """Every slot receives slot ``root_rank``'s row (reference:
+    ``hvd.broadcast``; root is a *global* rank even for process sets).
+    At host tier the process-set and global variants coincide: the single
+    returned array is what members observe."""
+    st = _st()
+    _heartbeat(name)
+    x = _lift(tensor, name)
+    if process_set is not None and root_rank not in process_set.ranks:
+        raise ValueError(
+            f"{name}: root rank {root_rank} is not a member of {process_set}"
+        )
+    fn = _broadcast_fn(int(root_rank))
+    with st.timeline.activity(name, "EXECUTE", {"root": root_rank}):
+        return fn(x)
+
+
+def broadcast_async(tensor, root_rank: int = 0, **kwargs) -> Handle:
+    return Handle(broadcast(tensor, root_rank, **kwargs),
+                  kwargs.get("name", "broadcast"))
+
+
+@functools.lru_cache(maxsize=128)
+def _alltoall_fn(members: Optional[Tuple[int, ...]], size: int):
+    def fn(x):  # [size, n*k, *T]
+        if members is None:
+            n = size
+            chunks = x.reshape((n, n, -1) + x.shape[2:])
+            out = jnp.swapaxes(chunks, 0, 1)
+            return out.reshape(x.shape)
+        idx = np.array(members)
+        n = len(idx)
+        xm = x[idx]                                   # [n, n*k, *T]
+        chunks = xm.reshape((n, n, -1) + x.shape[2:])
+        outm = jnp.swapaxes(chunks, 0, 1).reshape(xm.shape)
+        return jnp.zeros_like(x).at[idx].set(outm)    # non-members: zeros
+
+    gm = _st().mesh
+    return jax.jit(fn, out_shardings=gm.shard_leading())
+
+
+def alltoall(tensor, *, process_set=None, name: str = "alltoall"):
+    """Uniform all-to-all (reference: ``hvd.alltoall`` with equal
+    ``splits``).  Input ``[size, n·k, *T]`` (n = group size): slot *i*'s
+    row holds its n outgoing chunks; output row *i* holds the chunks
+    addressed to *i*, concatenated.  Ragged ``splits`` should be padded
+    to the max chunk by the caller — dynamic shapes don't exist under
+    XLA (deliberate design difference from the reference's
+    ``MPI_Alltoallv``)."""
+    st = _st()
+    _heartbeat(name)
+    x = _lift(tensor, name)
+    members = _members_key(process_set)
+    n = len(members) if members else st.mesh.size
+    if x.ndim < 2 or x.shape[1] % n != 0:
+        raise ValueError(
+            f"{name}: per-slot contributions must have dim-0 divisible by "
+            f"group size {n}; got per-slot shape {tuple(x.shape[1:])}"
+        )
+    fn = _alltoall_fn(members, st.mesh.size)
+    with st.timeline.activity(name, "EXECUTE"):
+        return fn(x)
+
+
+def alltoall_async(tensor, **kwargs) -> Handle:
+    return Handle(alltoall(tensor, **kwargs), kwargs.get("name", "alltoall"))
+
+
+@functools.lru_cache(maxsize=128)
+def _reducescatter_fn(op: str, members: Optional[Tuple[int, ...]], size: int):
+    def fn(x):  # [size, n*k, *T] -> [size, k, *T]
+        if members is None:
+            r = jnp.sum(x, axis=0)
+            if op == Average:
+                r = r / size
+            return r.reshape((size, -1) + x.shape[2:])
+        idx = np.array(members)
+        n = len(idx)
+        r = jnp.sum(x[idx], axis=0)
+        if op == Average:
+            r = r / n
+        rm = r.reshape((n, -1) + x.shape[2:])
+        out_shape = (size,) + rm.shape[1:]
+        # rm.dtype (not x.dtype): integer Average promotes to float; keep
+        # the same dtype the global-set branch returns.
+        return jnp.zeros(out_shape, dtype=rm.dtype).at[idx].set(rm)
+
+    gm = _st().mesh
+    return jax.jit(fn, out_shardings=gm.shard_leading())
+
+
+def reducescatter(tensor, *, op: str = Sum, process_set=None,
+                  name: str = "reducescatter"):
+    """Reduce and scatter shards (reference: ``hvd.reducescatter``, late
+    vintages).  Input ``[size, n·k, *T]`` → output ``[size, k, *T]``, row
+    *i* being slot *i*'s shard of the reduction (zeros on non-members)."""
+    if op not in (Sum, Average):
+        raise ValueError(f"reducescatter supports Sum/Average, got {op!r}")
+    st = _st()
+    _heartbeat(name)
+    x = _lift(tensor, name)
+    members = _members_key(process_set)
+    n = len(members) if members else st.mesh.size
+    if x.ndim < 2 or x.shape[1] % n != 0:
+        raise ValueError(
+            f"{name}: per-slot contributions must have dim-0 divisible by "
+            f"group size {n}; got per-slot shape {tuple(x.shape[1:])}"
+        )
+    fn = _reducescatter_fn(op, members, st.mesh.size)
+    with st.timeline.activity(name, "EXECUTE", {"op": op}):
+        return fn(x)
+
+
+def reducescatter_async(tensor, **kwargs) -> Handle:
+    return Handle(reducescatter(tensor, **kwargs),
+                  kwargs.get("name", "reducescatter"))
+
+
+def grouped_reducescatter(tensors, *, op: str = Sum, process_set=None,
+                          name: str = "grouped_reducescatter"):
+    return [reducescatter(t, op=op, process_set=process_set,
+                          name=f"{name}[{i}]") for i, t in enumerate(tensors)]
+
+
+def barrier(process_set=None, name: str = "barrier") -> None:
+    """Block until every slot reaches the barrier (reference:
+    ``hvd.barrier``, BARRIER request type).  Implemented as a 1-element
+    allreduce followed by a host sync."""
+    st = _st()
+    # _lift expects the process-local block in multi-process runs and the
+    # full per-slot stack in single-controller runs.
+    rows = st.mesh.local_size if jax.process_count() > 1 else st.mesh.size
+    out = allreduce(np.ones((rows, 1), dtype=np.float32),
+                    op=Sum, process_set=process_set, name=name)
+    jax.block_until_ready(out)
+
+
+def join() -> int:
+    """Reference: ``hvd.join()`` — lets a rank that ran out of data keep
+    participating in collectives with zero contributions.
+
+    Deliberate design difference: under XLA SPMD every slot executes the
+    same program, so ranks cannot run uneven step counts within one
+    compiled loop — uneven *data* is handled by padding/masking at the
+    input pipeline.  ``join`` therefore only synchronizes and reports the
+    last rank, for API compatibility.
+    """
+    st = _st()
+    barrier(name="join")
+    return st.mesh.size - 1

@@ -1,0 +1,75 @@
+"""Gradient/wire compression.
+
+Reference: ``horovod/torch/compression.py`` & ``horovod/tensorflow/compression.py``
+(paths per SURVEY.md §2.4, mount empty, unverified) — a ``Compression``
+namespace with ``none`` and ``fp16`` compressors, each providing
+``compress(tensor) -> (tensor, ctx)`` / ``decompress(tensor, ctx)``, used
+by ``DistributedOptimizer(compression=hvd.Compression.fp16)`` to halve
+allreduce wire traffic.
+
+TPU-native notes: the same API, plus a ``bf16`` compressor — on TPU,
+bfloat16 keeps float32's exponent range so gradient compression is usually
+*safer* than fp16 (no loss-scale dance) and the MXU-native dtype.  These
+run inside jit: the cast fuses into the surrounding computation, and XLA
+executes the AllReduce itself on the narrow dtype — which is precisely the
+wire saving the reference implements by casting before ``ncclAllReduce``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Compressor:
+    """Interface parity with the reference's ``Compressor`` base."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """Reference: ``Compression.none``."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Reference: ``Compression.fp16`` — cast floating tensors to float16
+    for the wire, back to the original dtype after."""
+
+    wire_dtype = jnp.float16
+
+    @classmethod
+    def compress(cls, tensor):
+        if jnp.issubdtype(tensor.dtype, jnp.floating):
+            return tensor.astype(cls.wire_dtype), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor.astype(ctx) if ctx is not None else tensor
+
+
+class BF16Compressor(FP16Compressor):
+    """TPU-native addition: bfloat16 wire dtype (fp32 range, MXU-native)."""
+
+    wire_dtype = jnp.bfloat16
+
+
+class Compression:
+    """Namespace parity with ``hvd.Compression``."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -1,0 +1,146 @@
+"""Tensor fusion: bucketing many small tensors into few large collectives.
+
+Reference: the fusion buffer + coordinator fusion logic
+(``horovod/common/fusion_buffer_manager.cc`` and the fusion pass inside
+``Controller::ComputeResponseList`` — SURVEY.md §2.1, mount empty,
+unverified).  There, a 64 MB scratch buffer (``HOROVOD_FUSION_THRESHOLD``)
+is filled with ready tensors via batched device memcpys, one NCCL call
+covers the buffer, and results are scattered back.
+
+TPU-native redesign: fusion happens at *trace time*.  ``plan_buckets``
+partitions a pytree's leaves into byte-bounded buckets (the planner is
+pure bookkeeping, so it can also run in native code — see
+``horovod_tpu/native``); ``fused_apply`` concatenates each bucket's leaves
+into one flat vector, applies one collective per bucket, and splits back.
+XLA fuses the concat/split into the collective's pre/post memcpys — the
+same batched-memcpy trick as the reference's fusion-buffer kernels, but
+compiler-generated, with no persistent scratch buffer to manage.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def plan_buckets(sizes_bytes: Sequence[int], threshold: int) -> List[List[int]]:
+    """Greedy in-order bin packing of tensor byte sizes into buckets of at
+    most ``threshold`` bytes (oversized tensors get singleton buckets).
+
+    Order-preserving, like the reference's fusion scan — deterministic
+    bucket membership is what lets every rank agree without negotiation.
+    Delegates to the native C++ planner when built and not disabled via
+    ``HVD_TPU_USE_NATIVE_PLANNER=0`` (same contract either way).
+    """
+    use_native = True
+    from .. import basics
+
+    if basics.is_initialized():
+        use_native = basics.config().use_native_planner
+    if use_native:
+        try:
+            from ..native import planner as _native
+
+            if _native.available():
+                return _native.plan_buckets(list(sizes_bytes), threshold)
+        except ImportError:
+            pass
+    return plan_buckets_py(sizes_bytes, threshold)
+
+
+def plan_buckets_py(sizes_bytes: Sequence[int], threshold: int) -> List[List[int]]:
+    buckets: List[List[int]] = []
+    current: List[int] = []
+    current_bytes = 0
+    for i, sz in enumerate(sizes_bytes):
+        if current and current_bytes + sz > threshold:
+            buckets.append(current)
+            current, current_bytes = [], 0
+        current.append(i)
+        current_bytes += sz
+    if current:
+        buckets.append(current)
+    return buckets
+
+
+def fused_apply(
+    leaves: Sequence[jax.Array],
+    collective_1d: Callable[[jax.Array], jax.Array],
+    threshold: int,
+    lead_ndim: int = 0,
+) -> List[jax.Array]:
+    """Apply a collective to ``leaves`` with fusion.
+
+    Leaves are grouped per dtype then bucketed by ``threshold`` *payload*
+    bytes (the bytes one slot puts on the wire — leading ``lead_ndim``
+    axes, e.g. the host-tier ``[size, ...]`` slot axis, don't count);
+    each bucket is flattened+concatenated along its last axis, passed
+    through ``collective_1d`` once, and split/reshaped back.  The
+    collective may consume the leading axes (host-tier reduction does);
+    splitting happens on the output's last axis.  Runs under jit.
+    """
+    out: List[jax.Array] = [None] * len(leaves)  # type: ignore[list-item]
+    by_dtype: dict = {}
+    for i, leaf in enumerate(leaves):
+        by_dtype.setdefault(jnp.asarray(leaf).dtype, []).append(i)
+
+    for dtype, idxs in by_dtype.items():
+        sizes = [int(np.prod(leaves[i].shape[lead_ndim:])) * dtype.itemsize
+                 for i in idxs]
+        for bucket in plan_buckets(sizes, threshold):
+            members = [idxs[j] for j in bucket]
+            flats = [leaves[i].reshape(leaves[i].shape[:lead_ndim] + (-1,))
+                     for i in members]
+            fused = (jnp.concatenate(flats, axis=lead_ndim)
+                     if len(flats) > 1 else flats[0])
+            reduced = collective_1d(fused)
+            offset = 0
+            for i in members:
+                tail_shape = leaves[i].shape[lead_ndim:]
+                n = int(np.prod(tail_shape)) if tail_shape else 1
+                piece = jax.lax.dynamic_slice_in_dim(
+                    reduced, offset, n, axis=reduced.ndim - 1
+                )
+                out[i] = piece.reshape(reduced.shape[:-1] + tail_shape)
+                offset += n
+    return out
+
+
+def fused_allreduce_pytree(
+    tree: Any,
+    *,
+    axis: str = "hvd",
+    op: str = "average",
+    threshold: int = 64 * 1024 * 1024,
+    groups=None,
+    compression=None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+) -> Any:
+    """Fused allreduce of every leaf of a pytree — the gradient hot path
+    (reference: fused ``ncclAllReduce`` over the fusion buffer).
+
+    Must run inside an SPMD region (``shard_map``) over ``axis``.
+    """
+    from . import spmd
+    from .compression import Compression
+
+    compression = compression or Compression.none
+    leaves, treedef = jax.tree.flatten(tree)
+
+    def collective(flat: jax.Array) -> jax.Array:
+        x = flat
+        if prescale_factor != 1.0:
+            x = x * prescale_factor
+        x, ctx = compression.compress(x)
+        x = spmd.allreduce(x, op=op, axis=axis, groups=groups)
+        x = compression.decompress(x, ctx)
+        if postscale_factor != 1.0:
+            x = x * postscale_factor
+        return x
+
+    reduced = fused_apply(leaves, collective, threshold)
+    return jax.tree.unflatten(treedef, reduced)

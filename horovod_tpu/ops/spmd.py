@@ -1,0 +1,114 @@
+"""SPMD-tier collectives: use these *inside* ``shard_map``/``pmap`` bodies.
+
+This is the layer the reference implements as C++ backend classes
+(``NCCLAllreduce::Execute`` etc. in ``horovod/common/ops/nccl_operations.cc``,
+path per SURVEY.md §2.2, mount empty, unverified).  On TPU each of these is
+a single XLA HLO that the compiler schedules onto ICI (intra-slice) or DCN
+(cross-slice) — there are no streams, events, or completion polling to
+manage, which is why this file is ~100 lines where the reference's backend
+layer is thousands.
+
+Process sets arrive as ``axis_index_groups`` partitions (see
+:meth:`horovod_tpu.ProcessSet.axis_index_groups`).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Groups = Optional[List[List[int]]]
+
+
+def rank(axis: str = "hvd"):
+    """This slot's index along the mesh axis (reference: per-process
+    ``hvd.rank()``; here a traced value via ``lax.axis_index``)."""
+    return lax.axis_index(axis)
+
+
+def size(axis: str = "hvd") -> int:
+    """Width of the mesh axis (reference: ``hvd.size()``)."""
+    return lax.axis_size(axis)
+
+
+def allreduce(x, op: str = "sum", axis: str = "hvd", groups: Groups = None):
+    """AllReduce HLO (reference: ``ncclAllReduce``).
+
+    ``op``: sum | average | min | max | product.  (Adasum has its own
+    module: :mod:`horovod_tpu.ops.adasum` — it is an algorithm, not an HLO.)
+    """
+    if op == "sum":
+        return lax.psum(x, axis, axis_index_groups=groups)
+    if op == "average":
+        n = len(groups[0]) if groups else lax.axis_size(axis)
+        return lax.psum(x, axis, axis_index_groups=groups) / n
+    if op == "min":
+        return lax.pmin(x, axis, axis_index_groups=groups)
+    if op == "max":
+        return lax.pmax(x, axis, axis_index_groups=groups)
+    if op == "product":
+        # No pprod HLO: gather members' values and multiply. Rare op; the
+        # bandwidth cost (n× vs allreduce) matches gloo's fallback behavior.
+        gathered = lax.all_gather(x, axis, axis_index_groups=groups)
+        return jnp.prod(gathered, axis=0)
+    raise ValueError(f"Unknown reduction op: {op!r}")
+
+
+def allgather(x, axis: str = "hvd", groups: Groups = None, tiled: bool = True):
+    """AllGather HLO, concatenating along dim 0 like the reference's
+    ``hvd.allgather`` (``ncclAllGather``)."""
+    return lax.all_gather(x, axis, axis_index_groups=groups, tiled=tiled)
+
+
+def broadcast(x, root_rank: int = 0, axis: str = "hvd", groups: Groups = None):
+    """Broadcast from ``root_rank`` (reference: ``ncclBroadcast``).
+
+    Lowered as select+psum — non-roots contribute zeros, so the wire cost
+    equals one allreduce; XLA commonly rewrites this to a collective
+    broadcast.  ``root_rank`` is the *global* slot index (matching the
+    reference, where broadcast roots are global ranks even in process
+    sets).
+    """
+    idx = lax.axis_index(axis)
+    mask = (idx == root_rank).astype(x.dtype)
+    return lax.psum(x * mask, axis, axis_index_groups=groups)
+
+
+def alltoall(x, axis: str = "hvd", groups: Groups = None):
+    """AllToAll HLO (reference: ``ncclAllToAll`` / MPI_Alltoallv).
+
+    ``x`` has leading dim divisible by the group size; slot *i* receives
+    the *i*-th chunk from every peer, concatenated along dim 0 — the
+    reference's uniform-splits case.  (Ragged ``splits`` are handled at
+    the host tier by padding; see ``collectives.alltoall``.)
+    """
+    n = len(groups[0]) if groups else lax.axis_size(axis)
+    chunks = x.reshape((n, x.shape[0] // n) + x.shape[1:])
+    out = lax.all_to_all(chunks, axis, split_axis=0, concat_axis=0,
+                         axis_index_groups=groups, tiled=False)
+    return out.reshape((-1,) + x.shape[1:])
+
+
+def reducescatter(x, op: str = "sum", axis: str = "hvd", groups: Groups = None):
+    """ReduceScatter HLO (reference: late-vintage ``hvd.reducescatter``;
+    also the first phase of hierarchical allreduce).  Slot *i* gets the
+    *i*-th shard (dim 0) of the reduction."""
+    if op not in ("sum", "average"):
+        raise ValueError(f"reducescatter supports sum/average, got {op!r}")
+    out = lax.psum_scatter(x, axis, axis_index_groups=groups, tiled=True)
+    if op == "average":
+        n = len(groups[0]) if groups else lax.axis_size(axis)
+        out = out / n
+    return out
+
+
+def ppermute_ring(x, axis: str = "hvd", shift: int = 1):
+    """Rotate values around the mesh axis ring — the building block for
+    ring attention and hand-written ring collectives (no reference
+    analogue; NCCL hides its rings)."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)

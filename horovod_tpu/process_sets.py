@@ -1,0 +1,159 @@
+"""Process sets: concurrent collectives over slot subsets.
+
+Reference: ``horovod/common/process_set.cc`` + ``horovod/common/process_sets.py``
+(paths per SURVEY.md §2.1/§2.4, reference mount empty, unverified) — there,
+each process set owns its own MPI/NCCL sub-communicator, controller and
+tensor queue, created via a dynamic registration protocol.
+
+TPU-native redesign: a process set is simply a subset of slot indices on the
+global mesh.  XLA collectives take ``axis_index_groups`` — a partition of
+the mesh axis — so a process-set collective is the *same HLO* with a group
+partition ``[members, non-members]``; no extra communicators, bootstrap
+rounds, or queues exist.  Registration is therefore purely local
+bookkeeping and needs no cross-rank negotiation (every rank traces the same
+program, so tables agree by construction — the property the reference's
+registration barrier exists to enforce).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class ProcessSet:
+    """A subset of slots collectives may reduce over.
+
+    Reference API parity: ``hvd.ProcessSet(ranks)``, ``.rank()``, ``.size()``,
+    ``.ranks``, ``.included()``.
+    """
+
+    def __init__(self, ranks: Sequence[int]):
+        if len(set(ranks)) != len(ranks):
+            raise ValueError(f"Duplicate ranks in process set: {ranks}")
+        self.ranks: Tuple[int, ...] = tuple(sorted(int(r) for r in ranks))
+        self.process_set_id: Optional[int] = None  # assigned on registration
+        self._world_size: Optional[int] = None
+
+    def _attach(self, process_set_id: int, world_size: int) -> None:
+        for r in self.ranks:
+            if not 0 <= r < world_size:
+                raise ValueError(
+                    f"Process set rank {r} out of range for world size {world_size}"
+                )
+        self.process_set_id = process_set_id
+        self._world_size = world_size
+
+    def size(self) -> int:
+        """Number of slots in this set (reference: ``ProcessSet.size()``)."""
+        return len(self.ranks)
+
+    def included(self, rank: Optional[int] = None) -> bool:
+        """Whether ``rank`` (default: this process's first slot) is a member
+        (reference: ``ProcessSet.included()``)."""
+        if rank is None:
+            from . import basics
+
+            rank = basics.rank()
+        return rank in self.ranks
+
+    def rank(self, global_rank: Optional[int] = None) -> int:
+        """Position of ``global_rank`` within the set (reference:
+        ``ProcessSet.rank()``)."""
+        if global_rank is None:
+            from . import basics
+
+            global_rank = basics.rank()
+        if global_rank not in self.ranks:
+            raise ValueError(f"Rank {global_rank} is not in process set {self.ranks}")
+        return self.ranks.index(global_rank)
+
+    def axis_index_groups(self) -> Optional[List[List[int]]]:
+        """The ``axis_index_groups`` partition implementing this set:
+        ``[members, complement]`` (complement reduces among itself; its
+        results are never observed).  ``None`` for the global set — XLA's
+        un-grouped fast path."""
+        if self._world_size is None:
+            raise RuntimeError("Process set is not registered; call add_process_set()")
+        if len(self.ranks) == self._world_size:
+            return None
+        complement = [r for r in range(self._world_size) if r not in self.ranks]
+        groups = [list(self.ranks)]
+        if complement:
+            groups.append(complement)
+        return groups
+
+    def __repr__(self) -> str:
+        return f"ProcessSet(id={self.process_set_id}, ranks={list(self.ranks)})"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ProcessSet) and self.ranks == other.ranks
+
+    def __hash__(self) -> int:
+        return hash(self.ranks)
+
+
+class ProcessSetTable:
+    """Registry of live process sets (reference: ``ProcessSetTable`` in
+    ``process_set.cc``).  Id 0 is always the global set."""
+
+    def __init__(self, global_mesh) -> None:
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._table: Dict[int, ProcessSet] = {}
+        self._world_size = global_mesh.size
+        self.global_process_set = self.register(ProcessSet(range(global_mesh.size)))
+
+    def register(self, ps: ProcessSet) -> ProcessSet:
+        with self._lock:
+            for existing in self._table.values():
+                if existing.ranks == tuple(sorted(ps.ranks)):
+                    raise ValueError(
+                        f"A process set with ranks {list(ps.ranks)} already exists "
+                        f"(id={existing.process_set_id})"
+                    )
+            ps._attach(self._next_id, self._world_size)
+            self._table[self._next_id] = ps
+            self._next_id += 1
+            return ps
+
+    def remove(self, ps: ProcessSet) -> None:
+        with self._lock:
+            if ps.process_set_id == 0:
+                raise ValueError("Cannot remove the global process set")
+            if ps.process_set_id not in self._table:
+                raise ValueError(f"Process set {ps} is not registered")
+            del self._table[ps.process_set_id]
+            ps.process_set_id = None
+
+    def get(self, process_set_id: int) -> ProcessSet:
+        with self._lock:
+            return self._table[process_set_id]
+
+    def ids(self) -> List[int]:
+        with self._lock:
+            return sorted(self._table)
+
+
+# --- module-level reference-parity API --------------------------------------
+
+def _table() -> ProcessSetTable:
+    from . import basics
+
+    return basics._require_init().process_sets
+
+
+def add_process_set(ranks_or_set) -> ProcessSet:
+    """Reference: ``hvd.add_process_set()`` (dynamic registration)."""
+    ps = ranks_or_set if isinstance(ranks_or_set, ProcessSet) else ProcessSet(ranks_or_set)
+    return _table().register(ps)
+
+
+def remove_process_set(ps: ProcessSet) -> None:
+    """Reference: ``hvd.remove_process_set()``."""
+    _table().remove(ps)
+
+
+def global_process_set() -> ProcessSet:
+    """Reference: ``hvd.process_sets.global_process_set``."""
+    return _table().global_process_set

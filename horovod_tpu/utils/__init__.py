@@ -1,0 +1,1 @@
+"""Utility subsystems: logging, timeline tracing, stall detection, env."""

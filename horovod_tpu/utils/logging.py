@@ -1,0 +1,58 @@
+"""Leveled logging (reference: ``horovod/common/logging.cc`` with
+``HOROVOD_LOG_LEVEL`` = trace/debug/info/warning/error/fatal — path per
+SURVEY.md §2.1, reference mount empty, unverified).
+
+Python's stdlib logger plays the role of the C++ logger; the env knob is
+honoured with the same name and level vocabulary, plus the reference's
+``HOROVOD_LOG_HIDE_TIME`` switch.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_LEVELS = {
+    "trace": logging.DEBUG - 5,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "fatal": logging.CRITICAL,
+}
+
+logging.addLevelName(_LEVELS["trace"], "TRACE")
+
+_configured = False
+
+
+def _configure_root() -> None:
+    global _configured
+    if _configured:
+        return
+    level_name = (
+        os.environ.get("HOROVOD_LOG_LEVEL")
+        or os.environ.get("HVD_TPU_LOG_LEVEL")
+        or "warning"
+    ).lower()
+    level = _LEVELS.get(level_name, logging.WARNING)
+    hide_time = (os.environ.get("HOROVOD_LOG_HIDE_TIME", "0").lower()
+                 in ("1", "true", "yes", "on"))
+    fmt = "[%(levelname)s] %(name)s: %(message)s" if hide_time else \
+          "%(asctime)s [%(levelname)s] %(name)s: %(message)s"
+    root = logging.getLogger("horovod_tpu")
+    root.setLevel(level)
+    if not root.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(fmt))
+        root.addHandler(handler)
+    root.propagate = False
+    _configured = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    _configure_root()
+    if not name.startswith("horovod_tpu"):
+        name = f"horovod_tpu.{name}"
+    return logging.getLogger(name)

@@ -1,0 +1,117 @@
+"""Stall detection.
+
+Reference: ``horovod/common/stall_inspector.cc`` (path per SURVEY.md §2.1,
+mount empty, unverified) — rank 0 tracks tensors submitted on some ranks
+but not all, and warns after ``HOROVOD_STALL_CHECK_TIME_SECONDS`` (then
+optionally shuts down after ``HOROVOD_STALL_SHUTDOWN_TIME_SECONDS``).
+
+TPU-native redesign: within one jit'ed SPMD program ranks *cannot* diverge
+on which collectives run — the failure mode that remains is a whole-step
+hang (a peer process died, DCN partition, host preemption).  So the
+inspector is a host-side watchdog: the training loop heartbeats it every
+step (``record_activity``); a daemon thread warns when no heartbeat
+arrives within the window and can abort the process so an elastic driver
+notices, which is exactly the operational role the reference's inspector
+plays.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from .logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class StallInspector:
+    def __init__(self, enabled: bool = True, warn_after_s: float = 60.0,
+                 shutdown_after_s: float = 0.0,
+                 on_shutdown: Optional[Callable[[], None]] = None) -> None:
+        self._enabled = enabled and warn_after_s > 0
+        self._warn_after_s = warn_after_s
+        self._shutdown_after_s = shutdown_after_s
+        self._on_shutdown = on_shutdown or (lambda: os._exit(17))
+        self._lock = threading.Lock()
+        self._last_activity: Optional[float] = None
+        self._warned = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        """Arm the watchdog (first heartbeat arms it implicitly too)."""
+        if not self._enabled or self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._watch, name="hvd-tpu-stall-inspector", daemon=True
+        )
+        self._thread.start()
+
+    def record_activity(self, what: str = "step") -> None:
+        """Heartbeat — called by the training loop / collective API."""
+        if not self._enabled:
+            return
+        with self._lock:
+            self._last_activity = time.monotonic()
+            self._warned = False
+        if self._thread is None:
+            self.start()
+
+    def pause(self):
+        """Context manager disarming the watchdog across known-idle spans
+        (evaluation, checkpoint writes) so healthy non-collective work is
+        not reported — the reference never fires on idleness at all (it
+        tracks some-but-not-all-ranks tensor submission), so without this
+        the TPU watchdog would be strictly noisier.
+
+        Usage::
+
+            with hvd.stall_inspector().pause():
+                evaluate(...)
+        """
+        import contextlib
+
+        @contextlib.contextmanager
+        def _pause():
+            with self._lock:
+                self._last_activity = None  # disarm
+            try:
+                yield
+            finally:
+                self.record_activity("resume")
+
+        return _pause()
+
+    def _watch(self) -> None:
+        while not self._stop.wait(min(self._warn_after_s / 4, 5.0)):
+            with self._lock:
+                last = self._last_activity
+                warned = self._warned
+            if last is None:
+                continue
+            idle = time.monotonic() - last
+            if idle > self._warn_after_s and not warned:
+                logger.warning(
+                    "Potential stall: no collective/step activity for %.0f s "
+                    "(threshold %.0f s). One or more peer processes may have "
+                    "stopped participating — or this process is doing long "
+                    "host-side work; wrap that in stall_inspector().pause().",
+                    idle, self._warn_after_s,
+                )
+                with self._lock:
+                    self._warned = True
+            if self._shutdown_after_s > 0 and idle > self._shutdown_after_s:
+                logger.error(
+                    "Stall exceeded shutdown threshold (%.0f s); aborting.",
+                    self._shutdown_after_s,
+                )
+                self._on_shutdown()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None

@@ -1,0 +1,115 @@
+"""Chrome-trace timeline of collective lifecycles.
+
+Reference: ``horovod/common/timeline.cc`` (path per SURVEY.md §2.1, mount
+empty, unverified) — a background-thread JSON writer recording each
+tensor's NEGOTIATE → QUEUE → *_OP → MEMCPY phases, activated by
+``HOROVOD_TIMELINE=<path>``, with optional cycle markers
+(``HOROVOD_TIMELINE_MARK_CYCLES``).
+
+TPU-native redesign: there is no negotiation phase (XLA SPMD makes
+collective schedules static), so the phases we record are the ones that
+exist here: ``ENQUEUE`` (API call), ``TRACE``/``COMPILE`` (jit cache
+miss), ``EXECUTE`` (device dispatch to completion).  The output is the
+same Chrome ``chrome://tracing`` / Perfetto JSON array format the
+reference emits, so existing viewing workflows carry over.  For on-device
+detail users layer ``jax.profiler`` traces (see
+:func:`horovod_tpu.utils.timeline.profiler_trace`).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+
+class Timeline:
+    """Thread-safe Chrome-trace event writer.
+
+    Events use the `ph` convention of the trace-event format: ``X``
+    (complete, with ``dur``) events per phase, ``i`` (instant) for cycle
+    marks — matching what the reference emits closely enough that the same
+    tooling renders both.
+    """
+
+    def __init__(self, path: Optional[str], mark_cycles: bool = False) -> None:
+        self._path = path
+        self._mark_cycles = mark_cycles
+        self._lock = threading.Lock()
+        self._file = None
+        self._first = True
+        self._t0 = time.perf_counter_ns()
+        if path:
+            self._file = open(path, "w", buffering=1)
+            self._file.write("[\n")
+
+    @property
+    def enabled(self) -> bool:
+        return self._file is not None
+
+    def _now_us(self) -> float:
+        return (time.perf_counter_ns() - self._t0) / 1e3
+
+    def _emit(self, event: dict) -> None:
+        if self._file is None:
+            return
+        with self._lock:
+            if self._file is None:
+                return
+            prefix = "" if self._first else ",\n"
+            self._first = False
+            self._file.write(prefix + json.dumps(event))
+
+    def record(self, name: str, phase: str, start_us: float, dur_us: float,
+               args: Optional[dict] = None) -> None:
+        """One complete event: e.g. tensor 'grad/kernel0', phase EXECUTE."""
+        self._emit({
+            "name": phase, "cat": "collective", "ph": "X",
+            "ts": start_us, "dur": dur_us,
+            "pid": os.getpid(), "tid": hash(name) % (1 << 31),
+            "args": {"tensor": name, **(args or {})},
+        })
+
+    def mark_cycle(self) -> None:
+        """Instant marker per dispatch cycle (reference:
+        ``HOROVOD_TIMELINE_MARK_CYCLES``)."""
+        if self._mark_cycles:
+            self._emit({
+                "name": "CYCLE", "cat": "cycle", "ph": "i",
+                "ts": self._now_us(), "pid": os.getpid(), "tid": 0, "s": "p",
+            })
+
+    @contextlib.contextmanager
+    def activity(self, name: str, phase: str, args: Optional[dict] = None):
+        """Context manager timing one phase of one named tensor/op."""
+        if self._file is None:
+            yield
+            return
+        start = self._now_us()
+        try:
+            yield
+        finally:
+            self.record(name, phase, start, self._now_us() - start, args)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.write("\n]\n")
+                self._file.close()
+                self._file = None
+
+
+@contextlib.contextmanager
+def profiler_trace(log_dir: str):
+    """On-device profiling via ``jax.profiler`` — the TPU-side complement
+    the reference gets from NVTX ranges inside NCCL ops."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

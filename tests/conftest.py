@@ -1,0 +1,38 @@
+"""Test harness: an 8-device CPU mesh standing in for a TPU slice.
+
+Mirrors the reference's CI strategy (SURVEY.md §4): run real collectives
+on loopback (there: Gloo/MPI over 127.0.0.1 with oversubscribed slots;
+here: XLA's CPU backend with ``--xla_force_host_platform_device_count=8``
+virtual devices).  No mocked backends — every test exercises the same HLO
+lowering path as TPU hardware.
+
+Note: this image's ``sitecustomize`` pre-registers a TPU PJRT plugin and
+pins ``jax_platforms``; ``jax.config.update`` below overrides it back to
+CPU before any backend initializes.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _init_horovod_tpu():
+    hvd.init()
+    yield
+    hvd.shutdown()
+
+
+@pytest.fixture(scope="session")
+def world_size():
+    return hvd.size()

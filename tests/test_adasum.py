@@ -1,0 +1,115 @@
+"""Adasum property tests (reference pattern: test/parallel/test_adasum_pytorch.py,
+SURVEY.md §4; math per arXiv:2006.02924 — see ops/adasum.py)."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.ops.adasum import _combine
+
+import jax.numpy as jnp
+
+
+def _adasum_pair_np(a, b):
+    a = a.astype(np.float64)
+    b = b.astype(np.float64)
+    dot = np.vdot(a, b)
+    asq = np.vdot(a, a)
+    bsq = np.vdot(b, b)
+    ca = 1.0 - (dot / (2 * asq) if asq > 0 else 0.0)
+    cb = 1.0 - (dot / (2 * bsq) if bsq > 0 else 0.0)
+    return ca * a + cb * b
+
+
+def _adasum_tree_np(rows):
+    """Recursive distance-doubling reference in numpy."""
+    n = len(rows)
+    vals = [r.astype(np.float64) for r in rows]
+    d = 1
+    while d < n:
+        vals = [_adasum_pair_np(vals[i], vals[i ^ d]) for i in range(n)]
+        d *= 2
+    return vals[0]
+
+
+class TestCombineRule:
+    def test_identical_inputs_average(self):
+        a = jnp.asarray(np.random.RandomState(0).randn(16).astype(np.float32))
+        out = np.asarray(_combine(a, a))
+        np.testing.assert_allclose(out, np.asarray(a), rtol=1e-6)
+
+    def test_orthogonal_inputs_add(self):
+        a = jnp.asarray(np.array([1.0, 0.0, 2.0, 0.0], np.float32))
+        b = jnp.asarray(np.array([0.0, 3.0, 0.0, 4.0], np.float32))
+        np.testing.assert_allclose(np.asarray(_combine(a, b)),
+                                   np.asarray(a + b), rtol=1e-6)
+
+    def test_scale_invariance(self):
+        rng = np.random.RandomState(1)
+        a = jnp.asarray(rng.randn(32).astype(np.float32))
+        b = jnp.asarray(rng.randn(32).astype(np.float32))
+        base = np.asarray(_combine(a, b))
+        scaled = np.asarray(_combine(a * 100.0, b * 100.0))
+        np.testing.assert_allclose(scaled, base * 100.0, rtol=1e-4)
+
+    def test_commutative(self):
+        rng = np.random.RandomState(2)
+        a = jnp.asarray(rng.randn(8).astype(np.float32))
+        b = jnp.asarray(rng.randn(8).astype(np.float32))
+        np.testing.assert_allclose(np.asarray(_combine(a, b)),
+                                   np.asarray(_combine(b, a)), rtol=1e-6)
+
+    def test_zero_input_passthrough(self):
+        a = jnp.zeros(4, jnp.float32)
+        b = jnp.asarray(np.array([1.0, 2.0, 3.0, 4.0], np.float32))
+        np.testing.assert_allclose(np.asarray(_combine(a, b)), np.asarray(b),
+                                   rtol=1e-6)
+
+
+class TestAdasumAllreduce:
+    def test_matches_numpy_tree(self, world_size):
+        rng = np.random.RandomState(3)
+        x = rng.randn(world_size, 17).astype(np.float32)
+        out = np.asarray(hvd.allreduce(x, op=hvd.Adasum))
+        expected = _adasum_tree_np(list(x))
+        np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
+
+    def test_identical_rows_are_fixed_point(self, world_size):
+        row = np.random.RandomState(4).randn(9).astype(np.float32)
+        x = np.tile(row, (world_size, 1))
+        out = np.asarray(hvd.allreduce(x, op=hvd.Adasum))
+        np.testing.assert_allclose(out, row, rtol=1e-5)
+
+    def test_multidim(self, world_size):
+        x = np.random.RandomState(5).randn(world_size, 3, 4).astype(np.float32)
+        out = np.asarray(hvd.allreduce(x, op=hvd.Adasum))
+        expected = _adasum_tree_np([r.ravel() for r in x]).reshape(3, 4)
+        np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
+
+    def test_process_set_power_of_two(self, world_size):
+        ps = hvd.add_process_set([0, 1, 4, 5])
+        try:
+            x = np.random.RandomState(6).randn(world_size, 7).astype(np.float32)
+            out = np.asarray(hvd.allreduce(x, op=hvd.Adasum, process_set=ps))
+            expected = _adasum_tree_np([x[0], x[1], x[4], x[5]])
+            np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
+        finally:
+            hvd.remove_process_set(ps)
+
+    def test_non_power_of_two_raises(self, world_size):
+        ps = hvd.add_process_set([0, 1, 2])
+        try:
+            x = np.zeros((world_size, 4), np.float32)
+            with pytest.raises(ValueError, match="power-of-two"):
+                hvd.allreduce(x, op=hvd.Adasum, process_set=ps)
+        finally:
+            hvd.remove_process_set(ps)
+
+    def test_grouped_adasum(self, world_size):
+        xs = [np.random.RandomState(s).randn(world_size, 5).astype(np.float32)
+              for s in range(3)]
+        outs = hvd.grouped_allreduce(xs, op=hvd.Adasum)
+        for x, out in zip(xs, outs):
+            np.testing.assert_allclose(np.asarray(out),
+                                       _adasum_tree_np(list(x)),
+                                       rtol=1e-4, atol=1e-5)

@@ -1,0 +1,64 @@
+"""Process-model tests (reference pattern: test/parallel/test_torch.py's
+rank/size assertions + test/single/test_run.py unit style, SURVEY.md §4)."""
+
+import pytest
+
+import horovod_tpu as hvd
+
+
+def test_initialized():
+    assert hvd.is_initialized()
+
+
+def test_size_is_device_count(world_size):
+    import jax
+
+    assert world_size == len(jax.devices()) == 8
+
+
+def test_rank_in_range(world_size):
+    assert 0 <= hvd.rank() < world_size
+
+
+def test_local_size_single_process(world_size):
+    # Single controller process owns all slots.
+    assert hvd.local_size() == world_size
+    assert hvd.local_rank() == 0
+
+
+def test_cross_rank_single_process():
+    assert hvd.cross_size() == 1
+    assert hvd.cross_rank() == 0
+
+
+def test_is_homogeneous():
+    assert hvd.is_homogeneous()
+
+
+def test_feature_matrix():
+    # The reference's hvd.mpi_built()/nccl_built() introspection surface.
+    assert not hvd.mpi_built()
+    assert not hvd.gloo_built()
+    assert hvd.nccl_built() == 0
+    assert not hvd.cuda_built()
+    assert hvd.xla_built()
+
+
+def test_double_init_is_idempotent():
+    hvd.init()
+    hvd.init()
+    assert hvd.is_initialized()
+
+
+def test_config_defaults():
+    cfg = hvd.config()
+    assert cfg.fusion_threshold == 64 * 1024 * 1024
+    assert cfg.mesh_axis_name == "hvd"
+
+
+def test_uninitialized_raises(monkeypatch):
+    from horovod_tpu import basics
+
+    monkeypatch.setattr(basics._state, "initialized", False)
+    with pytest.raises(hvd.NotInitializedError):
+        hvd.size()

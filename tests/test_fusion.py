@@ -1,0 +1,37 @@
+"""Fusion planner tests (reference pattern: fusion edge cases in
+test/parallel/* — odd sizes, empty tensors; SURVEY.md §4)."""
+
+import numpy as np
+
+from horovod_tpu.ops.fusion import plan_buckets_py, plan_buckets
+
+
+class TestPlanner:
+    def test_all_fit_one_bucket(self):
+        assert plan_buckets_py([10, 10, 10], 100) == [[0, 1, 2]]
+
+    def test_split_on_threshold(self):
+        assert plan_buckets_py([60, 60, 60], 100) == [[0], [1], [2]]
+
+    def test_order_preserved(self):
+        buckets = plan_buckets_py([10, 90, 10, 90], 100)
+        flat = [i for b in buckets for i in b]
+        assert flat == [0, 1, 2, 3]
+
+    def test_oversized_tensor_gets_own_bucket(self):
+        buckets = plan_buckets_py([10, 500, 10], 100)
+        assert [1] in buckets
+
+    def test_empty(self):
+        assert plan_buckets_py([], 100) == []
+
+    def test_zero_size_tensors(self):
+        assert plan_buckets_py([0, 0], 100) == [[0, 1]]
+
+    def test_greedy_packing(self):
+        # 40+40 fit; adding 30 would exceed 100, so 30+30 form bucket 2.
+        assert plan_buckets_py([40, 40, 30, 30], 100) == [[0, 1], [2, 3]]
+
+    def test_dispatch_matches_python(self):
+        sizes = list(np.random.RandomState(0).randint(1, 200, size=50))
+        assert plan_buckets(sizes, 256) == plan_buckets_py(sizes, 256)
