@@ -1,0 +1,134 @@
+"""Synthetic ResNet-50 benchmark — the reference's headline metric.
+
+Mirrors ``examples/pytorch/pytorch_synthetic_benchmark.py`` (SURVEY.md §6;
+mount empty, unverified): images/sec over synthetic ImageNet-shaped
+batches, full training step (forward + backward + SGD-momentum update,
+BatchNorm in training mode).  Runs on whatever devices the platform
+offers (the driver runs it on one real TPU chip); batch is sharded over
+the framework mesh so the same script scales to a slice.
+
+Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+
+``vs_baseline``: BASELINE.json recorded no reference number
+(``published: {}``); the denominator used here is 2500 img/s/chip — the
+order of a single A100's ResNet-50 AMP training throughput in the
+reference's 8×A100 NCCL target config — so >1.0 beats one baseline chip.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--preset", choices=["full", "tiny"], default="full",
+                        help="tiny = CPU smoke test (small model/batch)")
+    parser.add_argument("--batch-size", type=int, default=None)
+    parser.add_argument("--warmup", type=int, default=2)
+    parser.add_argument("--iters", type=int, default=4,
+                        help="timed dispatches; each runs --steps-per-call steps")
+    parser.add_argument("--steps-per-call", type=int, default=5,
+                        help="training steps fused into one dispatch "
+                             "(lax.scan) to amortize host dispatch latency")
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import ResNet18, ResNet50
+    from horovod_tpu.parallel.train import shard_batch
+
+    hvd.init()
+    gm = hvd.global_mesh()
+    n_chips = hvd.size()
+
+    if args.preset == "tiny":
+        model = ResNet18(num_classes=100, width=16)
+        batch = args.batch_size or 8 * n_chips
+        hw = 32
+    else:
+        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+        batch = args.batch_size or 256 * n_chips
+        hw = 224
+
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.randn(batch, hw, hw, 3), jnp.bfloat16
+                         if args.preset == "full" else jnp.float32)
+    labels = jnp.asarray(rng.randint(0, 100 if args.preset == "tiny" else 1000,
+                                     batch), jnp.int32)
+    images = shard_batch(images, gm.mesh, P(gm.axis_name))
+    labels = shard_batch(labels, gm.mesh, P(gm.axis_name))
+
+    variables = model.init(jax.random.PRNGKey(0), images[:2])
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = tx.init(params)
+
+    def train_step(carry, _):
+        params, batch_stats, opt_state = carry
+
+        def loss_fn(p):
+            logits, mutated = model.apply(
+                {"params": p, "batch_stats": batch_stats}, images,
+                mutable=["batch_stats"])
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            loss = -jnp.mean(
+                jnp.take_along_axis(logp, labels[:, None], axis=-1))
+            return loss, mutated["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, new_stats, opt_state), loss
+
+    @jax.jit
+    def train_chunk(params, batch_stats, opt_state):
+        (params, batch_stats, opt_state), losses = jax.lax.scan(
+            train_step, (params, batch_stats, opt_state), None,
+            length=args.steps_per_call)
+        return params, batch_stats, opt_state, losses[-1]
+
+    def run_chunk(params, batch_stats, opt_state):
+        params, batch_stats, opt_state, loss = train_chunk(
+            params, batch_stats, opt_state)
+        # NOTE: a scalar readback, not block_until_ready — on the
+        # tunneled platform only an actual device->host transfer is a
+        # reliable completion fence.
+        return params, batch_stats, opt_state, float(loss)
+
+    for _ in range(args.warmup):
+        params, batch_stats, opt_state, loss = run_chunk(
+            params, batch_stats, opt_state)
+
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        params, batch_stats, opt_state, loss = run_chunk(
+            params, batch_stats, opt_state)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = batch * args.iters * args.steps_per_call / dt
+    per_chip = imgs_per_sec / n_chips
+    baseline_per_chip = 2500.0  # see module docstring
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip"
+                  if args.preset == "full" else "resnet18_tiny_images_per_sec",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / baseline_per_chip, 4),
+    }))
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

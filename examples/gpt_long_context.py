@@ -1,0 +1,70 @@
+"""Long-context GPT training with dp × sp × tp sharding and ring
+attention — the capability layer beyond the reference (which is
+data-parallel only; SURVEY.md §2.9).
+
+Run (CPU, 8 virtual slots → mesh dp=2 sp=2 tp=2):
+    python examples/gpt_long_context.py
+"""
+
+import os
+import sys
+
+if "--tpu" not in sys.argv:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.models import GPT, GPTConfig
+from horovod_tpu.models.transformer import lm_loss_fn
+from horovod_tpu.parallel import (
+    init_opt_state, make_mesh, make_spmd_train_step, shard_batch,
+    shard_params,
+)
+
+
+def main():
+    n = len(jax.devices())
+    tp = 2 if n % 2 == 0 else 1
+    sp = 2 if n % 4 == 0 else 1
+    dp = n // (tp * sp)
+    mesh = make_mesh({"dp": dp, "sp": sp, "tp": tp})
+    print(f"mesh: dp={dp} sp={sp} tp={tp}")
+
+    cfg = GPTConfig(vocab_size=512, n_layer=2, n_head=4, d_model=64,
+                    d_ff=128, max_seq_len=128, attention="ring",
+                    dtype=jnp.float32)
+    model = GPT(cfg, mesh=mesh)
+    seq, batch = 64, 4 * dp
+
+    tokens = np.random.RandomState(0).randint(0, 512, (batch, seq + 1))
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.asarray(tokens[:dp * sp, :seq], jnp.int32))["params"]
+    params = shard_params(params, mesh)      # tp-sharded per rule table
+
+    tx = optax.adamw(1e-3)
+    opt_state = init_opt_state(tx, params)
+    step = make_spmd_train_step(lm_loss_fn(model), tx)
+    data = shard_batch(
+        (jnp.asarray(tokens[:, :-1], jnp.int32),
+         jnp.asarray(tokens[:, 1:], jnp.int32)),
+        mesh, P("dp", "sp"))
+
+    for i in range(10):
+        params, opt_state, loss = step(params, opt_state, data)
+        if i % 3 == 0:
+            print(f"step {i}: loss={float(loss):.4f}")
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

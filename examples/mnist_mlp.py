@@ -1,0 +1,66 @@
+"""Data-parallel MNIST-style training — parity with the reference's
+``examples/pytorch/pytorch_mnist.py`` config (BASELINE.json config #1).
+
+Run (CPU, 8 virtual slots):
+    python examples/mnist_mlp.py
+
+Uses synthetic MNIST-shaped data (the environment has no dataset
+downloads); swap in real MNIST arrays the same way.
+"""
+
+import os
+import sys
+
+if "--tpu" not in sys.argv:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import MLP
+
+
+def main():
+    hvd.init()
+    print(f"slots={hvd.size()} controller rank={hvd.rank()}")
+
+    rng = np.random.RandomState(42)
+    x_train = rng.randn(512, 28 * 28).astype(np.float32)
+    y_train = rng.randint(0, 10, 512)
+
+    model = MLP()
+    params = model.init(jax.random.PRNGKey(0), x_train[:1])["params"]
+    # Reference pattern: broadcast initial state from rank 0 so every
+    # process starts identically.
+    params = hvd.broadcast_parameters(params, root_rank=0)
+
+    def loss_fn(params, batch):
+        xb, yb = batch
+        logits = model.apply({"params": params}, xb)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(logp[jnp.arange(xb.shape[0]), yb])
+
+    tx = hvd.DistributedOptimizer(optax.adam(1e-3),
+                                  compression=hvd.Compression.bf16)
+    step = hvd.make_train_step(loss_fn, tx)
+    opt_state = tx.init(params)
+
+    for epoch in range(3):
+        for i in range(0, len(x_train), 64):
+            batch = (x_train[i:i + 64], y_train[i:i + 64])
+            params, opt_state, loss = step(params, opt_state, batch)
+        print(f"epoch {epoch}: loss={float(loss):.4f}")
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -11,12 +11,10 @@ Canonical usage (mirrors ``import horovod.torch as hvd``)::
     import horovod_tpu as hvd
 
     hvd.init()
-    g_avg = hvd.allreduce(grads_stack)              # default op=Average
-    outs = hvd.grouped_allreduce([a, b], op=hvd.Sum)
-
-(The optimizer layer — ``DistributedOptimizer``, ``make_train_step``,
-``broadcast_parameters`` — lives in ``horovod_tpu.optim`` and is
-re-exported here once imported.)
+    tx = hvd.DistributedOptimizer(optax.adam(1e-3))
+    step = hvd.make_train_step(loss_fn, tx)         # jit'ed SPMD step
+    params = hvd.broadcast_parameters(params, root_rank=0)
+    params, opt_state, loss = step(params, opt_state, batch)
 """
 
 from .basics import (  # noqa: F401
@@ -48,3 +46,20 @@ from .functions import (  # noqa: F401
 )
 from . import ops  # noqa: F401
 from .version import __version__  # noqa: F401
+
+# The optimizer layer depends on optax; keep it a lazy attribute (PEP 562)
+# so collectives-only usage works in optax-less environments.
+_OPTIM_EXPORTS = ("DistributedOptimizer", "make_train_step",
+                  "DistributedOptimizerState")
+
+
+def __getattr__(name):
+    if name in _OPTIM_EXPORTS:
+        from . import optim
+
+        return getattr(optim, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_OPTIM_EXPORTS))

@@ -1,0 +1,11 @@
+"""Model zoo used by the examples, benchmarks and parity configs.
+
+The reference ships models only as examples/benchmarks
+(``examples/pytorch/pytorch_mnist.py``,
+``pytorch_synthetic_benchmark.py`` ResNet-50, BERT fine-tune configs —
+SURVEY.md §6); these are their TPU-native counterparts in flax.
+"""
+
+from .mlp import MLP  # noqa: F401
+from .resnet import ResNet18, ResNet50, ResNet101, SyncBatchNorm  # noqa: F401
+from .transformer import GPT, GPTConfig  # noqa: F401

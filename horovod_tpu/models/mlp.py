@@ -1,0 +1,22 @@
+"""MNIST-scale MLP (parity config: ``examples/pytorch/pytorch_mnist.py``
+in the reference — a small convnet/MLP; SURVEY.md §6 configs list)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (128, 64)
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f, name=f"dense_{i}")(x)
+            x = nn.relu(x)
+        return nn.Dense(self.num_classes, name="head")(x)

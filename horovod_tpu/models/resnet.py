@@ -1,0 +1,135 @@
+"""ResNet v1.5 family — the reference's headline benchmark model
+(``examples/pytorch/pytorch_synthetic_benchmark.py`` defaults to
+ResNet-50; BASELINE.json's north-star metric is ResNet-50
+images/sec/chip).  Written for TPU: NHWC layout (XLA's native conv
+layout), bfloat16-friendly, BatchNorm with optional cross-replica sync.
+
+``SyncBatchNorm`` gives parity with the reference's
+``hvd.SyncBatchNorm`` (``horovod/torch/sync_batch_norm.py``, SURVEY.md
+§2.4): statistics are averaged across the data-parallel axis via
+``axis_name`` — on TPU that's one fused psum over ICI instead of the
+reference's hand-written allreduce of mean/var.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class SyncBatchNorm(nn.Module):
+    """Cross-replica BatchNorm (reference: ``hvd.SyncBatchNorm``).
+
+    Pass ``axis_name`` of the data-parallel mapped axis (inside
+    ``shard_map``/``pmap``); statistics then sync across it.  With
+    ``axis_name=None`` it is plain BatchNorm.
+    """
+
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Optional[Any] = None
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.BatchNorm(
+            use_running_average=self.use_running_average,
+            momentum=self.momentum,
+            epsilon=self.epsilon,
+            dtype=self.dtype,
+            axis_name=self.axis_name,
+            name="bn",
+        )(x)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    norm: ModuleDef = nn.BatchNorm
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (1, 1), name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), self.strides, name="conv2")(y)
+        y = self.norm(name="bn2")(y)
+        y = nn.relu(y)
+        y = conv(self.filters * 4, (1, 1), name="conv3")(y)
+        y = self.norm(name="bn3", scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1), self.strides,
+                            name="proj")(residual)
+            residual = self.norm(name="bn_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    norm: ModuleDef = nn.BatchNorm
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (3, 3), self.strides, name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), name="conv2")(y)
+        y = self.norm(name="bn2", scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1), self.strides,
+                            name="proj")(residual)
+            residual = self.norm(name="bn_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block: ModuleDef
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None   # set to 'hvd'/'dp' for SyncBN
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not self.train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            axis_name=self.bn_axis_name,
+        )
+        x = nn.Conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype, name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block(self.width * 2 ** i, strides=strides,
+                               norm=norm, dtype=self.dtype,
+                               name=f"stage{i}_block{j}")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x
+
+
+ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2), block=BasicBlock)
+ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block=BottleneckBlock)
+ResNet101 = partial(ResNet, stage_sizes=(3, 4, 23, 3), block=BottleneckBlock)

@@ -1,0 +1,145 @@
+"""Flagship decoder-only transformer with pluggable parallel attention.
+
+The reference's transformer coverage is the "BERT-Large fine-tune with
+tensor fusion + fp16 Compression" baseline config (SURVEY.md §6) — a
+data-parallel-only workload.  This model is designed for the full TPU
+parallelism stack instead:
+
+* ``dp``  — batch sharding (GSPMD; gradient psum implicit)
+* ``tp``  — Megatron-style column/row-parallel projections via the rule
+  table in ``parallel/sharding.py`` (XLA inserts the activation psums)
+* ``sp``  — sequence sharding with exact ring attention or Ulysses
+  all-to-all attention (``attention='ring' | 'ulysses' | 'full'``)
+
+bfloat16 activations by default: the MXU-native dtype.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ..parallel.ring_attention import full_attention, ring_self_attention
+from ..parallel.ulysses import ulysses_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 32000
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    max_seq_len: int = 2048
+    causal: bool = True
+    attention: str = "full"            # 'full' | 'ring' | 'ulysses'
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+
+class Attention(nn.Module):
+    config: GPTConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        B, T, C = x.shape
+        H = cfg.n_head
+        D = C // H
+        qkv = nn.Dense(3 * C, use_bias=False, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, D)
+        k = k.reshape(B, T, H, D)
+        v = v.reshape(B, T, H, D)
+        if cfg.attention == "ring":
+            if self.mesh is None:
+                raise ValueError("attention='ring' requires a mesh")
+            out = ring_self_attention(q, k, v, mesh=self.mesh,
+                                      causal=cfg.causal)
+        elif cfg.attention == "ulysses":
+            if self.mesh is None:
+                raise ValueError("attention='ulysses' requires a mesh")
+            out = ulysses_attention(q, k, v, mesh=self.mesh,
+                                    causal=cfg.causal)
+        elif cfg.attention == "full":
+            out = full_attention(q, k, v, causal=cfg.causal)
+        else:
+            raise ValueError(f"Unknown attention {cfg.attention!r}")
+        out = out.reshape(B, T, C)
+        return nn.Dense(C, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype, name="out")(out)
+
+
+class MlpBlock(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        x = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="up")(x)
+        x = nn.gelu(x)
+        return nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype, name="down")(x)
+
+
+class Block(nn.Module):
+    config: GPTConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        x = x + Attention(cfg, self.mesh, name="attn")(
+            nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x))
+        x = x + MlpBlock(cfg, name="mlp")(
+            nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x))
+        return x
+
+
+class GPT(nn.Module):
+    """Decoder-only LM.  ``apply(params, tokens)`` → logits ``[B, T, V]``."""
+
+    config: GPTConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.config
+        B, T = tokens.shape
+        tok_emb = nn.Embed(cfg.vocab_size, cfg.d_model,
+                           param_dtype=cfg.param_dtype,
+                           dtype=cfg.dtype, name="embed")(tokens)
+        pos_emb = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (cfg.max_seq_len, cfg.d_model), cfg.param_dtype,
+        )
+        x = tok_emb + pos_emb[None, :T].astype(cfg.dtype)
+        for i in range(cfg.n_layer):
+            x = Block(cfg, self.mesh, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                          param_dtype=cfg.param_dtype, name="lm_head")(x)
+        return logits
+
+
+def lm_loss_fn(model: GPT):
+    """Next-token cross-entropy: ``loss_fn(params, (inputs, targets))``
+    with both ``[B, T]`` (pre-shifted by the data pipeline, so ``T`` stays
+    divisible by the ``sp`` axis under sequence sharding)."""
+
+    def loss_fn(params, batch):
+        inputs, targets = batch
+        logits = model.apply({"params": params}, inputs)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll)
+
+    return loss_fn

@@ -1,0 +1,5 @@
+"""Optimizer layer: distributed gradient aggregation for optax."""
+
+from .distributed_optimizer import (  # noqa: F401
+    DistributedOptimizer, make_train_step, DistributedOptimizerState,
+)

@@ -1,0 +1,275 @@
+"""DistributedOptimizer: gradient averaging as an optax transformation.
+
+Reference: ``horovod/torch/optimizer.py`` (``_DistributedOptimizer``:
+per-parameter backward hooks firing ``allreduce_async_``, a handle table,
+``synchronize()`` before ``step()``, ``backward_passes_per_step`` local
+aggregation) and ``horovod/tensorflow/__init__.py``
+(``DistributedOptimizer`` wrapping ``compute_gradients``) — paths per
+SURVEY.md §2.4, mount empty, unverified.
+
+TPU-native redesign
+-------------------
+The reference needs hooks + async handles because framework autograd
+produces gradients one tensor at a time on an eager stream, and overlap
+comes from racing communication against the rest of backward.  Under
+XLA, the whole step is one compiled program: gradients are a pytree
+produced by ``jax.grad``, the fused allreduce is HLO inside that program,
+and **overlap is the XLA scheduler's job** (it hoists collectives to
+overlap with independent compute — the latency-hiding the reference
+hand-builds with streams).  So the natural form is an *optax gradient
+transformation*: ``update()`` allreduces (fused, compressed, Adasum-able)
+then defers to the wrapped optimizer.  ``backward_passes_per_step`` —
+local accumulation with a collective only on the boundary step — becomes
+a ``lax.cond`` in the same program.
+
+Use inside any SPMD region (``make_train_step`` builds one for you)::
+
+    tx  = hvd.DistributedOptimizer(optax.adamw(3e-4), op=hvd.Average)
+    step = hvd.make_train_step(loss_fn, tx)     # jit'ed, mesh-aware
+    params, opt_state, loss = step(params, opt_state, batch)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .._compat import shard_map
+from ..ops import collectives as C
+from ..ops import spmd
+from ..ops.adasum import adasum_pytree
+from ..ops.compression import Compression
+from ..ops.fusion import fused_allreduce_pytree
+
+
+class DistributedOptimizerState(NamedTuple):
+    inner_state: Any
+    accumulator: Any          # grad pytree (zeros when backward_passes == 1)
+    step_count: jax.Array     # int32 scalar
+
+
+def _allreduce_grads(grads, *, op, axis, groups, compression, threshold):
+    if op == C.Adasum:
+        return adasum_pytree(grads, axis=axis, groups=groups)
+    spmd_op = "average" if op == C.Average else "sum"
+    return fused_allreduce_pytree(
+        grads, axis=axis, op=spmd_op, threshold=threshold, groups=groups,
+        compression=compression,
+    )
+
+
+def DistributedOptimizer(
+    optimizer: optax.GradientTransformation,
+    *,
+    op: str = C.Average,
+    compression=Compression.none,
+    backward_passes_per_step: int = 1,
+    average_aggregated_gradients: bool = True,
+    process_set=None,
+    axis_name: Optional[str] = None,
+    fusion_threshold: Optional[int] = None,
+) -> optax.GradientTransformation:
+    """Wrap an optax optimizer with distributed gradient aggregation
+    (reference: ``hvd.DistributedOptimizer``).
+
+    Must be used inside an SPMD region over ``axis_name`` (default: the
+    framework mesh axis) — ``make_train_step`` provides one.
+
+    Args mirror the reference: ``op`` (Average/Sum/Adasum),
+    ``compression`` (``hvd.Compression.fp16``/``bf16``),
+    ``backward_passes_per_step`` (aggregate locally for k calls, allreduce
+    + apply on the k-th; in between, parameters receive zero updates),
+    ``average_aggregated_gradients`` (divide the accumulated sum by k).
+    """
+    if op not in (C.Average, C.Sum, C.Adasum):
+        raise ValueError(
+            f"DistributedOptimizer supports Average/Sum/Adasum, got {op!r}"
+        )
+    if backward_passes_per_step < 1:
+        raise ValueError("backward_passes_per_step must be >= 1")
+
+    k = int(backward_passes_per_step)
+
+    def _axis() -> str:
+        if axis_name is not None:
+            return axis_name
+        from .. import basics
+
+        return (basics.config().mesh_axis_name
+                if basics.is_initialized() else "hvd")
+
+    def _threshold() -> int:
+        if fusion_threshold is not None:
+            return fusion_threshold
+        from .. import basics
+
+        return (basics.config().fusion_threshold
+                if basics.is_initialized() else 64 * 1024 * 1024)
+
+    def _groups():
+        if process_set is None:
+            return None, None
+        groups = process_set.axis_index_groups()
+        member_groups = [list(process_set.ranks)] if groups else None
+        return groups, member_groups
+
+    def init_fn(params):
+        acc = (jax.tree.map(jnp.zeros_like, params) if k > 1
+               else jax.tree.map(lambda x: jnp.zeros((), x.dtype), params))
+        return DistributedOptimizerState(
+            inner_state=optimizer.init(params),
+            accumulator=acc,
+            step_count=jnp.zeros((), jnp.int32),
+        )
+
+    def _reduce_and_update(grads, state, params):
+        axis = _axis()
+        groups, member_groups = _groups()
+        g = _allreduce_grads(
+            grads,
+            op=op,
+            axis=axis,
+            groups=member_groups if op == C.Adasum else groups,
+            compression=compression,
+            threshold=_threshold(),
+        )
+        updates, inner_state = optimizer.update(g, state.inner_state, params)
+        return updates, inner_state
+
+    def update_fn(grads, state: DistributedOptimizerState, params=None):
+        if k == 1:
+            updates, inner_state = _reduce_and_update(grads, state, params)
+            return updates, DistributedOptimizerState(
+                inner_state=inner_state,
+                accumulator=state.accumulator,
+                step_count=state.step_count + 1,
+            )
+
+        acc = jax.tree.map(jnp.add, state.accumulator, grads)
+        count = state.step_count + 1
+        is_boundary = (count % k) == 0
+
+        def boundary(_):
+            g = (jax.tree.map(lambda a: a / k, acc)
+                 if average_aggregated_gradients else acc)
+            updates, inner_state = _reduce_and_update(g, state, params)
+            zeros = jax.tree.map(jnp.zeros_like, acc)
+            return updates, inner_state, zeros
+
+        def interior(_):
+            zero_updates = jax.tree.map(jnp.zeros_like, grads)
+            return zero_updates, state.inner_state, acc
+
+        updates, inner_state, acc = lax.cond(is_boundary, boundary, interior,
+                                             operand=None)
+        return updates, DistributedOptimizerState(
+            inner_state=inner_state, accumulator=acc, step_count=count,
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def make_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    *,
+    mesh=None,
+    axis_name: Optional[str] = None,
+    has_aux: bool = False,
+    donate: bool = True,
+    distributed: Optional[bool] = None,
+    op: str = C.Average,
+    compression=Compression.none,
+    process_set=None,
+):
+    """Build the jit'ed SPMD training step — the hot loop the reference
+    assembles from hooks + background thread + NCCL (§3.2 of SURVEY.md),
+    here a single compiled program.
+
+    ``loss_fn(params, batch) -> loss`` (or ``(loss, aux)`` with
+    ``has_aux``).  The returned ``step(params, opt_state, batch)`` shards
+    ``batch`` along its leading axis over the mesh, computes per-slot
+    gradients, allreduces them (unless ``optimizer`` is already a
+    ``DistributedOptimizer`` — pass ``distributed=False`` to force off),
+    applies updates, and returns ``(params, opt_state, loss[, aux])``
+    with loss averaged across slots.  Parameters and optimizer state stay
+    replicated.
+    """
+    from .. import basics
+
+    gm = mesh
+    if gm is None:
+        gm = basics.global_mesh()
+        mesh_obj = gm.mesh
+        axis = axis_name or gm.axis_name
+    else:
+        mesh_obj = gm
+        axis = axis_name or list(gm.axis_names)[0]
+
+    # Does the optimizer itself allreduce?  Decided at trace time by
+    # inspecting the *actual* optimizer state for a
+    # DistributedOptimizerState node (robust to optax.chain/masked
+    # wrapping — no probe init on fake params, which structure-sensitive
+    # optimizers would reject).  ``distributed=True/False`` overrides.
+    def _contains_dist_state(opt_state) -> bool:
+        found = False
+
+        def visit(node):
+            nonlocal found
+            if isinstance(node, DistributedOptimizerState):
+                found = True
+            return node
+
+        jax.tree.map(visit, opt_state,
+                     is_leaf=lambda n: isinstance(n, DistributedOptimizerState))
+        return found
+
+    groups = process_set.axis_index_groups() if process_set is not None else None
+    member_groups = ([list(process_set.ranks)]
+                     if process_set is not None and groups else None)
+
+    def _threshold():
+        return (basics.config().fusion_threshold
+                if basics.is_initialized() else 64 * 1024 * 1024)
+
+    def per_slot_step(params, opt_state, batch):
+        reduce_here = (distributed if distributed is not None
+                       else not _contains_dist_state(opt_state))
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
+        if has_aux:
+            (loss, aux), grads = grad_fn(params, batch)
+        else:
+            loss, grads = grad_fn(params, batch)
+            aux = None
+        if reduce_here:
+            grads = _allreduce_grads(
+                grads, op=op, axis=axis,
+                groups=member_groups if op == C.Adasum else groups,
+                compression=compression, threshold=_threshold(),
+            )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        loss = spmd.allreduce(loss, op="average", axis=axis, groups=groups)
+        if has_aux:
+            # Per-slot aux values come back stacked [size, ...]; add the
+            # slot axis so scalars survive out_specs=P(axis).
+            aux = jax.tree.map(lambda a: jnp.asarray(a)[None], aux)
+            return params, opt_state, loss, aux
+        return params, opt_state, loss
+
+    body = shard_map(
+        per_slot_step,
+        mesh=mesh_obj,
+        in_specs=(P(), P(), P(axis)),
+        out_specs=(P(), P(), P()) + ((P(axis),) if has_aux else ()),
+        check=False,
+    )
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(body, donate_argnums=donate_argnums)

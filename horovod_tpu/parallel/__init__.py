@@ -1,0 +1,23 @@
+"""Parallelism strategies beyond data-parallel.
+
+The reference implements data parallelism only (SURVEY.md §2.9 — no
+TP/PP/SP/EP anywhere in Horovod); process sets are its building block for
+hand-rolled model parallelism.  On TPU the mesh/pjit model makes the
+richer strategies natural, and long-context (sequence/context
+parallelism) is a first-class requirement of this framework:
+
+* :mod:`.sharding`   — multi-axis mesh construction + parameter rules
+  (dp / tp / sp axes).
+* :mod:`.ring_attention` — ring attention over the ``sp`` axis (blockwise
+  attention with log-sum-exp merging, K/V rotating over ICI neighbors).
+* :mod:`.ulysses`    — all-to-all sequence parallelism (scatter heads,
+  gather sequence).
+"""
+
+from .sharding import make_mesh, transformer_param_rules, shard_params  # noqa: F401
+from .ring_attention import (  # noqa: F401
+    full_attention, ring_attention_local, ring_self_attention,
+)
+from .ulysses import ulysses_attention  # noqa: F401
+from .train import make_spmd_train_step, shard_batch, init_opt_state  # noqa: F401
+from .sharding import param_shardings  # noqa: F401

@@ -1,0 +1,140 @@
+"""Ring attention: exact attention over sequences sharded across chips.
+
+No reference analogue — Horovod has no sequence/context parallelism
+(SURVEY.md §2.9); this is a required first-class capability of the TPU
+rebuild.  Technique per the Ring Attention line of work (blockwise
+attention with log-sum-exp accumulation; K/V blocks rotating around the
+``sp`` mesh axis so each chip only ever holds ``T/n`` keys), which maps
+perfectly onto TPU ICI: the rotation is a neighbor ``ppermute`` that XLA
+overlaps with the block's compute.
+
+Numerics: flash-attention style streaming softmax — running row max
+``m``, numerator ``num`` and denominator ``den`` merged per block with
+``exp(m_old - m_new)`` correction, accumulated in float32 regardless of
+input dtype, so the result matches full attention to dtype tolerance.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .._compat import shard_map
+
+_NEG_INF = -1e30
+
+
+def full_attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None):
+    """Plain softmax attention — the single-chip reference used by tests
+    and by models when no ``sp`` axis is in play.
+
+    Shapes: q ``[B, Tq, H, D]``, k/v ``[B, Tk, H, D]`` → ``[B, Tq, H, D]``.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        tq, tk = scores.shape[-2], scores.shape[-1]
+        qpos = jnp.arange(tq)[:, None]
+        kpos = jnp.arange(tk)[None, :]
+        scores = jnp.where(qpos >= kpos, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
+
+
+def _block_accumulate(q, k, v, num, den, m, qpos, kpos, scale, causal):
+    """Merge one K/V block into the streaming-softmax accumulators."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = qpos[:, None] >= kpos[None, :]
+        scores = jnp.where(mask, scores, _NEG_INF)
+    m_block = jnp.max(scores, axis=-1)                      # [b, h, tq]
+    m_new = jnp.maximum(m, m_block)
+    # Guard fully-masked rows: keep exp() finite.
+    p = jnp.exp(scores - m_new[..., None])                  # [b, h, tq, tk]
+    corr = jnp.exp(m - m_new)                               # [b, h, tq]
+    num = num * corr[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    den = den * corr + jnp.sum(p, axis=-1)
+    return num, den, m_new
+
+
+def ring_attention_local(q, k, v, *, axis: str, causal: bool = False,
+                         scale: Optional[float] = None):
+    """The per-shard ring attention body — call inside ``shard_map``.
+
+    ``q``/``k``/``v`` are the local sequence shards ``[b, t, h, d]``
+    (t = T / sp).  Runs ``sp`` rounds; round *s* attends the local
+    queries against the K/V block that originated on slot
+    ``(my_rank - s) mod sp``, then rotates K/V one neighbor around the
+    ring.  Exact — not an approximation.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    n = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    b, t, h, d = q.shape
+    qpos = me * t + jnp.arange(t)
+
+    num0 = jnp.zeros((b, h, t, d), jnp.float32)
+    den0 = jnp.zeros((b, h, t), jnp.float32)
+    m0 = jnp.full((b, h, t), _NEG_INF, jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(s, carry):
+        k_cur, v_cur, num, den, m = carry
+        src = (me - s) % n
+        kpos = src * t + jnp.arange(t)
+        num, den, m = _block_accumulate(q, k_cur, v_cur, num, den, m,
+                                        qpos, kpos, scale, causal)
+        k_nxt = lax.ppermute(k_cur, axis, perm)
+        v_nxt = lax.ppermute(v_cur, axis, perm)
+        return k_nxt, v_nxt, num, den, m
+
+    _, _, num, den, m = lax.fori_loop(0, n, body, (k, v, num0, den0, m0))
+    # Fully-masked rows (causal, never attendable) have den == 0 only if
+    # t-position 0 on slot 0 masks itself out — it never does (qpos>=kpos
+    # includes the diagonal) — but guard anyway for non-causal edge use.
+    out = num / jnp.maximum(den, 1e-30)[..., None]          # [b, h, t, d]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [b, t, h, d]
+
+
+def seq_parallel_call(local_fn, q, k, v, *, mesh: Mesh, sp_axis: str,
+                      dp_axis: Optional[str], tp_axis: Optional[str]):
+    """Shared host-callable wrapper for sequence-parallel attention
+    variants: shard ``[B, T, H, D]`` inputs with sequence over
+    ``sp_axis`` (batch over ``dp_axis``, heads over ``tp_axis`` when
+    those axes exist in ``mesh``) and run ``local_fn`` under
+    ``shard_map``.  Composable inside a jit'ed GSPMD program."""
+    axes = set(mesh.axis_names)
+    dp = dp_axis if dp_axis in axes else None
+    tp = tp_axis if tp_axis in axes else None
+    if sp_axis not in axes:
+        raise ValueError(f"mesh has no axis {sp_axis!r}: {mesh.axis_names}")
+    spec = P(dp, sp_axis, tp, None)
+    body = shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check=False,
+    )
+    return body(q, k, v)
+
+
+def ring_self_attention(q, k, v, *, mesh: Mesh, sp_axis: str = "sp",
+                        dp_axis: Optional[str] = "dp",
+                        tp_axis: Optional[str] = "tp",
+                        causal: bool = False,
+                        scale: Optional[float] = None):
+    """Host-callable ring attention (see :func:`seq_parallel_call` for
+    the sharding contract) — this is the designed usage from models."""
+    return seq_parallel_call(
+        partial(ring_attention_local, axis=sp_axis, causal=causal, scale=scale),
+        q, k, v, mesh=mesh, sp_axis=sp_axis, dp_axis=dp_axis, tp_axis=tp_axis,
+    )

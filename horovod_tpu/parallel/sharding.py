@@ -1,0 +1,123 @@
+"""Multi-axis mesh construction and parameter sharding rules.
+
+No direct reference analogue: Horovod's only "mesh" is the flat rank
+list (SURVEY.md §2.9); hierarchical structure existed solely inside
+hierarchical allreduce.  Here the mesh is the program: axes
+
+* ``dp`` — data parallel (batch sharded; gradient sync is GSPMD-implicit)
+* ``tp`` — tensor parallel (weight matrices sharded; activations psum'd)
+* ``sp`` — sequence/context parallel (tokens sharded; ring/Ulysses attn)
+
+XLA lays collectives for each axis over ICI (within a slice) or DCN
+(across slices) from the device order `mesh_utils` picks.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(axis_sizes: Dict[str, int], *, devices=None) -> Mesh:
+    """Build a named mesh, e.g. ``make_mesh({'dp': 2, 'sp': 2, 'tp': 2})``.
+
+    Axis order fixes ICI locality: later axes get nearer neighbors, so
+    put the most bandwidth-hungry axis (usually ``tp``) last.
+    """
+    names = tuple(axis_sizes)
+    shape = tuple(axis_sizes[n] for n in names)
+    n_needed = int(np.prod(shape))
+    if devices is None:
+        devices = jax.devices()
+    if n_needed > len(devices):
+        raise ValueError(
+            f"Mesh {axis_sizes} needs {n_needed} devices; only "
+            f"{len(devices)} available"
+        )
+    devices = devices[:n_needed]
+    try:
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except (ValueError, AssertionError):
+        dev_array = np.asarray(devices, dtype=object).reshape(shape)
+    return Mesh(dev_array, names)
+
+
+# --- parameter sharding rules -----------------------------------------------
+
+# Megatron-style placement for a decoder-only transformer:
+#   - column-parallel (output dim sharded over tp): qkv projection, mlp up
+#   - row-parallel    (input dim sharded over tp): attn out, mlp down
+#   - everything else replicated over tp (and always over dp/sp)
+_TRANSFORMER_RULES: Sequence[Tuple[str, P]] = (
+    (r".*attn.*(query|key|value|qkv).*kernel", P(None, "tp")),
+    (r".*attn.*(out|proj_out|output).*kernel", P("tp", None)),
+    (r".*mlp.*(up|fc1|gate|intermediate).*kernel", P(None, "tp")),
+    (r".*mlp.*(down|fc2|output).*kernel", P("tp", None)),
+    (r".*embed.*embedding", P(None, None)),
+    (r".*", P()),
+)
+
+
+def transformer_param_rules() -> Sequence[Tuple[str, P]]:
+    """The default tp-sharding rule table for :class:`models.transformer.GPT`."""
+    return _TRANSFORMER_RULES
+
+
+def drop_missing_axes(spec: P, mesh: Mesh) -> P:
+    """Replace axis names absent from ``mesh`` with None (so one spec /
+    rule table serves meshes of any axis subset)."""
+    axes = set(mesh.axis_names)
+    cleaned = tuple(
+        (a if a in axes else None) if not isinstance(a, tuple)
+        else (tuple(x for x in a if x in axes) or None)
+        for a in spec
+    )
+    return P(*cleaned)
+
+
+def spec_for_path(path: str, rules: Sequence[Tuple[str, P]],
+                  mesh: Optional[Mesh] = None) -> P:
+    """First matching rule wins; axes absent from ``mesh`` are dropped
+    (so the same rules work on a dp-only mesh)."""
+    for pattern, spec in rules:
+        if re.fullmatch(pattern, path, flags=re.IGNORECASE):
+            return spec if mesh is None else drop_missing_axes(spec, mesh)
+    return P()
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        key = getattr(p, "key", None)
+        if key is None:
+            key = getattr(p, "name", None)
+        if key is None:
+            key = getattr(p, "idx", None)
+        parts.append(str(key))
+    return "/".join(parts)
+
+
+def shard_params(params, mesh: Mesh,
+                 rules: Optional[Sequence[Tuple[str, P]]] = None):
+    """Place a parameter pytree onto ``mesh`` per the rule table; returns
+    the sharded pytree.  Use the matching ``param_shardings`` for jit
+    in_shardings."""
+    shardings = param_shardings(params, mesh, rules)
+    return jax.tree.map(jax.device_put, params, shardings)
+
+
+def param_shardings(params, mesh: Mesh,
+                    rules: Optional[Sequence[Tuple[str, P]]] = None):
+    """NamedSharding pytree matching ``params`` (for jit in_shardings)."""
+    rules = rules or _TRANSFORMER_RULES
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    shardings = [
+        NamedSharding(mesh, spec_for_path(_path_str(path), rules, mesh))
+        for path, _ in flat
+    ]
+    return jax.tree_util.tree_unflatten(treedef, shardings)

@@ -1,0 +1,64 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+No reference analogue (Horovod has no SP; SURVEY.md §2.9).  The second
+first-class long-context strategy: instead of rotating K/V (ring), one
+AllToAll re-partitions activations from sequence-sharded to
+head-sharded, each chip computes *full-sequence* attention for its head
+subset, and a second AllToAll restores sequence sharding.  Two
+collectives per attention call, each moving ``B·T·H·D / sp`` elements —
+cheaper than a ring when heads ≥ sp and the sequence fits per-chip
+memory after gathering; the ring wins for extreme sequence lengths.
+Exposing both, like the technique literature, lets users pick per model
+shape.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+from jax import lax
+from jax.sharding import Mesh
+
+from .ring_attention import full_attention
+
+
+def _ulysses_local(q, k, v, *, axis: str, causal: bool, scale):
+    """Body under shard_map: local shapes [b, t, h, d] with t = T/sp.
+
+    AllToAll #1: scatter heads, gather sequence → [b, T, h/sp, d].
+    Local full attention.  AllToAll #2: inverse.
+    Requires h % sp == 0.
+    """
+    n = lax.axis_size(axis)
+    h = q.shape[2]
+    if h % n != 0:
+        raise ValueError(
+            f"Ulysses sequence parallelism needs heads ({h}) divisible by "
+            f"the sp axis size ({n}); use ring attention otherwise."
+        )
+
+    def seq2head(x):  # [b, t, h, d] -> [b, T, h/n, d]
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+    def head2seq(x):  # [b, T, h/n, d] -> [b, t, h, d]
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+    qg, kg, vg = seq2head(q), seq2head(k), seq2head(v)
+    out = full_attention(qg, kg, vg, causal=causal, scale=scale)
+    return head2seq(out)
+
+
+def ulysses_attention(q, k, v, *, mesh: Mesh, sp_axis: str = "sp",
+                      dp_axis: Optional[str] = "dp",
+                      tp_axis: Optional[str] = "tp",
+                      causal: bool = False,
+                      scale: Optional[float] = None):
+    """Host-callable Ulysses attention on ``[B, T, H, D]`` inputs with the
+    same sharding contract as :func:`ring_self_attention`."""
+    from .ring_attention import seq_parallel_call
+
+    return seq_parallel_call(
+        partial(_ulysses_local, axis=sp_axis, causal=causal, scale=scale),
+        q, k, v, mesh=mesh, sp_axis=sp_axis, dp_axis=dp_axis, tp_axis=tp_axis,
+    )

@@ -1,0 +1,138 @@
+"""Model zoo + multi-axis SPMD training tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.models import MLP, ResNet18, GPT, GPTConfig
+from horovod_tpu.models.transformer import lm_loss_fn
+from horovod_tpu.parallel import (
+    make_mesh, make_spmd_train_step, shard_batch, shard_params,
+    init_opt_state,
+)
+from jax.sharding import PartitionSpec as P
+
+
+class TestMLP:
+    def test_trains_on_toy_mnist(self, world_size):
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 28 * 28).astype(np.float32)
+        y = rng.randint(0, 10, 64)
+        model = MLP()
+        params = model.init(jax.random.PRNGKey(0), x[:1])["params"]
+
+        def loss_fn(params, batch):
+            xb, yb = batch
+            logits = model.apply({"params": params}, xb)
+            return -jnp.mean(jax.nn.log_softmax(logits)[jnp.arange(len(yb)), yb])
+
+        tx = optax.adam(1e-3)
+        step = hvd.make_train_step(loss_fn, tx, donate=False)
+        state = tx.init(params)
+        losses = []
+        for _ in range(20):
+            params, state, loss = step(params, state, (x, y))
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.7
+
+
+class TestResNet:
+    def test_forward_shape_and_train_step(self):
+        model = ResNet18(num_classes=10, width=8)
+        x = jnp.zeros((4, 32, 32, 3), jnp.float32)
+        variables = model.init(jax.random.PRNGKey(0), x)
+        logits, mutated = model.apply(variables, x, mutable=["batch_stats"])
+        assert logits.shape == (4, 10)
+        assert "batch_stats" in mutated
+
+    def test_sync_bn_axis(self, world_size):
+        # SyncBatchNorm statistics ride the mapped axis: build the model
+        # with bn_axis_name and run under shard_map.
+        from horovod_tpu._compat import shard_map
+
+        gm = hvd.global_mesh()
+        model = ResNet18(num_classes=4, width=8, bn_axis_name=gm.axis_name)
+        x = np.random.RandomState(0).randn(8, 8, 8, 3).astype(np.float32)
+        variables = model.init(jax.random.PRNGKey(0), jnp.asarray(x[:1]))
+
+        def fwd(xb):
+            out, _ = model.apply(variables, xb, mutable=["batch_stats"])
+            return out
+
+        body = shard_map(fwd, mesh=gm.mesh, in_specs=P(gm.axis_name),
+                         out_specs=P(gm.axis_name), check=False)
+        out = jax.jit(body)(jnp.asarray(x))
+        assert out.shape == (8, 4)
+        assert bool(jnp.isfinite(out).all())
+
+
+def _tiny_gpt(attention="full", mesh=None, seq=16):
+    cfg = GPTConfig(vocab_size=64, n_layer=2, n_head=4, d_model=32,
+                    d_ff=64, max_seq_len=seq, attention=attention,
+                    dtype=jnp.float32)
+    model = GPT(cfg, mesh=mesh)
+    tokens = np.random.RandomState(0).randint(0, 64, (8, seq))
+    # Init with a mesh-divisible dummy (B=2, T=16 divides dp/sp sizes used
+    # in these tests); param shapes don't depend on B/T.
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.asarray(tokens[:2, :16]))["params"]
+    return model, params, tokens
+
+
+class TestGPT:
+    def test_forward(self):
+        model, params, tokens = _tiny_gpt()
+        logits = model.apply({"params": params}, jnp.asarray(tokens))
+        assert logits.shape == (8, 16, 64)
+        assert bool(jnp.isfinite(logits).all())
+
+    def test_dp_training_loss_decreases(self, world_size):
+        model, params, tokens = _tiny_gpt()
+        loss_fn = lm_loss_fn(model)
+        tx = optax.adam(1e-2)
+        step = hvd.make_train_step(loss_fn, tx, donate=False)
+        state = tx.init(params)
+        batch = (tokens[:, :-1], tokens[:, 1:])
+        first = None
+        for _ in range(10):
+            params, state, loss = step(params, state, batch)
+            first = float(loss) if first is None else first
+        assert float(loss) < first
+
+    def test_ring_attention_matches_full(self):
+        """The same weights must produce the same logits under sp=8 ring
+        attention as under single-chip full attention."""
+        import dataclasses
+
+        mesh = make_mesh({"sp": 8})
+        model_f, params, tokens = _tiny_gpt("full")
+        model_r = GPT(dataclasses.replace(model_f.config, attention="ring"),
+                      mesh=mesh)
+        lf = model_f.apply({"params": params}, jnp.asarray(tokens))
+        lr = model_r.apply({"params": params}, jnp.asarray(tokens))
+        np.testing.assert_allclose(np.asarray(lr), np.asarray(lf),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_dp_sp_tp_training(self):
+        """Full 3-axis SPMD training step: dp×sp×tp = 2×2×2, ring
+        attention, tp-sharded params, one step runs and loss is finite."""
+        mesh = make_mesh({"dp": 2, "sp": 2, "tp": 2})
+        model, params, _ = _tiny_gpt("ring", mesh=mesh, seq=17)
+        # inputs/targets of length 16: divisible by sp=2
+        tokens = np.random.RandomState(1).randint(0, 64, (8, 17))
+        params = shard_params(params, mesh)
+        loss_fn = lm_loss_fn(model)
+        tx = optax.adam(1e-2)
+        opt_state = init_opt_state(tx, params)
+        step = make_spmd_train_step(loss_fn, tx, donate=False)
+        batch = shard_batch(
+            (jnp.asarray(tokens[:, :-1]), jnp.asarray(tokens[:, 1:])),
+            mesh, P("dp", "sp"))
+        params2, opt_state, loss = step(params, opt_state, batch)
+        assert np.isfinite(float(loss))
+        # and a second step with the updated params still works
+        params3, opt_state, loss2 = step(params2, opt_state, batch)
+        assert np.isfinite(float(loss2))
