@@ -1,0 +1,98 @@
+"""Input-pipeline utilities: shard, pad, mask.
+
+The reference handles ragged/uneven data with the runtime ``Join`` op
+(ranks that exhaust data keep collectives alive with zeros — SURVEY.md
+§2.1 message types).  Under XLA SPMD every slot must execute the same
+program, so unevenness is resolved *before* the step: pad the final
+batch to a static shape and mask the loss.  These helpers make that the
+one-liner the reference's ``join()`` was.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+
+def pad_batch(batch: np.ndarray, batch_size: int,
+              pad_value=0) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad ``batch`` (leading axis) up to ``batch_size``; returns
+    ``(padded, mask)`` with ``mask[i]=1`` for real rows — feed the mask
+    into :func:`masked_mean` in the loss."""
+    n = batch.shape[0]
+    if n > batch_size:
+        raise ValueError(f"batch of {n} rows exceeds batch_size {batch_size}")
+    mask = np.zeros((batch_size,), np.float32)
+    mask[:n] = 1.0
+    if n == batch_size:
+        return batch, mask
+    pad_shape = (batch_size - n,) + batch.shape[1:]
+    pad = np.full(pad_shape, pad_value, dtype=batch.dtype)
+    return np.concatenate([batch, pad], axis=0), mask
+
+
+def masked_mean(values, mask):
+    """Mean over real (unmasked) entries; safe when a slot's shard is all
+    padding (the ``join``-with-zeros situation)."""
+    import jax.numpy as jnp
+
+    mask = mask.astype(values.dtype)
+    total = jnp.sum(values * mask)
+    count = jnp.maximum(jnp.sum(mask), 1)
+    return total / count
+
+
+class ShardedBatchIterator:
+    """Iterate ``(batch, mask)`` pairs of a fixed global batch size over
+    an array dataset, padding the tail — every rank sees the same number
+    of steps regardless of dataset divisibility (the SPMD invariant the
+    reference's elastic/join machinery protects at runtime).
+
+    For per-process loading in multi-controller deployments, pass
+    ``rank``/``world`` to read only this process's rows.
+    """
+
+    def __init__(self, *arrays: np.ndarray, batch_size: int,
+                 rank: int = 0, world: int = 1, shuffle: bool = False,
+                 seed: int = 0, drop_remainder: bool = False) -> None:
+        n = arrays[0].shape[0]
+        for a in arrays:
+            if a.shape[0] != n:
+                raise ValueError("all arrays need equal leading dims")
+        self.arrays = arrays
+        self.batch_size = batch_size
+        self.rank = rank
+        self.world = world
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_remainder = drop_remainder
+        self.epoch = 0
+
+    def __len__(self) -> int:
+        # Every rank MUST report the same step count (the SPMD invariant):
+        # derive it from the largest/smallest shard, not this rank's.
+        n = self.arrays[0].shape[0]
+        if self.drop_remainder:
+            min_rows = n // self.world
+            return min_rows // self.batch_size
+        max_rows = math.ceil(n / self.world)
+        return math.ceil(max_rows / self.batch_size)
+
+    def __iter__(self) -> Iterator[Tuple[Tuple[np.ndarray, ...], np.ndarray]]:
+        n = self.arrays[0].shape[0]
+        order = np.arange(n)
+        if self.shuffle:
+            np.random.RandomState(self.seed + self.epoch).shuffle(order)
+        my = order[self.rank::self.world]
+        steps = len(self)
+        for s in range(steps):
+            idx = my[s * self.batch_size:(s + 1) * self.batch_size]
+            padded, mask = None, None
+            outs = []
+            for a in self.arrays:
+                p, mask = pad_batch(a[idx], self.batch_size)
+                outs.append(p)
+            yield tuple(outs), mask
+        self.epoch += 1

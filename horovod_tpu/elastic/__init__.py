@@ -1,0 +1,20 @@
+"""Elastic training: survive slot/host membership changes.
+
+Reference: ``horovod/common/elastic.py`` (State/ObjectState,
+``hvd.elastic.run``), ``horovod/torch/elastic/state.py`` (TorchState),
+``sampler.py`` (ElasticSampler), and the driver stack under
+``horovod/runner/elastic/`` — paths per SURVEY.md §2.5/§3.5, mount
+empty, unverified.
+
+Failure model on TPU (deliberate redesign): GPU pools lose single
+workers; TPU slices fail or resize as *units*, and collectives halt the
+whole step.  So recovery is commit/rollback + re-initialization of the
+mesh (possibly after a slice re-provision), under the same
+State/commit/restore API the reference exposes.  Detection: any
+exception surfacing from a collective (XLA halts propagate as errors)
+or a driver notification.
+"""
+
+from .state import State, ObjectState, TpuState, HorovodInternalError, run  # noqa: F401
+from .sampler import ElasticSampler  # noqa: F401
+from .driver import ElasticDriver, HostDiscovery, ScriptDiscovery  # noqa: F401

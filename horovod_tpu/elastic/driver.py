@@ -1,0 +1,198 @@
+"""Elastic driver: host discovery polling, membership tracking,
+blacklisting, worker notification.
+
+Reference: ``horovod/runner/elastic/driver.py`` + ``discovery.py`` +
+``registration.py`` (SURVEY.md §2.5, mount empty, unverified): a driver
+polls ``--host-discovery-script``, maintains the host set, starts/stops
+workers as slots appear/fail, blacklists repeatedly-failing hosts, and
+pings workers through a WorkerNotificationService when membership
+changes.
+
+TPU-native notes: slice membership is managed by the platform
+(GKE/queued resources re-provision slices); this driver is the
+*control-plane* equivalent for self-managed fleets — it polls discovery,
+detects membership deltas, and invokes callbacks that typically raise
+``HostsUpdatedInterrupt`` inside workers or restart the
+``jax.distributed`` world via the runner.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Set
+
+from ..utils.logging import get_logger
+from .state import HostsUpdatedInterrupt
+
+logger = get_logger(__name__)
+
+
+class HostDiscovery:
+    """Interface (reference: ``HostDiscovery``): return the current
+    ``{host: slots}`` mapping."""
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        raise NotImplementedError
+
+
+class ScriptDiscovery(HostDiscovery):
+    """Reference: ``HostDiscoveryScript`` — run a user script that prints
+    ``hostname:slots`` per line (the ``--host-discovery-script``
+    contract)."""
+
+    def __init__(self, script: str, timeout_s: float = 30.0) -> None:
+        self.script = script
+        self.timeout_s = timeout_s
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        out = subprocess.run(
+            self.script, shell=True, capture_output=True, text=True,
+            timeout=self.timeout_s, check=True,
+        ).stdout
+        hosts: Dict[str, int] = {}
+        for line in out.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            if ":" in line:
+                host, slots = line.rsplit(":", 1)
+                hosts[host] = int(slots)
+            else:
+                hosts[line] = 1
+        return hosts
+
+
+class FixedDiscovery(HostDiscovery):
+    """Static host set (tests / non-elastic fallback)."""
+
+    def __init__(self, hosts: Dict[str, int]) -> None:
+        self.hosts = dict(hosts)
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        return dict(self.hosts)
+
+
+class ElasticDriver:
+    """Membership tracker (reference: ``ElasticDriver``).
+
+    ``on_hosts_updated`` callbacks receive ``(added, removed)`` host
+    sets.  Hosts that fail more than ``blacklist_after`` times are
+    excluded from future membership (reference: host blacklisting).
+    """
+
+    def __init__(self, discovery: HostDiscovery, *,
+                 poll_interval_s: float = 1.0,
+                 blacklist_after: int = 3) -> None:
+        self.discovery = discovery
+        self.poll_interval_s = poll_interval_s
+        self.blacklist_after = blacklist_after
+        self._hosts: Dict[str, int] = {}
+        self._failures: Dict[str, int] = {}
+        self._blacklist: Set[str] = set()
+        self._callbacks: List[Callable[[Set[str], Set[str]], None]] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # --- membership --------------------------------------------------------
+
+    @property
+    def hosts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._hosts)
+
+    def world_size(self) -> int:
+        return sum(self.hosts.values())
+
+    def register_hosts_updated_callback(self, cb) -> None:
+        self._callbacks.append(cb)
+
+    def record_failure(self, host: str) -> None:
+        """Reference: failed workers increment their host's strike count;
+        over the limit → blacklist."""
+        with self._lock:
+            self._failures[host] = self._failures.get(host, 0) + 1
+            if self._failures[host] >= self.blacklist_after:
+                if host not in self._blacklist:
+                    logger.warning("Blacklisting host %s after %d failures",
+                                   host, self._failures[host])
+                self._blacklist.add(host)
+
+    def blacklisted(self, host: str) -> bool:
+        with self._lock:
+            return host in self._blacklist
+
+    # --- polling -----------------------------------------------------------
+
+    def poll_once(self) -> bool:
+        """One discovery round; fires callbacks on delta.  Returns True
+        if membership changed."""
+        found = self.discovery.find_available_hosts_and_slots()
+        with self._lock:
+            found = {h: s for h, s in found.items()
+                     if h not in self._blacklist}
+            old = set(self._hosts)
+            new = set(found)
+            changed = found != self._hosts
+            self._hosts = found
+        if changed:
+            added, removed = new - old, old - new
+            logger.info("Membership change: +%s -%s",
+                        sorted(added), sorted(removed))
+            for cb in self._callbacks:
+                cb(added, removed)
+        return changed
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._poll_loop,
+                                        name="hvd-tpu-elastic-driver",
+                                        daemon=True)
+        self._thread.start()
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.poll_once()
+            except Exception as e:  # discovery scripts may be flaky
+                logger.warning("Host discovery failed: %s", e)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def wait_for_available_slots(self, min_slots: int,
+                                 timeout_s: float = 600.0) -> Dict[str, int]:
+        """Block until discovery reports at least ``min_slots`` (reference:
+        driver startup barrier with HOROVOD_ELASTIC_TIMEOUT)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            self.poll_once()
+            if self.world_size() >= min_slots:
+                return self.hosts
+            time.sleep(self.poll_interval_s)
+        raise TimeoutError(
+            f"Timed out waiting for {min_slots} slots; have "
+            f"{self.world_size()}")
+
+
+def hosts_updated_interrupt_callback():
+    """Convenience callback: raise ``HostsUpdatedInterrupt`` in the
+    training thread at the next commit boundary (reference:
+    WorkerNotificationManager's interrupt flow)."""
+    flag = {"pending": False}
+
+    def on_update(added, removed):
+        flag["pending"] = True
+
+    def check():
+        if flag["pending"]:
+            flag["pending"] = False
+            raise HostsUpdatedInterrupt("host membership changed")
+
+    return on_update, check

@@ -1,0 +1,203 @@
+"""Elastic state: in-memory commit/rollback + the ``run`` decorator.
+
+Reference: ``horovod/common/elastic.py`` (``State`` base with
+``commit/restore/sync`` and reset callbacks; ``run`` wrapper catching
+``HorovodInternalError`` → restore → re-init → retry) and
+``horovod/torch/elastic/state.py`` (``TorchState`` holding
+model/optimizer tensors) — SURVEY.md §3.5, mount empty, unverified.
+Checkpointing is deliberately in-memory (no filesystem), exactly like
+the reference; durable checkpoints belong to orbax.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+from ..utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class HorovodInternalError(RuntimeError):
+    """A collective failed mid-step (reference: raised by the C++ core
+    when a collective errors; here: raised by users/wrappers when a jax
+    collective raises, or injected by tests)."""
+
+
+class HostsUpdatedInterrupt(RuntimeError):
+    """Membership changed without a failure (reference: raised after a
+    WorkerNotificationService ping; graceful re-rendezvous)."""
+
+
+class State:
+    """Base elastic state (reference API: ``register_reset_callbacks``,
+    ``on_reset``, ``commit``, ``restore``, ``sync``)."""
+
+    def __init__(self) -> None:
+        self._reset_callbacks: List[Callable[[], None]] = []
+
+    def register_reset_callbacks(self, callbacks) -> None:
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        self.reset()
+        for cb in self._reset_callbacks:
+            cb()
+
+    def reset(self) -> None:  # re-establish process membership
+        pass
+
+    def commit(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+
+class ObjectState(State):
+    """Arbitrary-attribute state (reference: ``ObjectState`` — plain
+    Python values committed/restored by value)."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._saved: Dict[str, Any] = {}
+        for name, value in kwargs.items():
+            setattr(self, name, value)
+        self.commit()
+
+    def _public_attrs(self) -> Dict[str, Any]:
+        return {
+            k: v for k, v in self.__dict__.items()
+            if not k.startswith("_") and not callable(v)
+        }
+
+    def commit(self) -> None:
+        self._saved = copy.deepcopy(self._public_attrs())
+
+    def restore(self) -> None:
+        for k, v in copy.deepcopy(self._saved).items():
+            setattr(self, k, v)
+
+    def sync(self) -> None:
+        from ..functions import broadcast_object
+
+        synced = broadcast_object(self._public_attrs(), root_rank=0)
+        for k, v in synced.items():
+            setattr(self, k, v)
+        self.commit()
+
+
+class TpuState(ObjectState):
+    """Pytree-aware elastic state (reference: ``TorchState(model=...,
+    optimizer=...)``).  Array pytrees (``params``, ``opt_state``, …) are
+    snapshotted to host memory on ``commit`` and re-placed on ``restore``
+    — device buffers may be gone after a slice failure, so the snapshot
+    must live off-accelerator, mirroring the reference's CPU-side copies.
+    """
+
+    _TREE_KEYS = ("params", "opt_state", "batch_stats")
+
+    def __init__(self, **kwargs: Any) -> None:
+        self._tree_saved: Dict[str, Any] = {}
+        super().__init__(**kwargs)
+
+    def _trees(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self._TREE_KEYS if hasattr(self, k)}
+
+    def commit(self) -> None:
+        # Host snapshot (device_get) for array trees; deepcopy for the rest.
+        self._tree_saved = {
+            k: jax.device_get(v) for k, v in self._trees().items()
+        }
+        saved = {
+            k: v for k, v in self._public_attrs().items()
+            if k not in self._tree_saved
+        }
+        self._saved = copy.deepcopy(saved)
+
+    def restore(self) -> None:
+        for k, v in copy.deepcopy(self._saved).items():
+            setattr(self, k, v)
+        for k, v in self._tree_saved.items():
+            setattr(self, k, jax.tree.map(jax.numpy.asarray, v))
+
+    def sync(self) -> None:
+        from ..functions import broadcast_parameters, broadcast_object
+
+        for k in list(self._trees()):
+            setattr(self, k, broadcast_parameters(getattr(self, k), root_rank=0))
+        plain = {
+            k: v for k, v in self._public_attrs().items()
+            if k not in self._trees()
+        }
+        synced = broadcast_object(plain, root_rank=0)
+        for k, v in synced.items():
+            setattr(self, k, v)
+        self.commit()
+
+
+def _reinitialize() -> None:
+    """Tear down and rebuild the mesh/process state (reference: internal
+    shutdown + re-init over the new membership)."""
+    from .. import basics
+
+    basics.shutdown()
+    basics.init()
+
+
+def run(func: Callable) -> Callable:
+    """Decorator making a training function elastic (reference:
+    ``@hvd.elastic.run``)::
+
+        @hvd.elastic.run
+        def train(state):
+            for batch in data:
+                step(...)
+                state.commit()
+
+    On ``HorovodInternalError``: rollback to the last commit, re-init,
+    sync from rank 0, retry.  On ``HostsUpdatedInterrupt``: re-init and
+    continue without rollback (graceful resize).  Retries are bounded by
+    ``HOROVOD_ELASTIC_RESET_LIMIT`` (0 = unlimited).
+    """
+
+    def wrapper(state: State, *args: Any, **kwargs: Any):
+        from .. import basics
+
+        reset_limit = (basics.config().reset_limit
+                       if basics.is_initialized() else 0)
+        resets = 0
+        while True:
+            try:
+                return func(state, *args, **kwargs)
+            except HorovodInternalError as e:
+                resets += 1
+                if reset_limit and resets > reset_limit:
+                    raise RuntimeError(
+                        f"Elastic reset limit ({reset_limit}) exceeded"
+                    ) from e
+                logger.warning("Collective failure (%s); rolling back to "
+                               "last commit and re-initializing", e)
+                _reinitialize()
+                state.restore()
+                state.on_reset()
+                state.sync()
+            except HostsUpdatedInterrupt:
+                resets += 1
+                if reset_limit and resets > reset_limit:
+                    raise RuntimeError(
+                        f"Elastic reset limit ({reset_limit}) exceeded")
+                logger.info("Membership changed; re-initializing without "
+                            "rollback")
+                _reinitialize()
+                state.on_reset()
+                state.sync()
+
+    wrapper.__name__ = getattr(func, "__name__", "elastic_run")
+    return wrapper

@@ -1,0 +1,15 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference's runtime core is C++ (SURVEY.md §2.1); this package holds
+the TPU framework's native pieces.  Current inventory:
+
+* ``planner.cc`` — fusion bucket planner (see :mod:`.planner`).
+
+Components build lazily with the in-image toolchain (``g++``) on first
+use and cache the shared object next to the sources; every native entry
+point has a pure-python fallback, so a missing compiler only costs
+speed, never correctness (``horovodtpurun --check-build`` reports which
+path is active).
+"""
+
+from . import planner  # noqa: F401

@@ -1,0 +1,52 @@
+// Native fusion planner.
+//
+// Reference: the fusion scan inside Controller::ComputeResponseList +
+// FusionBufferManager (horovod/common/controller.cc,
+// fusion_buffer_manager.cc — paths per SURVEY.md §2.1, reference mount
+// empty, unverified).  There the planner runs on the C++ background
+// thread every cycle; here it runs at trace time, but stays native so
+// trace-time cost on large models (10k+ parameter tensors, retraced per
+// shape set) and future native runtime components share one
+// implementation.
+//
+// Contract (mirrors ops/fusion.py:plan_buckets_py exactly; property-
+// tested for equivalence in tests/test_native.py):
+//   - greedy, order-preserving bin packing
+//   - a bucket closes when adding the next tensor would exceed
+//     `threshold` bytes (oversized tensors get singleton buckets)
+//
+// Build: g++ -O2 -shared -fPIC planner.cc -o libhvdtpu_native.so
+
+#include <cstdint>
+
+extern "C" {
+
+// Writes bucket_ids[i] = bucket index of tensor i (buckets are
+// consecutive, starting at 0). Returns the number of buckets, or -1 on
+// invalid input.
+int64_t hvd_tpu_plan_buckets(const int64_t* sizes_bytes, int64_t n,
+                             int64_t threshold, int32_t* bucket_ids) {
+  if (n < 0 || threshold < 0 || (n > 0 && (!sizes_bytes || !bucket_ids))) {
+    return -1;
+  }
+  int64_t bucket = 0;
+  int64_t current_bytes = 0;
+  bool current_empty = true;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t sz = sizes_bytes[i];
+    if (sz < 0) return -1;
+    if (!current_empty && current_bytes + sz > threshold) {
+      ++bucket;
+      current_bytes = 0;
+    }
+    bucket_ids[i] = static_cast<int32_t>(bucket);
+    current_bytes += sz;
+    current_empty = false;
+  }
+  return n == 0 ? 0 : bucket + 1;
+}
+
+// Version tag so Python can verify ABI expectations.
+int64_t hvd_tpu_native_abi_version() { return 1; }
+
+}  // extern "C"

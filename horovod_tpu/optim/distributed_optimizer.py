@@ -31,12 +31,10 @@ Use inside any SPMD region (``make_train_step`` builds one for you)::
 
 from __future__ import annotations
 
-import dataclasses
 from typing import Any, Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import optax
 from jax import lax
 from jax.sharding import PartitionSpec as P
@@ -53,6 +51,17 @@ class DistributedOptimizerState(NamedTuple):
     inner_state: Any
     accumulator: Any          # grad pytree (zeros when backward_passes == 1)
     step_count: jax.Array     # int32 scalar
+
+
+def _check_reduce_args(op: str, compression) -> None:
+    if op not in (C.Average, C.Sum, C.Adasum):
+        raise ValueError(
+            f"Gradient reduction supports Average/Sum/Adasum, got {op!r}")
+    if op == C.Adasum and compression is not Compression.none:
+        raise ValueError(
+            "compression is not supported with op=Adasum (the pairwise "
+            "projections need full-precision dot products); drop the "
+            "compression argument or use op=Average/Sum")
 
 
 def _allreduce_grads(grads, *, op, axis, groups, compression, threshold):
@@ -88,10 +97,7 @@ def DistributedOptimizer(
     + apply on the k-th; in between, parameters receive zero updates),
     ``average_aggregated_gradients`` (divide the accumulated sum by k).
     """
-    if op not in (C.Average, C.Sum, C.Adasum):
-        raise ValueError(
-            f"DistributedOptimizer supports Average/Sum/Adasum, got {op!r}"
-        )
+    _check_reduce_args(op, compression)
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
 
@@ -204,6 +210,7 @@ def make_train_step(
     """
     from .. import basics
 
+    _check_reduce_args(op, compression)
     gm = mesh
     if gm is None:
         gm = basics.global_mesh()

@@ -1,0 +1,182 @@
+"""Autotuning of runtime knobs via Bayesian optimization.
+
+Reference: ``horovod/common/parameter_manager.cc`` +
+``horovod/common/optim/{bayesian_optimization,gaussian_process}.cc``
+(SURVEY.md §2.1, mount empty, unverified): with ``HOROVOD_AUTOTUNE=1``
+the background thread tunes fusion threshold and cycle time online — a
+Gaussian-process surrogate over Eigen, expected-improvement sampling,
+warmup discard, score = training samples/sec.
+
+TPU-native redesign: the tunable surface differs (there is no cycle
+time), but the machinery is the same.  Default knobs: the fusion
+threshold (bucket size trades collective latency hiding against
+pipelining) and steps-per-call (dispatch amortization).  The GP runs in
+numpy on the host — it needs microseconds of math per step, so there is
+no reason for native code here (the reference used C++ because it lived
+inside the C++ background thread).
+
+Usage::
+
+    pm = ParameterManager(knobs={"fusion_threshold": (1<<20, 1<<28)})
+    while training:
+        t0 = time.perf_counter(); steps(...); dt = time.perf_counter()-t0
+        suggestion = pm.record(samples=batch*k, seconds=dt)
+        if suggestion:   # re-build the train step with suggestion values
+            ...
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class GaussianProcess:
+    """Minimal GP regressor with RBF kernel (reference:
+    ``gaussian_process.cc``)."""
+
+    def __init__(self, length_scale: float = 1.0, noise: float = 1e-6,
+                 signal_variance: float = 1.0) -> None:
+        self.length_scale = length_scale
+        self.noise = noise
+        self.signal_variance = signal_variance
+        self._x: Optional[np.ndarray] = None
+        self._y: Optional[np.ndarray] = None
+        self._k_inv: Optional[np.ndarray] = None
+
+    def _kernel(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return self.signal_variance * np.exp(-0.5 * d2 / self.length_scale ** 2)
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> None:
+        self._x = np.atleast_2d(np.asarray(x, np.float64))
+        self._y = np.asarray(y, np.float64)
+        k = self._kernel(self._x, self._x)
+        k[np.diag_indices_from(k)] += self.noise
+        self._k_inv = np.linalg.inv(k)
+
+    def predict(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        x = np.atleast_2d(np.asarray(x, np.float64))
+        if self._x is None:
+            return (np.zeros(len(x)),
+                    np.full(len(x), math.sqrt(self.signal_variance)))
+        ks = self._kernel(x, self._x)
+        mean = ks @ self._k_inv @ self._y
+        kss = self.signal_variance
+        var = np.maximum(kss - np.einsum("ij,jk,ik->i", ks, self._k_inv, ks),
+                         1e-12)
+        return mean, np.sqrt(var)
+
+
+def expected_improvement(mean: np.ndarray, std: np.ndarray,
+                         best: float, xi: float = 0.01) -> np.ndarray:
+    """EI acquisition (reference: ``bayesian_optimization.cc``)."""
+    from math import erf, sqrt
+
+    z = (mean - best - xi) / std
+    cdf = 0.5 * (1.0 + np.vectorize(erf)(z / sqrt(2.0)))
+    pdf = np.exp(-0.5 * z ** 2) / math.sqrt(2 * math.pi)
+    return (mean - best - xi) * cdf + std * pdf
+
+
+class ParameterManager:
+    """Online knob tuner (reference: ``ParameterManager``).
+
+    Knobs are searched in log2 space over ``(low, high)`` ranges.
+    ``record(samples, seconds)`` aggregates scores; every
+    ``steps_per_sample`` records it proposes the next candidate (after
+    ``warmup_samples`` discarded).  When the candidate pool is
+    exhausted or scores converge, tuning freezes at the best point
+    (reference behavior).
+    """
+
+    def __init__(self, knobs: Dict[str, Tuple[float, float]],
+                 *, warmup_samples: int = 3, steps_per_sample: int = 10,
+                 max_samples: int = 20, candidates_per_round: int = 64,
+                 log_path: Optional[str] = None, seed: int = 0) -> None:
+        if not knobs:
+            raise ValueError("ParameterManager needs at least one knob")
+        self.knob_names = sorted(knobs)
+        self.bounds = np.array(
+            [[math.log2(knobs[k][0]), math.log2(knobs[k][1])]
+             for k in self.knob_names])
+        self.warmup_samples = warmup_samples
+        self.steps_per_sample = steps_per_sample
+        self.max_samples = max_samples
+        self.candidates_per_round = candidates_per_round
+        self._rng = np.random.RandomState(seed)
+        self._gp = GaussianProcess(length_scale=2.0)
+        self._x: List[np.ndarray] = []
+        self._y: List[float] = []
+        self._current = self.bounds.mean(axis=1)
+        self._records: List[float] = []
+        self._samples_seen = 0
+        self._frozen = False
+        self._log = open(log_path, "w") if log_path else None
+
+    # --- public API --------------------------------------------------------
+
+    @property
+    def frozen(self) -> bool:
+        return self._frozen
+
+    def current_values(self) -> Dict[str, float]:
+        return {k: float(2 ** v)
+                for k, v in zip(self.knob_names, self._current)}
+
+    def record(self, samples: float, seconds: float) -> Optional[Dict[str, float]]:
+        """Feed one timing observation.  Returns new knob values when the
+        manager wants the caller to reconfigure, else None."""
+        if self._frozen or seconds <= 0:
+            return None
+        self._records.append(samples / seconds)
+        if len(self._records) < self.steps_per_sample:
+            return None
+        score = float(np.median(self._records))
+        self._records = []
+        self._samples_seen += 1
+        if self._samples_seen <= self.warmup_samples:
+            return None  # discard warmup; keep current knobs
+        self._x.append(self._current.copy())
+        self._y.append(score)
+        self._log_sample(score)
+        if len(self._y) >= self.max_samples:
+            return self._freeze()
+        self._current = self._propose()
+        return self.current_values()
+
+    # --- internals ---------------------------------------------------------
+
+    def _propose(self) -> np.ndarray:
+        y = np.asarray(self._y)
+        # Normalize scores for GP conditioning.
+        y_n = (y - y.mean()) / (y.std() + 1e-9)
+        self._gp.fit(np.asarray(self._x), y_n)
+        cand = self._rng.uniform(self.bounds[:, 0], self.bounds[:, 1],
+                                 size=(self.candidates_per_round,
+                                       len(self.knob_names)))
+        mean, std = self._gp.predict(cand)
+        ei = expected_improvement(mean, std, float(y_n.max()))
+        return cand[int(np.argmax(ei))]
+
+    def _freeze(self) -> Dict[str, float]:
+        best = int(np.argmax(self._y))
+        self._current = self._x[best]
+        self._frozen = True
+        self._log_sample(self._y[best], note="frozen")
+        if self._log:
+            self._log.close()
+            self._log = None
+        return self.current_values()
+
+    def _log_sample(self, score: float, note: str = "") -> None:
+        if self._log:
+            self._log.write(json.dumps({
+                "knobs": self.current_values(), "score": score,
+                "note": note, "ts": time.time(),
+            }) + "\n")
+            self._log.flush()

@@ -1,0 +1,9 @@
+"""Launcher (reference: ``horovod/runner/`` — ``horovodrun`` CLI,
+SURVEY.md §2.5).  Entry points:
+
+* CLI: ``python -m horovod_tpu.runner -np 4 python train.py``
+* API: ``horovod_tpu.runner.run(np=4, command=[...])``
+"""
+
+from .launch import main, run, parse_args  # noqa: F401
+from .check_build import check_build_str  # noqa: F401

@@ -1,0 +1,61 @@
+"""``--check-build`` feature matrix (reference: ``horovodrun
+--check-build`` prints which frameworks/controllers/ops were compiled in
+— ``horovod/runner/launch.py``, SURVEY.md §2.7)."""
+
+from __future__ import annotations
+
+
+def check_build_str() -> str:
+    from ..version import __version__
+
+    try:
+        import jax
+
+        jax_line = f"jax {jax.__version__}"
+    except ImportError:  # pragma: no cover
+        jax_line = "jax MISSING"
+    try:
+        import optax
+
+        optax_line = f"optax {optax.__version__}"
+    except ImportError:
+        optax_line = "optax not installed (collectives-only mode)"
+    try:
+        import flax
+
+        flax_line = f"flax {flax.__version__}"
+    except ImportError:
+        flax_line = "flax not installed (no model zoo)"
+    try:
+        from ..native import planner
+
+        native_line = ("native planner built"
+                       if planner.available() else "native planner not built "
+                       "(pure-python fallback)")
+    except ImportError:
+        native_line = "native planner not built (pure-python fallback)"
+
+    lines = [
+        f"horovod_tpu v{__version__}",
+        "",
+        "Available frameworks:",
+        f"    [X] {jax_line}",
+        f"    [{'X' if 'not' not in optax_line else ' '}] {optax_line}",
+        f"    [{'X' if 'not' not in flax_line else ' '}] {flax_line}",
+        "",
+        "Available controllers:",
+        "    [X] jax.distributed (DCN coordination service)",
+        "    [ ] MPI (not applicable on TPU)",
+        "    [ ] Gloo (not applicable on TPU)",
+        "",
+        "Available tensor operations:",
+        "    [X] XLA collectives over ICI/DCN "
+        "(AllReduce/AllGather/AllToAll/ReduceScatter/CollectivePermute)",
+        f"    [{'X' if 'built' in native_line and 'not' not in native_line else ' '}] {native_line}",
+        "",
+        "Parallelism:",
+        "    [X] data parallel (+Adasum, elastic, process sets)",
+        "    [X] tensor parallel (Megatron column/row rules)",
+        "    [X] sequence/context parallel (ring attention, Ulysses)",
+    ]
+    return "\n".join(lines)

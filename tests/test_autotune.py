@@ -1,0 +1,100 @@
+"""Autotuner tests (reference pattern: parameter_manager behavior —
+warmup discard, GP proposal, freeze at best; SURVEY.md §2.1)."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.optim.parameter_manager import (
+    GaussianProcess, ParameterManager, expected_improvement,
+)
+
+
+class TestGaussianProcess:
+    def test_interpolates_observations(self):
+        gp = GaussianProcess(length_scale=1.0, noise=1e-8)
+        x = np.array([[0.0], [1.0], [2.0]])
+        y = np.array([0.0, 1.0, 0.0])
+        gp.fit(x, y)
+        mean, std = gp.predict(x)
+        np.testing.assert_allclose(mean, y, atol=1e-3)
+        assert (std < 0.05).all()
+
+    def test_uncertainty_grows_away_from_data(self):
+        gp = GaussianProcess()
+        gp.fit(np.array([[0.0]]), np.array([1.0]))
+        _, std_near = gp.predict(np.array([[0.1]]))
+        _, std_far = gp.predict(np.array([[5.0]]))
+        assert std_far > std_near
+
+    def test_prior_before_fit(self):
+        gp = GaussianProcess()
+        mean, std = gp.predict(np.array([[3.0]]))
+        assert mean[0] == 0.0 and std[0] > 0
+
+
+class TestExpectedImprovement:
+    def test_prefers_high_mean_when_std_equal(self):
+        ei = expected_improvement(np.array([0.0, 1.0]),
+                                  np.array([0.5, 0.5]), best=0.0)
+        assert ei[1] > ei[0]
+
+    def test_prefers_high_std_when_mean_equal(self):
+        ei = expected_improvement(np.array([0.0, 0.0]),
+                                  np.array([0.1, 1.0]), best=0.5)
+        assert ei[1] > ei[0]
+
+
+class TestParameterManager:
+    def _drive(self, pm, objective, rounds=400):
+        """Simulate training: per-step timing from a knob-dependent
+        throughput function."""
+        suggestions = 0
+        for _ in range(rounds):
+            if pm.frozen:
+                break
+            vals = pm.current_values()
+            rate = objective(vals)
+            out = pm.record(samples=rate, seconds=1.0)
+            if out is not None:
+                suggestions += 1
+        return suggestions
+
+    def test_warmup_then_tunes_and_freezes(self, tmp_path):
+        log = tmp_path / "autotune.jsonl"
+        pm = ParameterManager({"fusion_threshold": (2 ** 20, 2 ** 28)},
+                              warmup_samples=1, steps_per_sample=2,
+                              max_samples=6, log_path=str(log))
+        # Throughput peaks at 2^24.
+        peak = 24.0
+
+        def objective(vals):
+            import math
+
+            x = math.log2(vals["fusion_threshold"])
+            return 100.0 - (x - peak) ** 2
+
+        self._drive(pm, objective)
+        assert pm.frozen
+        final = pm.current_values()["fusion_threshold"]
+        # Froze at the best *sampled* point; must beat the midpoint start
+        # badly only if sampling found better — at minimum it's in range.
+        assert 2 ** 20 <= final <= 2 ** 28
+        lines = log.read_text().strip().splitlines()
+        assert len(lines) >= 2  # samples + frozen marker
+
+    def test_record_before_enough_steps_returns_none(self):
+        pm = ParameterManager({"k": (1, 1024)}, steps_per_sample=5)
+        for _ in range(4):
+            assert pm.record(10, 1.0) is None
+
+    def test_requires_knobs(self):
+        with pytest.raises(ValueError):
+            ParameterManager({})
+
+    def test_frozen_ignores_records(self):
+        pm = ParameterManager({"k": (1, 256)}, warmup_samples=0,
+                              steps_per_sample=1, max_samples=2)
+        pm.record(1, 1.0)
+        pm.record(2, 1.0)
+        assert pm.frozen
+        assert pm.record(3, 1.0) is None

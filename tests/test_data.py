@@ -1,0 +1,74 @@
+"""Data utility tests: padding/masking — the SPMD answer to hvd.join."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from horovod_tpu.data import ShardedBatchIterator, masked_mean, pad_batch
+
+
+class TestPadBatch:
+    def test_no_pad_needed(self):
+        x = np.arange(6).reshape(3, 2)
+        p, m = pad_batch(x, 3)
+        np.testing.assert_array_equal(p, x)
+        np.testing.assert_array_equal(m, [1, 1, 1])
+
+    def test_pads_tail(self):
+        x = np.ones((2, 3))
+        p, m = pad_batch(x, 4, pad_value=9)
+        assert p.shape == (4, 3)
+        np.testing.assert_array_equal(m, [1, 1, 0, 0])
+        assert (p[2:] == 9).all()
+
+    def test_oversize_raises(self):
+        with pytest.raises(ValueError):
+            pad_batch(np.ones((5, 1)), 4)
+
+
+class TestMaskedMean:
+    def test_ignores_padding(self):
+        vals = jnp.asarray([1.0, 2.0, 100.0, 100.0])
+        mask = jnp.asarray([1.0, 1.0, 0.0, 0.0])
+        assert float(masked_mean(vals, mask)) == pytest.approx(1.5)
+
+    def test_all_masked_is_finite(self):
+        vals = jnp.asarray([5.0, 5.0])
+        mask = jnp.zeros(2)
+        assert np.isfinite(float(masked_mean(vals, mask)))
+
+
+class TestShardedBatchIterator:
+    def test_covers_all_rows_with_padding(self):
+        x = np.arange(10)
+        it = ShardedBatchIterator(x, batch_size=4)
+        batches = list(it)
+        assert len(batches) == 3
+        (last,), last_mask = batches[-1]
+        assert last_mask.sum() == 2  # 10 = 4+4+2
+        seen = np.concatenate([xb[mask.astype(bool)]
+                               for (xb,), mask in batches])
+        assert sorted(seen) == list(range(10))
+
+    def test_rank_sharding_disjoint(self):
+        x = np.arange(12)
+        a = np.concatenate([xb[mask.astype(bool)]
+                            for (xb,), mask in ShardedBatchIterator(
+                                x, batch_size=2, rank=0, world=2)])
+        b = np.concatenate([xb[mask.astype(bool)]
+                            for (xb,), mask in ShardedBatchIterator(
+                                x, batch_size=2, rank=1, world=2)])
+        assert set(a).isdisjoint(b)
+        assert sorted(np.concatenate([a, b])) == list(range(12))
+
+    def test_equal_steps_across_ranks(self):
+        x = np.arange(13)  # odd count
+        it0 = ShardedBatchIterator(x, batch_size=4, rank=0, world=2)
+        it1 = ShardedBatchIterator(x, batch_size=4, rank=1, world=2)
+        assert len(it0) == len(it1) == 2  # 7 vs 6 rows -> both 2 steps
+        assert len(list(it0)) == len(list(it1))
+
+    def test_mismatched_arrays_raise(self):
+        with pytest.raises(ValueError):
+            ShardedBatchIterator(np.ones(3), np.ones(4), batch_size=2)

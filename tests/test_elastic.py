@@ -1,0 +1,191 @@
+"""Elastic state/driver/sampler tests (reference pattern:
+test/integration/test_elastic_torch.py with fake discovery scripts —
+SURVEY.md §4)."""
+
+import os
+import stat
+import textwrap
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.elastic import (
+    ElasticDriver, ElasticSampler, HorovodInternalError, ObjectState,
+    ScriptDiscovery, TpuState, run,
+)
+from horovod_tpu.elastic.driver import FixedDiscovery, hosts_updated_interrupt_callback
+from horovod_tpu.elastic.state import HostsUpdatedInterrupt
+
+
+class TestObjectState:
+    def test_commit_restore(self):
+        state = ObjectState(epoch=0, batch=0)
+        state.epoch = 5
+        state.commit()
+        state.epoch = 9
+        state.batch = 3
+        state.restore()
+        assert state.epoch == 5
+        assert state.batch == 0
+
+    def test_sync_single_process_is_identity(self):
+        state = ObjectState(epoch=2)
+        state.sync()
+        assert state.epoch == 2
+
+
+class TestTpuState:
+    def test_pytree_commit_restore(self):
+        params = {"w": jnp.ones((3,)), "b": jnp.zeros(())}
+        state = TpuState(params=params, epoch=0)
+        state.params = {"w": jnp.full((3,), 7.0), "b": jnp.ones(())}
+        state.epoch = 4
+        state.restore()
+        np.testing.assert_array_equal(np.asarray(state.params["w"]),
+                                      np.ones(3))
+        assert state.epoch == 0
+
+    def test_commit_updates_snapshot(self):
+        state = TpuState(params={"w": jnp.zeros((2,))})
+        state.params = {"w": jnp.ones((2,))}
+        state.commit()
+        state.params = {"w": jnp.full((2,), 9.0)}
+        state.restore()
+        np.testing.assert_array_equal(np.asarray(state.params["w"]),
+                                      np.ones(2))
+
+
+class TestRunDecorator:
+    def test_retries_on_internal_error(self):
+        state = ObjectState(step=0, completed=0)
+        calls = {"n": 0}
+
+        @run
+        def train(state):
+            calls["n"] += 1
+            state.step += 1
+            if calls["n"] < 3:
+                # uncommitted progress must roll back
+                raise HorovodInternalError("simulated collective failure")
+            state.commit()
+            return state.step
+
+        result = train(state)
+        assert calls["n"] == 3
+        assert result == 1  # step rolled back twice, incremented thrice → 1
+
+    def test_hosts_updated_interrupt_no_rollback(self):
+        state = ObjectState(progress=0)
+        calls = {"n": 0}
+
+        @run
+        def train(state):
+            calls["n"] += 1
+            state.progress += 10
+            state.commit()
+            if calls["n"] == 1:
+                raise HostsUpdatedInterrupt("resize")
+            return state.progress
+
+        assert train(state) == 20  # no rollback: both increments kept
+        assert calls["n"] == 2
+
+    def test_reset_limit(self, monkeypatch):
+        from horovod_tpu import basics
+
+        cfg = hvd.config()
+        object.__setattr__(cfg, "reset_limit", 2)
+        try:
+            state = ObjectState(x=0)
+
+            @run
+            def train(state):
+                raise HorovodInternalError("always fails")
+
+            with pytest.raises(RuntimeError, match="reset limit"):
+                train(state)
+        finally:
+            object.__setattr__(cfg, "reset_limit", 0)
+
+
+class TestElasticDriver:
+    def test_fixed_discovery_delta_callbacks(self):
+        disc = FixedDiscovery({"a": 4, "b": 4})
+        driver = ElasticDriver(disc, poll_interval_s=0.01)
+        events = []
+        driver.register_hosts_updated_callback(
+            lambda added, removed: events.append((sorted(added),
+                                                  sorted(removed))))
+        assert driver.poll_once()       # initial population
+        assert driver.world_size() == 8
+        disc.hosts["c"] = 4
+        del disc.hosts["a"]
+        assert driver.poll_once()
+        assert events[-1] == (["c"], ["a"])
+        assert driver.world_size() == 8
+
+    def test_blacklist(self):
+        disc = FixedDiscovery({"a": 1, "b": 1})
+        driver = ElasticDriver(disc, blacklist_after=2)
+        driver.poll_once()
+        driver.record_failure("b")
+        driver.record_failure("b")
+        assert driver.blacklisted("b")
+        driver.poll_once()
+        assert driver.hosts == {"a": 1}
+
+    def test_script_discovery(self, tmp_path):
+        script = tmp_path / "discover.sh"
+        script.write_text("#!/bin/sh\necho host1:4\necho host2:2\n")
+        script.chmod(script.stat().st_mode | stat.S_IEXEC)
+        disc = ScriptDiscovery(str(script))
+        assert disc.find_available_hosts_and_slots() == {"host1": 4,
+                                                         "host2": 2}
+
+    def test_wait_for_available_slots_timeout(self):
+        driver = ElasticDriver(FixedDiscovery({"a": 1}),
+                               poll_interval_s=0.01)
+        with pytest.raises(TimeoutError):
+            driver.wait_for_available_slots(5, timeout_s=0.1)
+
+    def test_interrupt_callback(self):
+        on_update, check = hosts_updated_interrupt_callback()
+        check()  # no-op before any update
+        on_update({"new"}, set())
+        with pytest.raises(HostsUpdatedInterrupt):
+            check()
+        check()  # flag cleared
+
+
+class TestElasticSampler:
+    def test_shards_and_resharding(self):
+        s = ElasticSampler(num_samples=100, batch_size=5, shuffle=False)
+        s.set_world(0, 2)
+        batches = list(s)
+        assert len(batches) == 10
+        seen = np.concatenate(batches)
+        assert set(seen) == set(range(0, 100, 2))
+
+    def test_no_replay_after_reshard(self):
+        s = ElasticSampler(num_samples=20, batch_size=2, shuffle=False)
+        s.set_world(0, 2)
+        it = iter(s)
+        first = next(it)
+        s.record_batch(first)
+        # world shrinks to 1; remaining excludes processed
+        saved = s.state_dict()
+        s2 = ElasticSampler(num_samples=20, batch_size=2, shuffle=False)
+        s2.load_state_dict(saved)
+        s2.set_world(0, 1)
+        rest = np.concatenate(list(s2)) if len(s2) else np.array([])
+        assert set(first).isdisjoint(set(rest))
+        assert set(first) | set(rest) == set(range(20))
+
+    def test_set_epoch_clears_processed(self):
+        s = ElasticSampler(num_samples=10, batch_size=2, shuffle=True, seed=1)
+        s.set_world(0, 1)
+        s.record_batch([0, 1, 2])
+        s.set_epoch(1)
+        assert len(np.concatenate(list(s))) == 10

@@ -192,3 +192,12 @@ class TestDistributedOptimizer:
     def test_invalid_backward_passes_raises(self):
         with pytest.raises(ValueError, match=">= 1"):
             DistributedOptimizer(optax.sgd(0.1), backward_passes_per_step=0)
+
+    def test_train_step_invalid_op_raises(self):
+        with pytest.raises(ValueError, match="Average/Sum/Adasum"):
+            make_train_step(loss_fn, optax.sgd(0.1), op=hvd.Min)
+
+    def test_adasum_with_compression_raises(self):
+        with pytest.raises(ValueError, match="not supported with op=Adasum"):
+            DistributedOptimizer(optax.sgd(0.1), op=hvd.Adasum,
+                                 compression=hvd.Compression.bf16)

@@ -1,0 +1,117 @@
+"""Launcher tests (reference pattern: test/single/test_run.py — arg
+parsing and launch mechanics as pure unit tests with real subprocesses
+on localhost; SURVEY.md §4)."""
+
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.runner import check_build_str, parse_args, run
+
+
+class TestParseArgs:
+    def test_defaults(self):
+        args = parse_args(["-np", "4", "python", "train.py"])
+        assert args.num_proc == 4
+        assert args.command == ["python", "train.py"]
+        assert not args.check_build
+
+    def test_check_build_flag(self):
+        assert parse_args(["--check-build"]).check_build
+
+    def test_elastic_args(self):
+        args = parse_args(["-np", "2", "--min-np", "1", "--max-np", "4",
+                           "--host-discovery-script", "./d.sh", "x"])
+        assert args.min_np == 1 and args.max_np == 4
+        assert args.host_discovery_script == "./d.sh"
+
+
+class TestCheckBuild:
+    def test_feature_matrix_contents(self):
+        out = check_build_str()
+        assert "horovod_tpu v" in out
+        assert "jax.distributed" in out
+        assert "XLA collectives" in out
+        assert "sequence/context parallel" in out
+
+    def test_cli_check_build(self):
+        res = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner", "--check-build"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert res.returncode == 0
+        assert "Available controllers" in res.stdout
+
+
+class TestLocalRun:
+    def test_single_process_success(self):
+        assert run(1, [sys.executable, "-c", "print('ok')"]) == 0
+
+    def test_failure_propagates(self):
+        assert run(1, [sys.executable, "-c", "raise SystemExit(3)"]) == 3
+
+    def test_env_contract(self, tmp_path):
+        """Workers receive the coordinator/rank env the init() consumes."""
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import os, sys\n"
+            "assert os.environ['HVD_TPU_NUM_PROCESSES'] == '2'\n"
+            "assert os.environ['HVD_TPU_PROCESS_ID'] in ('0', '1')\n"
+            "assert ':' in os.environ['HVD_TPU_COORDINATOR_ADDR']\n"
+        )
+        assert run(2, [sys.executable, str(script)]) == 0
+
+    def test_peer_failure_kills_job(self, tmp_path):
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import os, sys, time\n"
+            "if os.environ['HVD_TPU_PROCESS_ID'] == '0':\n"
+            "    sys.exit(7)\n"
+            "time.sleep(60)\n"   # must be terminated, not waited for
+        )
+        assert run(2, [sys.executable, str(script)]) == 7
+
+    def test_no_command_errors(self):
+        from horovod_tpu.runner.launch import main
+
+        assert main(["-np", "2"]) == 2
+
+    def test_remote_hosts_rejected(self):
+        from horovod_tpu.runner.launch import main
+
+        assert main(["-np", "2", "-H", "otherhost:8", "x"]) == 2
+
+
+@pytest.mark.slow
+class TestMultiProcessIntegration:
+    def test_two_process_allreduce(self, tmp_path):
+        """The reference CI pattern: the same pytest-style body under
+        ``horovodrun -np 2`` — here two real processes rendezvous over
+        jax.distributed (CPU backend) and allreduce."""
+        script = tmp_path / "worker.py"
+        script.write_text(
+            "import os\n"
+            "os.environ.pop('PALLAS_AXON_POOL_IPS', None)\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "import numpy as np\n"
+            "import horovod_tpu as hvd\n"
+            "hvd.init()\n"
+            "assert hvd.cross_size() == 2, hvd.cross_size()\n"
+            "x = np.full((hvd.local_size(), 4), hvd.cross_rank() + 1.0,\n"
+            "            np.float32)\n"
+            "out = np.asarray(hvd.allreduce(x, op=hvd.Sum))\n"
+            "# each process contributes local_size rows of (cross_rank+1)\n"
+            "expected = hvd.local_size() * (1.0 + 2.0)\n"
+            "assert np.allclose(out, expected), out\n"
+            "print('rank', hvd.cross_rank(), 'ok')\n"
+        )
+        import os
+
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = {"PYTHONPATH": repo_root + os.pathsep
+               + os.environ.get("PYTHONPATH", "")}
+        rc = run(2, [sys.executable, str(script)], start_timeout=180, env=env)
+        assert rc == 0

@@ -1,0 +1,113 @@
+"""Timeline + stall inspector tests (reference pattern:
+test/single/test_timeline.py parses the emitted JSON; test_stall.py —
+SURVEY.md §4)."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.utils.stall import StallInspector
+from horovod_tpu.utils.timeline import Timeline
+
+
+class TestTimeline:
+    def test_emits_valid_chrome_trace(self, tmp_path, world_size):
+        path = tmp_path / "timeline.json"
+        hvd.start_timeline(str(path))
+        x = np.ones((world_size, 4), np.float32)
+        hvd.allreduce(x, name="grad/layer0")
+        hvd.allgather(np.ones((world_size, 2, 2), np.float32), name="gather0")
+        hvd.stop_timeline()
+        events = json.load(open(path))
+        assert len(events) >= 3
+        phases = {e["name"] for e in events}
+        assert "ENQUEUE" in phases and "EXECUTE" in phases
+        tensors = {e["args"]["tensor"] for e in events if "args" in e}
+        assert "grad/layer0" in tensors and "gather0" in tensors
+        for e in events:
+            assert e["ph"] in ("X", "i")
+            assert "ts" in e and "pid" in e
+
+    def test_disabled_timeline_is_noop(self):
+        tl = Timeline(None)
+        assert not tl.enabled
+        with tl.activity("x", "EXECUTE"):
+            pass
+        tl.close()
+
+    def test_mark_cycles(self, tmp_path):
+        path = tmp_path / "t.json"
+        tl = Timeline(str(path), mark_cycles=True)
+        tl.mark_cycle()
+        tl.record("t", "EXECUTE", 0.0, 5.0)
+        tl.close()
+        events = json.load(open(path))
+        assert any(e["name"] == "CYCLE" and e["ph"] == "i" for e in events)
+
+
+@pytest.fixture
+def stall_records():
+    """The horovod_tpu logger doesn't propagate to root (so caplog can't
+    see it); attach a capturing handler directly."""
+    import logging
+
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    handler = _Capture()
+    logger = logging.getLogger("horovod_tpu.utils.stall")
+    logger.addHandler(handler)
+    yield records
+    logger.removeHandler(handler)
+
+
+class TestStallInspector:
+    def test_warns_on_idle(self, stall_records):
+        si = StallInspector(enabled=True, warn_after_s=0.05)
+        si.record_activity("step")
+        time.sleep(0.3)
+        # watchdog thread polls at warn_after_s/4
+        si.stop()
+        assert any("Potential stall" in r.getMessage()
+                   for r in stall_records)
+
+    def test_heartbeat_prevents_warning(self, stall_records):
+        si = StallInspector(enabled=True, warn_after_s=0.5)
+        for _ in range(5):
+            si.record_activity("step")
+            time.sleep(0.02)
+        si.stop()
+        assert not any("Potential stall" in r.getMessage()
+                       for r in stall_records)
+
+    def test_shutdown_hook_fires(self):
+        fired = []
+        si = StallInspector(enabled=True, warn_after_s=0.02,
+                            shutdown_after_s=0.05,
+                            on_shutdown=lambda: fired.append(1))
+        si.record_activity("step")
+        time.sleep(0.4)
+        si.stop()
+        assert fired
+
+    def test_pause_disarms(self, stall_records):
+        si = StallInspector(enabled=True, warn_after_s=0.05)
+        si.record_activity("step")
+        with si.pause():
+            time.sleep(0.3)
+        si.stop()
+        assert not any("Potential stall" in r.getMessage()
+                       for r in stall_records)
+
+    def test_disabled_never_warns(self, stall_records):
+        si = StallInspector(enabled=False, warn_after_s=0.01)
+        si.record_activity("step")
+        time.sleep(0.1)
+        si.stop()
+        assert not stall_records
