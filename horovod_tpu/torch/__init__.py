@@ -1,0 +1,76 @@
+"""``import horovod_tpu.torch as hvd`` — the torch binding.
+
+Reference: ``horovod/torch/__init__.py`` (path per SURVEY.md §2.4, mount
+empty, unverified).  A torch *worker* is one controller process: torch
+runs the model on host CPU while collectives ride the framework's XLA
+path over the TPU mesh (see :mod:`.mpi_ops` for the slot mapping).
+
+Canonical usage, identical to the reference::
+
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..basics import (  # noqa: F401
+    init, shutdown, is_initialized, is_homogeneous,
+    local_rank, local_size,
+    mpi_built, nccl_built, gloo_built, ccl_built, cuda_built, rocm_built,
+    xla_built, mpi_threads_supported,
+    NotInitializedError,
+)
+from .. import basics as _basics
+from ..process_sets import (  # noqa: F401
+    ProcessSet, add_process_set, remove_process_set, global_process_set,
+)
+from .mpi_ops import (  # noqa: F401
+    Average, Sum, Adasum, Min, Max, Product,
+    allreduce, allreduce_, allreduce_async, allreduce_async_,
+    grouped_allreduce, grouped_allreduce_, grouped_allreduce_async,
+    grouped_allreduce_async_,
+    allgather, allgather_async, grouped_allgather,
+    broadcast, broadcast_, broadcast_async, broadcast_async_,
+    alltoall, reducescatter,
+    barrier, join, synchronize, poll, Handle,
+)
+from .compression import Compression  # noqa: F401
+from .functions import (  # noqa: F401
+    broadcast_object, allgather_object, broadcast_parameters,
+    broadcast_optimizer_state,
+)
+from .optimizer import DistributedOptimizer  # noqa: F401
+from .sync_batch_norm import SyncBatchNorm  # noqa: F401
+from ..elastic.sampler import ElasticSampler  # noqa: F401
+
+
+def rank() -> int:
+    """This torch worker's rank == the controller-process index
+    (reference: ``hvd.rank()``; design note: one process may drive many
+    TPU chips, so worker rank is process-, not chip-, granular)."""
+    _basics._require_init()
+    return jax.process_index()
+
+
+def size() -> int:
+    """Number of torch workers == controller processes (reference:
+    ``hvd.size()``)."""
+    _basics._require_init()
+    return jax.process_count()
+
+
+def cross_rank() -> int:
+    """Reference: ``hvd.cross_rank()`` (node index)."""
+    return _basics.cross_rank()
+
+
+def cross_size() -> int:
+    """Reference: ``hvd.cross_size()``."""
+    return _basics.cross_size()

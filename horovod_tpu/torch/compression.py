@@ -1,0 +1,63 @@
+"""Gradient compression for the torch binding.
+
+Reference: ``horovod/torch/compression.py`` (path per SURVEY.md §2.4,
+mount empty, unverified) — ``Compression.none`` / ``Compression.fp16``
+compressors applied to gradients before they hit the wire, decompressed
+after the collective.
+
+Here "the wire" is the host→TPU transfer plus the ICI collective, so
+fp16 compression halves both; the reduction itself runs in fp16 exactly
+like the reference's ``--fp16-allreduce`` path.
+"""
+
+from __future__ import annotations
+
+import torch
+
+
+class Compressor:
+    """Interface: ``compress(tensor) -> (tensor, ctx)``; ``decompress``."""
+
+    @staticmethod
+    def compress(tensor: "torch.Tensor"):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor: "torch.Tensor", ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """Identity (reference: ``Compression.none``)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Cast floating tensors to fp16 for transport (reference:
+    ``Compression.fp16``)."""
+
+    @staticmethod
+    def compress(tensor):
+        if tensor.dtype.is_floating_point:
+            return tensor.to(torch.float16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is not None:
+            return tensor.to(ctx)
+        return tensor
+
+
+class Compression:
+    """Namespace mirroring ``hvd.Compression``."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor

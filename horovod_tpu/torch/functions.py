@@ -1,0 +1,108 @@
+"""State broadcast helpers for torch models.
+
+Reference: ``horovod/torch/functions.py`` (path per SURVEY.md §2.4, mount
+empty, unverified) — ``broadcast_parameters(model.state_dict(), 0)`` and
+``broadcast_optimizer_state(optimizer, 0)`` make every worker start from
+the root's state; non-tensor optimizer scalars ride a pickled
+``broadcast_object``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, List
+
+import torch
+
+from . import mpi_ops
+from ..functions import broadcast_object as _broadcast_object
+from ..functions import allgather_object as _allgather_object
+
+
+def broadcast_object(obj: Any, root_rank: int = 0, name: str = "") -> Any:
+    """Reference: ``hvd.broadcast_object`` (pickle → bytes broadcast →
+    unpickle)."""
+    return _broadcast_object(obj, root_rank=root_rank, name=name)
+
+
+def allgather_object(obj: Any, name: str = "") -> List[Any]:
+    """Reference: ``hvd.allgather_object``."""
+    return _allgather_object(obj, name=name)
+
+
+def _named_tensors(params) -> Iterable:
+    if isinstance(params, dict):
+        return sorted(params.items())
+    params = list(params)
+    if params and not isinstance(params[0], tuple):
+        raise ValueError(
+            "broadcast_parameters expects a state_dict or a sequence of "
+            "(name, tensor) tuples (e.g. model.named_parameters())")
+    return params
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """Reference: ``hvd.broadcast_parameters(model.state_dict(), 0)`` —
+    in-place broadcast of every tensor; all asyncs enqueued first, then
+    synchronized (the reference's exact dispatch pattern)."""
+    handles = []
+    for name, p in _named_tensors(params):
+        if isinstance(p, torch.Tensor):
+            if p.dtype == torch.bool:
+                # Transport bools as uint8 (no boolean collectives in XLA
+                # reductions); exact round-trip.
+                got = mpi_ops.broadcast(p.to(torch.uint8), root_rank,
+                                        name=f"broadcast.{name}")
+                p.copy_(got.to(torch.bool))
+                continue
+            handles.append(mpi_ops.broadcast_async_(
+                p.data, root_rank, name=f"broadcast.{name}"))
+    for h in handles:
+        mpi_ops.synchronize(h)
+
+
+def broadcast_optimizer_state(optimizer: "torch.optim.Optimizer",
+                              root_rank: int = 0) -> None:
+    """Reference: ``hvd.broadcast_optimizer_state(optimizer, 0)`` —
+    tensors broadcast in place; scalar state (step counters, lrs,
+    momentum flags…) broadcast as one pickled object and loaded back."""
+    if isinstance(optimizer, torch.optim.LBFGS):
+        raise ValueError("cannot broadcast torch.optim.LBFGS state")
+
+    state_dict = optimizer.state_dict()
+
+    # Some optimizers are lazy: no state until the first step().  Run the
+    # same "identity step" trick as the reference so every worker has a
+    # fully-populated, broadcastable state.
+    if not state_dict.get("state"):
+        for group in optimizer.param_groups:
+            for p in group["params"]:
+                if p.requires_grad and p.grad is None:
+                    p.grad = torch.zeros_like(p)
+        # A zero-lr step materializes state without moving parameters.
+        saved = [g.get("lr") for g in optimizer.param_groups]
+        for g in optimizer.param_groups:
+            g["lr"] = 0.0
+        optimizer.step()
+        for g, lr in zip(optimizer.param_groups, saved):
+            g["lr"] = lr
+        state_dict = optimizer.state_dict()
+
+    tensors = []
+    scalars: dict = {"param_groups": state_dict["param_groups"], "state": {}}
+    for pid, pstate in state_dict["state"].items():
+        scalars["state"][pid] = {}
+        for key, value in pstate.items():
+            if isinstance(value, torch.Tensor) and value.numel() > 0:
+                tensors.append((f"opt.{pid}.{key}", value))
+            else:
+                scalars["state"][pid][key] = value
+
+    broadcast_parameters(tensors, root_rank)
+    scalars = broadcast_object(scalars, root_rank)
+
+    for pid, pstate in state_dict["state"].items():
+        for key, value in scalars["state"].get(pid, {}).items():
+            if not isinstance(value, torch.Tensor):
+                pstate[key] = value
+    state_dict["param_groups"] = scalars["param_groups"]
+    optimizer.load_state_dict(state_dict)
