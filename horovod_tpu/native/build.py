@@ -1,0 +1,59 @@
+"""Build driver for the native runtime library.
+
+Reference analogue: the CMake/setup.py machinery that produces
+``libhorovod`` once per framework ABI (SURVEY.md §2.7, mount empty,
+unverified).  Here the library has a plain C ABI with zero third-party
+dependencies, so the whole build is one ``g++`` invocation, executed
+lazily and cached by source mtime; ``python -m horovod_tpu.native.build``
+forces a rebuild (the packaging hook calls this at wheel build time).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import subprocess
+from typing import List, Optional
+
+from ..utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+SRC_DIR = os.path.join(_HERE, "src")
+SO_PATH = os.path.join(_HERE, "libhvdtpu_native.so")
+
+
+def sources() -> List[str]:
+    return sorted(glob.glob(os.path.join(SRC_DIR, "*.cc")))
+
+
+def needs_build() -> bool:
+    if not os.path.exists(SO_PATH):
+        return True
+    so_mtime = os.path.getmtime(SO_PATH)
+    deps = sources() + glob.glob(os.path.join(SRC_DIR, "*.h"))
+    return any(os.path.getmtime(p) > so_mtime for p in deps)
+
+
+def build(verbose: bool = False) -> Optional[str]:
+    """Compile the library; returns the .so path or None on failure."""
+    cmd = ["g++", "-O2", "-std=c++14", "-shared", "-fPIC",
+           *sources(), "-o", SO_PATH, "-lpthread"]
+    try:
+        proc = subprocess.run(cmd, check=True, capture_output=True,
+                              timeout=300)
+        if verbose and proc.stderr:
+            logger.info("native build stderr: %s", proc.stderr.decode())
+        return SO_PATH
+    except (subprocess.SubprocessError, FileNotFoundError, OSError) as e:
+        err = getattr(e, "stderr", b"") or b""
+        logger.info("Native build failed (%s) %s; python fallbacks active",
+                    e, err.decode(errors="replace")[:500])
+        return None
+
+
+if __name__ == "__main__":
+    path = build(verbose=True)
+    print(path or "BUILD FAILED")
+    raise SystemExit(0 if path else 1)

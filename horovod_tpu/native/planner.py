@@ -1,86 +1,26 @@
-"""ctypes binding for the native fusion planner (see ``planner.cc``).
+"""ctypes binding for the native fusion planner (see ``src/planner.cc``).
 
-Pybind11 isn't in the image, so bindings use ctypes over a plain C ABI —
-no Python.h dependency, trivially cacheable .so.
+Part of the native control-plane runtime (``bindings.py`` owns the
+build/load of the shared library; this module keeps the original
+planner API used by ``ops/fusion.py``).
 """
 
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
-import threading
-from typing import List, Optional, Sequence
+from typing import List, Sequence
 
-from ..utils.logging import get_logger
-
-logger = get_logger(__name__)
-
-_HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "planner.cc")
-_SO = os.path.join(_HERE, "libhvdtpu_native.so")
-
-_lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_build_failed = False
-
-
-def _build() -> bool:
-    try:
-        subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", _SRC, "-o", _SO],
-            check=True, capture_output=True, timeout=120,
-        )
-        return True
-    except (subprocess.SubprocessError, FileNotFoundError, OSError) as e:
-        logger.info("Native planner build failed (%s); using python "
-                    "fallback", e)
-        return False
-
-
-def _load() -> Optional[ctypes.CDLL]:
-    global _lib, _build_failed
-    with _lock:
-        if _lib is not None:
-            return _lib
-        if _build_failed:
-            return None
-        needs_build = (
-            not os.path.exists(_SO)
-            or (os.path.exists(_SRC)
-                and os.path.getmtime(_SRC) > os.path.getmtime(_SO))
-        )
-        if needs_build and not _build():
-            _build_failed = True
-            return None
-        try:
-            lib = ctypes.CDLL(_SO)
-            lib.hvd_tpu_plan_buckets.restype = ctypes.c_int64
-            lib.hvd_tpu_plan_buckets.argtypes = [
-                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
-                ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
-            ]
-            lib.hvd_tpu_native_abi_version.restype = ctypes.c_int64
-            lib.hvd_tpu_native_abi_version.argtypes = []
-            if lib.hvd_tpu_native_abi_version() != 1:
-                raise OSError("ABI version mismatch")
-            _lib = lib
-            return _lib
-        except OSError as e:
-            logger.info("Native planner load failed (%s); using python "
-                        "fallback", e)
-            _build_failed = True
-            return None
+from . import bindings
 
 
 def available() -> bool:
-    return _load() is not None
+    return bindings.available()
 
 
 def plan_buckets(sizes_bytes: Sequence[int], threshold: int) -> List[List[int]]:
     """Same contract as ``ops.fusion.plan_buckets_py`` (equivalence is
-    property-tested)."""
-    lib = _load()
+    property-tested in tests/test_native.py)."""
+    lib = bindings.load()
     if lib is None:
         from ..ops.fusion import plan_buckets_py
 

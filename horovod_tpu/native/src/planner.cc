@@ -46,7 +46,4 @@ int64_t hvd_tpu_plan_buckets(const int64_t* sizes_bytes, int64_t n,
   return n == 0 ? 0 : bucket + 1;
 }
 
-// Version tag so Python can verify ABI expectations.
-int64_t hvd_tpu_native_abi_version() { return 1; }
-
 }  // extern "C"

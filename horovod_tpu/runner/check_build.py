@@ -27,13 +27,15 @@ def check_build_str() -> str:
     except ImportError:
         flax_line = "flax not installed (no model zoo)"
     try:
-        from ..native import planner
+        from .. import native
 
-        native_line = ("native planner built"
-                       if planner.available() else "native planner not built "
-                       "(pure-python fallback)")
+        native_ok = native.available()
     except ImportError:
-        native_line = "native planner not built (pure-python fallback)"
+        native_ok = False
+    native_line = (
+        "native runtime built (controller, coordinator, fusion planner, "
+        "response cache, group table, stall inspector, timeline writer)"
+        if native_ok else "native runtime not built (pure-python fallbacks)")
 
     lines = [
         f"horovod_tpu v{__version__}",
@@ -45,6 +47,8 @@ def check_build_str() -> str:
         "",
         "Available controllers:",
         "    [X] jax.distributed (DCN coordination service)",
+        f"    [{'X' if native_ok else ' '}] native TCP coordinator "
+        "(eager multi-process negotiation)",
         "    [ ] MPI (not applicable on TPU)",
         "    [ ] Gloo (not applicable on TPU)",
         "",

@@ -33,22 +33,39 @@ class Timeline:
     (complete, with ``dur``) events per phase, ``i`` (instant) for cycle
     marks — matching what the reference emits closely enough that the same
     tooling renders both.
+
+    Backend: prefers the native background-thread writer
+    (``native/src/timeline.cc`` — the reference's writer-thread design),
+    falling back to inline Python writes when the native library is
+    unavailable.
     """
 
-    def __init__(self, path: Optional[str], mark_cycles: bool = False) -> None:
+    def __init__(self, path: Optional[str], mark_cycles: bool = False,
+                 use_native: bool = True) -> None:
         self._path = path
         self._mark_cycles = mark_cycles
         self._lock = threading.Lock()
         self._file = None
+        self._native = None
         self._first = True
         self._t0 = time.perf_counter_ns()
         if path:
-            self._file = open(path, "w", buffering=1)
-            self._file.write("[\n")
+            if use_native:
+                try:
+                    from ..native import runtime as _nrt
+
+                    if _nrt.available():
+                        self._native = _nrt.NativeTimeline(
+                            path, mark_cycles=mark_cycles)
+                except Exception:
+                    self._native = None
+            if self._native is None:
+                self._file = open(path, "w", buffering=1)
+                self._file.write("[\n")
 
     @property
     def enabled(self) -> bool:
-        return self._file is not None
+        return self._file is not None or self._native is not None
 
     def _now_us(self) -> float:
         return (time.perf_counter_ns() - self._t0) / 1e3
@@ -66,6 +83,12 @@ class Timeline:
     def record(self, name: str, phase: str, start_us: float, dur_us: float,
                args: Optional[dict] = None) -> None:
         """One complete event: e.g. tensor 'grad/kernel0', phase EXECUTE."""
+        native = self._native  # snapshot: close() may null it concurrently
+        if native is not None:
+            body = ", ".join(f"{json.dumps(str(k))}: {json.dumps(v)}"
+                             for k, v in (args or {}).items())
+            native.record(name, phase, start_us, dur_us, body)
+            return
         self._emit({
             "name": phase, "cat": "collective", "ph": "X",
             "ts": start_us, "dur": dur_us,
@@ -76,16 +99,21 @@ class Timeline:
     def mark_cycle(self) -> None:
         """Instant marker per dispatch cycle (reference:
         ``HOROVOD_TIMELINE_MARK_CYCLES``)."""
-        if self._mark_cycles:
-            self._emit({
-                "name": "CYCLE", "cat": "cycle", "ph": "i",
-                "ts": self._now_us(), "pid": os.getpid(), "tid": 0, "s": "p",
-            })
+        if not self._mark_cycles:
+            return
+        native = self._native
+        if native is not None:
+            native.mark_cycle(self._now_us())
+            return
+        self._emit({
+            "name": "CYCLE", "cat": "cycle", "ph": "i",
+            "ts": self._now_us(), "pid": os.getpid(), "tid": 0, "s": "p",
+        })
 
     @contextlib.contextmanager
     def activity(self, name: str, phase: str, args: Optional[dict] = None):
         """Context manager timing one phase of one named tensor/op."""
-        if self._file is None:
+        if not self.enabled:
             yield
             return
         start = self._now_us()
@@ -96,6 +124,9 @@ class Timeline:
 
     def close(self) -> None:
         with self._lock:
+            if self._native is not None:
+                self._native.close()
+                self._native = None
             if self._file is not None:
                 self._file.write("\n]\n")
                 self._file.close()

@@ -1,0 +1,391 @@
+"""Native control-plane runtime tests: wire codec, controller
+(consensus/fusion/cache/groups), TCP coordinator, stall inspector,
+timeline writer.
+
+Test model follows the reference's pattern for the C++ core — coverage
+through the (here: ctypes) binding with property tests against a Python
+model (SURVEY.md §4).
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu import native
+from horovod_tpu.native.runtime import (
+    Request, Response, encode_requests, decode_requests,
+    encode_responses, decode_responses,
+    wire_requests_roundtrip_native, wire_responses_roundtrip_native,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(),
+    reason="native toolchain unavailable; python fallbacks cover behavior",
+)
+
+
+def _mk_req(rank, name, op="allreduce", dtype="float32", size=64,
+            root=-1, group=-1):
+    return Request(rank=rank, name=name, op=op, dtype=dtype,
+                   size_bytes=size, root_rank=root, group_id=group)
+
+
+class TestWireCodec:
+    def test_request_python_roundtrip(self):
+        reqs = [
+            _mk_req(0, "grad/layer0/kernel", size=4096),
+            _mk_req(3, "π-name-ünïcode", op="broadcast", dtype="bfloat16",
+                    root=2),
+            _mk_req(1, "", op="barrier", size=0),
+        ]
+        assert decode_requests(encode_requests(reqs)) == reqs
+
+    def test_response_python_roundtrip(self):
+        resps = [
+            Response(op="allreduce", dtype="float32", total_bytes=128,
+                     root_rank=-1, names=("a", "b", "c")),
+            Response(op="broadcast", dtype="int64", total_bytes=8,
+                     root_rank=0, names=("x",)),
+        ]
+        assert decode_responses(encode_responses(resps)) == resps
+
+    def test_python_and_cpp_codecs_byte_compatible(self):
+        """Python-encoded bytes, fed through the C++ decode→encode pair,
+        must come back byte-identical — the two codecs implement one
+        format."""
+        rng = np.random.RandomState(7)
+        for _ in range(20):
+            reqs = [
+                _mk_req(int(rng.randint(0, 8)), f"t{i}-{rng.randint(99)}",
+                        op=["allreduce", "allgather", "broadcast",
+                            "alltoall", "reducescatter", "adasum"][
+                                int(rng.randint(6))],
+                        dtype=["float32", "bfloat16", "int32", "bool"][
+                            int(rng.randint(4))],
+                        size=int(rng.randint(0, 1 << 20)),
+                        root=int(rng.randint(-1, 4)),
+                        group=int(rng.randint(-1, 3)))
+                for i in range(int(rng.randint(0, 12)))
+            ]
+            data = encode_requests(reqs)
+            assert wire_requests_roundtrip_native(data) == data
+
+        resps = [Response(op="allreduce", dtype="float16", total_bytes=12,
+                          root_rank=-1, names=("a", "bb", "ccc"))]
+        data = encode_responses(resps)
+        assert wire_responses_roundtrip_native(data) == data
+
+    def test_malformed_rejected(self):
+        with pytest.raises(Exception):
+            decode_responses(b"\x07\x00\x00\x00\x00")  # bad version
+        assert native.runtime._lib().hvd_wire_requests_roundtrip(
+            (__import__("ctypes").c_uint8 * 3)(1, 2, 3), 3, None, 0) == -1
+
+
+class TestController:
+    def test_not_ready_until_all_ranks(self):
+        c = native.Controller(world_size=3, fusion_threshold=1 << 20)
+        c.submit(_mk_req(0, "g0"))
+        c.submit(_mk_req(1, "g0"))
+        assert c.compute_response_list() == []
+        c.submit(_mk_req(2, "g0"))
+        (resp,) = c.compute_response_list()
+        assert resp.names == ("g0",)
+        # consumed: next compute is empty
+        assert c.compute_response_list() == []
+
+    def test_fusion_under_threshold_and_order(self):
+        c = native.Controller(world_size=2, fusion_threshold=100)
+        for name, size in [("a", 40), ("b", 40), ("c", 40), ("d", 200)]:
+            c.submit(_mk_req(0, name, size=size))
+            c.submit(_mk_req(1, name, size=size))
+        resps = c.compute_response_list()
+        assert [r.names for r in resps] == [("a", "b"), ("c",), ("d",)]
+        assert resps[0].total_bytes == 80
+
+    def test_fusion_respects_dtype_and_op_class(self):
+        c = native.Controller(world_size=1, fusion_threshold=1 << 20)
+        c.submit(_mk_req(0, "f32", dtype="float32"))
+        c.submit(_mk_req(0, "bf16", dtype="bfloat16"))
+        c.submit(_mk_req(0, "gather", op="allgather"))
+        c.submit(_mk_req(0, "bcast", op="broadcast", root=0))
+        resps = c.compute_response_list()
+        assert [r.names for r in resps] == [
+            ("f32",), ("bf16",), ("gather",), ("bcast",)]
+
+    def test_ready_order_is_completion_order(self):
+        """Tensors are emitted in the order they became fully ready, not
+        first-submission order — deterministic across ranks."""
+        c = native.Controller(world_size=2, fusion_threshold=0)
+        c.submit(_mk_req(0, "x"))
+        c.submit(_mk_req(0, "y"))
+        c.submit(_mk_req(1, "y"))  # y ready first
+        c.submit(_mk_req(1, "x"))
+        resps = c.compute_response_list()
+        assert [r.names for r in resps] == [("y",), ("x",)]
+
+    def test_metadata_mismatch_raises(self):
+        c = native.Controller(world_size=2, fusion_threshold=1 << 20)
+        c.submit(_mk_req(0, "g", dtype="float32"))
+        with pytest.raises(ValueError, match="Mismatched collective"):
+            c.submit(_mk_req(1, "g", dtype="bfloat16"))
+
+    def test_response_cache_hits_on_steady_state(self):
+        c = native.Controller(world_size=2, fusion_threshold=1 << 20)
+        for step in range(5):
+            for name in ("g0", "g1", "g2"):
+                c.submit(_mk_req(0, name))
+                c.submit(_mk_req(1, name))
+            resps = c.compute_response_list()
+            assert [r.names for r in resps] == [("g0", "g1", "g2")]
+        hits, misses = c.cache_stats()
+        assert misses == 1 and hits == 4
+
+    def test_group_atomicity(self):
+        c = native.Controller(world_size=2, fusion_threshold=0)
+        gid = c.register_group(["ga", "gb"])
+        assert gid >= 0
+        c.submit(_mk_req(0, "ga"))
+        c.submit(_mk_req(1, "ga"))
+        c.submit(_mk_req(0, "solo"))
+        c.submit(_mk_req(1, "solo"))
+        resps = c.compute_response_list()
+        # ga ready but group incomplete -> only solo emitted
+        assert [r.names for r in resps] == [("solo",)]
+        c.submit(_mk_req(0, "gb"))
+        c.submit(_mk_req(1, "gb"))
+        resps = c.compute_response_list()
+        # whole group as ONE response despite threshold 0 (atomic fusion)
+        assert [sorted(r.names) for r in resps] == [["ga", "gb"]]
+
+    def test_pending_partial_reports_missing_ranks(self):
+        c = native.Controller(world_size=4, fusion_threshold=1 << 20)
+        c.submit(_mk_req(0, "slow"))
+        c.submit(_mk_req(2, "slow"))
+        ((name, missing),) = c.pending_partial()
+        assert name == "slow" and missing == [1, 3]
+
+    def test_out_of_range_rank_rejected(self):
+        c = native.Controller(world_size=3, fusion_threshold=1 << 20)
+        with pytest.raises(ValueError, match="outside world size"):
+            c.submit(_mk_req(7, "g"))
+        with pytest.raises(ValueError, match="outside world size"):
+            c.submit(_mk_req(-1, "g"))
+
+    def test_unregistered_group_id_treated_as_ungrouped(self):
+        """A group_id never registered must not wedge the tensor
+        (silent permanent hang); it degrades to ungrouped."""
+        c = native.Controller(world_size=1, fusion_threshold=1 << 20)
+        c.submit(_mk_req(0, "g", group=42))
+        (resp,) = c.compute_response_list()
+        assert resp.names == ("g",)
+
+    def test_group_registration_invalidates_cached_plan(self):
+        """The same ready set must re-plan after its tensors join a
+        registered group (atomicity overrides the cached split plan)."""
+        c = native.Controller(world_size=1, fusion_threshold=0)
+        for name in ("ga", "gb"):
+            c.submit(_mk_req(0, name, size=10))
+        resps = c.compute_response_list()
+        assert [r.names for r in resps] == [("ga",), ("gb",)]  # split
+        c.register_group(["ga", "gb"])
+        for name in ("ga", "gb"):
+            c.submit(_mk_req(0, name, size=10))
+        resps = c.compute_response_list()
+        assert [sorted(r.names) for r in resps] == [["ga", "gb"]]  # atomic
+
+    def test_large_response_list_survives_buffer_growth(self):
+        """>64KB of encoded responses must come back complete — the
+        compute side effect may not be lost to the grow-and-retry."""
+        c = native.Controller(world_size=1, fusion_threshold=0)
+        names = [f"tensor/{'x' * 60}/{i}" for i in range(2000)]
+        for n in names:
+            c.submit(_mk_req(0, n))
+        resps = c.compute_response_list()
+        assert [r.names[0] for r in resps] == names
+        # and the table was consumed exactly once
+        assert c.compute_response_list() == []
+
+    def test_awkward_names_in_reports(self):
+        c = native.Controller(world_size=2, fusion_threshold=1 << 20)
+        weird = 'enc|dec/"kernel"\nrow'
+        c.submit(_mk_req(0, weird))
+        ((name, missing),) = c.pending_partial()
+        assert name == weird and missing == [1]
+
+
+class TestCoordinator:
+    def _run_world(self, world_size, worker_fn):
+        """Spawn world_size coordinator members on threads; returns
+        per-rank results."""
+        port_box = {}
+        ready = threading.Event()
+        results = [None] * world_size
+        errors = []
+
+        def runner(rank):
+            try:
+                if rank == 0:
+                    coord = native.Coordinator(0, world_size, port=0,
+                                               timeout_s=30.0)
+                    port_box["port"] = coord.bound_port
+                    ready.set()
+                else:
+                    ready.wait(30.0)
+                    coord = native.Coordinator(rank, world_size,
+                                               port=port_box["port"],
+                                               timeout_s=30.0)
+                try:
+                    results[rank] = worker_fn(rank, coord)
+                finally:
+                    coord.shutdown()
+                    coord.close()
+            except Exception as e:  # pragma: no cover
+                errors.append((rank, e))
+
+        threads = [threading.Thread(target=runner, args=(r,))
+                   for r in range(world_size)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60.0)
+        assert not errors, errors
+        return results
+
+    def test_negotiate_three_ranks(self):
+        def worker(rank, coord):
+            out = []
+            # cycle 1: ranks 0,1 submit g0; not globally ready
+            reqs = [_mk_req(rank, "g0")] if rank < 2 else []
+            out.append(coord.negotiate(reqs))
+            # cycle 2: rank 2 submits; now ready
+            reqs = [_mk_req(rank, "g0")] if rank == 2 else []
+            out.append(coord.negotiate(reqs))
+            return out
+
+        results = self._run_world(3, worker)
+        for res in results:
+            assert res[0] == []
+            assert [r.names for r in res[1]] == [("g0",)]
+        # all ranks saw identical decisions
+        assert results[0] == results[1] == results[2]
+
+    def test_fusion_across_processes_and_cache(self):
+        def worker(rank, coord):
+            seen = []
+            for step in range(4):
+                reqs = [_mk_req(rank, f"grad{i}", size=100)
+                        for i in range(3)]
+                seen.append(coord.negotiate(reqs))
+            return seen
+
+        results = self._run_world(2, worker)
+        for res in results:
+            for step_resps in res:
+                assert [r.names for r in step_resps] == \
+                    [("grad0", "grad1", "grad2")]
+
+    def test_barrier(self):
+        order = []
+
+        def worker(rank, coord):
+            if rank == 1:
+                time.sleep(0.3)
+            order.append(("before", rank))
+            coord.barrier()
+            order.append(("after", rank))
+            return True
+
+        self._run_world(2, worker)
+        phases = [p for p, _ in order]
+        assert phases[:2] == ["before", "before"]
+        assert phases[2:] == ["after", "after"]
+
+    def test_metadata_mismatch_fails_job(self):
+        def worker(rank, coord):
+            dtype = "float32" if rank == 0 else "bfloat16"
+            try:
+                coord.negotiate([_mk_req(rank, "g", dtype=dtype)])
+                return "ok"
+            except RuntimeError:
+                return "error"
+
+        results = self._run_world(2, worker)
+        # rank 0 (coordinator) detects the mismatch; worker sees failure
+        assert "error" in results
+
+
+class TestNativeStallInspector:
+    def test_reports_missing_ranks_after_threshold(self):
+        si = native.NativeStallInspector(world_size=3, warn_after_s=1.0)
+        si.submit("g", 0, now_s=100.0)
+        si.submit("g", 2, now_s=100.2)
+        assert si.report(now_s=100.5) == []  # under threshold
+        ((name, age, missing),) = si.report(now_s=102.0)
+        assert name == "g" and missing == [1] and age == pytest.approx(2.0)
+
+    def test_complete_clears(self):
+        si = native.NativeStallInspector(world_size=2, warn_after_s=0.1)
+        si.submit("g", 0, now_s=0.0)
+        si.complete("g")
+        assert si.report(now_s=10.0) == []
+
+    def test_fully_submitted_not_stalled(self):
+        si = native.NativeStallInspector(world_size=2, warn_after_s=0.1)
+        si.submit("g", 0, now_s=0.0)
+        si.submit("g", 1, now_s=0.0)
+        assert si.report(now_s=10.0) == []
+
+    def test_shutdown_threshold(self):
+        si = native.NativeStallInspector(world_size=2, warn_after_s=0.1,
+                                         shutdown_after_s=5.0)
+        si.submit("g", 0, now_s=0.0)
+        assert not si.should_shutdown(now_s=1.0)
+        assert si.should_shutdown(now_s=6.0)
+
+    def test_awkward_names_in_stall_report(self):
+        si = native.NativeStallInspector(world_size=2, warn_after_s=0.1)
+        weird = 'a|b"c\nd'
+        si.submit(weird, 1, now_s=0.0)
+        ((name, age, missing),) = si.report(now_s=1.0)
+        assert name == weird and missing == [0]
+
+
+class TestNativeTimeline:
+    def test_writes_valid_chrome_trace(self, tmp_path):
+        path = str(tmp_path / "trace.json")
+        tl = native.NativeTimeline(path, mark_cycles=True)
+        tl.record("grad/w0", "NEGOTIATE", 0.0, 10.0)
+        tl.record("grad/w0", "EXECUTE", 10.0, 25.0, '"op": "sum"')
+        tl.record('weird"name\n', "QUEUE", 1.0, 2.0)
+        tl.mark_cycle(40.0)
+        tl.close()
+        events = json.loads(open(path).read())
+        assert len(events) == 4
+        assert events[0]["name"] == "NEGOTIATE"
+        assert events[1]["args"]["op"] == "sum"
+        assert events[1]["args"]["tensor"] == "grad/w0"
+        assert events[3]["ph"] == "i"
+        # same-tensor events share a lane (tid)
+        assert events[0]["tid"] == events[1]["tid"]
+
+    def test_event_count_and_threaded_writes(self, tmp_path):
+        path = str(tmp_path / "trace2.json")
+        tl = native.NativeTimeline(path)
+
+        def spam(k):
+            for i in range(200):
+                tl.record(f"t{k}", "EXECUTE", i * 1.0, 0.5)
+
+        threads = [threading.Thread(target=spam, args=(k,))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        tl.close()
+        events = json.loads(open(path).read())
+        assert len(events) == 800
