@@ -1,0 +1,439 @@
+"""Process-level host-tensor collectives shared by the framework bindings.
+
+Reference analogue: the C core called by every binding —
+``EnqueueTensorAllreduce/Allgather/Broadcast/Alltoall`` in
+``horovod/common/operations.cc`` reached from ``horovod/torch/mpi_ops_v2.cc``
+and ``horovod/tensorflow/mpi_ops.cc`` (SURVEY.md §2.1/§2.3, mount empty,
+unverified).  In the reference each binding converts a framework tensor to
+the common ``Tensor`` interface and enqueues; here each binding converts to
+numpy and calls these functions, which map the *process*-level op onto the
+framework's *slot*-level SPMD collectives (:mod:`horovod_tpu.ops.collectives`).
+
+Slot mapping (shared contract for all host bindings): each worker process
+owns ``local_size`` mesh slots; its contribution rides on its first ("head")
+slot and the remaining local rows carry the reduction's neutral element
+(0 for sum, ±inf for min/max, 1 for product; Adasum tiles — pairwise
+idempotent), so an un-grouped slot reduction equals the process reduction.
+Gather-style ops (allgather / broadcast / alltoall / reducescatter) instead
+use an internal process set containing one head slot per process.  With the
+canonical deployment — one process per chip — both schemes degenerate to the
+plain global collective.
+
+Handles returned here resolve to **numpy** arrays; the framework layers wrap
+them with their own tensor conversion and in-place semantics.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import basics
+from .ops import collectives as C
+from .process_sets import ProcessSet
+
+Average = C.Average
+Sum = C.Sum
+Adasum = C.Adasum
+Min = C.Min
+Max = C.Max
+Product = C.Product
+
+REDUCE_OPS = (Average, Sum, Adasum, Min, Max, Product)
+
+
+def x64_if(*dtypes):
+    """64-bit transport context: JAX downcasts f64/i64 to 32 bits unless
+    x64 mode is on (the reference's MPI/NCCL path is exact for these, so
+    match it).  No-op for 32-bit-or-narrower wires."""
+    import jax
+
+    if any(np.dtype(d).itemsize == 8 for d in dtypes):
+        return jax.enable_x64(True)
+    return contextlib.nullcontext()
+
+
+def to_host(x) -> np.ndarray:
+    """Materialize a replicated global jax.Array on this process."""
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(x)
+    return np.asarray(x.addressable_shards[0].data)
+
+
+def row_from_sharded(x, row: int) -> np.ndarray:
+    """Extract one leading-dim row of a slot-sharded global array; the
+    row must live on one of this process's devices."""
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(x)[row]
+    for s in x.addressable_shards:
+        idx = s.index[0]
+        start = idx.start or 0
+        stop = idx.stop if idx.stop is not None else x.shape[0]
+        if start <= row < stop:
+            return np.asarray(s.data)[row - start]
+    raise RuntimeError(f"Row {row} is not addressable from this process")
+
+
+# --- process/world bookkeeping ----------------------------------------------
+
+def world() -> Tuple[int, int, int]:
+    """(process_count, process_index, local_size); asserts homogeneity."""
+    basics._require_init()
+    if not basics.is_homogeneous():
+        raise RuntimeError(
+            "host bindings require a homogeneous slot layout "
+            "(equal local_size on every process)"
+        )
+    import jax
+
+    return jax.process_count(), jax.process_index(), basics.local_size()
+
+
+def head_slots() -> List[int]:
+    """First slot index of each process, in process order."""
+    gm = basics.global_mesh()
+    heads: Dict[int, int] = {}
+    for i, d in enumerate(gm.devices):
+        heads.setdefault(d.process_index, i)
+    return [heads[p] for p in sorted(heads)]
+
+
+_slot_sets_lock = threading.Lock()
+_slot_sets: Dict[Tuple[int, ...], ProcessSet] = {}
+
+
+def slot_set(slot_ranks: Sequence[int]) -> ProcessSet:
+    """Registered slot-level process set for ``slot_ranks`` (cached —
+    the core table rejects duplicate registrations)."""
+    key = tuple(sorted(int(r) for r in slot_ranks))
+    with _slot_sets_lock:
+        ps = _slot_sets.get(key)
+        if ps is None or ps.process_set_id is None:
+            from .process_sets import add_process_set
+
+            ps = add_process_set(ProcessSet(key))
+            _slot_sets[key] = ps
+        return ps
+
+
+def member_ranks(process_set) -> Optional[List[int]]:
+    """Process-level ranks of a user-supplied process set (None = all)."""
+    if process_set is None:
+        return None
+    ranks = list(process_set.ranks)
+    if len(ranks) == world()[0]:
+        return None
+    return ranks
+
+
+def require_member(ranks: Optional[List[int]], name: str) -> None:
+    """Raise for callers outside the process set (reference semantics).
+    Must only be called after every collective in the op has been
+    dispatched, so member controllers are never left hanging."""
+    if ranks is not None and world()[1] not in ranks:
+        raise ValueError(
+            f"{name}: this worker (rank {world()[1]}) is not a member of "
+            f"the process set {ranks}")
+
+
+_NEUTRAL = {Sum: 0, Average: 0, Min: None, Max: None, Product: 1}
+
+
+def neutral_for(op: str, np_dtype) -> Any:
+    if op == Min:
+        return (np.finfo(np_dtype).max if np.issubdtype(np_dtype, np.floating)
+                else np.iinfo(np_dtype).max)
+    if op == Max:
+        return (np.finfo(np_dtype).min if np.issubdtype(np_dtype, np.floating)
+                else np.iinfo(np_dtype).min)
+    return _NEUTRAL[op]
+
+
+def local_block(value: np.ndarray, op: str, local_size: int) -> np.ndarray:
+    """[local_size, *S] block: head row carries the value, the rest the
+    op's neutral element (Adasum tiles — pairwise-idempotent)."""
+    if op == Adasum:
+        return np.broadcast_to(value[None], (local_size,) + value.shape).copy()
+    block = np.empty((local_size,) + value.shape, dtype=value.dtype)
+    block[0] = value
+    if local_size > 1:
+        block[1:] = neutral_for(op, value.dtype)
+    return block
+
+
+def lift_local(block: np.ndarray):
+    """Hand a process-local [local_size, *S] block to the core: in
+    multi-process runs the core lifts it via
+    ``make_array_from_process_local_data``; in single-controller runs the
+    block *is* the full stack."""
+    return block
+
+
+# --- handles -----------------------------------------------------------------
+
+class HostHandle:
+    """Async handle resolving to numpy (reference: the int handle of
+    ``*_async`` ops resolved by ``HandleManager``).  Wraps the in-flight
+    device value(s) plus the host-side finish step."""
+
+    def __init__(self, raw, finish: Callable[[], Any], name: str = ""):
+        self._raw = raw
+        self._finish = finish
+        self._result: Any = None
+        self._done_flag = False
+        self.name = name
+
+    def wait(self):
+        if not self._done_flag:
+            self._result = self._finish()
+            self._done_flag = True
+        return self._result
+
+    def done(self) -> bool:
+        if self._done_flag:
+            return True
+        leaves = self._raw if isinstance(self._raw, (list, tuple)) else [self._raw]
+        return all(getattr(l, "is_ready", lambda: True)() for l in leaves)
+
+
+# --- allreduce ---------------------------------------------------------------
+
+def _average_finish(r: np.ndarray, op: str, n: int) -> np.ndarray:
+    if op == Average:
+        if np.issubdtype(r.dtype, np.integer) or r.dtype == np.bool_:
+            r = (r // n).astype(r.dtype)
+        else:
+            r = (r / n).astype(r.dtype)
+    return r
+
+
+def allreduce_async(value: np.ndarray, *, op: str = Average,
+                    process_set=None, prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0,
+                    name: str = "allreduce") -> HostHandle:
+    """Process-level allreduce of one host array; resolves to numpy."""
+    if op not in REDUCE_OPS:
+        raise ValueError(f"Unknown reduction op: {op!r}")
+    P_, _, L = world()
+    ranks = member_ranks(process_set)
+    n = len(ranks) if ranks is not None else P_
+    block = local_block(value, op, L)
+    core_op = Sum if op == Average else op
+    slot_ps = None
+    if ranks is not None:
+        heads = head_slots()
+        slot_ps = slot_set([heads[r] for r in ranks])
+    with x64_if(block.dtype):
+        raw = C.allreduce(
+            lift_local(block), op=core_op, process_set=slot_ps,
+            prescale_factor=float(prescale_factor),
+            postscale_factor=float(postscale_factor), name=name)
+    # Membership is checked *after* dispatch: every controller must issue
+    # the same collective program or members would deadlock (SPMD); the
+    # reference errors for non-members too (via the C++ status path).
+    require_member(ranks, name)
+
+    def finish():
+        return _average_finish(to_host(raw), op, n)
+
+    return HostHandle(raw, finish, name)
+
+
+def grouped_allreduce_async(values: Sequence[np.ndarray], *, op: str = Average,
+                            process_set=None, prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0,
+                            name: str = "grouped_allreduce") -> HostHandle:
+    """Fused process-level allreduce of several host arrays; resolves to
+    a list of numpy arrays."""
+    if op not in REDUCE_OPS:
+        raise ValueError(f"Unknown reduction op: {op!r}")
+    P_, _, L = world()
+    ranks = member_ranks(process_set)
+    n = len(ranks) if ranks is not None else P_
+    core_op = Sum if op == Average else op
+    slot_ps = None
+    if ranks is not None:
+        heads = head_slots()
+        slot_ps = slot_set([heads[r] for r in ranks])
+    blocks = [lift_local(local_block(v, op, L)) for v in values]
+    with x64_if(*[b.dtype for b in blocks]):
+        raws = C.grouped_allreduce(
+            blocks, op=core_op, process_set=slot_ps,
+            prescale_factor=float(prescale_factor),
+            postscale_factor=float(postscale_factor), name=name)
+    require_member(ranks, name)
+
+    def finish():
+        return [_average_finish(to_host(raw), op, n) for raw in raws]
+
+    return HostHandle(raws, finish, name)
+
+
+# --- allgather ---------------------------------------------------------------
+
+def allgather_async(value: np.ndarray, *, process_set=None,
+                    name: str = "allgather") -> HostHandle:
+    """Concat along dim 0 over workers; supports ragged first dims (the
+    reference's MPI_Allgatherv) via a max-pad + slice round."""
+    P_, rank_, L = world()
+    ranks = member_ranks(process_set)
+    members = ranks if ranks is not None else list(range(P_))
+    heads = head_slots()
+    ps = slot_set([heads[r] for r in members])
+
+    if value.ndim == 0:
+        value = value[None]
+    k_local = value.shape[0]
+
+    # Round 1 (dispatched async here): the (possibly ragged) first-dim
+    # lengths.  Round 2 depends on the global max length, so it is
+    # deferred to finish() — queued allgather_asyncs thus overlap their
+    # length exchanges, and wait() order defines round-2 dispatch order
+    # (keep it consistent across workers, as with any collective).
+    len_block = np.zeros((L, 1), np.int32)
+    len_block[0, 0] = k_local
+    len_raw = C.allgather(lift_local(len_block), process_set=ps,
+                          name=f"{name}.lengths")
+    require_member(ranks, name)
+
+    def finish():
+        lengths = to_host(len_raw).reshape(-1)
+        k_max = int(lengths.max())
+        padded = np.zeros((k_max,) + value.shape[1:], dtype=value.dtype)
+        padded[:k_local] = value
+        block = np.zeros((L,) + padded.shape, dtype=value.dtype)
+        block[0] = padded
+        with x64_if(block.dtype):
+            raw = C.allgather(lift_local(block), process_set=ps, name=name)
+        g = to_host(raw).reshape((len(members), k_max) + value.shape[1:])
+        parts = [g[i, : int(lengths[i])] for i in range(len(members))]
+        return np.concatenate(parts, axis=0)
+
+    return HostHandle(len_raw, finish, name)
+
+
+# --- broadcast ---------------------------------------------------------------
+
+def broadcast_async(value: np.ndarray, root_rank: int = 0, *,
+                    process_set=None, name: str = "broadcast") -> HostHandle:
+    """Every worker resolves to the root worker's array."""
+    P_, _, L = world()
+    ranks = member_ranks(process_set)
+    if ranks is not None and root_rank not in ranks:
+        raise ValueError(f"{name}: root rank {root_rank} not in process set")
+    block = np.broadcast_to(value[None], (L,) + value.shape).copy()
+    root_slot = head_slots()[root_rank]
+    with x64_if(block.dtype):
+        raw = C.broadcast(lift_local(block), root_rank=root_slot, name=name)
+    require_member(ranks, name)
+
+    def finish():
+        return to_host(raw)
+
+    return HostHandle(raw, finish, name)
+
+
+# --- alltoall ----------------------------------------------------------------
+
+def alltoall(value: np.ndarray, splits: Optional[np.ndarray] = None, *,
+             process_set=None,
+             name: str = "alltoall") -> Tuple[np.ndarray, np.ndarray]:
+    """Scatter dim-0 chunks to every worker, gather received chunks;
+    returns ``(gathered, received_splits)``.  Ragged splits ride a
+    max-pad exchange (XLA needs static shapes)."""
+    P_, rank_, L = world()
+    ranks = member_ranks(process_set)
+    members = ranks if ranks is not None else list(range(P_))
+    n = len(members)
+    heads = head_slots()
+    ps = slot_set([heads[r] for r in members])
+    is_member = rank_ in members
+    me = members.index(rank_) if is_member else None
+
+    if not is_member:
+        split_sizes = np.zeros((n,), np.int64)  # dispatch-only contribution
+    elif splits is None:
+        if value.shape[0] % n != 0:
+            raise ValueError(
+                f"{name}: dim 0 ({value.shape[0]}) not divisible by the "
+                f"worker count {n}; pass explicit splits")
+        split_sizes = np.full((n,), value.shape[0] // n, np.int64)
+    else:
+        split_sizes = np.asarray(splits, np.int64).reshape(-1)
+        if split_sizes.shape[0] != n or int(split_sizes.sum()) != value.shape[0]:
+            raise ValueError(f"{name}: splits must have {n} entries summing "
+                             f"to dim 0 ({value.shape[0]})")
+
+    # Exchange the full split matrix S[i, j] = worker i's chunk size for
+    # destination j via one summed allreduce: replicated on every
+    # controller, so the padded chunk size below is globally agreed and
+    # all controllers dispatch the identical program (SPMD requirement).
+    sp_local = np.zeros((n, n), np.int32)
+    if is_member:
+        sp_local[me] = split_sizes
+    sp_block = local_block(sp_local, Sum, L)
+    S = to_host(C.allreduce(lift_local(sp_block), op=Sum,
+                            name=f"{name}.splits"))
+    k_max = max(int(S.max()), 1)
+
+    chunks = np.zeros((n, k_max) + value.shape[1:], dtype=value.dtype)
+    off = 0
+    for i, s in enumerate(split_sizes):
+        chunks[i, : int(s)] = value[off: off + int(s)]
+        off += int(s)
+    block = np.zeros((L, n * k_max) + value.shape[1:], dtype=value.dtype)
+    block[0] = chunks.reshape((n * k_max,) + value.shape[1:])
+    with x64_if(block.dtype):
+        raw = C.alltoall(lift_local(block), process_set=ps, name=name)
+    require_member(ranks, name)
+
+    received_splits = S[:, me]
+    got = row_from_sharded(raw, heads[me]).reshape(
+        (n, k_max) + value.shape[1:])
+    parts = [got[i, : int(received_splits[i])] for i in range(n)]
+    gathered = np.concatenate(parts, axis=0)
+    return gathered, received_splits.astype(np.int64)
+
+
+# --- reducescatter -----------------------------------------------------------
+
+def reducescatter(value: np.ndarray, *, op: str = Sum, process_set=None,
+                  name: str = "reducescatter") -> np.ndarray:
+    """Reduce then scatter dim-0 shards; dim 0 must divide by the worker
+    count."""
+    P_, rank_, L = world()
+    ranks = member_ranks(process_set)
+    members = ranks if ranks is not None else list(range(P_))
+    n = len(members)
+    heads = head_slots()
+    ps = slot_set([heads[r] for r in members])
+    if value.shape[0] % n != 0:
+        raise ValueError(f"{name}: dim 0 ({value.shape[0]}) not divisible "
+                         f"by worker count {n}")
+    block = np.zeros((L,) + value.shape, dtype=value.dtype)
+    block[0] = value
+    with x64_if(block.dtype):
+        raw = C.reducescatter(lift_local(block), op=op, process_set=ps,
+                              name=name)
+    require_member(ranks, name)
+    # Average over member slots == over member processes (neutral rows),
+    # so the core's op handling is already process-correct here.
+    return row_from_sharded(raw, heads[members.index(rank_)])
+
+
+# --- barrier / join ----------------------------------------------------------
+
+def barrier(process_set=None, name: str = "barrier") -> None:
+    ranks = member_ranks(process_set)
+    slot_ps = None
+    if ranks is not None:
+        heads = head_slots()
+        slot_ps = slot_set([heads[r] for r in ranks])
+    C.barrier(process_set=slot_ps, name=name)
+
+
+def join() -> int:
+    return C.join()

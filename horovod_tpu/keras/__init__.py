@@ -1,0 +1,14 @@
+"""horovod_tpu.keras — standalone-Keras alias of the TF/Keras binding.
+
+Reference: ``horovod/keras/`` (SURVEY.md §2.4, mount empty, unverified)
+— upstream keeps a standalone-keras package mirroring
+``horovod.tensorflow.keras``; with Keras 3 both are the same optimizer
+and callback implementations, so this package re-exports them.
+"""
+
+from ..tensorflow.keras import (  # noqa: F401
+    Compression, DistributedOptimizer, broadcast_model, broadcast_variables,
+    callbacks,
+    init, shutdown, is_initialized,
+    rank, size, local_rank, local_size, cross_rank, cross_size,
+)

@@ -1,0 +1,252 @@
+"""horovod_tpu.tensorflow — the TF binding of the framework.
+
+Reference surface: ``horovod/tensorflow/__init__.py`` (SURVEY.md §2.4,
+mount empty, unverified): ``hvd.init/rank/size``, collectives on tf
+tensors, ``DistributedOptimizer`` (gradient allreduce wrapped around a
+Keras optimizer), ``DistributedGradientTape``, ``broadcast_variables``,
+fp16 ``Compression``, ``backward_passes_per_step`` local aggregation.
+
+Canonical usage (mirrors ``import horovod.tensorflow as hvd``)::
+
+    import horovod_tpu.tensorflow as hvd
+
+    hvd.init()
+    opt = hvd.DistributedOptimizer(tf.keras.optimizers.Adam(1e-3))
+    model.compile(optimizer=opt, ...)
+    hvd.broadcast_variables(model.variables, root_rank=0)
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import tensorflow as tf
+
+# Process-model surface, shared with the pure-JAX API (reference: every
+# binding re-exports the HorovodBasics symbols).
+from ..basics import (  # noqa: F401
+    init, shutdown, is_initialized,
+    local_rank, local_size, cross_rank, cross_size,
+    is_homogeneous,
+    mpi_built, nccl_built, gloo_built, ccl_built, cuda_built, rocm_built,
+    xla_built, mpi_threads_supported,
+    start_timeline, stop_timeline,
+)
+from .. import basics as _basics
+
+
+def rank() -> int:
+    """This TF worker's rank == the controller-process index (reference:
+    ``hvd.rank()``; one process may drive many TPU chips, so worker rank
+    is process-, not chip-, granular — same contract as the torch
+    binding)."""
+    _basics._require_init()
+    import jax
+
+    return jax.process_index()
+
+
+def size() -> int:
+    """Number of TF workers == controller processes (reference:
+    ``hvd.size()``)."""
+    _basics._require_init()
+    import jax
+
+    return jax.process_count()
+from ..process_sets import (  # noqa: F401
+    ProcessSet, add_process_set, remove_process_set, global_process_set,
+)
+from .compression import Compression  # noqa: F401
+from .functions import (  # noqa: F401
+    allgather_object, broadcast_model, broadcast_object, broadcast_variables,
+)
+from .mpi_ops import (  # noqa: F401
+    Adasum, Average, Max, Min, Product, Sum,
+    allgather, allreduce, alltoall, barrier, broadcast, grouped_allgather,
+    grouped_allreduce, join, reducescatter,
+)
+from . import keras  # noqa: F401  (horovod.tensorflow.keras parity)
+
+
+def _to_dense(grad):
+    if isinstance(grad, tf.IndexedSlices):
+        return tf.convert_to_tensor(grad)
+    return grad
+
+
+def _allreduce_grads(grads: Sequence, *, op: str, compression,
+                     process_set, sparse_as_dense: bool,
+                     name: str) -> List:
+    """Reduce a gradient set as ONE ordered logical op: dense grads ride
+    a fused grouped_allreduce (the reference's tensor-fusion guarantee),
+    sparse/None entries are handled per reference semantics."""
+    if sparse_as_dense:
+        grads = [_to_dense(g) for g in grads]
+    dense_idx = [i for i, g in enumerate(grads)
+                 if g is not None and not isinstance(g, tf.IndexedSlices)]
+    out = list(grads)
+    if dense_idx:
+        reduced = grouped_allreduce(
+            [grads[i] for i in dense_idx], op=op, compression=compression,
+            process_set=process_set, name=name)
+        for i, r in zip(dense_idx, reduced):
+            out[i] = r
+    for i, g in enumerate(grads):
+        if isinstance(g, tf.IndexedSlices):
+            out[i] = allreduce(g, op=op, process_set=process_set,
+                               name=f"{name}.sparse[{i}]")
+    return out
+
+
+class LocalGradientAggregationHelper:
+    """Reference: ``horovod/tensorflow/gradient_aggregation*.py``
+    (SURVEY.md §2.4) — ``backward_passes_per_step`` local accumulation:
+    gradients are summed into local variables for N passes; every Nth
+    pass the average is allreduced and applied, other passes skip the
+    optimizer entirely (so optimizer slots/step counters advance once
+    per effective step, matching the reference)."""
+
+    def __init__(self, backward_passes_per_step: int, allreduce_fn):
+        if backward_passes_per_step < 1:
+            raise ValueError("backward_passes_per_step must be >= 1")
+        self.n = int(backward_passes_per_step)
+        self._allreduce = allreduce_fn
+        self._counter: Optional[tf.Variable] = None
+        self._accum: Optional[List[tf.Variable]] = None
+
+    def _build(self, grads):
+        self._counter = tf.Variable(0, dtype=tf.int64, trainable=False,
+                                    name="hvd_tpu_agg_counter")
+        self._accum = [
+            tf.Variable(tf.zeros_like(g), trainable=False,
+                        name=f"hvd_tpu_agg_{i}")
+            for i, g in enumerate(grads)
+        ]
+
+    def apply(self, grads: Sequence, apply_fn) -> None:
+        """Accumulate ``grads``; on pass N, allreduce the mean and call
+        ``apply_fn(reduced_grads)``, then reset the accumulators."""
+        grads = [_to_dense(g) for g in grads]
+        if self._counter is None:
+            self._build(grads)
+        for acc, g in zip(self._accum, grads):
+            if g is not None:
+                acc.assign_add(tf.cast(g, acc.dtype))
+        self._counter.assign_add(1)
+
+        def boundary():
+            mean = [tf.cast(a / self.n, a.dtype) for a in self._accum]
+            apply_fn(self._allreduce(mean))
+            for a in self._accum:
+                a.assign(tf.zeros_like(a))
+            return tf.constant(True)
+
+        tf.cond(tf.equal(self._counter % self.n, 0),
+                boundary, lambda: tf.constant(False))
+
+
+class _DistributedOptimizerMixin:
+    """Mixed in ahead of the wrapped Keras optimizer class; intercepts
+    ``apply`` (which ``apply_gradients`` routes through in Keras 3) to
+    allreduce gradients first — the reference's
+    ``_DistributedOptimizer._aggregate_gradients``."""
+
+    _hvd_tpu_distributed = True
+
+    def _hvd_setup(self, *, op, compression, process_set, sparse_as_dense,
+                   backward_passes_per_step, reduce_name):
+        self._hvd_op = op
+        self._hvd_compression = compression
+        self._hvd_process_set = process_set
+        self._hvd_sparse_as_dense = sparse_as_dense
+        self._hvd_reduce_name = reduce_name
+        self._hvd_agg = (
+            LocalGradientAggregationHelper(
+                backward_passes_per_step, self._hvd_allreduce)
+            if backward_passes_per_step > 1 else None)
+
+    def _hvd_allreduce(self, grads):
+        return _allreduce_grads(
+            grads, op=self._hvd_op, compression=self._hvd_compression,
+            process_set=self._hvd_process_set,
+            sparse_as_dense=self._hvd_sparse_as_dense,
+            name=self._hvd_reduce_name)
+
+    def apply(self, grads, trainable_variables=None, **kwargs):
+        sup = super()
+        if trainable_variables is None:
+            apply_fn = lambda gs: sup.apply(gs, **kwargs)
+        else:
+            apply_fn = lambda gs: sup.apply(gs, trainable_variables, **kwargs)
+        if self._hvd_agg is not None:
+            return self._hvd_agg.apply(list(grads), apply_fn)
+        return apply_fn(self._hvd_allreduce(list(grads)))
+
+
+def DistributedOptimizer(optimizer, *, op: str = Average,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1,
+                         process_set=None, sparse_as_dense: bool = False,
+                         name: Optional[str] = None):
+    """Reference: ``hvd.DistributedOptimizer(opt)`` — returns an
+    optimizer of a dynamically-created subclass of ``type(opt)`` whose
+    ``apply`` allreduces gradients across workers before the update.
+    Rebuilt from ``opt.get_config()`` like the reference (so the wrapped
+    instance is fresh and unbuilt)."""
+    if getattr(optimizer, "_hvd_tpu_distributed", False):
+        raise ValueError(
+            "optimizer is already distributed (double-wrapping detected)")
+    base = type(optimizer)
+    cls = type("Distributed" + base.__name__,
+               (_DistributedOptimizerMixin, base), {})
+    dist = cls.from_config(optimizer.get_config())
+    dist._hvd_setup(
+        op=op, compression=compression, process_set=process_set,
+        sparse_as_dense=sparse_as_dense,
+        backward_passes_per_step=backward_passes_per_step,
+        reduce_name=name or "DistributedOptimizer.grads")
+    return dist
+
+
+class _DistributedGradientTape:
+    """Reference: ``hvd.DistributedGradientTape`` — a ``tf.GradientTape``
+    whose ``gradient()`` returns allreduced gradients."""
+
+    def __init__(self, tape: "tf.GradientTape", *, op, compression,
+                 process_set, sparse_as_dense):
+        self._tape = tape
+        self._op = op
+        self._compression = compression
+        self._process_set = process_set
+        self._sparse_as_dense = sparse_as_dense
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources, output_gradients)
+        flat = tf.nest.flatten(grads)
+        reduced = _allreduce_grads(
+            flat, op=self._op, compression=self._compression,
+            process_set=self._process_set,
+            sparse_as_dense=self._sparse_as_dense,
+            name="DistributedGradientTape.grads")
+        return tf.nest.pack_sequence_as(grads, reduced)
+
+
+def DistributedGradientTape(gradtape: "tf.GradientTape", *,
+                            op: str = Average,
+                            compression=Compression.none,
+                            process_set=None,
+                            sparse_as_dense: bool = False):
+    """Reference: ``hvd.DistributedGradientTape(tape)``."""
+    return _DistributedGradientTape(
+        gradtape, op=op, compression=compression, process_set=process_set,
+        sparse_as_dense=sparse_as_dense)

@@ -1,0 +1,67 @@
+"""Gradient compression for the TF binding.
+
+Reference: ``horovod/tensorflow/compression.py`` (SURVEY.md §2.4, mount
+empty, unverified): ``Compression.none`` / ``Compression.fp16`` — cast
+floating tensors to fp16 for the wire, cast back after the collective.
+On TPU the natural wire format is bfloat16 (MXU-native, same 16-bit
+wire cost, wider dynamic range), so ``Compression.fp16`` here uses
+bf16; an explicit ``Compression.true_fp16`` keeps reference numerics.
+"""
+
+from __future__ import annotations
+
+import tensorflow as tf
+
+
+class Compressor:
+    """Interface: ``compress(tensor) -> (wire, ctx)``;
+    ``decompress(wire, ctx) -> tensor``."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype: "tf.DType" = tf.bfloat16
+
+    @classmethod
+    def compress(cls, tensor):
+        if tensor.dtype.is_floating and tensor.dtype.size > 2:
+            return tf.cast(tensor, cls.wire_dtype), tensor.dtype
+        return tensor, None
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        return tensor if ctx is None else tf.cast(tensor, ctx)
+
+
+class FP16Compressor(_CastCompressor):
+    """16-bit wire compression (bf16 on TPU; see module docstring)."""
+    wire_dtype = tf.bfloat16
+
+
+class TrueFP16Compressor(_CastCompressor):
+    """Bit-faithful reference numerics: IEEE fp16 wire."""
+    wire_dtype = tf.float16
+
+
+class Compression:
+    """Reference: ``hvd.Compression`` option enum."""
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    true_fp16 = TrueFP16Compressor

@@ -1,0 +1,51 @@
+"""State broadcast helpers for TF models.
+
+Reference: ``horovod/tensorflow/functions.py`` (path per SURVEY.md §2.4,
+mount empty, unverified) — ``broadcast_variables`` assigns the root
+worker's values into every worker's ``tf.Variable`` list at step 0;
+objects ride pickled byte broadcasts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, List
+
+import tensorflow as tf
+
+from . import mpi_ops
+from ..functions import allgather_object as _allgather_object
+from ..functions import broadcast_object as _broadcast_object
+
+
+def broadcast_object(obj: Any, root_rank: int = 0, name: str = "") -> Any:
+    """Reference: ``hvd.broadcast_object`` (pickle → bytes broadcast →
+    unpickle)."""
+    return _broadcast_object(obj, root_rank=root_rank, name=name)
+
+
+def allgather_object(obj: Any, name: str = "") -> List[Any]:
+    """Reference: ``hvd.allgather_object``."""
+    return _allgather_object(obj, name=name)
+
+
+def broadcast_variables(variables: Iterable["tf.Variable"],
+                        root_rank: int = 0) -> None:
+    """Reference: ``hvd.broadcast_variables(model.variables, 0)`` —
+    every worker's variables are assigned the root worker's values
+    (the reference's `BroadcastGlobalVariablesOp` / callback path)."""
+    for i, v in enumerate(variables):
+        name = f"broadcast.{getattr(v, 'name', i)}"
+        if v.dtype == tf.bool:
+            # Transport bools as uint8 (no boolean collectives in XLA
+            # reductions); exact round-trip.
+            got = mpi_ops.broadcast(tf.cast(v, tf.uint8), root_rank,
+                                    name=name)
+            v.assign(tf.cast(got, tf.bool))
+        else:
+            v.assign(mpi_ops.broadcast(v, root_rank, name=name))
+
+
+def broadcast_model(model, root_rank: int = 0) -> None:
+    """Broadcast a Keras model's variables (reference equivalent:
+    ``broadcast_variables(model.variables, root_rank)``)."""
+    broadcast_variables(model.variables, root_rank)

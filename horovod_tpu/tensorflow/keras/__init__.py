@@ -1,0 +1,27 @@
+"""horovod_tpu.tensorflow.keras — Keras binding.
+
+Reference surface: ``horovod/tensorflow/keras/__init__.py`` +
+``horovod/keras/`` (SURVEY.md §2.4, mount empty, unverified):
+``hvd.keras.DistributedOptimizer`` plus the callback set
+(`BroadcastGlobalVariablesCallback`, `MetricAverageCallback`,
+`LearningRateWarmupCallback`, `LearningRateScheduleCallback`).
+"""
+
+from __future__ import annotations
+
+from ...basics import (  # noqa: F401
+    init, shutdown, is_initialized,
+    local_rank, local_size, cross_rank, cross_size,
+)
+from .. import rank, size  # noqa: F401  (process-level, not slot-level)
+from ..compression import Compression  # noqa: F401
+from ..functions import broadcast_model, broadcast_variables  # noqa: F401
+from . import callbacks  # noqa: F401
+
+
+def DistributedOptimizer(optimizer, **kwargs):
+    """Reference: ``hvd.keras.DistributedOptimizer`` — same wrapper as
+    the TF binding's (Keras 3 optimizers are the TF optimizers)."""
+    from .. import DistributedOptimizer as _impl
+
+    return _impl(optimizer, **kwargs)

@@ -1,0 +1,160 @@
+"""Keras callbacks.
+
+Reference: ``horovod/tensorflow/keras/callbacks.py`` /
+``horovod/_keras/callbacks.py`` (SURVEY.md §2.4, mount empty,
+unverified): broadcast-at-start, metric averaging across workers, and
+the linear learning-rate warmup / schedule pair from the "Accurate,
+Large Minibatch SGD" recipe the reference ships.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import tensorflow as tf
+from tensorflow import keras
+
+from .. import rank, size
+from ..functions import broadcast_variables
+from ..mpi_ops import Average, allreduce
+
+
+class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
+    """Reference: broadcast all model + optimizer variables from
+    ``root_rank`` before the first batch, so every worker starts
+    identical."""
+
+    def __init__(self, root_rank: int = 0):
+        super().__init__()
+        self.root_rank = root_rank
+        self.broadcast_done = False
+
+    def on_batch_begin(self, batch, logs=None):
+        if self.broadcast_done:
+            return
+        broadcast_variables(self.model.variables, self.root_rank)
+        if getattr(self.model, "optimizer", None) is not None:
+            broadcast_variables(self.model.optimizer.variables,
+                                self.root_rank)
+        self.broadcast_done = True
+
+
+class MetricAverageCallback(keras.callbacks.Callback):
+    """Reference: average epoch metrics over workers at epoch end (so
+    rank-0 logging/checkpoint decisions see global metrics)."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is None or size() == 1:
+            return
+        for k, v in list(logs.items()):
+            if isinstance(v, (int, float)) and math.isfinite(float(v)):
+                logs[k] = float(allreduce(
+                    tf.constant(float(v), tf.float32), op=Average,
+                    name=f"metric.{k}"))
+
+
+def _get_lr(optimizer) -> float:
+    return float(tf.keras.backend.get_value(optimizer.learning_rate))
+
+
+def _set_lr(optimizer, lr: float) -> None:
+    lr_var = optimizer.learning_rate
+    if isinstance(lr_var, tf.Variable):
+        lr_var.assign(lr)
+    else:  # plain attribute (schedules are rejected by the callbacks)
+        optimizer.learning_rate = lr
+
+
+class LearningRateWarmupCallback(keras.callbacks.Callback):
+    """Reference: ramp the LR batchwise from ``initial_lr / size()`` to
+    ``initial_lr`` over ``warmup_epochs`` (Goyal et al. gradual warmup;
+    ``initial_lr`` is the already-scaled target rate)."""
+
+    def __init__(self, initial_lr: float, warmup_epochs: float = 5,
+                 momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None, verbose: int = 0):
+        super().__init__()
+        self.initial_lr = initial_lr
+        self.warmup_epochs = warmup_epochs
+        self.steps_per_epoch = steps_per_epoch
+        self.verbose = verbose
+        self._momentum_correction = momentum_correction
+        self.current_epoch = 0
+        self._steps = None
+
+    def on_train_begin(self, logs=None):
+        self._steps = self.steps_per_epoch or self.params.get("steps")
+        if self._steps is None:
+            raise ValueError(
+                "LearningRateWarmupCallback needs steps_per_epoch (could "
+                "not infer it from the fit parameters)")
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+
+    def on_batch_begin(self, batch, logs=None):
+        progress = (self.current_epoch * self._steps + batch + 1) / float(
+            self.warmup_epochs * self._steps)
+        if progress >= 1.0:
+            return
+        # Linear ramp 1/size → 1 of the target rate.
+        factor = (1.0 / size()) + (1.0 - 1.0 / size()) * progress
+        _set_lr(self.model.optimizer, self.initial_lr * factor)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if epoch + 1 == int(math.ceil(self.warmup_epochs)):
+            _set_lr(self.model.optimizer, self.initial_lr)
+            if self.verbose and rank() == 0:
+                print(f"\nEpoch {epoch + 1}: finished gradual learning "
+                      f"rate warmup to {self.initial_lr}.")
+
+
+class LearningRateScheduleCallback(keras.callbacks.Callback):
+    """Reference: multiply ``initial_lr`` by ``multiplier`` (a constant,
+    or a function of epoch) between ``start_epoch`` and ``end_epoch``;
+    ``staircase`` applies it per epoch, otherwise per batch with
+    fractional epochs."""
+
+    def __init__(self, initial_lr: float, multiplier, start_epoch: int = 0,
+                 end_epoch: Optional[int] = None, staircase: bool = True,
+                 steps_per_epoch: Optional[int] = None, verbose: int = 0):
+        super().__init__()
+        self.initial_lr = initial_lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.steps_per_epoch = steps_per_epoch
+        self.verbose = verbose
+        self.current_epoch = 0
+        self._steps = None
+        if callable(multiplier):
+            self.multiplier = multiplier
+        else:
+            self.multiplier = lambda epoch: multiplier
+
+    def _in_range(self, epoch) -> bool:
+        if epoch < self.start_epoch:
+            return False
+        return self.end_epoch is None or epoch < self.end_epoch
+
+    def on_train_begin(self, logs=None):
+        self._steps = self.steps_per_epoch or self.params.get("steps")
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+        if self.staircase and self._in_range(epoch):
+            _set_lr(self.model.optimizer,
+                    self.initial_lr * self.multiplier(epoch))
+
+    def on_batch_begin(self, batch, logs=None):
+        if self.staircase or self._steps is None:
+            return
+        epoch = self.current_epoch + float(batch) / self._steps
+        if self._in_range(self.current_epoch):
+            _set_lr(self.model.optimizer,
+                    self.initial_lr * self.multiplier(epoch))
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None:
+            logs["lr"] = _get_lr(self.model.optimizer)

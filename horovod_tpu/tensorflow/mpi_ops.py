@@ -1,0 +1,236 @@
+"""TensorFlow-tensor collective API — reference parity with
+``horovod.tensorflow``.
+
+Reference surface (``horovod/tensorflow/mpi_ops.py`` + the custom-op
+library ``horovod/tensorflow/mpi_ops.cc`` and its XLA adapter
+``xla_mpi_ops.cc``, paths per SURVEY.md §2.3/2.4, mount empty,
+unverified): ``allreduce``, ``grouped_allreduce``, ``allgather``,
+``broadcast``, ``alltoall``, ``reducescatter``, ``barrier``, ``join``
+with op/compression/prescale/postscale arguments, usable both eagerly
+and inside ``tf.function`` graphs.
+
+TPU-native redesign
+-------------------
+The reference registers C++ custom ops that enqueue into the background
+coordinator.  Here a TF worker is a *controller process* of the JAX
+world: host tensors bridge to the shared host-binding core
+(:mod:`horovod_tpu.hostops`), which maps process-level ops onto the
+framework's slot-stack SPMD collectives over ICI/DCN.  Inside
+``tf.function`` graphs the bridge rides ``tf.py_function`` — the moral
+equivalent of the reference's async kernel, with XLA's dispatch queue
+playing the background thread.  Collective *order* must match across
+workers; grouped ops make a whole gradient set one ordered call (the
+reference's tensor-fusion guarantee).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+try:
+    import tensorflow as tf
+except ImportError as _e:  # pragma: no cover - tf is baked into the image
+    raise ImportError(
+        "horovod_tpu.tensorflow requires tensorflow; import horovod_tpu "
+        "directly for the pure-JAX API"
+    ) from _e
+
+from .. import hostops as H
+
+# Reduction-op constants (re-exported verbatim from the core).
+Average = H.Average
+Sum = H.Sum
+Adasum = H.Adasum
+Min = H.Min
+Max = H.Max
+Product = H.Product
+
+
+def _to_numpy(t) -> np.ndarray:
+    """Host numpy view of a tf tensor (TF>=2.16 returns ml_dtypes
+    bfloat16 arrays natively, which the core transports bit-exactly)."""
+    return np.asarray(tf.convert_to_tensor(t).numpy())
+
+
+def _np_bridge(fn, inputs: Sequence, out_dtypes: Sequence,
+               name: str) -> List:
+    """Run ``fn(*numpy_inputs) -> [numpy...]`` on host tensors, eagerly
+    or as a ``tf.py_function`` node when tracing a graph."""
+    if tf.executing_eagerly():
+        outs = fn(*[_to_numpy(i) for i in inputs])
+        return [tf.convert_to_tensor(o) for o in outs]
+
+    def eager_fn(*args):
+        return [tf.convert_to_tensor(o)
+                for o in fn(*[np.asarray(a.numpy()) for a in args])]
+
+    return tf.py_function(eager_fn, list(inputs), list(out_dtypes),
+                          name=name.replace(":", "_"))
+
+
+# --- allreduce ---------------------------------------------------------------
+
+def _allreduce_dense(tensor, op, process_set, prescale_factor,
+                     postscale_factor, name):
+    def run(value):
+        return [H.allreduce_async(
+            value, op=op, process_set=process_set,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, name=name).wait()]
+
+    out = _np_bridge(run, [tensor], [tensor.dtype], name)[0]
+    out.set_shape(tensor.shape)
+    return out
+
+
+def allreduce(tensor, *, op: str = Average, process_set=None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              compression=None, name: str = "allreduce"):
+    """Reference: ``hvd.allreduce`` — average (by default) over all
+    workers.  ``tf.IndexedSlices`` ride the reference's sparse path: an
+    allgather of values and indices (averaging deferred to the dense
+    apply), matching ``horovod.tensorflow._allreduce`` semantics."""
+    if isinstance(tensor, tf.IndexedSlices):
+        values = allgather(tensor.values, name=f"{name}.values")
+        indices = allgather(tensor.indices, name=f"{name}.indices")
+        if op == Average:
+            n = _set_size(process_set)
+            values = values / tf.cast(n, values.dtype)
+        return tf.IndexedSlices(values, indices,
+                                dense_shape=tensor.dense_shape)
+    tensor = tf.convert_to_tensor(tensor)
+    wire, ctx = (compression.compress(tensor) if compression is not None
+                 else (tensor, None))
+    out = _allreduce_dense(wire, op, process_set, float(prescale_factor),
+                           float(postscale_factor), name)
+    if compression is not None:
+        out = compression.decompress(out, ctx)
+    return tf.cast(out, tensor.dtype)
+
+
+def grouped_allreduce(tensors: Sequence, *, op: str = Average,
+                      process_set=None, prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0, compression=None,
+                      name: str = "grouped_allreduce") -> List:
+    """Reference: ``hvd.grouped_allreduce`` — one fused, ordered logical
+    op for a whole tensor set (the DistributedOptimizer hot path)."""
+    tensors = [tf.convert_to_tensor(t) for t in tensors]
+    wires, ctxs = [], []
+    for t in tensors:
+        w, c = (compression.compress(t) if compression is not None
+                else (t, None))
+        wires.append(w)
+        ctxs.append(c)
+
+    def run(*values):
+        return H.grouped_allreduce_async(
+            list(values), op=op, process_set=process_set,
+            prescale_factor=float(prescale_factor),
+            postscale_factor=float(postscale_factor), name=name).wait()
+
+    outs = _np_bridge(run, wires, [w.dtype for w in wires], name)
+    results = []
+    for o, w, t, c in zip(outs, wires, tensors, ctxs):
+        o.set_shape(w.shape)
+        if compression is not None:
+            o = compression.decompress(o, c)
+        results.append(tf.cast(o, t.dtype))
+    return results
+
+
+# --- allgather ---------------------------------------------------------------
+
+def allgather(tensor, *, process_set=None, name: str = "allgather"):
+    """Reference: ``hvd.allgather`` — concat along dim 0 over workers;
+    ragged first dims supported (MPI_Allgatherv semantics)."""
+    tensor = tf.convert_to_tensor(tensor)
+
+    def run(value):
+        return [H.allgather_async(value, process_set=process_set,
+                                  name=name).wait()]
+
+    out = _np_bridge(run, [tensor], [tensor.dtype], name)[0]
+    out.set_shape(tf.TensorShape([None]).concatenate(tensor.shape[1:]))
+    return out
+
+
+def grouped_allgather(tensors: Sequence, *, process_set=None,
+                      name: str = "grouped_allgather") -> List:
+    return [allgather(t, process_set=process_set, name=f"{name}[{i}]")
+            for i, t in enumerate(tensors)]
+
+
+# --- broadcast ---------------------------------------------------------------
+
+def broadcast(tensor, root_rank: int = 0, *, process_set=None,
+              name: str = "broadcast"):
+    """Reference: ``hvd.broadcast`` — every worker receives the root
+    worker's tensor."""
+    tensor = tf.convert_to_tensor(tensor)
+
+    def run(value):
+        return [H.broadcast_async(value, root_rank, process_set=process_set,
+                                  name=name).wait()]
+
+    out = _np_bridge(run, [tensor], [tensor.dtype], name)[0]
+    out.set_shape(tensor.shape)
+    return out
+
+
+# --- alltoall ----------------------------------------------------------------
+
+def alltoall(tensor, splits=None, *, process_set=None,
+             name: str = "alltoall"):
+    """Reference: ``hvd.alltoall(tensor, splits=None)`` — scatter dim-0
+    chunks to every worker, gather received chunks; with ``splits``
+    returns ``(gathered, received_splits)``."""
+    tensor = tf.convert_to_tensor(tensor)
+    np_splits = None if splits is None else _to_numpy(splits).astype(np.int64)
+
+    def run(value):
+        gathered, received = H.alltoall(value, np_splits,
+                                        process_set=process_set, name=name)
+        return [gathered, received]
+
+    gathered, received = _np_bridge(run, [tensor], [tensor.dtype, tf.int64],
+                                    name)
+    gathered.set_shape(tf.TensorShape([None]).concatenate(tensor.shape[1:]))
+    if splits is None:
+        return gathered
+    return gathered, received
+
+
+# --- reducescatter -----------------------------------------------------------
+
+def reducescatter(tensor, *, op: str = Sum, process_set=None,
+                  name: str = "reducescatter"):
+    """Reference: ``hvd.reducescatter`` (late vintages) — reduce then
+    scatter dim-0 shards."""
+    tensor = tf.convert_to_tensor(tensor)
+
+    def run(value):
+        return [H.reducescatter(value, op=op, process_set=process_set,
+                                name=name)]
+
+    out = _np_bridge(run, [tensor], [tensor.dtype], name)[0]
+    out.set_shape(tf.TensorShape([None]).concatenate(tensor.shape[1:]))
+    return out
+
+
+# --- barrier / join ----------------------------------------------------------
+
+def barrier(process_set=None, name: str = "barrier") -> None:
+    """Reference: ``hvd.barrier``."""
+    H.barrier(process_set=process_set, name=name)
+
+
+def join() -> int:
+    """Reference: ``hvd.join()``."""
+    return H.join()
+
+
+def _set_size(process_set) -> int:
+    ranks = H.member_ranks(process_set)
+    return len(ranks) if ranks is not None else H.world()[0]

@@ -11,27 +11,16 @@ TPU-native redesign
 -------------------
 There is no pybind extension and no handle table: a torch worker is a
 *controller process* (``rank() == jax.process_index()``), its CPU tensor
-is bridged zero-copy(ish) to the framework's slot-stack collectives
-(:mod:`horovod_tpu.ops.collectives`), and XLA's async dispatch plays the
-role of the background thread — a :class:`Handle` simply wraps the
-not-yet-materialized device value plus the torch write-back.
-
-Mapping a *process*-level collective onto the *slot*-level core: each
-process owns ``local_size`` mesh slots; its contribution rides on its
-first ("head") slot and the remaining local rows carry the reduction's
-neutral element (0 for sum, +inf for min, 1 for product, …), so an
-un-grouped slot reduction equals the process reduction.  Gather-style
-ops (allgather / broadcast / alltoall / reducescatter) instead use an
-internal process set containing one head slot per process.  With the
-reference's canonical deployment — one process per accelerator — both
-schemes degenerate to the plain global collective.
+is bridged to the shared host-binding core (:mod:`horovod_tpu.hostops`,
+which maps process-level ops onto the framework's slot-stack SPMD
+collectives), and XLA's async dispatch plays the role of the background
+thread — a :class:`Handle` simply wraps the not-yet-materialized device
+value plus the torch write-back.
 """
 
 from __future__ import annotations
 
-import contextlib
-import threading
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence
 
 import numpy as np
 
@@ -45,17 +34,15 @@ except ImportError as _e:  # pragma: no cover - torch is baked into the image
 
 import ml_dtypes
 
-from .. import basics
-from ..ops import collectives as C
-from ..process_sets import ProcessSet
+from .. import hostops as H
 
 # Reduction-op constants (re-exported verbatim from the core).
-Average = C.Average
-Sum = C.Sum
-Adasum = C.Adasum
-Min = C.Min
-Max = C.Max
-Product = C.Product
+Average = H.Average
+Sum = H.Sum
+Adasum = H.Adasum
+Min = H.Min
+Max = H.Max
+Product = H.Product
 
 
 # --- torch <-> numpy bridge (bf16-exact via ml_dtypes bit views) ------------
@@ -90,162 +77,28 @@ def _to_torch(a: np.ndarray, like_dtype: "torch.dtype") -> "torch.Tensor":
     return out
 
 
-def _x64_if(*dtypes):
-    """64-bit transport context: JAX downcasts f64/i64 to 32 bits unless
-    x64 mode is on (the reference's MPI/NCCL path is exact for these, so
-    match it).  No-op for 32-bit-or-narrower wires."""
-    import jax
-
-    if any(np.dtype(d).itemsize == 8 for d in dtypes):
-        return jax.enable_x64(True)
-    return contextlib.nullcontext()
-
-
-def _to_host(x) -> np.ndarray:
-    """Materialize a replicated global jax.Array on this process."""
-    if getattr(x, "is_fully_addressable", True):
-        return np.asarray(x)
-    return np.asarray(x.addressable_shards[0].data)
-
-
-def _row_from_sharded(x, row: int) -> np.ndarray:
-    """Extract one leading-dim row of a slot-sharded global array; the
-    row must live on one of this process's devices."""
-    if getattr(x, "is_fully_addressable", True):
-        return np.asarray(x)[row]
-    for s in x.addressable_shards:
-        idx = s.index[0]
-        start = idx.start or 0
-        stop = idx.stop if idx.stop is not None else x.shape[0]
-        if start <= row < stop:
-            return np.asarray(s.data)[row - start]
-    raise RuntimeError(f"Row {row} is not addressable from this process")
-
-
-# --- process/world bookkeeping ----------------------------------------------
-
-def _world() -> Tuple[int, int, int]:
-    """(process_count, process_index, local_size); asserts homogeneity."""
-    basics._require_init()
-    if not basics.is_homogeneous():
-        raise RuntimeError(
-            "horovod_tpu.torch requires a homogeneous slot layout "
-            "(equal local_size on every process)"
-        )
-    import jax
-
-    return jax.process_count(), jax.process_index(), basics.local_size()
-
-
-def _head_slots() -> List[int]:
-    """First slot index of each process, in process order."""
-    gm = basics.global_mesh()
-    heads: Dict[int, int] = {}
-    for i, d in enumerate(gm.devices):
-        heads.setdefault(d.process_index, i)
-    return [heads[p] for p in sorted(heads)]
-
-
-_slot_sets_lock = threading.Lock()
-_slot_sets: Dict[Tuple[int, ...], ProcessSet] = {}
-
-
-def _slot_set(slot_ranks: Sequence[int]) -> ProcessSet:
-    """Registered slot-level process set for ``slot_ranks`` (cached —
-    the core table rejects duplicate registrations)."""
-    key = tuple(sorted(int(r) for r in slot_ranks))
-    with _slot_sets_lock:
-        ps = _slot_sets.get(key)
-        if ps is None or ps.process_set_id is None:
-            from ..process_sets import add_process_set
-
-            ps = add_process_set(ProcessSet(key))
-            _slot_sets[key] = ps
-        return ps
-
-
-def _heads_set() -> ProcessSet:
-    return _slot_set(_head_slots())
-
-
-def _torch_ranks(process_set) -> Optional[List[int]]:
-    """Torch-level (process) ranks of a user-supplied process set."""
-    if process_set is None:
-        return None
-    ranks = list(process_set.ranks)
-    if len(ranks) == _world()[0]:
-        return None
-    return ranks
-
-
-def _require_member(torch_ranks: Optional[List[int]], name: str) -> None:
-    """Raise for callers outside the process set (reference semantics).
-    Must only be called after every collective in the op has been
-    dispatched, so member controllers are never left hanging."""
-    if torch_ranks is not None and _world()[1] not in torch_ranks:
-        raise ValueError(
-            f"{name}: this worker (rank {_world()[1]}) is not a member of "
-            f"the process set {torch_ranks}")
-
-
-_NEUTRAL = {Sum: 0, Average: 0, Min: None, Max: None, Product: 1}
-
-
-def _neutral_for(op: str, np_dtype) -> Any:
-    if op == Min:
-        return (np.finfo(np_dtype).max if np.issubdtype(np_dtype, np.floating)
-                else np.iinfo(np_dtype).max)
-    if op == Max:
-        return (np.finfo(np_dtype).min if np.issubdtype(np_dtype, np.floating)
-                else np.iinfo(np_dtype).min)
-    return _NEUTRAL[op]
-
-
-def _local_block(value: np.ndarray, op: str, local_size: int) -> np.ndarray:
-    """[local_size, *S] block: head row carries the value, the rest the
-    op's neutral element (Adasum tiles — pairwise-idempotent)."""
-    if op == Adasum:
-        return np.broadcast_to(value[None], (local_size,) + value.shape).copy()
-    block = np.empty((local_size,) + value.shape, dtype=value.dtype)
-    block[0] = value
-    if local_size > 1:
-        block[1:] = _neutral_for(op, value.dtype)
-    return block
-
-
-def _lift_local(block: np.ndarray):
-    """Hand a process-local [local_size, *S] block to the core: in
-    multi-process runs the core lifts it via
-    ``make_array_from_process_local_data``; in single-controller runs the
-    block *is* the full stack."""
-    return block
-
-
 # --- handles -----------------------------------------------------------------
 
 class Handle:
     """Async handle (reference: the int handle of ``allreduce_async_``
-    resolved by ``HandleManager``).  Wraps the in-flight device value and
+    resolved by ``HandleManager``).  Wraps the in-flight host handle and
     the torch write-back applied at ``synchronize`` time."""
 
-    def __init__(self, raw, finish: Callable[[], "torch.Tensor"], name: str = ""):
-        self._raw = raw
-        self._finish = finish
-        self._result: Optional[torch.Tensor] = None
+    def __init__(self, host: H.HostHandle, to_torch, name: str = ""):
+        self._host = host
+        self._to_torch = to_torch
+        self._result = None
         self._done_flag = False
         self.name = name
 
     def wait(self) -> "torch.Tensor":
         if not self._done_flag:
-            self._result = self._finish()
+            self._result = self._to_torch(self._host.wait())
             self._done_flag = True
         return self._result
 
     def done(self) -> bool:
-        if self._done_flag:
-            return True
-        leaves = self._raw if isinstance(self._raw, (list, tuple)) else [self._raw]
-        return all(getattr(l, "is_ready", lambda: True)() for l in leaves)
+        return self._done_flag or self._host.done()
 
 
 def synchronize(handle: Handle) -> "torch.Tensor":
@@ -260,39 +113,6 @@ def poll(handle: Handle) -> bool:
 
 
 # --- allreduce ---------------------------------------------------------------
-
-def _allreduce_raw(tensor: "torch.Tensor", op: str, torch_ranks,
-                   prescale_factor: float, postscale_factor: float,
-                   name: str):
-    P_, _, L = _world()
-    value = _to_numpy(tensor)
-    block = _local_block(value, op, L)
-    core_op = Sum if op == Average else op
-    process_set = None
-    if torch_ranks is not None:
-        process_set = _slot_set([_head_slots()[r] for r in torch_ranks])
-    with _x64_if(block.dtype):
-        return C.allreduce(
-            _lift_local(block), op=core_op, process_set=process_set,
-            prescale_factor=prescale_factor, postscale_factor=postscale_factor,
-            name=name,
-        )
-
-
-def _allreduce_finish(raw, op: str, n: int, like: "torch.Tensor",
-                      out: Optional["torch.Tensor"]) -> "torch.Tensor":
-    r = _to_host(raw)
-    if op == Average:
-        if np.issubdtype(r.dtype, np.floating) or r.dtype == ml_dtypes.bfloat16:
-            r = (r / n).astype(r.dtype)
-        else:
-            r = r // n
-    t = _to_torch(r, like.dtype)
-    if out is not None:
-        out.copy_(t)
-        return out
-    return t
-
 
 def allreduce(tensor: "torch.Tensor", *, op: str = Average,
               process_set=None, prescale_factor: float = 1.0,
@@ -334,32 +154,26 @@ def allreduce_async_(tensor: "torch.Tensor", *, op: str = Average,
 
 def _allreduce_async_impl(tensor, out, op, process_set, prescale_factor,
                           postscale_factor, compression, name) -> Handle:
-    if op not in (Average, Sum, Adasum, Min, Max, Product):
-        raise ValueError(f"Unknown reduction op: {op!r}")
-    torch_ranks = _torch_ranks(process_set)
-    n = len(torch_ranks) if torch_ranks is not None else _world()[0]
     wire = tensor
     ctx = None
     if compression is not None:
         wire, ctx = compression.compress(tensor)
-    raw = _allreduce_raw(wire, op, torch_ranks, float(prescale_factor),
-                         float(postscale_factor), name)
-    # Membership is checked *after* dispatch: every controller must issue
-    # the same collective program or members would deadlock (SPMD); the
-    # reference errors for non-members too (via the C++ status path).
-    _require_member(torch_ranks, name)
+    host = H.allreduce_async(
+        _to_numpy(wire), op=op, process_set=process_set,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        name=name)
 
-    def finish():
-        r = _allreduce_finish(raw, op, n, wire, None)
+    def finish(r: np.ndarray) -> "torch.Tensor":
+        t = _to_torch(r, wire.dtype)
         if compression is not None:
-            r = compression.decompress(r, ctx)
-        r = r.to(tensor.dtype)
+            t = compression.decompress(t, ctx)
+        t = t.to(tensor.dtype)
         if out is not None:
-            out.copy_(r)
+            out.copy_(t)
             return out
-        return r
+        return t
 
-    return Handle(raw, finish, name)
+    return Handle(host, finish, name)
 
 
 def grouped_allreduce(tensors: Sequence["torch.Tensor"], *, op: str = Average,
@@ -391,9 +205,6 @@ def _grouped_allreduce_async_impl(tensors, in_place, *, op: str = Average,
                                   postscale_factor: float = 1.0,
                                   compression=None,
                                   name: str = "grouped_allreduce") -> Handle:
-    P_, _, L = _world()
-    torch_ranks = _torch_ranks(process_set)
-    n = len(torch_ranks) if torch_ranks is not None else P_
     wires, ctxs = [], []
     for t in tensors:
         if compression is not None:
@@ -402,33 +213,26 @@ def _grouped_allreduce_async_impl(tensors, in_place, *, op: str = Average,
             w, c = t, None
         wires.append(w)
         ctxs.append(c)
-    core_op = Sum if op == Average else op
-    slot_ps = None
-    if torch_ranks is not None:
-        slot_ps = _slot_set([_head_slots()[r] for r in torch_ranks])
-    blocks = [_lift_local(_local_block(_to_numpy(w), op, L)) for w in wires]
-    with _x64_if(*[b.dtype for b in blocks]):
-        raws = C.grouped_allreduce(
-            blocks, op=core_op, process_set=slot_ps,
-            prescale_factor=float(prescale_factor),
-            postscale_factor=float(postscale_factor), name=name)
-    _require_member(torch_ranks, name)
+    host = H.grouped_allreduce_async(
+        [_to_numpy(w) for w in wires], op=op, process_set=process_set,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        name=name)
 
-    def finish():
+    def finish(results: List[np.ndarray]) -> List["torch.Tensor"]:
         outs = []
-        for raw, t, w, c in zip(raws, tensors, wires, ctxs):
-            r = _allreduce_finish(raw, op, n, w, None)
+        for r, t, w, c in zip(results, tensors, wires, ctxs):
+            rt = _to_torch(r, w.dtype)
             if compression is not None:
-                r = compression.decompress(r, c)
-            r = r.to(t.dtype)
+                rt = compression.decompress(rt, c)
+            rt = rt.to(t.dtype)
             if in_place:
-                t.copy_(r)
+                t.copy_(rt)
                 outs.append(t)
             else:
-                outs.append(r)
+                outs.append(rt)
         return outs
 
-    return Handle(raws, finish, name)
+    return Handle(host, finish, name)
 
 
 # --- allgather ---------------------------------------------------------------
@@ -444,42 +248,9 @@ def allgather(tensor: "torch.Tensor", *, process_set=None,
 
 def allgather_async(tensor: "torch.Tensor", *, process_set=None,
                     name: str = "allgather") -> Handle:
-    P_, rank_, L = _world()
-    torch_ranks = _torch_ranks(process_set)
-    members = torch_ranks if torch_ranks is not None else list(range(P_))
-    heads = _head_slots()
-    ps = _slot_set([heads[r] for r in members])
-
-    value = _to_numpy(tensor)
-    if value.ndim == 0:
-        value = value[None]
-    k_local = value.shape[0]
-
-    # Round 1 (dispatched async here): the (possibly ragged) first-dim
-    # lengths.  Round 2 depends on the global max length, so it is
-    # deferred to finish() — queued allgather_asyncs thus overlap their
-    # length exchanges, and synchronize() order defines round-2 dispatch
-    # order (keep it consistent across workers, as with any collective).
-    len_block = np.zeros((L, 1), np.int32)
-    len_block[0, 0] = k_local
-    len_raw = C.allgather(_lift_local(len_block), process_set=ps,
-                          name=f"{name}.lengths")
-    _require_member(torch_ranks, name)
-
-    def finish():
-        lengths = _to_host(len_raw).reshape(-1)
-        k_max = int(lengths.max())
-        padded = np.zeros((k_max,) + value.shape[1:], dtype=value.dtype)
-        padded[:k_local] = value
-        block = np.zeros((L,) + padded.shape, dtype=value.dtype)
-        block[0] = padded
-        with _x64_if(block.dtype):
-            raw = C.allgather(_lift_local(block), process_set=ps, name=name)
-        g = _to_host(raw).reshape((len(members), k_max) + value.shape[1:])
-        parts = [g[i, : int(lengths[i])] for i in range(len(members))]
-        return _to_torch(np.concatenate(parts, axis=0), tensor.dtype)
-
-    return Handle(len_raw, finish, name)
+    host = H.allgather_async(_to_numpy(tensor), process_set=process_set,
+                             name=name)
+    return Handle(host, lambda r: _to_torch(r, tensor.dtype), name)
 
 
 def grouped_allgather(tensors: Sequence["torch.Tensor"], *, process_set=None,
@@ -514,25 +285,17 @@ def broadcast_async_(tensor: "torch.Tensor", root_rank: int = 0, *,
 
 
 def _broadcast_async_impl(tensor, out, root_rank, process_set, name) -> Handle:
-    P_, _, L = _world()
-    torch_ranks = _torch_ranks(process_set)
-    if torch_ranks is not None and root_rank not in torch_ranks:
-        raise ValueError(f"{name}: root rank {root_rank} not in process set")
-    value = _to_numpy(tensor)
-    block = np.broadcast_to(value[None], (L,) + value.shape).copy()
-    root_slot = _head_slots()[root_rank]
-    with _x64_if(block.dtype):
-        raw = C.broadcast(_lift_local(block), root_rank=root_slot, name=name)
-    _require_member(torch_ranks, name)
+    host = H.broadcast_async(_to_numpy(tensor), root_rank,
+                             process_set=process_set, name=name)
 
-    def finish():
-        t = _to_torch(_to_host(raw), tensor.dtype)
+    def finish(r: np.ndarray) -> "torch.Tensor":
+        t = _to_torch(r, tensor.dtype)
         if out is not None:
             out.copy_(t)
             return out
         return t
 
-    return Handle(raw, finish, name)
+    return Handle(host, finish, name)
 
 
 # --- alltoall ----------------------------------------------------------------
@@ -543,61 +306,13 @@ def alltoall(tensor: "torch.Tensor", splits: Optional["torch.Tensor"] = None,
     chunks to every worker, gather received chunks.  With ``splits``
     given, returns ``(gathered, received_splits)`` like the reference;
     ragged splits ride a max-pad exchange (XLA needs static shapes)."""
-    P_, rank_, L = _world()
-    torch_ranks = _torch_ranks(process_set)
-    members = torch_ranks if torch_ranks is not None else list(range(P_))
-    n = len(members)
-    heads = _head_slots()
-    ps = _slot_set([heads[r] for r in members])
-    value = _to_numpy(tensor)
-    is_member = rank_ in members
-    me = members.index(rank_) if is_member else None
-
-    if not is_member:
-        split_sizes = np.zeros((n,), np.int64)  # dispatch-only contribution
-    elif splits is None:
-        if value.shape[0] % n != 0:
-            raise ValueError(
-                f"{name}: dim 0 ({value.shape[0]}) not divisible by the "
-                f"worker count {n}; pass explicit splits")
-        split_sizes = np.full((n,), value.shape[0] // n, np.int64)
-    else:
-        split_sizes = _to_numpy(splits).astype(np.int64).reshape(-1)
-        if split_sizes.shape[0] != n or int(split_sizes.sum()) != value.shape[0]:
-            raise ValueError(f"{name}: splits must have {n} entries summing "
-                             f"to dim 0 ({value.shape[0]})")
-
-    # Exchange the full split matrix S[i, j] = worker i's chunk size for
-    # destination j via one summed allreduce: replicated on every
-    # controller, so the padded chunk size below is globally agreed and
-    # all controllers dispatch the identical program (SPMD requirement).
-    sp_local = np.zeros((n, n), np.int32)
-    if is_member:
-        sp_local[me] = split_sizes
-    sp_block = _local_block(sp_local, Sum, L)
-    S = _to_host(C.allreduce(_lift_local(sp_block), op=Sum,
-                             name=f"{name}.splits"))
-    k_max = max(int(S.max()), 1)
-
-    chunks = np.zeros((n, k_max) + value.shape[1:], dtype=value.dtype)
-    off = 0
-    for i, s in enumerate(split_sizes):
-        chunks[i, : int(s)] = value[off: off + int(s)]
-        off += int(s)
-    block = np.zeros((L, n * k_max) + value.shape[1:], dtype=value.dtype)
-    block[0] = chunks.reshape((n * k_max,) + value.shape[1:])
-    with _x64_if(block.dtype):
-        raw = C.alltoall(_lift_local(block), process_set=ps, name=name)
-    _require_member(torch_ranks, name)
-
-    received_splits = S[:, me]
-    got = _row_from_sharded(raw, heads[me]).reshape(
-        (n, k_max) + value.shape[1:])
-    parts = [got[i, : int(received_splits[i])] for i in range(n)]
-    gathered = _to_torch(np.concatenate(parts, axis=0), tensor.dtype)
+    np_splits = None if splits is None else _to_numpy(splits)
+    gathered, received = H.alltoall(_to_numpy(tensor), np_splits,
+                                    process_set=process_set, name=name)
+    out = _to_torch(gathered, tensor.dtype)
     if splits is None:
-        return gathered
-    return gathered, _to_torch(received_splits.astype(np.int64), torch.int64)
+        return out
+    return out, _to_torch(received, torch.int64)
 
 
 # --- reducescatter -----------------------------------------------------------
@@ -606,25 +321,8 @@ def reducescatter(tensor: "torch.Tensor", *, op: str = Sum,
                   process_set=None, name: str = "reducescatter"):
     """Reference: ``hvd.reducescatter`` (late vintages) — reduce then
     scatter dim-0 shards; dim 0 must divide by the worker count."""
-    P_, rank_, L = _world()
-    torch_ranks = _torch_ranks(process_set)
-    members = torch_ranks if torch_ranks is not None else list(range(P_))
-    n = len(members)
-    heads = _head_slots()
-    ps = _slot_set([heads[r] for r in members])
-    value = _to_numpy(tensor)
-    if value.shape[0] % n != 0:
-        raise ValueError(f"{name}: dim 0 ({value.shape[0]}) not divisible "
-                         f"by worker count {n}")
-    block = np.zeros((L,) + value.shape, dtype=value.dtype)
-    block[0] = value
-    with _x64_if(block.dtype):
-        raw = C.reducescatter(_lift_local(block), op=op, process_set=ps,
-                              name=name)
-    _require_member(torch_ranks, name)
-    # Average over member slots == over member processes (neutral rows),
-    # so the core's op handling is already process-correct here.
-    shard = _row_from_sharded(raw, heads[members.index(rank_)])
+    shard = H.reducescatter(_to_numpy(tensor), op=op,
+                            process_set=process_set, name=name)
     return _to_torch(shard, tensor.dtype)
 
 
@@ -632,14 +330,10 @@ def reducescatter(tensor: "torch.Tensor", *, op: str = Sum,
 
 def barrier(process_set=None, name: str = "barrier") -> None:
     """Reference: ``hvd.barrier``."""
-    torch_ranks = _torch_ranks(process_set)
-    slot_ps = None
-    if torch_ranks is not None:
-        slot_ps = _slot_set([_head_slots()[r] for r in torch_ranks])
-    C.barrier(process_set=slot_ps, name=name)
+    H.barrier(process_set=process_set, name=name)
 
 
 def join() -> int:
     """Reference: ``hvd.join()`` (see core docstring for the XLA-SPMD
     design difference)."""
-    return C.join()
+    return H.join()
