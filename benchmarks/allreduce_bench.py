@@ -1,0 +1,94 @@
+"""Allreduce bus-bandwidth sweep — the reference's second headline metric.
+
+Reference vehicle (SURVEY.md §6; mount empty, unverified): the
+BASELINE.json "allreduce bus BW (GB/s) @ 64M floats" config, measured
+the nccl-tests way: ``busbw = algbw * 2 * (n - 1) / n`` where
+``algbw = payload_bytes / time`` — the standard ring-allreduce wire
+cost model, so numbers are comparable across backends (NCCL ring on the
+reference's 8xA100 vs XLA collectives over ICI here).
+
+Usage::
+
+    python benchmarks/allreduce_bench.py                 # sweep to 64M floats
+    python benchmarks/allreduce_bench.py --max-elems 1048576 --cpu-mesh
+
+Prints one JSON line per size and a trailing summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--max-elems", type=int, default=64 * 1024 * 1024,
+                        help="largest payload in float32 elements (64M = "
+                             "the BASELINE.json config)")
+    parser.add_argument("--min-elems", type=int, default=1024)
+    parser.add_argument("--iters", type=int, default=10)
+    parser.add_argument("--warmup", type=int, default=3)
+    parser.add_argument("--dtype", default="float32",
+                        choices=["float32", "bfloat16"])
+    parser.add_argument("--cpu-mesh", action="store_true",
+                        help="force the 8-device virtual CPU mesh "
+                             "(functional check, not a perf number)")
+    args = parser.parse_args()
+
+    if args.cpu_mesh:
+        import os
+
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=8")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+    from horovod_tpu.ops import collectives as C
+
+    hvd.init()
+    n = hvd.size()
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    bytes_per = 2 if args.dtype == "bfloat16" else 4
+
+    results = []
+    elems = args.min_elems
+    while elems <= args.max_elems:
+        # Per-slot stack: every slot contributes `elems` elements; the
+        # reduced payload (the "message size" in nccl-tests terms) is
+        # one slot's worth.
+        stack = jnp.ones((n, elems), dtype)
+        out = C.allreduce(stack, op=hvd.Sum)
+        jax.block_until_ready(out)  # compile + warm cache
+        for _ in range(args.warmup):
+            jax.block_until_ready(C.allreduce(stack, op=hvd.Sum))
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = C.allreduce(stack, op=hvd.Sum)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / args.iters
+
+        payload = elems * bytes_per
+        algbw = payload / dt / 1e9
+        busbw = algbw * (2 * (n - 1) / n) if n > 1 else algbw
+        row = {"elems": elems, "bytes": payload, "time_us": dt * 1e6,
+               "algbw_GBps": round(algbw, 3), "busbw_GBps": round(busbw, 3),
+               "n_slots": n}
+        results.append(row)
+        print(json.dumps(row), flush=True)
+        elems *= 4
+
+    peak = max(r["busbw_GBps"] for r in results)
+    print(json.dumps({"metric": "allreduce_busbw_peak", "value": peak,
+                      "unit": "GB/s", "sizes_swept": len(results),
+                      "max_elems": results[-1]["elems"]}))
+
+
+if __name__ == "__main__":
+    main()

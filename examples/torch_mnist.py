@@ -1,0 +1,76 @@
+"""PyTorch data-parallel MNIST — parity with the reference's
+``examples/pytorch/pytorch_mnist.py``.
+
+Run (single controller):
+    python examples/torch_mnist.py
+Multi-worker:
+    python -m horovod_tpu.runner -np 2 python examples/torch_mnist.py
+
+Synthetic MNIST-shaped data (no dataset downloads in this environment).
+"""
+
+import os
+import sys
+
+if "--tpu" not in sys.argv:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(28 * 28, 128)
+        self.fc2 = nn.Linear(128, 10)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x.flatten(1))))
+
+
+def main():
+    hvd.init()
+    torch.manual_seed(42)
+    print(f"workers={hvd.size()} rank={hvd.rank()}")
+
+    rng = np.random.RandomState(1234 + hvd.rank())  # per-worker shard
+    x = torch.from_numpy(rng.randn(512, 28 * 28).astype(np.float32))
+    y = torch.from_numpy(rng.randint(0, 10, 512))
+
+    model = Net()
+    optimizer = torch.optim.SGD(model.parameters(),
+                                lr=0.01 * hvd.size(), momentum=0.9)
+
+    # Reference pattern: broadcast initial state, wrap the optimizer.
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+
+    model.train()
+    for epoch in range(2):
+        perm = torch.randperm(len(x))
+        for i in range(0, len(x), 64):
+            idx = perm[i:i + 64]
+            optimizer.zero_grad()
+            loss = F.cross_entropy(model(x[idx]), y[idx])
+            loss.backward()
+            optimizer.step()
+        avg = hvd.allreduce(loss.detach(), name="epoch_loss")
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss={avg.item():.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -47,6 +47,7 @@ from .functions import (  # noqa: F401
 from . import ops  # noqa: F401
 from . import elastic  # noqa: F401
 from . import data  # noqa: F401
+from . import checkpoint  # noqa: F401
 from .version import __version__  # noqa: F401
 
 # The optimizer layer depends on optax; keep it a lazy attribute (PEP 562)

@@ -141,6 +141,24 @@ class TpuState(ObjectState):
             setattr(self, k, v)
         self.commit()
 
+    # --- durable tier (orbax; reference delegates this to the framework,
+    # --- see horovod_tpu.checkpoint module docstring) -----------------------
+
+    def save_to(self, checkpointer, step: int) -> None:
+        """Persist the committed state durably (preemption-proof tier on
+        top of the reference's in-memory commit)."""
+        if not self._tree_saved and not self._saved:
+            self.commit()
+        checkpointer.save(step, {"trees": self._tree_saved,
+                                 "plain": self._saved})
+
+    def load_from(self, checkpointer, step=None) -> None:
+        """Load a durable checkpoint into this state and restore it."""
+        payload = checkpointer.restore(step)
+        self._tree_saved = payload["trees"]
+        self._saved = payload["plain"]
+        self.restore()
+
 
 def _reinitialize() -> None:
     """Tear down and rebuild the mesh/process state (reference: internal

@@ -207,7 +207,9 @@ def _average_finish(r: np.ndarray, op: str, n: int) -> np.ndarray:
             r = (r // n).astype(r.dtype)
         else:
             r = (r / n).astype(r.dtype)
-    return r
+    # 0-d arrays decay to numpy scalars under arithmetic; the framework
+    # bridges (torch.from_numpy etc.) need real ndarrays.
+    return np.asarray(r)
 
 
 def allreduce_async(value: np.ndarray, *, op: str = Average,

@@ -1,0 +1,163 @@
+"""horovod_tpu.ray — run training on a Ray cluster.
+
+Reference: ``horovod/ray/runner.py`` (``RayExecutor``) and
+``elastic_v2.py`` (SURVEY.md §2.6, mount empty, unverified): worker
+actors placed via placement groups, ``hvd.init()`` inside the actors,
+elastic variant discovering hosts from the Ray autoscaler.
+
+TPU-native redesign: Ray places the controller processes; the training
+world is a ``jax.distributed`` mesh formed from the actor ranks, and
+collectives ride XLA over ICI/DCN.  ray is not bundled in this image;
+the module imports cleanly (the placement math in :mod:`.strategy` is
+pure Python), the executor raises a clear error without ray.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from .strategy import pack_bundles, ranks_per_bundle, spread_bundles  # noqa: F401
+
+
+def _require_ray():
+    try:
+        import ray
+
+        return ray
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.ray requires ray (`pip install 'ray[default]'`); "
+            "this environment does not bundle it"
+        ) from e
+
+
+class Settings:
+    """Reference: ``RayExecutor.create_settings`` product — launch
+    knobs carried to the workers."""
+
+    def __init__(self, *, timeout_s: float = 300.0,
+                 placement_group_timeout_s: float = 100.0,
+                 verbose: int = 1):
+        self.timeout_s = timeout_s
+        self.placement_group_timeout_s = placement_group_timeout_s
+        self.verbose = verbose
+
+
+class RayExecutor:
+    """Reference API shape::
+
+        executor = RayExecutor(settings, num_workers=4, use_gpu=False)
+        executor.start()
+        results = executor.run(train_fn, args=[...])
+        executor.shutdown()
+    """
+
+    def __init__(self, settings: Optional[Settings] = None, *,
+                 num_workers: int = 1, cpus_per_worker: int = 1,
+                 gpus_per_worker: int = 0, use_gpu: bool = False,
+                 strategy: str = "pack",
+                 workers_per_host: Optional[int] = None):
+        if num_workers < 1:
+            raise ValueError("num_workers must be >= 1")
+        if strategy not in ("pack", "spread"):
+            raise ValueError("strategy must be 'pack' or 'spread'")
+        self.settings = settings or Settings()
+        self.num_workers = num_workers
+        self.cpus_per_worker = cpus_per_worker
+        self.gpus_per_worker = gpus_per_worker if use_gpu else 0
+        self.strategy = strategy
+        self.workers_per_host = workers_per_host
+        self._workers: List[Any] = []
+        self._pg = None
+
+    def bundles(self) -> List[Dict[str, int]]:
+        """The placement-group bundles this executor would request
+        (pure math — usable without ray for capacity planning)."""
+        if self.strategy == "spread":
+            return spread_bundles(self.num_workers, self.cpus_per_worker,
+                                  self.gpus_per_worker)
+        return pack_bundles(self.num_workers, self.cpus_per_worker,
+                            self.gpus_per_worker, self.workers_per_host)
+
+    def start(self) -> None:
+        """Create the placement group and worker actors."""
+        ray = _require_ray()
+        from ray.util.placement_group import placement_group
+
+        self._pg = placement_group(self.bundles(),
+                                   strategy=self.strategy.upper())
+        ray.get(self._pg.ready(),
+                timeout=self.settings.placement_group_timeout_s)
+        coordinator = _coordinator_address()
+
+        @ray.remote(num_cpus=self.cpus_per_worker,
+                    num_gpus=self.gpus_per_worker or None)
+        class _Worker:
+            def setup(self, rank: int, world: int, coord: str) -> None:
+                import os
+
+                os.environ["HVD_TPU_COORDINATOR_ADDR"] = coord
+                os.environ["HVD_TPU_NUM_PROCESSES"] = str(world)
+                os.environ["HVD_TPU_PROCESS_ID"] = str(rank)
+                import horovod_tpu as hvd
+
+                hvd.init()
+
+            def execute(self, fn, args, kwargs):
+                return fn(*args, **kwargs)
+
+            def shutdown(self) -> None:
+                import horovod_tpu as hvd
+
+                hvd.shutdown()
+
+        ranks = ranks_per_bundle(self.num_workers, self.bundles(),
+                                 self.cpus_per_worker)
+        self._workers = []
+        for bundle_idx, bundle_ranks in enumerate(ranks):
+            for rank in bundle_ranks:
+                self._workers.append(_Worker.options(
+                    placement_group=self._pg,
+                    placement_group_bundle_index=bundle_idx).remote())
+        ray.get([w.setup.remote(i, self.num_workers, coordinator)
+                 for i, w in enumerate(self._workers)],
+                timeout=self.settings.timeout_s)
+
+    def run(self, fn: Callable, args: Optional[List] = None,
+            kwargs: Optional[Dict] = None) -> List[Any]:
+        """Run ``fn`` on every worker; returns results in rank order."""
+        ray = _require_ray()
+        if not self._workers:
+            raise RuntimeError("call start() before run()")
+        return ray.get([w.execute.remote(fn, args or [], kwargs or {})
+                        for w in self._workers],
+                       timeout=self.settings.timeout_s)
+
+    def execute_single(self, fn: Callable, rank: int = 0) -> Any:
+        ray = _require_ray()
+        return ray.get(self._workers[rank].execute.remote(fn, [], {}))
+
+    def shutdown(self) -> None:
+        if not self._workers:
+            return
+        ray = _require_ray()
+        ray.get([w.shutdown.remote() for w in self._workers], timeout=60)
+        for w in self._workers:
+            ray.kill(w)
+        self._workers = []
+        if self._pg is not None:
+            from ray.util.placement_group import remove_placement_group
+
+            remove_placement_group(self._pg)
+            self._pg = None
+
+
+def _coordinator_address() -> str:
+    import socket
+
+    from ..runner.common.network import resolvable_hostname
+
+    with socket.socket() as s:
+        s.bind(("0.0.0.0", 0))
+        port = s.getsockname()[1]
+    return f"{resolvable_hostname()}:{port}"

@@ -1,0 +1,7 @@
+"""Runner support services.
+
+Reference: ``horovod/runner/common/`` (SURVEY.md §2.5, mount empty,
+unverified) — the driver/task pre-flight mesh: HMAC-signed pickled RPC
+over sockets, network-interface detection, and safe subprocess
+execution used by the launcher before any worker calls ``init()``.
+"""

@@ -1,0 +1,190 @@
+"""Socket RPC + network-interface detection for the runner.
+
+Reference: ``horovod/runner/common/util/network.py`` and
+``common/service/*`` (SURVEY.md §2.5, mount empty, unverified): a tiny
+threaded TCP service speaking HMAC-signed pickled request/response
+messages, plus helpers to enumerate local addresses so the driver can
+pick interfaces every host can route to (on TPU pods this selects the
+DCN-facing NIC; ICI is invisible to the host network stack).
+
+Security note: frames are authenticated *before* unpickling — a frame
+whose HMAC does not match the launcher-minted secret is dropped.
+"""
+
+from __future__ import annotations
+
+import hmac
+import hashlib
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from .secret import DIGEST_LEN
+
+_LEN = struct.Struct(">Q")
+
+
+class PingRequest:
+    pass
+
+
+class PingResponse:
+    def __init__(self, service_name: str, source_address: str):
+        self.service_name = service_name
+        self.source_address = source_address
+
+
+class AckResponse:
+    pass
+
+
+def local_addresses() -> Dict[str, List[str]]:
+    """{interface: [ipv4...]} for all non-loopback interfaces (plus
+    loopback itself, which single-host runs rely on)."""
+    import psutil
+
+    out: Dict[str, List[str]] = {}
+    for nic, addrs in psutil.net_if_addrs().items():
+        v4 = [a.address for a in addrs if a.family == socket.AF_INET]
+        if v4:
+            out[nic] = v4
+    return out
+
+
+def routable_addresses(include_loopback: bool = True) -> List[str]:
+    addrs = [ip for ips in local_addresses().values() for ip in ips]
+    if not include_loopback:
+        addrs = [a for a in addrs if not a.startswith("127.")]
+    return addrs
+
+
+def resolvable_hostname() -> str:
+    host = socket.gethostname()
+    try:
+        socket.gethostbyname(host)
+        return host
+    except OSError:
+        return "127.0.0.1"
+
+
+def _sign(key: bytes, payload: bytes) -> bytes:
+    return hmac.new(key, payload, hashlib.sha256).digest()
+
+
+def write_message(sock: socket.socket, obj: Any, key: bytes) -> None:
+    payload = pickle.dumps(obj)
+    frame = _sign(key, payload) + payload
+    sock.sendall(_LEN.pack(len(frame)) + frame)
+
+
+def read_message(sock: socket.socket, key: bytes) -> Any:
+    header = _read_exact(sock, _LEN.size)
+    (length,) = _LEN.unpack(header)
+    if length > 64 * 1024 * 1024:
+        raise ValueError(f"RPC frame too large: {length}")
+    frame = _read_exact(sock, length)
+    digest, payload = frame[:DIGEST_LEN], frame[DIGEST_LEN:]
+    if not hmac.compare_digest(digest, _sign(key, payload)):
+        raise PermissionError("RPC frame failed HMAC authentication")
+    return pickle.loads(payload)
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf += chunk
+    return buf
+
+
+class BasicService:
+    """Threaded TCP request/response service (reference:
+    ``network.BasicService``).  Subclasses override ``_handle``."""
+
+    def __init__(self, name: str, key: bytes, host: str = "0.0.0.0"):
+        self.name = name
+        self._key = key
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    req = read_message(self.request, outer._key)
+                except (PermissionError, ConnectionError, ValueError):
+                    return  # unauthenticated/broken peer: drop silently
+                resp = outer._handle(req, self.client_address)
+                write_message(self.request, resp, outer._key)
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, 0), _Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True,
+                                        name=f"{name}-service")
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def addresses(self) -> List[Tuple[str, int]]:
+        """Every (ip, port) a client could try, all interfaces."""
+        return [(ip, self.port) for ip in routable_addresses()]
+
+    def _handle(self, req: Any, client_address) -> Any:
+        if isinstance(req, PingRequest):
+            return PingResponse(self.name, client_address[0])
+        return AckResponse()
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
+
+
+class BasicClient:
+    """Client side; tries each candidate address until one answers the
+    ping (reference: the driver probing every task address to find a
+    routable interface)."""
+
+    def __init__(self, name: str, addresses: List[Tuple[str, int]],
+                 key: bytes, probe_timeout: float = 5.0):
+        self.name = name
+        self._key = key
+        self._timeout = probe_timeout
+        self._address = self._probe(addresses)
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._address
+
+    def _probe(self, addresses) -> Tuple[str, int]:
+        errs = []
+        for addr in addresses:
+            try:
+                resp = self._call(PingRequest(), addr)
+                if isinstance(resp, PingResponse) and resp.service_name == self.name:
+                    return tuple(addr)
+            except OSError as e:
+                errs.append((addr, e))
+        raise ConnectionError(
+            f"no address of service {self.name!r} answered: {errs}")
+
+    def _call(self, req: Any, addr: Optional[Tuple[str, int]] = None) -> Any:
+        addr = addr or self._address
+        with socket.create_connection(addr, timeout=self._timeout) as sock:
+            write_message(sock, req, self._key)
+            return read_message(sock, self._key)
+
+    def request(self, req: Any) -> Any:
+        return self._call(req)
+
+    def ping(self) -> PingResponse:
+        return self._call(PingRequest())

@@ -1,0 +1,31 @@
+"""Shared-secret generation for the runner RPC layer.
+
+Reference: ``horovod/runner/common/util/secret.py`` (SURVEY.md §2.5,
+mount empty, unverified): the driver mints a random key, passes it to
+every task via the environment, and every RPC frame is HMAC-signed with
+it so an unauthenticated peer can't inject pickled payloads.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+
+# Env var carrying the key from driver to spawned tasks (reference:
+# HOROVOD_SECRET_KEY).
+SECRET_ENV = "HVD_TPU_SECRET_KEY"
+
+DIGEST_LEN = 32  # sha256
+
+
+def make_secret_key() -> bytes:
+    return base64.b64encode(os.urandom(32))
+
+
+def secret_from_env() -> bytes:
+    key = os.environ.get(SECRET_ENV)
+    if not key:
+        raise RuntimeError(
+            f"{SECRET_ENV} is not set; the launcher must pass the RPC "
+            "secret to every task")
+    return key.encode()

@@ -1,0 +1,196 @@
+"""Driver/task pre-flight services.
+
+Reference: ``horovod/runner/common/service/driver_service.py`` +
+``task_service.py`` (SURVEY.md §2.5/§3.4, mount empty, unverified).
+Before any worker calls ``init()``, the launcher runs a *driver service*
+on the controlling host and a *task service* per target host.  Tasks
+register with the driver; the driver probes task→task connectivity and
+intersects the interfaces every pair can route (the reference's common-
+NIC selection); then tasks are told to exec the worker command.
+
+On TPU pods the platform does placement, so this mesh's job narrows to:
+verify mutual reachability over DCN, agree on the coordinator address
+for ``jax.distributed``, and fan the run command out — but the protocol
+is kept so self-managed (non-GKE) fleets work like the reference.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .network import (
+    AckResponse, BasicClient, BasicService, PingRequest, PingResponse,
+)
+from .safe_shell_exec import execute
+
+
+class RegisterTaskRequest:
+    def __init__(self, index: int, addresses: List[Tuple[str, int]],
+                 hostname: str):
+        self.index = index
+        self.addresses = addresses
+        self.hostname = hostname
+
+
+class AllTaskAddressesRequest:
+    def __init__(self, index: int):
+        self.index = index
+
+
+class AllTaskAddressesResponse:
+    def __init__(self, all_addresses: Dict[int, List[Tuple[str, int]]]):
+        self.all_addresses = all_addresses
+
+
+class ProbePeerRequest:
+    def __init__(self, peer_index: int,
+                 peer_addresses: List[Tuple[str, int]]):
+        self.peer_index = peer_index
+        self.peer_addresses = peer_addresses
+
+
+class ProbePeerResponse:
+    def __init__(self, reachable_address: Optional[Tuple[str, int]]):
+        self.reachable_address = reachable_address
+
+
+class RunCommandRequest:
+    def __init__(self, command: List[str], env: Dict[str, str]):
+        self.command = command
+        self.env = env
+
+
+class CommandExitCodeRequest:
+    pass
+
+
+class CommandExitCodeResponse:
+    def __init__(self, done: bool, exit_code: Optional[int]):
+        self.done = done
+        self.exit_code = exit_code
+
+
+class DriverService(BasicService):
+    """Collects task registrations and answers the full address table
+    (reference: ``HorovodRunDriverService``)."""
+
+    def __init__(self, num_tasks: int, key: bytes, name: str = "driver"):
+        super().__init__(name, key)
+        self._num_tasks = num_tasks
+        self._tasks: Dict[int, RegisterTaskRequest] = {}
+        self._cv = threading.Condition()
+
+    def _handle(self, req: Any, client_address) -> Any:
+        if isinstance(req, RegisterTaskRequest):
+            with self._cv:
+                self._tasks[req.index] = req
+                self._cv.notify_all()
+            return AckResponse()
+        if isinstance(req, AllTaskAddressesRequest):
+            with self._cv:
+                return AllTaskAddressesResponse(
+                    {i: t.addresses for i, t in self._tasks.items()})
+        return super()._handle(req, client_address)
+
+    def wait_for_initial_registration(self, timeout_s: float = 120.0) -> None:
+        with self._cv:
+            ok = self._cv.wait_for(
+                lambda: len(self._tasks) >= self._num_tasks,
+                timeout=timeout_s)
+        if not ok:
+            missing = sorted(set(range(self._num_tasks)) - set(self._tasks))
+            raise TimeoutError(
+                f"tasks {missing} did not register within {timeout_s}s")
+
+    def task_addresses(self) -> Dict[int, List[Tuple[str, int]]]:
+        with self._cv:
+            return {i: t.addresses for i, t in self._tasks.items()}
+
+    def task_hostnames(self) -> Dict[int, str]:
+        with self._cv:
+            return {i: t.hostname for i, t in self._tasks.items()}
+
+
+class TaskService(BasicService):
+    """Per-host agent: answers pings, probes peers on request, and execs
+    the worker command (reference: ``HorovodRunTaskService``)."""
+
+    def __init__(self, index: int, key: bytes, name: Optional[str] = None):
+        super().__init__(name or f"task-{index}", key)
+        self.index = index
+        self._key_bytes = key
+        self._cmd_thread: Optional[threading.Thread] = None
+        self._exit_code: Optional[int] = None
+        self._abort = threading.Event()
+
+    def _handle(self, req: Any, client_address) -> Any:
+        if isinstance(req, ProbePeerRequest):
+            try:
+                client = BasicClient(f"task-{req.peer_index}",
+                                     req.peer_addresses, self._key_bytes,
+                                     probe_timeout=3.0)
+                return ProbePeerResponse(client.address)
+            except ConnectionError:
+                return ProbePeerResponse(None)
+        if isinstance(req, RunCommandRequest):
+            self._launch(req.command, req.env)
+            return AckResponse()
+        if isinstance(req, CommandExitCodeRequest):
+            done = (self._cmd_thread is not None
+                    and not self._cmd_thread.is_alive())
+            return CommandExitCodeResponse(done,
+                                           self._exit_code if done else None)
+        return super()._handle(req, client_address)
+
+    def _launch(self, command: List[str], env: Dict[str, str]) -> None:
+        if self._cmd_thread is not None and self._cmd_thread.is_alive():
+            raise RuntimeError("a command is already running")
+
+        def target():
+            self._exit_code = execute(command, env=env,
+                                      events=[self._abort])
+
+        self._cmd_thread = threading.Thread(target=target, daemon=True)
+        self._cmd_thread.start()
+
+    def wait_for_command(self, timeout_s: Optional[float] = None) -> int:
+        if self._cmd_thread is None:
+            raise RuntimeError("no command was launched")
+        self._cmd_thread.join(timeout=timeout_s)
+        if self._cmd_thread.is_alive():
+            raise TimeoutError("command still running")
+        return self._exit_code
+
+    def abort_command(self) -> None:
+        self._abort.set()
+
+    def shutdown(self) -> None:
+        self._abort.set()
+        super().shutdown()
+
+
+def probe_full_mesh(driver: DriverService, key: bytes,
+                    timeout_s: float = 60.0) -> Dict[Tuple[int, int],
+                                                     Tuple[str, int]]:
+    """Drive the pairwise connectivity probe (reference: the driver's
+    interface-selection pass): for every ordered task pair (i, j), ask i
+    to reach j; returns {(i, j): address_that_worked}.  Raises if any
+    pair is unreachable."""
+    addresses = driver.task_addresses()
+    clients = {i: BasicClient(f"task-{i}", addrs, key)
+               for i, addrs in addresses.items()}
+    routes: Dict[Tuple[int, int], Tuple[str, int]] = {}
+    deadline = time.monotonic() + timeout_s
+    for i, client in clients.items():
+        for j, peer_addrs in addresses.items():
+            if i == j:
+                continue
+            if time.monotonic() > deadline:
+                raise TimeoutError("mesh probe timed out")
+            resp = client.request(ProbePeerRequest(j, peer_addrs))
+            if resp.reachable_address is None:
+                raise ConnectionError(f"task {i} cannot reach task {j}")
+            routes[(i, j)] = resp.reachable_address
+    return routes

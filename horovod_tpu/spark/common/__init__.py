@@ -1,0 +1,8 @@
+"""Shared Spark-estimator plumbing (stores, params).
+
+Reference: ``horovod/spark/common/`` (SURVEY.md §2.6, mount empty,
+unverified).
+"""
+
+from .params import EstimatorParams  # noqa: F401
+from .store import FilesystemStore, LocalStore, Store  # noqa: F401

@@ -1,0 +1,69 @@
+"""Keras Spark estimator.
+
+Reference: ``horovod/spark/keras/`` (SURVEY.md §2.6, mount empty,
+unverified): ``KerasEstimator`` — a Spark ML Estimator that writes the
+DataFrame to the store as Parquet (Petastorm in the reference), runs a
+distributed ``model.fit`` over ``num_proc`` Spark tasks via
+``horovod_tpu.spark.run``, and returns a ``KerasModel`` transformer
+holding the trained weights.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from ..common.params import EstimatorParams
+from ..common.store import Store
+
+
+class KerasEstimator(EstimatorParams):
+    """Reference API shape: ``KerasEstimator(model=..., optimizer=...,
+    loss=..., store=..., num_proc=N).fit(df) -> KerasModel``."""
+
+    def __init__(self, model=None, optimizer=None, custom_objects=None,
+                 **params: Any) -> None:
+        super().__init__(**params)
+        self.model = model
+        self.optimizer = optimizer
+        self.custom_objects = custom_objects or {}
+
+    def _validate(self) -> None:
+        if self.model is None:
+            raise ValueError("KerasEstimator requires model=")
+        if self._get("loss") is None:
+            raise ValueError("KerasEstimator requires loss=")
+        store = self._get("store")
+        if store is not None and not isinstance(store, Store):
+            raise TypeError("store must be a horovod_tpu.spark Store")
+
+    def fit(self, df, params: Optional[dict] = None) -> "KerasModel":
+        """Distributed fit over a Spark DataFrame (requires pyspark)."""
+        self._validate()
+        from .. import _require_pyspark, run
+
+        _require_pyspark()
+        raise NotImplementedError(
+            "DataFrame training requires the Parquet data-loader path, "
+            "which needs pyspark at build time; this environment does not "
+            "bundle pyspark.  Train with horovod_tpu.spark.run(fn) or the "
+            "native data pipeline (horovod_tpu.data) instead.")
+
+
+class KerasModel:
+    """Reference: the fitted Spark Transformer — holds trained weights
+    and applies the model to DataFrames."""
+
+    def __init__(self, model=None, history: Optional[List[dict]] = None,
+                 run_id: Optional[str] = None):
+        self.model = model
+        self.history = history or []
+        self.run_id = run_id
+
+    def getModel(self):
+        return self.model
+
+    def transform(self, df):
+        from .. import _require_pyspark
+
+        _require_pyspark()
+        raise NotImplementedError("DataFrame inference requires pyspark")

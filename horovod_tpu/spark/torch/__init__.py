@@ -1,0 +1,60 @@
+"""Torch Spark estimator.
+
+Reference: ``horovod/spark/torch/`` (SURVEY.md §2.6, mount empty,
+unverified) — same estimator contract as the Keras one with a torch
+``model``/``optimizer``/``loss`` triple.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from ..common.params import EstimatorParams
+from ..common.store import Store
+
+
+class TorchEstimator(EstimatorParams):
+    """Reference API shape: ``TorchEstimator(model=..., optimizer=...,
+    loss=..., store=..., num_proc=N).fit(df) -> TorchModel``."""
+
+    def __init__(self, model=None, optimizer=None, input_shapes=None,
+                 **params: Any) -> None:
+        super().__init__(**params)
+        self.model = model
+        self.optimizer = optimizer
+        self.input_shapes = input_shapes
+
+    def _validate(self) -> None:
+        if self.model is None:
+            raise ValueError("TorchEstimator requires model=")
+        if self._get("loss") is None:
+            raise ValueError("TorchEstimator requires loss=")
+        store = self._get("store")
+        if store is not None and not isinstance(store, Store):
+            raise TypeError("store must be a horovod_tpu.spark Store")
+
+    def fit(self, df, params: Optional[dict] = None) -> "TorchModel":
+        self._validate()
+        from .. import _require_pyspark
+
+        _require_pyspark()
+        raise NotImplementedError(
+            "DataFrame training requires pyspark; train with "
+            "horovod_tpu.spark.run(fn) or horovod_tpu.torch directly.")
+
+
+class TorchModel:
+    def __init__(self, model=None, history: Optional[List[dict]] = None,
+                 run_id: Optional[str] = None):
+        self.model = model
+        self.history = history or []
+        self.run_id = run_id
+
+    def getModel(self):
+        return self.model
+
+    def transform(self, df):
+        from .. import _require_pyspark
+
+        _require_pyspark()
+        raise NotImplementedError("DataFrame inference requires pyspark")

@@ -117,7 +117,11 @@ class LocalGradientAggregationHelper:
     def _build(self, grads):
         self._counter = tf.Variable(0, dtype=tf.int64, trainable=False,
                                     name="hvd_tpu_agg_counter")
+        # Unconnected/frozen variables yield None gradients; they get no
+        # accumulator and stay None through the boundary apply (the same
+        # pass-through the backward_passes_per_step=1 path gives them).
         self._accum = [
+            None if g is None else
             tf.Variable(tf.zeros_like(g), trainable=False,
                         name=f"hvd_tpu_agg_{i}")
             for i, g in enumerate(grads)
@@ -130,15 +134,17 @@ class LocalGradientAggregationHelper:
         if self._counter is None:
             self._build(grads)
         for acc, g in zip(self._accum, grads):
-            if g is not None:
+            if acc is not None and g is not None:
                 acc.assign_add(tf.cast(g, acc.dtype))
         self._counter.assign_add(1)
 
         def boundary():
-            mean = [tf.cast(a / self.n, a.dtype) for a in self._accum]
+            mean = [None if a is None else tf.cast(a / self.n, a.dtype)
+                    for a in self._accum]
             apply_fn(self._allreduce(mean))
             for a in self._accum:
-                a.assign(tf.zeros_like(a))
+                if a is not None:
+                    a.assign(tf.zeros_like(a))
             return tf.constant(True)
 
         tf.cond(tf.equal(self._counter % self.n, 0),

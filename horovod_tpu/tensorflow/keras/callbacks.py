@@ -22,15 +22,18 @@ from ..mpi_ops import Average, allreduce
 
 class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
     """Reference: broadcast all model + optimizer variables from
-    ``root_rank`` before the first batch, so every worker starts
-    identical."""
+    ``root_rank`` after the first batch, so every worker proceeds from
+    identical state.  ``on_batch_end`` (not ``_begin``) because Keras
+    builds model/optimizer variables lazily during the first batch —
+    broadcasting earlier would sync an empty or partial variable list
+    (the reference hooks batch end for the same reason)."""
 
     def __init__(self, root_rank: int = 0):
         super().__init__()
         self.root_rank = root_rank
         self.broadcast_done = False
 
-    def on_batch_begin(self, batch, logs=None):
+    def on_batch_end(self, batch, logs=None):
         if self.broadcast_done:
             return
         broadcast_variables(self.model.variables, self.root_rank)
@@ -42,28 +45,48 @@ class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
 
 class MetricAverageCallback(keras.callbacks.Callback):
     """Reference: average epoch metrics over workers at epoch end (so
-    rank-0 logging/checkpoint decisions see global metrics)."""
+    rank-0 logging/checkpoint decisions see global metrics).
+
+    Every worker must dispatch the same collectives in the same order
+    (SPMD), so metric *keys* are walked in sorted order and a metric is
+    reduced whenever its value is numeric — including NaN/inf, which
+    propagate through the average rather than desynchronizing workers
+    that skip the op."""
 
     def on_epoch_end(self, epoch, logs=None):
         if logs is None or size() == 1:
             return
-        for k, v in list(logs.items()):
-            if isinstance(v, (int, float)) and math.isfinite(float(v)):
-                logs[k] = float(allreduce(
-                    tf.constant(float(v), tf.float32), op=Average,
-                    name=f"metric.{k}"))
+        for k in sorted(logs):
+            try:
+                v = float(logs[k])   # covers int/float/np scalars/0-d tf
+            except (TypeError, ValueError):
+                continue
+            logs[k] = float(allreduce(
+                tf.constant(v, tf.float32), op=Average,
+                name=f"metric.{k}"))
 
 
 def _get_lr(optimizer) -> float:
     return float(tf.keras.backend.get_value(optimizer.learning_rate))
 
 
-def _set_lr(optimizer, lr: float) -> None:
+def _set_lr(optimizer, lr: float, momentum_correction: bool = False) -> None:
+    old_lr = _get_lr(optimizer)
     lr_var = optimizer.learning_rate
     if isinstance(lr_var, tf.Variable):
         lr_var.assign(lr)
     else:  # plain attribute (schedules are rejected by the callbacks)
         optimizer.learning_rate = lr
+    # Reference recipe (Goyal et al. §2.1 / upstream momentum_correction):
+    # SGD momentum buffers accumulate lr-scaled updates, so an LR change
+    # must rescale them by new/old or the first post-change steps move
+    # with the stale magnitude.
+    if momentum_correction and old_lr > 0 and lr != old_lr:
+        scale = lr / old_lr
+        for v in getattr(optimizer, "variables", []):
+            name = getattr(v, "path", None) or getattr(v, "name", "")
+            if "momentum" in str(name).lower():
+                v.assign(v * scale)
 
 
 class LearningRateWarmupCallback(keras.callbacks.Callback):
@@ -100,7 +123,8 @@ class LearningRateWarmupCallback(keras.callbacks.Callback):
             return
         # Linear ramp 1/size → 1 of the target rate.
         factor = (1.0 / size()) + (1.0 - 1.0 / size()) * progress
-        _set_lr(self.model.optimizer, self.initial_lr * factor)
+        _set_lr(self.model.optimizer, self.initial_lr * factor,
+                self._momentum_correction)
 
     def on_epoch_end(self, epoch, logs=None):
         if epoch + 1 == int(math.ceil(self.warmup_epochs)):
@@ -118,6 +142,7 @@ class LearningRateScheduleCallback(keras.callbacks.Callback):
 
     def __init__(self, initial_lr: float, multiplier, start_epoch: int = 0,
                  end_epoch: Optional[int] = None, staircase: bool = True,
+                 momentum_correction: bool = True,
                  steps_per_epoch: Optional[int] = None, verbose: int = 0):
         super().__init__()
         self.initial_lr = initial_lr
@@ -126,6 +151,7 @@ class LearningRateScheduleCallback(keras.callbacks.Callback):
         self.staircase = staircase
         self.steps_per_epoch = steps_per_epoch
         self.verbose = verbose
+        self._momentum_correction = momentum_correction
         self.current_epoch = 0
         self._steps = None
         if callable(multiplier):
@@ -145,7 +171,8 @@ class LearningRateScheduleCallback(keras.callbacks.Callback):
         self.current_epoch = epoch
         if self.staircase and self._in_range(epoch):
             _set_lr(self.model.optimizer,
-                    self.initial_lr * self.multiplier(epoch))
+                    self.initial_lr * self.multiplier(epoch),
+                    self._momentum_correction)
 
     def on_batch_begin(self, batch, logs=None):
         if self.staircase or self._steps is None:
@@ -153,7 +180,8 @@ class LearningRateScheduleCallback(keras.callbacks.Callback):
         epoch = self.current_epoch + float(batch) / self._steps
         if self._in_range(self.current_epoch):
             _set_lr(self.model.optimizer,
-                    self.initial_lr * self.multiplier(epoch))
+                    self.initial_lr * self.multiplier(epoch),
+                    self._momentum_correction)
 
     def on_epoch_end(self, epoch, logs=None):
         if logs is not None:

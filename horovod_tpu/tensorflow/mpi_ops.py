@@ -93,8 +93,10 @@ def allreduce(tensor, *, op: str = Average, process_set=None,
     allgather of values and indices (averaging deferred to the dense
     apply), matching ``horovod.tensorflow._allreduce`` semantics."""
     if isinstance(tensor, tf.IndexedSlices):
-        values = allgather(tensor.values, name=f"{name}.values")
-        indices = allgather(tensor.indices, name=f"{name}.indices")
+        values = allgather(tensor.values, process_set=process_set,
+                           name=f"{name}.values")
+        indices = allgather(tensor.indices, process_set=process_set,
+                            name=f"{name}.indices")
         if op == Average:
             n = _set_size(process_set)
             values = values / tf.cast(n, values.dtype)
@@ -187,14 +189,26 @@ def alltoall(tensor, splits=None, *, process_set=None,
     chunks to every worker, gather received chunks; with ``splits``
     returns ``(gathered, received_splits)``."""
     tensor = tf.convert_to_tensor(tensor)
-    np_splits = None if splits is None else _to_numpy(splits).astype(np.int64)
+    if splits is None:
+        def run(value):
+            gathered, received = H.alltoall(value, None,
+                                            process_set=process_set,
+                                            name=name)
+            return [gathered, received]
 
-    def run(value):
-        gathered, received = H.alltoall(value, np_splits,
-                                        process_set=process_set, name=name)
-        return [gathered, received]
+        inputs = [tensor]
+    else:
+        # splits rides through the bridge too: inside tf.function it is a
+        # symbolic tensor with no .numpy() until the op executes.
+        def run(value, np_splits):
+            gathered, received = H.alltoall(
+                value, np.asarray(np_splits, np.int64),
+                process_set=process_set, name=name)
+            return [gathered, received]
 
-    gathered, received = _np_bridge(run, [tensor], [tensor.dtype, tf.int64],
+        inputs = [tensor, tf.convert_to_tensor(splits)]
+
+    gathered, received = _np_bridge(run, inputs, [tensor.dtype, tf.int64],
                                     name)
     gathered.set_shape(tf.TensorShape([None]).concatenate(tensor.shape[1:]))
     if splits is None:

@@ -1,0 +1,110 @@
+"""Spark/Ray integration-layer tests (no pyspark/ray in this image —
+mirrors the reference's unit pattern for launcher layers: test the pure
+logic, gate the cluster paths; SURVEY.md §4 ``test/single/``)."""
+
+import pytest
+
+from horovod_tpu.ray import RayExecutor, Settings
+from horovod_tpu.ray.strategy import (
+    pack_bundles, ranks_per_bundle, spread_bundles,
+)
+from horovod_tpu.spark.common.params import EstimatorParams
+from horovod_tpu.spark.common.store import FilesystemStore, Store
+from horovod_tpu.spark.keras import KerasEstimator
+from horovod_tpu.spark.torch import TorchEstimator
+
+
+class TestStore:
+    def test_layout(self, tmp_path):
+        s = Store.create(str(tmp_path))
+        assert s.get_checkpoint_path("run1").endswith("runs/run1/checkpoint")
+        assert "intermediate_train_data" in s.get_train_data_path()
+
+    def test_filesystem_roundtrip(self, tmp_path):
+        s = FilesystemStore(str(tmp_path))
+        p = s.get_checkpoint_path("r") + "/obj.pkl"
+        s.write_serialized(p, {"a": 1})
+        assert s.exists(p)
+        assert s.read_serialized(p) == {"a": 1}
+        s.delete(s.get_run_path("r"))
+        assert not s.exists(p)
+
+    def test_remote_schemes_rejected(self):
+        with pytest.raises(ValueError, match="HDFS/S3"):
+            Store.create("hdfs://nn/path")
+
+
+class TestEstimatorParams:
+    def test_defaults_and_accessors(self):
+        p = EstimatorParams(epochs=3)
+        assert p.getEpochs() == 3
+        p.setBatchSize(64)
+        assert p.getBatchSize() == 64
+        assert p.getNumProc() is None
+
+    def test_unknown_param_rejected(self):
+        with pytest.raises(TypeError, match="unknown estimator param"):
+            EstimatorParams(bogus=1)
+
+    def test_keras_estimator_validation(self, tmp_path):
+        est = KerasEstimator(model=object(), loss="mse",
+                             store=FilesystemStore(str(tmp_path)))
+        with pytest.raises((ImportError, NotImplementedError)):
+            est.fit(None)
+        with pytest.raises(ValueError, match="requires model"):
+            KerasEstimator(loss="mse").fit(None)
+
+    def test_torch_estimator_validation(self):
+        with pytest.raises(ValueError, match="requires loss"):
+            TorchEstimator(model=object()).fit(None)
+
+
+class TestSparkRunGated:
+    def test_run_requires_pyspark(self):
+        import horovod_tpu.spark as hvd_spark
+
+        with pytest.raises(ImportError, match="pyspark"):
+            hvd_spark.run(lambda: None, num_proc=2)
+
+
+class TestRayStrategy:
+    def test_pack_single_host(self):
+        assert pack_bundles(4, cpus_per_worker=2) == [{"CPU": 8}]
+
+    def test_pack_multi_host(self):
+        bundles = pack_bundles(5, cpus_per_worker=1, workers_per_host=2)
+        assert bundles == [{"CPU": 2}, {"CPU": 2}, {"CPU": 1}]
+        assert ranks_per_bundle(5, bundles) == [[0, 1], [2, 3], [4]]
+
+    def test_spread(self):
+        assert spread_bundles(3, cpus_per_worker=2) == [{"CPU": 2}] * 3
+
+    def test_gpu_bundles(self):
+        assert pack_bundles(2, 1, gpus_per_worker=1) == [{"CPU": 2, "GPU": 2}]
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            pack_bundles(0)
+        with pytest.raises(ValueError):
+            ranks_per_bundle(3, [{"CPU": 1}])
+
+
+class TestRayExecutorGated:
+    def test_bundles_without_ray(self):
+        ex = RayExecutor(Settings(), num_workers=4, cpus_per_worker=1,
+                         strategy="spread")
+        assert ex.bundles() == [{"CPU": 1}] * 4
+
+    def test_start_requires_ray(self):
+        ex = RayExecutor(num_workers=2)
+        with pytest.raises(ImportError, match="ray"):
+            ex.start()
+
+    def test_run_before_start(self):
+        ex = RayExecutor(num_workers=2)
+        with pytest.raises((RuntimeError, ImportError)):
+            ex.run(lambda: 1)
+
+    def test_bad_strategy(self):
+        with pytest.raises(ValueError, match="strategy"):
+            RayExecutor(num_workers=1, strategy="diagonal")

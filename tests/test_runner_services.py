@@ -1,0 +1,140 @@
+"""Runner service-layer tests.
+
+Reference pattern: ``test/single/test_run.py`` (SURVEY.md §4) — pure
+unit tests of the launcher plumbing on loopback; mocks are reserved for
+ssh/exec, the RPC itself is real sockets.
+"""
+
+import sys
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.runner.common import network, secret
+from horovod_tpu.runner.common.safe_shell_exec import (
+    execute, terminate_process_group,
+)
+from horovod_tpu.runner.common.service import (
+    AllTaskAddressesRequest, DriverService, RegisterTaskRequest,
+    RunCommandRequest, TaskService, probe_full_mesh,
+)
+
+
+@pytest.fixture
+def key():
+    return secret.make_secret_key()
+
+
+class TestSecret:
+    def test_distinct(self):
+        assert secret.make_secret_key() != secret.make_secret_key()
+
+    def test_env_roundtrip(self, key, monkeypatch):
+        monkeypatch.setenv(secret.SECRET_ENV, key.decode())
+        assert secret.secret_from_env() == key
+
+    def test_env_missing(self, monkeypatch):
+        monkeypatch.delenv(secret.SECRET_ENV, raising=False)
+        with pytest.raises(RuntimeError, match="not set"):
+            secret.secret_from_env()
+
+
+class TestNetwork:
+    def test_local_addresses(self):
+        addrs = network.local_addresses()
+        assert any(ip.startswith("127.") for ips in addrs.values()
+                   for ip in ips)
+
+    def test_ping(self, key):
+        svc = network.BasicService("svc", key)
+        try:
+            client = network.BasicClient("svc", [("127.0.0.1", svc.port)],
+                                         key)
+            resp = client.ping()
+            assert resp.service_name == "svc"
+        finally:
+            svc.shutdown()
+
+    def test_bad_key_rejected(self, key):
+        svc = network.BasicService("svc", key)
+        try:
+            with pytest.raises(ConnectionError):
+                network.BasicClient("svc", [("127.0.0.1", svc.port)],
+                                    b"wrong-key", probe_timeout=2.0)
+        finally:
+            svc.shutdown()
+
+    def test_wrong_service_name_rejected(self, key):
+        svc = network.BasicService("actual", key)
+        try:
+            with pytest.raises(ConnectionError):
+                network.BasicClient("expected", [("127.0.0.1", svc.port)],
+                                    key, probe_timeout=2.0)
+        finally:
+            svc.shutdown()
+
+
+class TestDriverTaskMesh:
+    def test_registration_and_probe(self, key):
+        driver = DriverService(num_tasks=2, key=key)
+        tasks = [TaskService(i, key) for i in range(2)]
+        try:
+            dclient = network.BasicClient(
+                "driver", [("127.0.0.1", driver.port)], key)
+            for t in tasks:
+                dclient.request(RegisterTaskRequest(
+                    t.index, [("127.0.0.1", t.port)], "localhost"))
+            driver.wait_for_initial_registration(timeout_s=10)
+            table = dclient.request(AllTaskAddressesRequest(0)).all_addresses
+            assert set(table) == {0, 1}
+            routes = probe_full_mesh(driver, key)
+            assert set(routes) == {(0, 1), (1, 0)}
+        finally:
+            driver.shutdown()
+            for t in tasks:
+                t.shutdown()
+
+    def test_registration_timeout(self, key):
+        driver = DriverService(num_tasks=2, key=key)
+        try:
+            with pytest.raises(TimeoutError, match=r"\[0, 1\]"):
+                driver.wait_for_initial_registration(timeout_s=0.2)
+        finally:
+            driver.shutdown()
+
+    def test_run_command_through_task_service(self, key, capfd):
+        task = TaskService(0, key)
+        try:
+            client = network.BasicClient("task-0", [("127.0.0.1", task.port)],
+                                         key)
+            client.request(RunCommandRequest(
+                [sys.executable, "-c", "print('hello-from-task')"], None))
+            assert task.wait_for_command(timeout_s=30) == 0
+            assert "hello-from-task" in capfd.readouterr().out
+        finally:
+            task.shutdown()
+
+
+class TestSafeShellExec:
+    def test_exit_code(self):
+        assert execute([sys.executable, "-c", "import sys; sys.exit(3)"]) == 3
+
+    def test_timeout_kills_group(self):
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            execute([sys.executable, "-c", "import time; time.sleep(60)"],
+                    timeout_s=1.0)
+        assert time.monotonic() - t0 < 30
+
+    def test_cancellation_event(self):
+        ev = threading.Event()
+
+        def cancel_soon():
+            time.sleep(0.5)
+            ev.set()
+
+        threading.Thread(target=cancel_soon, daemon=True).start()
+        rc = execute([sys.executable, "-c", "import time; time.sleep(60)"],
+                     events=[ev])
+        assert rc != 0

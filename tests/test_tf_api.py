@@ -43,6 +43,12 @@ class TestSingleWorkerOps:
         np.testing.assert_array_equal(
             tf.cast(out, tf.float32).numpy(), tf.cast(t, tf.float32).numpy())
 
+    def test_allreduce_scalar(self):
+        # 0-dim tensors must survive the host bridge (regression: numpy
+        # scalar decay broke torch.from_numpy / tf conversion).
+        out = hvd.allreduce(tf.constant(3.0), op=hvd.Average)
+        assert float(out) == pytest.approx(3.0)
+
     def test_allreduce_prescale(self):
         t = tf.ones((3,))
         out = hvd.allreduce(t, op=hvd.Sum, prescale_factor=2.0)
@@ -110,6 +116,18 @@ class TestSingleWorkerOps:
         out = step(x)
         np.testing.assert_allclose(out.numpy(), [1.0, 2.0])
 
+    def test_alltoall_splits_inside_tf_function(self):
+        # splits is a symbolic tensor while tracing (regression: the
+        # bridge called .numpy() on it at trace time).
+        @tf.function
+        def step(x, s):
+            out, rs = hvd.alltoall(x, splits=s)
+            return out, rs
+
+        out, rs = step(tf.range(3, dtype=tf.float32), tf.constant([3]))
+        np.testing.assert_allclose(out.numpy(), np.arange(3))
+        assert rs.numpy().tolist() == [3]
+
     def test_broadcast_variables(self):
         v = tf.Variable([1.0, 2.0])
         b = tf.Variable([True, False])
@@ -161,6 +179,18 @@ class TestDistributedOptimizer:
         np.testing.assert_allclose(
             m.trainable_variables[0].numpy(), w0 - 0.1 * 2.0 * np.ones((3, 2)),
             atol=1e-6)
+
+    def test_backward_passes_with_none_grad(self):
+        # Unconnected variables produce None grads; aggregation must not
+        # crash on them (regression: tf.zeros_like(None)).
+        m = self._model()
+        extra = tf.Variable([1.0], name="unconnected")
+        opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(0.1),
+                                       backward_passes_per_step=2)
+        g = [tf.ones((3, 2)), None]
+        opt.apply(g, m.trainable_variables + [extra])
+        opt.apply(g, m.trainable_variables + [extra])
+        np.testing.assert_allclose(extra.numpy(), [1.0])  # untouched
 
     def test_model_fit(self):
         m = self._model()
@@ -222,6 +252,22 @@ class TestKerasCallbacks:
             initial_lr=0.4, multiplier=lambda e: 0.5 ** e, staircase=True)
         m = self._fit([cb], epochs=2, lr=0.4)
         assert float(m.optimizer.learning_rate.numpy()) == pytest.approx(0.2)
+
+    def test_momentum_correction(self):
+        from horovod_tpu.tensorflow.keras.callbacks import _set_lr
+
+        v = tf.Variable([1.0, 2.0])
+        opt = tf.keras.optimizers.SGD(0.1, momentum=0.9)
+        opt.build([v])
+        opt.apply([tf.ones((2,))], [v])   # populate momentum buffer
+        mom_before = [x.numpy().copy() for x in opt.variables
+                      if "momentum" in str(getattr(x, "path", x.name)).lower()]
+        assert mom_before, "SGD momentum slot not found"
+        _set_lr(opt, 0.2, momentum_correction=True)
+        mom_after = [x.numpy() for x in opt.variables
+                     if "momentum" in str(getattr(x, "path", x.name)).lower()]
+        for b, a in zip(mom_before, mom_after):
+            np.testing.assert_allclose(a, b * 2.0, rtol=1e-6)
 
     def test_standalone_keras_alias(self):
         import horovod_tpu.keras as hvk

@@ -46,6 +46,13 @@ class TestSingleWorkerOps:
         assert out.dtype == dtype
         assert torch.equal(out.float(), t.float())
 
+    def test_allreduce_scalar(self):
+        # 0-dim tensors must survive the host bridge (regression: numpy
+        # scalar decay broke torch.from_numpy).
+        out = hvd.allreduce(torch.tensor(3.0), op=hvd.Average)
+        assert out.item() == pytest.approx(3.0)
+        assert out.dim() == 0
+
     def test_allreduce_inplace(self):
         t = torch.ones(3)
         out = hvd.allreduce_(t, op=hvd.Sum)
