@@ -37,7 +37,7 @@ class GPTConfig:
     d_ff: int = 3072
     max_seq_len: int = 2048
     causal: bool = True
-    attention: str = "full"            # 'full' | 'ring' | 'ulysses'
+    attention: str = "full"            # 'full' | 'flash' | 'ring' | 'ulysses'
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -68,6 +68,14 @@ class Attention(nn.Module):
                 raise ValueError("attention='ulysses' requires a mesh")
             out = ulysses_attention(q, k, v, mesh=self.mesh,
                                     causal=cfg.causal)
+        elif cfg.attention == "flash":
+            from ..ops import pallas_attention
+
+            if cfg.causal:
+                # Handles any T by padding up to the kernel block size.
+                out = pallas_attention.flash_attention_padded(q, k, v)
+            else:
+                out = pallas_attention.flash_attention(q, k, v, causal=False)
         elif cfg.attention == "full":
             out = full_attention(q, k, v, causal=cfg.causal)
         else:

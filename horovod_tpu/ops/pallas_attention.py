@@ -1,0 +1,245 @@
+"""Flash attention as a Pallas TPU kernel.
+
+No reference analogue — Horovod ships no kernels (SURVEY.md §2.9: no
+attention/sequence machinery at all); this is part of the TPU rebuild's
+first-class long-context support.  The forward pass is a Pallas kernel
+(per `/opt/skills/guides/pallas_guide.md` patterns): grid
+``(batch·head, q-block, k-block)`` with K/V streamed block-by-block
+through VMEM (usage is O(block·d), not O(T·d)) and the flash
+streaming-softmax state (running max / numerator / denominator, float32)
+carried across the k-block grid steps in VMEM scratch; causal blocks
+skip their compute via ``pl.when``.  The backward pass is the standard
+flash recompute — chunked over K blocks with ``lax.scan`` so memory
+stays O(T·block) — in plain jnp, where XLA already emits MXU-optimal
+matmuls.
+
+Used by ``models.transformer`` (``attention='flash'``, which pads odd
+causal lengths up to the block size).  Off-TPU the same kernel runs in
+the Pallas interpreter (tests); it does not silently fall back to
+another implementation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+# Lane width of the m/den scratch rows (the TPU vector lane count; the
+# scalars are replicated across it to keep scratch tileable).
+_LANES = 128
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_ref, num_ref, den_ref, *,
+                scale: float, causal: bool, block_q: int, block_k: int):
+    """One (batch·head, q-block, k-block) grid step."""
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+    qi = pl.program_id(1)
+    q_start = qi * block_q
+
+    @pl.when(kj == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        num_ref[:] = jnp.zeros_like(num_ref)
+        den_ref[:] = jnp.zeros_like(den_ref)
+
+    # Causal: blocks whose first key position exceeds the last query
+    # position contribute nothing — skip their compute entirely.
+    live = (not causal) or (kj * block_k <= q_start + block_q - 1)
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
+        k_blk = k_ref[0].astype(jnp.float32)              # [bk, d]
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [bq, bk]
+        if causal:
+            qpos = q_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kj * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        m = m_ref[:, 0]                                   # [bq]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        num_ref[:] = num_ref[:] * corr[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        den_ref[:] = den_ref[:] * corr[:, None] + jnp.sum(
+            p, axis=-1)[:, None]
+        m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        den = den_ref[:, 0]
+        o_ref[0] = (num_ref[:] / den[:, None]).astype(o_ref.dtype)
+        lse_ref[0, :, 0] = m_ref[:, 0] + jnp.log(den)
+
+
+def _flash_fwd(q3, k3, v3, *, scale, causal, block_q, block_k, interpret):
+    bh, t, d = q3.shape
+    tk = k3.shape[1]
+    grid = (bh, t // block_q, tk // block_k)
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            # lse rides a trailing unit dim: TPU lowering requires the
+            # last two block dims be (multiple-of-8, multiple-of-128) or
+            # equal to the array dims; (block_q, 1) satisfies that where
+            # a rank-2 (1, block_q) block would not.
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),   # running max
+            pltpu.VMEM((block_q, d), jnp.float32),        # numerator
+            pltpu.VMEM((block_q, _LANES), jnp.float32),   # denominator
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+    return o, lse[..., 0]
+
+
+def _flash_bwd(q3, k3, v3, o3, lse, do3, *, scale, causal, block_k):
+    """Chunked flash backward (recompute), all float32 accumulation."""
+    bh, t, d = q3.shape
+    tk = k3.shape[1]
+    qf = q3.astype(jnp.float32)
+    dof = do3.astype(jnp.float32)
+    # D_i = rowsum(dO * O) — the softmax-jacobian diagonal term.
+    delta = jnp.sum(dof * o3.astype(jnp.float32), axis=-1)     # [bh, t]
+    nk = tk // block_k
+    k_blocks = k3.reshape(bh, nk, block_k, d).transpose(1, 0, 2, 3)
+    v_blocks = v3.reshape(bh, nk, block_k, d).transpose(1, 0, 2, 3)
+
+    qpos = lax.broadcasted_iota(jnp.int32, (t, block_k), 0)
+    koff = lax.broadcasted_iota(jnp.int32, (t, block_k), 1)
+
+    def body(dq, xs):
+        kj, k_blk, v_blk = xs
+        s = jnp.einsum("bqd,bkd->bqk", qf, k_blk.astype(jnp.float32)) * scale
+        if causal:
+            s = jnp.where(qpos >= kj * block_k + koff, s, _NEG_INF)
+        p = jnp.exp(s - lse[..., None])                         # [bh, t, bk]
+        dv_blk = jnp.einsum("bqk,bqd->bkd", p, dof)
+        dp = jnp.einsum("bqd,bkd->bqk", dof, v_blk.astype(jnp.float32))
+        ds = p * (dp - delta[..., None]) * scale
+        dk_blk = jnp.einsum("bqk,bqd->bkd", ds, qf)
+        dq = dq + jnp.einsum("bqk,bkd->bqd", ds, k_blk.astype(jnp.float32))
+        return dq, (dk_blk, dv_blk)
+
+    dq0 = jnp.zeros((bh, t, d), jnp.float32)
+    dq, (dk_b, dv_b) = lax.scan(
+        body, dq0, (jnp.arange(nk), k_blocks, v_blocks))
+    dk = dk_b.transpose(1, 0, 2, 3).reshape(bh, tk, d)
+    dv = dv_b.transpose(1, 0, 2, 3).reshape(bh, tk, d)
+    return (dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash3(q3, k3, v3, scale, causal, block_q, block_k, interpret):
+    o, _ = _flash_fwd(q3, k3, v3, scale=scale, causal=causal,
+                      block_q=block_q, block_k=block_k, interpret=interpret)
+    return o
+
+
+def _flash3_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
+    o, lse = _flash_fwd(q3, k3, v3, scale=scale, causal=causal,
+                        block_q=block_q, block_k=block_k, interpret=interpret)
+    return o, (q3, k3, v3, o, lse)
+
+
+def _flash3_bwd(scale, causal, block_q, block_k, interpret, res, do3):
+    q3, k3, v3, o3, lse = res
+    return _flash_bwd(q3, k3, v3, o3, lse, do3, scale=scale, causal=causal,
+                      block_k=block_k)
+
+
+_flash3.defvjp(_flash3_fwd, _flash3_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Flash attention; same contract as
+    :func:`horovod_tpu.parallel.ring_attention.full_attention`:
+    q/k/v ``[B, T, H, D]`` → ``[B, T, H, D]``, differentiable.
+
+    Sequence lengths must divide the block sizes; for causal self-
+    attention :func:`flash_attention_padded` accepts any length.
+    ``interpret`` defaults to True off-TPU so the same kernel runs under
+    the CPU test mesh.
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected [B, T, H, D] inputs, got {q.shape}")
+    b, t, h, d = q.shape
+    tk = k.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    block_q = min(block_q, t)
+    block_k = min(block_k, tk)
+    if t % block_q or tk % block_k:
+        raise ValueError(
+            f"sequence lengths ({t}, {tk}) must be multiples of the block "
+            f"sizes ({block_q}, {block_k}); pad, or use "
+            f"flash_attention_padded for causal self-attention")
+    if causal and t != tk:
+        raise ValueError("causal flash attention requires Tq == Tk")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def pack(x):
+        tb = x.shape[1]
+        return x.transpose(0, 2, 1, 3).reshape(b * h, tb, d)
+
+    o3 = _flash3(pack(q), pack(k), pack(v), float(scale), bool(causal),
+                 int(block_q), int(block_k), bool(interpret))
+    return o3.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def flash_attention_padded(q, k, v, *, scale: Optional[float] = None,
+                           block_q: int = 128, block_k: int = 128,
+                           interpret: Optional[bool] = None):
+    """Causal self-attention for arbitrary sequence length: pads T up to
+    a block multiple, runs the kernel, slices back.  Safe exactly
+    because the attention is causal — padded key positions sit after
+    every real query position, so the mask removes them."""
+    b, t, h, d = q.shape
+    if k.shape[1] != t:
+        raise ValueError("flash_attention_padded is self-attention only")
+    blk = max(block_q, block_k)
+    if t >= blk:
+        tp = -(-t // blk) * blk          # round up to a block multiple
+    else:
+        tp = -(-t // 8) * 8              # short seq: one 8-aligned block
+    pad = tp - t
+    cfg = dict(causal=True, scale=scale, block_q=block_q, block_k=block_k,
+               interpret=interpret)
+    if pad == 0:
+        return flash_attention(q, k, v, **cfg)
+    padded = [jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+              for x in (q, k, v)]
+    return flash_attention(*padded, **cfg)[:, :t]

@@ -102,6 +102,19 @@ class TestGPT:
             first = float(loss) if first is None else first
         assert float(loss) < first
 
+    def test_flash_attention_matches_full(self):
+        """Same weights, same logits: pallas flash kernel (interpret mode
+        on CPU) vs full attention."""
+        import dataclasses
+
+        model_f, params, tokens = _tiny_gpt("full")
+        model_fl = GPT(dataclasses.replace(model_f.config,
+                                           attention="flash"))
+        lf = model_f.apply({"params": params}, jnp.asarray(tokens))
+        lfl = model_fl.apply({"params": params}, jnp.asarray(tokens))
+        np.testing.assert_allclose(np.asarray(lfl), np.asarray(lf),
+                                   rtol=2e-4, atol=2e-4)
+
     def test_ring_attention_matches_full(self):
         """The same weights must produce the same logits under sp=8 ring
         attention as under single-chip full attention."""

@@ -1,0 +1,116 @@
+"""Flash-attention kernel tests (interpret mode on the CPU mesh;
+numerics vs the full_attention reference implementation)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.ops.pallas_attention import (
+    flash_attention, flash_attention_padded,
+)
+from horovod_tpu.parallel.ring_attention import full_attention
+
+
+def _qkv(b=2, t=64, h=2, d=16, dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (b, t, h, d)
+    return tuple(jax.random.normal(k, shape, dtype) for k in ks)
+
+
+class TestFlashForward:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_full_attention(self, causal):
+        q, k, v = _qkv()
+        out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+        ref = full_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_single_block(self):
+        q, k, v = _qkv(t=32)
+        out = flash_attention(q, k, v, block_q=32, block_k=32)
+        ref = full_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_bf16(self):
+        q, k, v = _qkv(dtype=jnp.bfloat16)
+        out = flash_attention(q, k, v, block_q=32, block_k=32)
+        ref = full_attention(q, k, v)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            atol=3e-2, rtol=3e-2)
+
+    def test_cross_attention_lengths(self):
+        q, _, _ = _qkv(t=32)
+        _, k, v = _qkv(t=64, seed=1)
+        out = flash_attention(q, k, v, block_q=32, block_k=32)
+        ref = full_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("t", [24, 48, 100])
+    def test_padded_odd_lengths(self, t):
+        # Non-block-multiple causal self-attention via the padded entry.
+        q, k, v = _qkv(t=t, d=8)
+        out = flash_attention_padded(q, k, v, block_q=32, block_k=32)
+        ref = full_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_padded_grads(self):
+        q, k, v = _qkv(t=24, d=8)
+
+        def loss(q, k, v):
+            return jnp.sum(flash_attention_padded(
+                q, k, v, block_q=32, block_k=32) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(full_attention(q, k, v, causal=True) ** 2)
+
+        gf = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_bad_shapes_rejected(self):
+        q, k, v = _qkv(t=48)
+        with pytest.raises(ValueError, match="multiples"):
+            flash_attention(q, k, v, block_q=32, block_k=32)
+        with pytest.raises(ValueError, match="B, T, H, D"):
+            flash_attention(q[0], k[0], v[0])
+
+
+class TestFlashBackward:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_full_attention(self, causal):
+        q, k, v = _qkv(t=64, d=8)
+
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, causal=causal,
+                                block_q=32, block_k=32)
+            return jnp.sum(o * o)
+
+        def loss_ref(q, k, v):
+            o = full_attention(q, k, v, causal=causal)
+            return jnp.sum(o * o)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-4,
+                                       err_msg=f"d{name}")
+
+    def test_jit_and_value(self):
+        q, k, v = _qkv(t=32, d=8)
+
+        @jax.jit
+        def f(q, k, v):
+            return flash_attention(q, k, v, causal=True,
+                                   block_q=32, block_k=32).sum()
+
+        assert np.isfinite(float(f(q, k, v)))
