@@ -38,6 +38,7 @@ class GPTConfig:
     max_seq_len: int = 2048
     causal: bool = True
     attention: str = "full"            # 'full' | 'flash' | 'ring' | 'ulysses'
+    attention_engine: str = "xla"      # ring per-block engine: 'xla' | 'flash'
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -62,7 +63,8 @@ class Attention(nn.Module):
             if self.mesh is None:
                 raise ValueError("attention='ring' requires a mesh")
             out = ring_self_attention(q, k, v, mesh=self.mesh,
-                                      causal=cfg.causal)
+                                      causal=cfg.causal,
+                                      engine=cfg.attention_engine)
         elif cfg.attention == "ulysses":
             if self.mesh is None:
                 raise ValueError("attention='ulysses' requires a mesh")
@@ -75,6 +77,14 @@ class Attention(nn.Module):
                 # Handles any T by padding up to the kernel block size.
                 out = pallas_attention.flash_attention_padded(q, k, v)
             else:
+                if T % 128:
+                    # Non-causal padding would need key masking in the
+                    # kernel; fail with guidance instead of a shape error
+                    # deep inside the wrapper.
+                    raise ValueError(
+                        f"attention='flash' with causal=False requires the "
+                        f"sequence length ({T}) to be a multiple of 128; "
+                        f"pad the batch or use attention='full'")
                 out = pallas_attention.flash_attention(q, k, v, causal=False)
         elif cfg.attention == "full":
             out = full_attention(q, k, v, causal=cfg.causal)

@@ -122,14 +122,22 @@ def _flash_fwd(q3, k3, v3, *, scale, causal, block_q, block_k, interpret):
     return o, lse[..., 0]
 
 
-def _flash_bwd(q3, k3, v3, o3, lse, do3, *, scale, causal, block_k):
-    """Chunked flash backward (recompute), all float32 accumulation."""
+def _flash_bwd(q3, k3, v3, o3, lse, do3, *, scale, causal, block_k,
+               dlse=None):
+    """Chunked flash backward (recompute), all float32 accumulation.
+
+    ``dlse``: cotangent of the logsumexp output (for the
+    :func:`flash_attention_with_lse` entry).  ∂lse_i/∂s_ik = p_ik, so it
+    folds into the same dS term as the softmax-jacobian diagonal:
+    dS = P · (dP − Δ + dlse)."""
     bh, t, d = q3.shape
     tk = k3.shape[1]
     qf = q3.astype(jnp.float32)
     dof = do3.astype(jnp.float32)
     # D_i = rowsum(dO * O) — the softmax-jacobian diagonal term.
     delta = jnp.sum(dof * o3.astype(jnp.float32), axis=-1)     # [bh, t]
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
     nk = tk // block_k
     k_blocks = k3.reshape(bh, nk, block_k, d).transpose(1, 0, 2, 3)
     v_blocks = v3.reshape(bh, nk, block_k, d).transpose(1, 0, 2, 3)
@@ -159,25 +167,25 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, *, scale, causal, block_k):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash3(q3, k3, v3, scale, causal, block_q, block_k, interpret):
-    o, _ = _flash_fwd(q3, k3, v3, scale=scale, causal=causal,
+def _flash3_lse(q3, k3, v3, scale, causal, block_q, block_k, interpret):
+    return _flash_fwd(q3, k3, v3, scale=scale, causal=causal,
                       block_q=block_q, block_k=block_k, interpret=interpret)
-    return o
 
 
-def _flash3_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
+def _flash3_lse_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
     o, lse = _flash_fwd(q3, k3, v3, scale=scale, causal=causal,
                         block_q=block_q, block_k=block_k, interpret=interpret)
-    return o, (q3, k3, v3, o, lse)
+    return (o, lse), (q3, k3, v3, o, lse)
 
 
-def _flash3_bwd(scale, causal, block_q, block_k, interpret, res, do3):
+def _flash3_lse_bwd(scale, causal, block_q, block_k, interpret, res, cts):
     q3, k3, v3, o3, lse = res
+    do3, dlse = cts
     return _flash_bwd(q3, k3, v3, o3, lse, do3, scale=scale, causal=causal,
-                      block_k=block_k)
+                      block_k=block_k, dlse=dlse)
 
 
-_flash3.defvjp(_flash3_fwd, _flash3_bwd)
+_flash3_lse.defvjp(_flash3_lse_fwd, _flash3_lse_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = False,
@@ -193,6 +201,22 @@ def flash_attention(q, k, v, *, causal: bool = False,
     ``interpret`` defaults to True off-TPU so the same kernel runs under
     the CPU test mesh.
     """
+    # The kernel emits lse unconditionally; dropping it here gives it a
+    # zero cotangent, which folds into the backward as a no-op.
+    o, _ = flash_attention_with_lse(
+        q, k, v, causal=causal, scale=scale, block_q=block_q,
+        block_k=block_k, interpret=interpret)
+    return o
+
+
+def flash_attention_with_lse(q, k, v, *, causal: bool = False,
+                             scale: Optional[float] = None,
+                             block_q: int = 128, block_k: int = 128,
+                             interpret: Optional[bool] = None):
+    """Like :func:`flash_attention` but also returns the per-row
+    logsumexp ``[B, H, T]`` (float32) — the merge key that lets callers
+    combine partial attention outputs exactly (ring attention's
+    per-block engine).  Differentiable in both outputs."""
     if q.ndim != 4:
         raise ValueError(f"expected [B, T, H, D] inputs, got {q.shape}")
     b, t, h, d = q.shape
@@ -215,9 +239,11 @@ def flash_attention(q, k, v, *, causal: bool = False,
         tb = x.shape[1]
         return x.transpose(0, 2, 1, 3).reshape(b * h, tb, d)
 
-    o3 = _flash3(pack(q), pack(k), pack(v), float(scale), bool(causal),
-                 int(block_q), int(block_k), bool(interpret))
-    return o3.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    o3, lse3 = _flash3_lse(pack(q), pack(k), pack(v), float(scale),
+                           bool(causal), int(block_q), int(block_k),
+                           bool(interpret))
+    o = o3.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    return o, lse3.reshape(b, h, t)
 
 
 def flash_attention_padded(q, k, v, *, scale: Optional[float] = None,

@@ -65,7 +65,8 @@ def _block_accumulate(q, k, v, num, den, m, qpos, kpos, scale, causal):
 
 
 def ring_attention_local(q, k, v, *, axis: str, causal: bool = False,
-                         scale: Optional[float] = None):
+                         scale: Optional[float] = None,
+                         engine: str = "xla"):
     """The per-shard ring attention body — call inside ``shard_map``.
 
     ``q``/``k``/``v`` are the local sequence shards ``[b, t, h, d]``
@@ -73,7 +74,17 @@ def ring_attention_local(q, k, v, *, axis: str, causal: bool = False,
     queries against the K/V block that originated on slot
     ``(my_rank - s) mod sp``, then rotates K/V one neighbor around the
     ring.  Exact — not an approximation.
+
+    ``engine='flash'`` computes each block with the Pallas flash kernel
+    (:mod:`horovod_tpu.ops.pallas_attention`) and merges blocks by
+    logsumexp — same numerics, kernel-speed blocks; requires the local
+    shard length to satisfy the kernel's block-divisibility rule.
     """
+    if engine == "flash":
+        return _ring_flash_local(q, k, v, axis=axis, causal=causal,
+                                 scale=scale)
+    if engine != "xla":
+        raise ValueError(f"unknown ring attention engine {engine!r}")
     if scale is None:
         scale = q.shape[-1] ** -0.5
     n = lax.axis_size(axis)
@@ -104,6 +115,69 @@ def ring_attention_local(q, k, v, *, axis: str, causal: bool = False,
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [b, t, h, d]
 
 
+def _ring_flash_local(q, k, v, *, axis: str, causal: bool,
+                      scale: Optional[float]):
+    """Ring body with the Pallas flash kernel as the per-block engine.
+
+    Per round the rotating K/V block is, relative to the local queries:
+    the *diagonal* block (same origin slot → causal mask), an *earlier*
+    block (full attention), or a *later* block (contributes nothing,
+    skipped).  Blocks are merged by streaming logsumexp — running max
+    ``m``, output numerator and denominator — which is exact.
+    Differentiable end-to-end (the kernel's VJP carries the lse
+    cotangent; the merge is plain jnp).
+    """
+    from ..ops.pallas_attention import flash_attention_with_lse
+
+    b, t, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    n = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+
+    def diag_block(q, k, v):
+        return flash_attention_with_lse(q, k, v, causal=True, scale=scale)
+
+    def full_block(q, k, v):
+        return flash_attention_with_lse(q, k, v, causal=False, scale=scale)
+
+    def skip_block(q, k, v):
+        # Later-origin block under causality: nothing attendable.  The
+        # -2e30 lse makes its merge weight exp(-2e30 + 1e30) == 0 while
+        # keeping every exponent finite (never -inf - -inf).
+        return (jnp.zeros_like(q),
+                jnp.full((b, h, t), 2 * _NEG_INF, jnp.float32))
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(s, carry):
+        k_cur, v_cur, num_o, den, m = carry
+        src = (me - s) % n
+        if causal:
+            branch = jnp.where(src == me, 0, jnp.where(src < me, 1, 2))
+            o_b, lse_b = lax.switch(branch,
+                                    [diag_block, full_block, skip_block],
+                                    q, k_cur, v_cur)
+        else:
+            o_b, lse_b = full_block(q, k_cur, v_cur)
+        o32 = jnp.transpose(o_b, (0, 2, 1, 3)).astype(jnp.float32)
+        m_new = jnp.maximum(m, lse_b)
+        corr = jnp.exp(m - m_new)
+        w = jnp.exp(lse_b - m_new)
+        num_o = num_o * corr[..., None] + o32 * w[..., None]
+        den = den * corr + w
+        k_nxt = lax.ppermute(k_cur, axis, perm)
+        v_nxt = lax.ppermute(v_cur, axis, perm)
+        return k_nxt, v_nxt, num_o, den, m_new
+
+    num0 = jnp.zeros((b, h, t, d), jnp.float32)
+    den0 = jnp.zeros((b, h, t), jnp.float32)
+    m0 = jnp.full((b, h, t), _NEG_INF, jnp.float32)
+    _, _, num_o, den, _ = lax.fori_loop(0, n, body, (k, v, num0, den0, m0))
+    out = num_o / jnp.maximum(den, 1e-30)[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
 def seq_parallel_call(local_fn, q, k, v, *, mesh: Mesh, sp_axis: str,
                       dp_axis: Optional[str], tp_axis: Optional[str]):
     """Shared host-callable wrapper for sequence-parallel attention
@@ -131,10 +205,13 @@ def ring_self_attention(q, k, v, *, mesh: Mesh, sp_axis: str = "sp",
                         dp_axis: Optional[str] = "dp",
                         tp_axis: Optional[str] = "tp",
                         causal: bool = False,
-                        scale: Optional[float] = None):
+                        scale: Optional[float] = None,
+                        engine: str = "xla"):
     """Host-callable ring attention (see :func:`seq_parallel_call` for
-    the sharding contract) — this is the designed usage from models."""
+    the sharding contract) — this is the designed usage from models.
+    ``engine='flash'`` runs each ring block on the Pallas flash kernel."""
     return seq_parallel_call(
-        partial(ring_attention_local, axis=sp_axis, causal=causal, scale=scale),
+        partial(ring_attention_local, axis=sp_axis, causal=causal,
+                scale=scale, engine=engine),
         q, k, v, mesh=mesh, sp_axis=sp_axis, dp_axis=dp_axis, tp_axis=tp_axis,
     )
