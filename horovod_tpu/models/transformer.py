@@ -77,10 +77,12 @@ class Attention(nn.Module):
                 # Handles any T by padding up to the kernel block size.
                 out = pallas_attention.flash_attention_padded(q, k, v)
             else:
-                if T % 128:
-                    # Non-causal padding would need key masking in the
-                    # kernel; fail with guidance instead of a shape error
-                    # deep inside the wrapper.
+                if T % min(128, T):
+                    # T < 128 runs as a single clamped block; larger T
+                    # must divide the 128 block.  Non-causal padding
+                    # would need key masking in the kernel, so fail with
+                    # guidance instead of a shape error deep inside the
+                    # wrapper.
                     raise ValueError(
                         f"attention='flash' with causal=False requires the "
                         f"sequence length ({T}) to be a multiple of 128; "

@@ -1,0 +1,48 @@
+"""horovod_tpu.mxnet — MXNet binding (gated).
+
+Reference: ``horovod/mxnet/`` (``DistributedTrainer``, per-dtype mpi_ops
+through the MXNet engine — SURVEY.md §2.3/§2.4, mount empty,
+unverified).  MXNet reached end-of-life upstream (retired by Apache in
+2023) and is not installable in this environment; the binding surface
+is declared for reference parity and raises with guidance.  The
+implementation recipe, should it ever be needed, is the same as the
+torch binding: bridge ``mx.nd.NDArray`` host tensors through
+:mod:`horovod_tpu.hostops` and wrap ``gluon.Trainer`` the way
+``horovod_tpu.torch.DistributedOptimizer`` wraps torch optimizers.
+"""
+
+from __future__ import annotations
+
+_MSG = ("horovod_tpu.mxnet requires mxnet, which is end-of-life and not "
+        "bundled in this environment; use horovod_tpu.torch, "
+        "horovod_tpu.tensorflow, or the pure-JAX API instead")
+
+
+def _unavailable(name: str):
+    try:
+        import mxnet  # noqa: F401
+    except ImportError as e:
+        raise ImportError(_MSG) from e
+    # mxnet importable but the binding is deliberately not implemented —
+    # never fall through silently (a no-op broadcast would let ranks
+    # train from divergent state).
+    raise NotImplementedError(
+        f"horovod_tpu.mxnet.{name} is not implemented (mxnet is "
+        "end-of-life); see the module docstring for the porting recipe")
+
+
+def init(*args, **kwargs):
+    _unavailable("init")
+
+
+def DistributedTrainer(*args, **kwargs):
+    """Reference: ``hvd.DistributedTrainer(params, opt)``."""
+    _unavailable("DistributedTrainer")
+
+
+def broadcast_parameters(*args, **kwargs):
+    _unavailable("broadcast_parameters")
+
+
+def allreduce(*args, **kwargs):
+    _unavailable("allreduce")

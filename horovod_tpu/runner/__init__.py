@@ -5,5 +5,5 @@ SURVEY.md §2.5).  Entry points:
 * API: ``horovod_tpu.runner.run(np=4, command=[...])``
 """
 
-from .launch import main, run, parse_args  # noqa: F401
+from .launch import main, run, run_elastic, parse_args  # noqa: F401
 from .check_build import check_build_str  # noqa: F401

@@ -27,6 +27,19 @@ def check_build_str() -> str:
     except ImportError:
         flax_line = "flax not installed (no model zoo)"
     try:
+        import torch
+
+        torch_line = f"pytorch {torch.__version__} (horovod_tpu.torch)"
+    except ImportError:
+        torch_line = "pytorch not installed"
+    try:
+        import tensorflow as tf
+
+        tf_line = (f"tensorflow {tf.__version__} "
+                   "(horovod_tpu.tensorflow, horovod_tpu.keras)")
+    except ImportError:
+        tf_line = "tensorflow not installed"
+    try:
         from .. import native
 
         native_ok = native.available()
@@ -44,6 +57,8 @@ def check_build_str() -> str:
         f"    [X] {jax_line}",
         f"    [{'X' if 'not' not in optax_line else ' '}] {optax_line}",
         f"    [{'X' if 'not' not in flax_line else ' '}] {flax_line}",
+        f"    [{'X' if 'not' not in torch_line else ' '}] {torch_line}",
+        f"    [{'X' if 'not' not in tf_line else ' '}] {tf_line}",
         "",
         "Available controllers:",
         "    [X] jax.distributed (DCN coordination service)",
@@ -56,10 +71,17 @@ def check_build_str() -> str:
         "    [X] XLA collectives over ICI/DCN "
         "(AllReduce/AllGather/AllToAll/ReduceScatter/CollectivePermute)",
         f"    [{'X' if 'built' in native_line and 'not' not in native_line else ' '}] {native_line}",
+        "    [X] Pallas kernels (flash attention; ring-attention "
+        "flash engine)",
         "",
         "Parallelism:",
         "    [X] data parallel (+Adasum, elastic, process sets)",
         "    [X] tensor parallel (Megatron column/row rules)",
         "    [X] sequence/context parallel (ring attention, Ulysses)",
+        "",
+        "Launchers:",
+        "    [X] local multi-process (-np N)",
+        "    [X] elastic (--host-discovery-script, min/max-np)",
+        "    [X] TPU pod passthrough (platform-set coordination env)",
     ]
     return "\n".join(lines)

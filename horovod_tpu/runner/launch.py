@@ -57,6 +57,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         help="elastic: maximum world size")
     parser.add_argument("--host-discovery-script", default=None,
                         help="elastic: script printing host:slots per line")
+    parser.add_argument("--reset-limit", type=int, default=0,
+                        help="elastic: max world restarts (0 = unlimited; "
+                             "reference: HOROVOD_ELASTIC_RESET_LIMIT)")
+    parser.add_argument("--blacklist-after", type=int, default=0,
+                        help="elastic: blacklist a host after this many "
+                             "failures (0 = never)")
     parser.add_argument("--coordinator", default=None,
                         help="coordinator address (default: 127.0.0.1:random)")
     parser.add_argument("--start-timeout", type=float, default=120.0)
@@ -66,16 +72,9 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     return parser.parse_args(argv)
 
 
-def run(np_: int, command: List[str], *, coordinator: Optional[str] = None,
-        env: Optional[Dict[str, str]] = None,
-        start_timeout: float = 120.0, verbose: bool = False) -> int:
-    """Spawn ``np_`` local worker processes wired into one
-    ``jax.distributed`` world; returns the first nonzero exit code (0 on
-    success).  Workers that outlive a failed peer are terminated —
-    reference behavior (gloo_run kills the job on first failure)."""
-    if not command:
-        raise ValueError("No command given")
-    coordinator = coordinator or f"127.0.0.1:{_free_port()}"
+def _spawn_world(np_: int, command: List[str], coordinator: str,
+                 env: Optional[Dict[str, str]],
+                 verbose: bool) -> List[subprocess.Popen]:
     procs: List[subprocess.Popen] = []
     base_env = dict(os.environ)
     if env:
@@ -91,6 +90,31 @@ def run(np_: int, command: List[str], *, coordinator: Optional[str] = None,
             print(f"[horovodtpurun] spawning rank {rank}: {' '.join(command)}",
                   file=sys.stderr)
         procs.append(subprocess.Popen(command, env=worker_env))
+    return procs
+
+
+def _terminate_all(procs: List[subprocess.Popen]) -> None:
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def run(np_: int, command: List[str], *, coordinator: Optional[str] = None,
+        env: Optional[Dict[str, str]] = None,
+        start_timeout: float = 120.0, verbose: bool = False) -> int:
+    """Spawn ``np_`` local worker processes wired into one
+    ``jax.distributed`` world; returns the first nonzero exit code (0 on
+    success).  Workers that outlive a failed peer are terminated —
+    reference behavior (gloo_run kills the job on first failure)."""
+    if not command:
+        raise ValueError("No command given")
+    coordinator = coordinator or f"127.0.0.1:{_free_port()}"
+    procs = _spawn_world(np_, command, coordinator, env, verbose)
 
     exit_code = 0
     deadline = time.monotonic() + start_timeout
@@ -118,15 +142,110 @@ def run(np_: int, command: List[str], *, coordinator: Optional[str] = None,
             p.send_signal(signal.SIGINT)
         exit_code = 130
     finally:
-        for p in procs:
-            if p.poll() is None:
-                p.terminate()
-        for p in procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
+        _terminate_all(procs)
     return exit_code
+
+
+def run_elastic(command: List[str], *, min_np: int = 1,
+                max_np: Optional[int] = None,
+                discovery_script: Optional[str] = None,
+                discovery=None,
+                env: Optional[Dict[str, str]] = None,
+                start_timeout: float = 120.0,
+                poll_interval_s: float = 1.0,
+                reset_limit: int = 0,
+                blacklist_after: int = 0,
+                verbose: bool = False) -> int:
+    """Elastic local supervision (reference: ``horovodrun
+    --host-discovery-script`` driving the ElasticDriver, §3.5 of
+    SURVEY.md): poll discovery, run a world sized to the available
+    slots, and on membership change or worker failure tear the world
+    down and restart it at the new size — workers recover state through
+    ``hvd.elastic``/checkpoints.
+
+    Worlds are restarted (never resized in place): a ``jax.distributed``
+    world is fixed at init, so resize = teardown + re-init, which is the
+    reference's elastic flow too (shutdown → rendezvous → broadcast).
+    Returns 0 when a world runs the command to completion on every
+    worker; nonzero after ``reset_limit`` failed restarts (0 =
+    unlimited).
+
+    ``blacklist_after`` enables host blacklisting after that many
+    failures; it defaults to off here because a local supervisor cannot
+    attribute a failure to one host — blacklisting the whole (usually
+    single-host) set would contradict ``reset_limit=0`` unlimited
+    retries.
+    """
+    from ..elastic.driver import ElasticDriver, ScriptDiscovery
+
+    if discovery is None:
+        if not discovery_script:
+            raise ValueError("need discovery_script or a discovery object")
+        discovery = ScriptDiscovery(discovery_script)
+    driver = ElasticDriver(
+        discovery, poll_interval_s=poll_interval_s,
+        blacklist_after=(blacklist_after if blacklist_after > 0
+                         else (1 << 30)))
+    try:
+        driver.wait_for_available_slots(min_np, timeout_s=start_timeout)
+    except TimeoutError as e:
+        print(f"[horovodtpurun] {e}", file=sys.stderr)
+        return 1
+
+    resets = 0
+    while True:
+        np_ = driver.world_size()
+        if max_np is not None:
+            np_ = min(np_, max_np)
+        if np_ < min_np:
+            print(f"[horovodtpurun] only {np_} slots available "
+                  f"(< --min-np {min_np}); waiting", file=sys.stderr)
+            try:
+                driver.wait_for_available_slots(min_np,
+                                                timeout_s=start_timeout)
+                continue
+            except TimeoutError:
+                return 1
+        coordinator = f"127.0.0.1:{_free_port()}"
+        if verbose:
+            print(f"[horovodtpurun] elastic world of {np_} starting",
+                  file=sys.stderr)
+        procs = _spawn_world(np_, command, coordinator, env, verbose)
+        hosts_this_world = sorted(driver.hosts)
+        failed = False
+        try:
+            while True:
+                # Exit codes first: a world that already finished must
+                # not be "restarted" by a late membership delta.
+                rcs = [p.poll() for p in procs]
+                if all(rc == 0 for rc in rcs):
+                    return 0
+                if any(rc is not None and rc != 0 for rc in rcs):
+                    # A local supervisor cannot attribute the failure to
+                    # one host; strike every host of the failed world
+                    # (only matters when blacklist_after is enabled).
+                    for host in hosts_this_world:
+                        driver.record_failure(host)
+                    _terminate_all(procs)
+                    failed = True
+                    break
+                if driver.poll_once():
+                    if verbose:
+                        print("[horovodtpurun] membership changed; "
+                              "restarting world", file=sys.stderr)
+                    _terminate_all(procs)
+                    failed = True   # counts as a reset, not an error
+                    break
+                time.sleep(poll_interval_s)
+        except KeyboardInterrupt:
+            _terminate_all(procs)
+            return 130
+        if failed:
+            resets += 1
+            if reset_limit and resets > reset_limit:
+                print(f"[horovodtpurun] reset limit ({reset_limit}) "
+                      f"exceeded", file=sys.stderr)
+                return 1
 
 
 def _none_started(procs) -> bool:
@@ -161,6 +280,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"error: -np {args.num_proc} < --min-np {args.min_np}",
               file=sys.stderr)
         return 2
+    if args.host_discovery_script:
+        # Reference semantics: -np is the target size, bounded by
+        # --min-np/--max-np; discovery grows the world only up to the
+        # max, never past what the user asked for.
+        return run_elastic(
+            command, min_np=args.min_np or args.num_proc,
+            max_np=args.max_np or args.num_proc,
+            discovery_script=args.host_discovery_script,
+            start_timeout=args.start_timeout,
+            reset_limit=args.reset_limit,
+            blacklist_after=args.blacklist_after,
+            verbose=args.verbose)
     return run(args.num_proc, command, coordinator=args.coordinator,
                start_timeout=args.start_timeout, verbose=args.verbose)
 

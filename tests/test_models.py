@@ -115,6 +115,21 @@ class TestGPT:
         np.testing.assert_allclose(np.asarray(lfl), np.asarray(lf),
                                    rtol=2e-4, atol=2e-4)
 
+    def test_flash_noncausal_short_seq_ok(self):
+        """T < 128 runs as one clamped block — must not be rejected by
+        the non-causal guard (regression)."""
+        import dataclasses
+
+        model_f, params, tokens = _tiny_gpt("full")
+        cfg = dataclasses.replace(model_f.config, attention="flash",
+                                  causal=False)
+        model_fl = GPT(cfg)
+        model_ref = GPT(dataclasses.replace(cfg, attention="full"))
+        lfl = model_fl.apply({"params": params}, jnp.asarray(tokens))
+        lf = model_ref.apply({"params": params}, jnp.asarray(tokens))
+        np.testing.assert_allclose(np.asarray(lfl), np.asarray(lf),
+                                   rtol=2e-4, atol=2e-4)
+
     def test_ring_attention_matches_full(self):
         """The same weights must produce the same logits under sp=8 ring
         attention as under single-chip full attention."""
