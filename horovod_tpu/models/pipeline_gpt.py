@@ -1,0 +1,132 @@
+"""Pipeline-parallel GPT: the flagship model over a ``pp`` mesh axis.
+
+No reference analogue (Horovod has no pipeline parallelism, SURVEY.md
+§2.9).  The trunk's ``n_layer`` blocks become ``pp`` identical stages of
+``n_layer // pp`` blocks whose stacked parameters shard over the ``pp``
+axis; microbatches flow through :func:`..parallel.pipeline.pipeline_apply`
+(GPipe schedule over ``ppermute``).  Embedding and LM head run outside
+the pipeline (replicated / dp-sharded), which is the standard cut.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ..parallel.pipeline import (
+    pipeline_apply, shard_stage_params, stack_stage_params,
+)
+from .transformer import Block, GPTConfig
+
+
+class _Embed(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.config
+        B, T = tokens.shape
+        tok = nn.Embed(cfg.vocab_size, cfg.d_model,
+                       param_dtype=cfg.param_dtype, dtype=cfg.dtype,
+                       name="embed")(tokens)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (cfg.max_seq_len, cfg.d_model), cfg.param_dtype)
+        return tok + pos[None, :T].astype(cfg.dtype)
+
+
+class _Head(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                        param_dtype=cfg.param_dtype, name="lm_head")(x)
+
+
+class _Stage(nn.Module):
+    """``n_layer // pp`` consecutive blocks — one pipeline stage."""
+
+    config: GPTConfig
+    blocks_per_stage: int
+
+    @nn.compact
+    def __call__(self, x):
+        for i in range(self.blocks_per_stage):
+            x = Block(self.config, name=f"block_{i}")(x)
+        return x
+
+
+class PipelinedGPT:
+    """GPT with its trunk pipelined over ``mesh``'s ``pp`` axis.
+
+    Same ``init(rng, tokens) -> params`` / ``apply(params, tokens) ->
+    logits`` contract as :class:`GPT` (params are a plain dict with
+    ``embed`` / ``stages`` / ``head`` groups; ``stages`` leaves carry a
+    leading ``[pp]`` stage dim).  ``n_micro`` microbatches must divide
+    the per-dp-shard batch.
+    """
+
+    def __init__(self, config: GPTConfig, mesh: Mesh, *,
+                 n_micro: int = 2, pp_axis: str = "pp",
+                 dp_axis: Optional[str] = "dp"):
+        if config.attention not in ("full", "flash"):
+            raise ValueError(
+                "PipelinedGPT stages run attention per-microbatch; use "
+                "attention='full' or 'flash' (sp composes via the "
+                "non-pipelined GPT)")
+        self.config = config
+        self.mesh = mesh
+        self.n_micro = n_micro
+        self.pp_axis = pp_axis
+        self.dp_axis = dp_axis
+        self.n_stages = int(mesh.shape[pp_axis])
+        if config.n_layer % self.n_stages:
+            raise ValueError(
+                f"n_layer ({config.n_layer}) must divide into the pp axis "
+                f"size ({self.n_stages})")
+        self._embed = _Embed(config)
+        self._head = _Head(config)
+        self._stage = _Stage(config, config.n_layer // self.n_stages)
+
+    def init(self, rng, tokens) -> Any:
+        cfg = self.config
+        r_embed, r_head, *r_stages = jax.random.split(rng, 2 + self.n_stages)
+        x = jnp.zeros(tokens.shape + (cfg.d_model,), cfg.dtype)
+        embed = self._embed.init(r_embed, tokens)["params"]
+        per_stage = [self._stage.init(r, x)["params"] for r in r_stages]
+        stages = stack_stage_params(per_stage)
+        stages = shard_stage_params(stages, self.mesh, self.pp_axis)
+        head = self._head.init(r_head, x)["params"]
+        return {"embed": embed, "stages": stages, "head": head}
+
+    def apply(self, params, tokens):
+        x = self._embed.apply({"params": params["embed"]}, tokens)
+
+        def stage_fn(stage_params, h):
+            return self._stage.apply({"params": stage_params}, h)
+
+        x = pipeline_apply(stage_fn, params["stages"], x, mesh=self.mesh,
+                           n_micro=self.n_micro, pp_axis=self.pp_axis,
+                           dp_axis=self.dp_axis)
+        return self._head.apply({"params": params["head"]}, x)
+
+
+def pipelined_lm_loss_fn(model: PipelinedGPT):
+    """Next-token cross-entropy over the pipelined model — same contract
+    as :func:`..models.transformer.lm_loss_fn`."""
+
+    def loss_fn(params, batch):
+        inputs, targets = batch
+        logits = model.apply(params, inputs)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None],
+                                   axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    return loss_fn

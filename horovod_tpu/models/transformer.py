@@ -39,6 +39,10 @@ class GPTConfig:
     causal: bool = True
     attention: str = "full"            # 'full' | 'flash' | 'ring' | 'ulysses'
     attention_engine: str = "xla"      # ring per-block engine: 'xla' | 'flash'
+    moe_experts: int = 0               # 0 = dense FFN; >0 = MoE with ep axis
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_every: int = 2                 # every Nth block is MoE (rest dense)
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -113,14 +117,24 @@ class MlpBlock(nn.Module):
 class Block(nn.Module):
     config: GPTConfig
     mesh: Optional[Mesh] = None
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x):
         cfg = self.config
         x = x + Attention(cfg, self.mesh, name="attn")(
             nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x))
-        x = x + MlpBlock(cfg, name="mlp")(
-            nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x))
+        if self.use_moe:
+            from ..parallel.moe import MoEMlp
+
+            ffn = MoEMlp(d_model=cfg.d_model, d_ff=cfg.d_ff,
+                         n_experts=cfg.moe_experts, top_k=cfg.moe_top_k,
+                         capacity_factor=cfg.moe_capacity_factor,
+                         dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         name="moe")
+        else:
+            ffn = MlpBlock(cfg, name="mlp")
+        x = x + ffn(nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x))
         return x
 
 
@@ -143,7 +157,10 @@ class GPT(nn.Module):
         )
         x = tok_emb + pos_emb[None, :T].astype(cfg.dtype)
         for i in range(cfg.n_layer):
-            x = Block(cfg, self.mesh, name=f"block_{i}")(x)
+            use_moe = (cfg.moe_experts > 0
+                       and (i + 1) % max(1, cfg.moe_every) == 0)
+            x = Block(cfg, self.mesh, use_moe=use_moe,
+                      name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
                           param_dtype=cfg.param_dtype, name="lm_head")(x)

@@ -12,6 +12,10 @@ parallelism) is a first-class requirement of this framework:
   attention with log-sum-exp merging, K/V rotating over ICI neighbors).
 * :mod:`.ulysses`    — all-to-all sequence parallelism (scatter heads,
   gather sequence).
+* :mod:`.pipeline`   — GPipe pipeline parallelism over the ``pp`` axis
+  (microbatches over ``ppermute``).
+* :mod:`.moe`        — GShard mixture-of-experts over the ``ep`` axis
+  (top-k routing, capacity, expert all-to-alls via GSPMD).
 """
 
 from .sharding import make_mesh, transformer_param_rules, shard_params  # noqa: F401
@@ -21,3 +25,5 @@ from .ring_attention import (  # noqa: F401
 from .ulysses import ulysses_attention  # noqa: F401
 from .train import make_spmd_train_step, shard_batch, init_opt_state  # noqa: F401
 from .sharding import param_shardings  # noqa: F401
+from .pipeline import pipeline_apply, shard_stage_params  # noqa: F401
+from .moe import MoEMlp, moe_aux_loss  # noqa: F401

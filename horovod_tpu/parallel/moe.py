@@ -1,0 +1,143 @@
+"""Mixture-of-Experts FFN with expert parallelism (the ``ep`` mesh axis).
+
+No reference analogue — Horovod has no expert parallelism (SURVEY.md
+§2.9); this is a first-class capability of the TPU rebuild.  Technique
+per the GShard line of work: a learned top-k router assigns each token
+to experts under a fixed per-expert capacity (static shapes — XLA needs
+them), dispatch/combine are einsums against a one-hot capacity tensor,
+and the expert dimension of the weights is sharded over ``ep`` so GSPMD
+inserts the all-to-alls that move token blocks to their experts' chips
+(over ICI).  The router runs in float32 (softmax numerics), experts in
+the model dtype (MXU).
+
+Load balancing: the standard auxiliary loss (mean gate fraction × mean
+dispatch fraction × E²) is sown under ``intermediates/moe_aux_loss``;
+:func:`moe_aux_loss` sums it from a model's captured intermediates.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _constrain(x, spec: P):
+    """Best-effort sharding hint: annotate under jit, no-op outside."""
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, RuntimeError):
+        return x
+
+
+class MoEMlp(nn.Module):
+    """Drop-in replacement for the transformer's dense FFN block.
+
+    ``[B, T, C] -> [B, T, C]``; ``n_experts`` expert FFNs, each token
+    routed to its ``top_k`` highest-gate experts, capacity
+    ``ceil(top_k * tokens / n_experts * capacity_factor)`` per expert.
+    Route weights are the top-k gates normalized *before* capacity
+    drops, so an overflowed route simply loses its share (GShard
+    semantics) — survivors are never amplified.
+    """
+
+    d_model: int
+    d_ff: int
+    n_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        B, T, C = x.shape
+        E = self.n_experts
+        K = min(self.top_k, E)
+        S = B * T
+        cap = max(1, math.ceil(K * S / E * self.capacity_factor))
+
+        xf = x.reshape(S, C)
+
+        # --- router (float32) ------------------------------------------------
+        logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
+                          param_dtype=jnp.float32, name="router")(
+            xf.astype(jnp.float32))
+        gates = jax.nn.softmax(logits, axis=-1)               # [S, E]
+
+        # --- top-k assignment with capacity (GShard) -------------------------
+        dispatch = jnp.zeros((S, E, cap), jnp.float32)
+        slots = []
+        remaining = gates
+        # Tokens already slotted per expert accumulate across the k rounds
+        # so round k's positions start after round k-1's.
+        fill = jnp.zeros((E,), jnp.int32)
+        topk_gates = []
+        masks = []
+        for _ in range(K):
+            idx = jnp.argmax(remaining, axis=-1)              # [S]
+            mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # [S, E]
+            gate_k = jnp.sum(gates * mask, axis=-1)           # [S]
+            # Position of each token inside its expert's capacity buffer.
+            pos = (jnp.cumsum(mask, axis=0) - 1.0) + fill[None, :].astype(
+                jnp.float32)
+            pos = jnp.sum(pos * mask, axis=-1)                # [S]
+            keep = (pos < cap) & (gate_k > 0)
+            pos_oh = jax.nn.one_hot(pos, cap, dtype=jnp.float32)  # [S, cap]
+            slot = mask[:, :, None] * pos_oh[:, None, :]      # [S, E, cap]
+            slot = slot * keep[:, None, None]
+            dispatch = dispatch + slot
+            slots.append(slot)
+            fill = fill + jnp.sum(mask * keep[:, None],
+                                  axis=0).astype(jnp.int32)
+            remaining = remaining * (1.0 - mask)
+            topk_gates.append(gate_k)
+            masks.append(mask)
+
+        # Route weights: top-k gates normalized BEFORE capacity drops, so
+        # a dropped route's share is lost, not redistributed.
+        denom = jnp.maximum(sum(topk_gates), 1e-9)            # [S]
+        combine = sum(
+            slot * (gate_k / denom)[:, None, None]
+            for slot, gate_k in zip(slots, topk_gates))
+
+        # --- load-balancing auxiliary loss -----------------------------------
+        me = jnp.mean(gates, axis=0)                          # [E]
+        ce = jnp.mean(masks[0], axis=0)                       # top-1 fraction
+        self.sow("intermediates", "moe_aux_loss",
+                 jnp.sum(me * ce) * E * E)
+
+        # --- expert computation (ep-sharded) ---------------------------------
+        w_up = self.param("w_up", nn.initializers.lecun_normal(),
+                          (E, C, self.d_ff), self.param_dtype)
+        w_down = self.param("w_down", nn.initializers.lecun_normal(),
+                            (E, self.d_ff, C), self.param_dtype)
+
+        expert_in = jnp.einsum("sec,sd->ecd", dispatch.astype(self.dtype),
+                               xf.astype(self.dtype))         # [E, cap, C]
+        expert_in = _constrain(expert_in, P("ep", None, None))
+        h = jnp.einsum("ecd,edf->ecf", expert_in,
+                       w_up.astype(self.dtype))
+        h = nn.gelu(h)
+        h = _constrain(h, P("ep", None, "tp"))
+        out_e = jnp.einsum("ecf,efd->ecd", h, w_down.astype(self.dtype))
+        out_e = _constrain(out_e, P("ep", None, None))
+        out = jnp.einsum("sec,ecd->sd", combine.astype(self.dtype), out_e)
+        return out.reshape(B, T, C)
+
+
+def moe_aux_loss(intermediates, weight: float = 1e-2) -> jnp.ndarray:
+    """Sum the sown load-balancing losses from
+    ``model.apply(..., mutable=['intermediates'])`` captures."""
+    total = jnp.float32(0.0)
+    n = 0
+    for leaf in jax.tree_util.tree_leaves(intermediates):
+        total = total + jnp.sum(jnp.asarray(leaf, jnp.float32))
+        n += 1
+    if n == 0:
+        return jnp.float32(0.0)
+    return weight * total / n

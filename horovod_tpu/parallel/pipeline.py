@@ -1,0 +1,135 @@
+"""Pipeline parallelism (the ``pp`` mesh axis) — GPipe schedule over
+``shard_map`` + ``ppermute``.
+
+No reference analogue — Horovod has no pipeline parallelism (SURVEY.md
+§2.9); this is a first-class capability of the TPU rebuild.  Design per
+the standard JAX/TPU pipelining recipe (scaling-book style): the model
+trunk is a stack of identical stages whose parameters carry a leading
+stage dimension sharded over ``pp``; inside ``shard_map`` each chip
+holds one stage's weights, microbatches flow stage-to-stage with
+neighbor ``ppermute`` over ICI, and the schedule runs
+``n_micro + pp - 1`` ticks (the GPipe bubble).  Differentiable: the
+whole schedule is ``lax.scan``-traced, so ``jax.grad`` produces the
+reverse pipeline automatically.
+
+Use :func:`pipeline_apply` for a raw stage function, or
+``models.transformer.GPT`` with ``n_stages`` via ``stack_blocks`` for
+the flagship model.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .._compat import shard_map
+
+
+def pipeline_apply(stage_fn: Callable, stage_params: Any, x, *,
+                   mesh: Mesh, n_micro: int, pp_axis: str = "pp",
+                   dp_axis: Optional[str] = "dp"):
+    """Run ``x`` through ``pp`` pipeline stages.
+
+    ``stage_fn(params_one_stage, activation) -> activation`` — one
+    stage's compute (same shapes in and out).
+    ``stage_params`` — pytree whose leaves have a leading ``[n_stages]``
+    dimension (sharded over ``pp_axis``; see
+    :func:`stage_param_shardings`).
+    ``x`` — ``[B, ...]`` global batch; split into ``n_micro``
+    microbatches along dim 0 (``B`` divisible by ``n_micro`` × the dp
+    size).  Returns the pipelined result, same shape as ``x``.
+    """
+    axes = set(mesh.axis_names)
+    if pp_axis not in axes:
+        raise ValueError(f"mesh has no axis {pp_axis!r}: {mesh.axis_names}")
+    dp = dp_axis if (dp_axis and dp_axis in axes) else None
+
+    def local(params_local, x_local):
+        # params_local: [1, ...] stage slice; x_local: [B/dp, ...]
+        params_me = jax.tree.map(lambda p: p[0], params_local)
+        n = lax.axis_size(pp_axis)
+        me = lax.axis_index(pp_axis)
+        b = x_local.shape[0]
+        if b % n_micro:
+            raise ValueError(
+                f"local batch {b} not divisible by n_micro {n_micro}")
+        micro = x_local.reshape((n_micro, b // n_micro) + x_local.shape[1:])
+        mshape = micro.shape[1:]
+
+        fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+        n_ticks = n_micro + n - 1
+
+        def tick(carry, t):
+            state, outputs = carry
+            # Stage 0 picks up microbatch t (a dummy after they run out);
+            # other stages consume what arrived from their predecessor.
+            feed = micro[jnp.minimum(t, n_micro - 1)]
+            x_in = jnp.where(me == 0, feed, state)
+            y = stage_fn(params_me, x_in)
+            # The last stage banks microbatch t-(n-1) once the pipeline
+            # is full; earlier ticks write to a dummy slot then get
+            # masked by the where().
+            out_idx = jnp.clip(t - (n - 1), 0, n_micro - 1)
+            valid = (me == n - 1) & (t >= n - 1)
+            outputs = lax.dynamic_update_index_in_dim(
+                outputs,
+                jnp.where(valid, y,
+                          lax.dynamic_index_in_dim(outputs, out_idx,
+                                                   keepdims=False)),
+                out_idx, axis=0)
+            # Hand this tick's activation to the next stage.
+            state = lax.ppermute(y, pp_axis, fwd_perm)
+            return (state, outputs), None
+
+        state0 = jnp.zeros(mshape, x_local.dtype)
+        out0 = jnp.zeros((n_micro,) + mshape, x_local.dtype)
+        (_, outputs), _ = lax.scan(tick, (state0, out0),
+                                   jnp.arange(n_ticks))
+        # Only the last stage holds real outputs; broadcast them to all
+        # pp members so the result is replicated over pp (a psum of the
+        # masked buffer — one collective, and keeps out_specs simple).
+        outputs = lax.psum(
+            jnp.where(me == n - 1, outputs, jnp.zeros_like(outputs)),
+            pp_axis)
+        return outputs.reshape(x_local.shape)
+
+    body = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(pp_axis), P(dp)),
+        out_specs=P(dp),
+        check=False,
+    )
+    return body(stage_params, x)
+
+
+def stage_param_shardings(mesh: Mesh, pp_axis: str = "pp"):
+    """Sharding for stacked stage parameters: leading stage dim over
+    ``pp``, everything else replicated (compose tp by hand if needed)."""
+    from jax.sharding import NamedSharding
+
+    def shard(tree):
+        return jax.tree.map(
+            lambda _: NamedSharding(mesh, P(pp_axis)), tree)
+
+    return shard
+
+
+def shard_stage_params(stage_params: Any, mesh: Mesh,
+                       pp_axis: str = "pp") -> Any:
+    """Place stacked stage parameters with the stage dim over ``pp``."""
+    from jax.sharding import NamedSharding
+
+    sharding = NamedSharding(mesh, P(pp_axis))
+    return jax.tree.map(lambda p: jax.device_put(p, sharding), stage_params)
+
+
+def stack_stage_params(per_stage_params: list) -> Any:
+    """Stack per-stage parameter pytrees into one tree with a leading
+    stage dimension (the layout :func:`pipeline_apply` consumes)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)

@@ -58,6 +58,10 @@ _TRANSFORMER_RULES: Sequence[Tuple[str, P]] = (
     (r".*attn.*(out|proj_out|output).*kernel", P("tp", None)),
     (r".*mlp.*(up|fc1|gate|intermediate).*kernel", P(None, "tp")),
     (r".*mlp.*(down|fc2|output).*kernel", P("tp", None)),
+    # MoE experts: expert dim over ep, FFN dims over tp; router replicated.
+    (r".*moe.*router.*kernel", P()),
+    (r".*moe.*w_up", P("ep", None, "tp")),
+    (r".*moe.*w_down", P("ep", "tp", None)),
     (r".*embed.*embedding", P(None, None)),
     (r".*", P()),
 )
