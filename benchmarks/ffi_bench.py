@@ -1,0 +1,89 @@
+"""Micro-benchmark: native FFI bucket pack/unpack vs the pure-HLO path.
+
+Reference analogue: the fusion-buffer memcpy cost the reference pays in
+``MemcpyInFusionBuffer``/``MemcpyOutFusionBuffer`` (SURVEY.md §2.1 —
+mount empty, unverified).  This bench times ``fused_apply``'s scatter+
+gather legs around an identity collective on the CPU backend — the
+controller tier where the FFI custom calls are load-bearing (XLA:TPU
+runs no user custom calls on-device; there the HLO path *is* native).
+
+Run: ``python benchmarks/ffi_bench.py`` → one JSON line per variant.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+os.environ.setdefault("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def make_leaves(n_tensors: int, total_mb: float, seed: int = 0):
+    """A gradient-set-shaped workload: many small per-slot vectors."""
+    rng = np.random.RandomState(seed)
+    total = int(total_mb * (1 << 20) / 4)
+    cuts = np.sort(rng.choice(np.arange(1, total), n_tensors - 1,
+                              replace=False))
+    sizes = np.diff(np.concatenate([[0], cuts, [total]]))
+    return [jnp.asarray(rng.randn(int(s)).astype(np.float32))
+            for s in sizes]
+
+
+def bench_variant(leaves, use_ffi: bool, iters: int = 20) -> float:
+    """Fused allreduce of the leaf set under shard_map — the gradient hot
+    path.  A real collective (psum) sits between pack and unpack, so the
+    scatter/gather legs cannot be optimized away; what's timed is the
+    genuine fusion-buffer cost of each variant."""
+    os.environ["HVD_TPU_USE_NATIVE_FFI"] = "1" if use_ffi else "0"
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from horovod_tpu._compat import shard_map
+    from horovod_tpu.ops import fusion
+
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+
+    def body(ls):
+        return fusion.fused_apply(
+            ls, lambda x: jax.lax.psum(x, "x"), 1 << 30, lead_ndim=0)
+
+    run = shard_map(body, mesh=mesh, in_specs=P(None), out_specs=P(None),
+                    check=False)
+    fn = jax.jit(run)
+    out = fn(leaves)
+    jax.block_until_ready(out)  # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(leaves)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    from horovod_tpu.native import ffi
+
+    have_ffi = ffi.available()  # before the env-var toggling below
+    leaves = make_leaves(n_tensors=128, total_mb=64)
+    t_hlo = bench_variant(leaves, use_ffi=False)
+    results = {"hlo_ms": round(t_hlo * 1e3, 3)}
+    if have_ffi:
+        t_ffi = bench_variant(leaves, use_ffi=True)
+        results["ffi_ms"] = round(t_ffi * 1e3, 3)
+        results["speedup"] = round(t_hlo / t_ffi, 3)
+    print(json.dumps({
+        "metric": "fusion_pack_unpack_64MB_128t",
+        "unit": "ms", **results,
+    }))
+
+
+if __name__ == "__main__":
+    main()

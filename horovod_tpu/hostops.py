@@ -393,7 +393,10 @@ def alltoall(value: np.ndarray, splits: Optional[np.ndarray] = None, *,
     require_member(ranks, name)
 
     received_splits = S[:, me]
-    got = row_from_sharded(raw, heads[me]).reshape(
+    # Output rows are indexed by *global slot*, so read this process's own
+    # head slot — not heads[me], which is the me-th member's slot and only
+    # coincides for the global set (ADVICE r1, subset-set corruption).
+    got = row_from_sharded(raw, heads[rank_]).reshape(
         (n, k_max) + value.shape[1:])
     parts = [got[i, : int(received_splits[i])] for i in range(n)]
     gathered = np.concatenate(parts, axis=0)
@@ -422,8 +425,9 @@ def reducescatter(value: np.ndarray, *, op: str = Sum, process_set=None,
                               name=name)
     require_member(ranks, name)
     # Average over member slots == over member processes (neutral rows),
-    # so the core's op handling is already process-correct here.
-    return row_from_sharded(raw, heads[members.index(rank_)])
+    # so the core's op handling is already process-correct here.  Output
+    # rows are indexed by global slot: read this process's own head slot.
+    return row_from_sharded(raw, heads[rank_])
 
 
 # --- barrier / join ----------------------------------------------------------

@@ -25,7 +25,10 @@ SO_PATH = os.path.join(_HERE, "libhvdtpu_native.so")
 
 
 def sources() -> List[str]:
-    return sorted(glob.glob(os.path.join(SRC_DIR, "*.cc")))
+    # ffi_ops.cc is the XLA FFI library: different toolchain contract
+    # (C++17 + jaxlib headers), built separately by native/ffi.py.
+    return sorted(p for p in glob.glob(os.path.join(SRC_DIR, "*.cc"))
+                  if not p.endswith("ffi_ops.cc"))
 
 
 def needs_build() -> bool:

@@ -1,0 +1,178 @@
+// XLA FFI custom-call handlers: the native half of the fusion buffer.
+//
+// Reference analogue: horovod/tensorflow/xla_mpi_ops.cc — the XLA
+// custom-call adapter SURVEY.md §2.3 calls "the highest-leverage file
+// for the TPU port" — plus the fusion-buffer batched-memcpy kernels in
+// horovod/common/fusion_buffer_manager.cc (SURVEY.md §2.1; mount empty,
+// unverified).  There, custom calls let collectives live *inside* a
+// compiled XLA graph instead of bridging out to an eager op per tensor.
+//
+// TPU-native redesign: on TPU itself, XLA compiles concat/slice into the
+// collective's pre/post memcpys, so no custom call is needed — or
+// possible (XLA:TPU does not run user custom-call handlers on-device).
+// The place a native handler IS the right tool is the *controller tier*:
+// host-binding collectives (horovod_tpu/hostops.py) execute on the CPU
+// backend, where these typed-FFI handlers splice the fusion buffer's
+// scatter/gather directly into the jitted program — one strided memcpy
+// pass instead of an HLO concat + N dynamic-slices.
+//
+//   hvd_bucket_pack:   k buffers [L, n_i]  -> one [L, sum(n_i)] buffer
+//   hvd_bucket_unpack: one [L, sum(n_i)]   -> k buffers [L, n_i]
+//   hvd_adasum_combine: the Adasum pairwise rule on two equal vectors
+//     (reference: Adasum::DispatchComputeDotAndNormSqrds + ScaledAdd in
+//     horovod/common/ops/adasum/adasum.h), one fused pass over both.
+//
+// All handlers are dtype-agnostic byte movers except adasum_combine
+// (f32/f64).  Zero third-party deps beyond the header-only XLA FFI API.
+
+#include <cstdint>
+#include <cstring>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+namespace {
+
+// Byte size of one trailing row-chunk and the leading (row) count for a
+// [L, n] buffer; scalars/rank-1 are treated as L=1.
+inline void RowLayout(const ffi::AnyBuffer& b, int64_t* rows,
+                      int64_t* row_bytes) {
+  auto dims = b.dimensions();
+  int64_t n = 1;
+  for (size_t i = 1; i < dims.size(); ++i) n *= dims[i];
+  *rows = dims.size() ? dims[0] : 1;
+  *row_bytes = static_cast<int64_t>(b.size_bytes() / (*rows ? *rows : 1));
+  (void)n;
+}
+
+ffi::Error BucketPackImpl(ffi::RemainingArgs args,
+                          ffi::Result<ffi::AnyBuffer> out) {
+  int64_t out_rows, out_row_bytes;
+  RowLayout(*out, &out_rows, &out_row_bytes);
+  char* dst_base = reinterpret_cast<char*>(out->untyped_data());
+
+  int64_t col_off = 0;
+  for (size_t i = 0; i < args.size(); ++i) {
+    auto arg = args.get<ffi::AnyBuffer>(i);
+    if (!arg.has_value()) {
+      return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                        "bucket_pack: argument is not a buffer");
+    }
+    int64_t rows, row_bytes;
+    RowLayout(*arg, &rows, &row_bytes);
+    if (rows != out_rows) {
+      return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                        "bucket_pack: leading (slot) dims must match");
+    }
+    const char* src = reinterpret_cast<const char*>(arg->untyped_data());
+    for (int64_t r = 0; r < rows; ++r) {
+      std::memcpy(dst_base + r * out_row_bytes + col_off,
+                  src + r * row_bytes, row_bytes);
+    }
+    col_off += row_bytes;
+  }
+  if (col_off != out_row_bytes) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "bucket_pack: output row size != sum of input rows");
+  }
+  return ffi::Error::Success();
+}
+
+ffi::Error BucketUnpackImpl(ffi::AnyBuffer in, ffi::RemainingRets outs) {
+  int64_t in_rows, in_row_bytes;
+  RowLayout(in, &in_rows, &in_row_bytes);
+  const char* src_base = reinterpret_cast<const char*>(in.untyped_data());
+
+  int64_t col_off = 0;
+  for (size_t i = 0; i < outs.size(); ++i) {
+    auto ret = outs.get<ffi::AnyBuffer>(i);
+    if (!ret.has_value()) {
+      return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                        "bucket_unpack: result is not a buffer");
+    }
+    int64_t rows, row_bytes;
+    RowLayout(**ret, &rows, &row_bytes);
+    if (rows != in_rows) {
+      return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                        "bucket_unpack: leading (slot) dims must match");
+    }
+    char* dst = reinterpret_cast<char*>((*ret)->untyped_data());
+    for (int64_t r = 0; r < rows; ++r) {
+      std::memcpy(dst + r * row_bytes,
+                  src_base + r * in_row_bytes + col_off, row_bytes);
+    }
+    col_off += row_bytes;
+  }
+  if (col_off != in_row_bytes) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "bucket_unpack: output rows don't cover the input row");
+  }
+  return ffi::Error::Success();
+}
+
+// adasum(a, b) = (1 - a.b / (2 a.a)) a + (1 - a.b / (2 b.b)) b,
+// dots accumulated in double; zero-norm guarded like the HLO version
+// (horovod_tpu/ops/adasum.py::_combine).
+template <typename T>
+void AdasumCombine(const T* a, const T* b, T* out, int64_t n) {
+  double dot = 0.0, asq = 0.0, bsq = 0.0;
+  for (int64_t i = 0; i < n; ++i) {
+    const double ai = static_cast<double>(a[i]);
+    const double bi = static_cast<double>(b[i]);
+    dot += ai * bi;
+    asq += ai * ai;
+    bsq += bi * bi;
+  }
+  const double ca = 1.0 - (asq > 0.0 ? dot / (2.0 * asq) : 0.0);
+  const double cb = 1.0 - (bsq > 0.0 ? dot / (2.0 * bsq) : 0.0);
+  for (int64_t i = 0; i < n; ++i) {
+    out[i] = static_cast<T>(ca * static_cast<double>(a[i]) +
+                            cb * static_cast<double>(b[i]));
+  }
+}
+
+ffi::Error AdasumCombineImpl(ffi::AnyBuffer a, ffi::AnyBuffer b,
+                             ffi::Result<ffi::AnyBuffer> out) {
+  if (a.element_count() != b.element_count() ||
+      a.element_count() != out->element_count() ||
+      a.element_type() != b.element_type() ||
+      a.element_type() != out->element_type()) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "adasum_combine: a, b, out must match in shape/dtype");
+  }
+  const int64_t n = static_cast<int64_t>(a.element_count());
+  switch (a.element_type()) {
+    case ffi::F32:
+      AdasumCombine(reinterpret_cast<const float*>(a.untyped_data()),
+                    reinterpret_cast<const float*>(b.untyped_data()),
+                    reinterpret_cast<float*>(out->untyped_data()), n);
+      return ffi::Error::Success();
+    case ffi::F64:
+      AdasumCombine(reinterpret_cast<const double*>(a.untyped_data()),
+                    reinterpret_cast<const double*>(b.untyped_data()),
+                    reinterpret_cast<double*>(out->untyped_data()), n);
+      return ffi::Error::Success();
+    default:
+      return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                        "adasum_combine: only f32/f64 supported");
+  }
+}
+
+}  // namespace
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(hvd_bucket_pack, BucketPackImpl,
+                              ffi::Ffi::Bind()
+                                  .RemainingArgs()
+                                  .Ret<ffi::AnyBuffer>());
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(hvd_bucket_unpack, BucketUnpackImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::AnyBuffer>()
+                                  .RemainingRets());
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(hvd_adasum_combine, AdasumCombineImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::AnyBuffer>()
+                                  .Arg<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>());

@@ -66,6 +66,27 @@ def plan_buckets_py(sizes_bytes: Sequence[int], threshold: int) -> List[List[int
     return buckets
 
 
+def _native_ffi_ok() -> bool:
+    """Route the bucket scatter/gather through the native XLA-FFI
+    handlers?  Only on the CPU backend (on TPU, XLA's own fusion of
+    concat/slice into the collective's memcpys is the native path —
+    XLA:TPU runs no user custom calls on-device) and only inside a
+    *manual* SPMD region (shard_map): under the auto partitioner an
+    opaque custom call makes XLA all-gather slot-sharded operands, an
+    8x comms regression vs the partial-sum + all-reduce it finds for
+    the plain concat path."""
+    try:
+        if jax.default_backend() != "cpu":
+            return False
+        if not jax.sharding.get_abstract_mesh().manual_axes:
+            return False
+        from ..native import ffi
+
+        return ffi.available()
+    except Exception:
+        return False
+
+
 def fused_apply(
     leaves: Sequence[jax.Array],
     collective_1d: Callable[[jax.Array], jax.Array],
@@ -81,11 +102,19 @@ def fused_apply(
     through ``collective_1d`` once, and split/reshaped back.  The
     collective may consume the leading axes (host-tier reduction does);
     splitting happens on the output's last axis.  Runs under jit.
+
+    On the CPU backend the pack/split legs ride the native typed-FFI
+    handlers (``native/src/ffi_ops.cc``) — one strided-memcpy pass, the
+    fusion buffer's scatter/gather as compiled custom calls.
     """
     out: List[jax.Array] = [None] * len(leaves)  # type: ignore[list-item]
     by_dtype: dict = {}
     for i, leaf in enumerate(leaves):
         by_dtype.setdefault(jnp.asarray(leaf).dtype, []).append(i)
+
+    use_ffi = _native_ffi_ok()
+    if use_ffi:
+        from ..native import ffi as native_ffi
 
     for dtype, idxs in by_dtype.items():
         sizes = [int(np.prod(leaves[i].shape[lead_ndim:])) * dtype.itemsize
@@ -94,13 +123,30 @@ def fused_apply(
             members = [idxs[j] for j in bucket]
             flats = [leaves[i].reshape(leaves[i].shape[:lead_ndim] + (-1,))
                      for i in members]
-            fused = (jnp.concatenate(flats, axis=lead_ndim)
-                     if len(flats) > 1 else flats[0])
+            if len(flats) > 1 and use_ffi:
+                # [rows, n_i] normal form (rows=1 when there is no slot
+                # axis); the handler does one row-strided memcpy pass.
+                rows2 = [f.reshape((-1, f.shape[-1])) for f in flats]
+                fused = native_ffi.bucket_pack(rows2).reshape(
+                    flats[0].shape[:-1] + (-1,))
+            elif len(flats) > 1:
+                fused = jnp.concatenate(flats, axis=lead_ndim)
+            else:
+                fused = flats[0]
             reduced = collective_1d(fused)
+            cols = [int(np.prod(leaves[i].shape[lead_ndim:]))
+                    if leaves[i].shape[lead_ndim:] else 1
+                    for i in members]
+            if len(members) > 1 and use_ffi:
+                pieces = native_ffi.bucket_unpack(
+                    reduced.reshape((-1, reduced.shape[-1])), cols)
+                for i, piece in zip(members, pieces):
+                    out[i] = piece.reshape(
+                        reduced.shape[:-1] + leaves[i].shape[lead_ndim:])
+                continue
             offset = 0
-            for i in members:
+            for i, n in zip(members, cols):
                 tail_shape = leaves[i].shape[lead_ndim:]
-                n = int(np.prod(tail_shape)) if tail_shape else 1
                 piece = jax.lax.dynamic_slice_in_dim(
                     reduced, offset, n, axis=reduced.ndim - 1
                 )

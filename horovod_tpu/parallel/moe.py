@@ -87,7 +87,10 @@ class MoEMlp(nn.Module):
                 jnp.float32)
             pos = jnp.sum(pos * mask, axis=-1)                # [S]
             keep = (pos < cap) & (gate_k > 0)
-            pos_oh = jax.nn.one_hot(pos, cap, dtype=jnp.float32)  # [S, cap]
+            # one_hot wants integer positions (float indices deprecate in
+            # jax 0.9); pos comes from a float cumsum.
+            pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), cap,
+                                    dtype=jnp.float32)  # [S, cap]
             slot = mask[:, :, None] * pos_oh[:, None, :]      # [S, E, cap]
             slot = slot * keep[:, None, None]
             dispatch = dispatch + slot

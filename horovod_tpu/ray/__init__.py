@@ -88,11 +88,22 @@ class RayExecutor:
                                    strategy=self.strategy.upper())
         ray.get(self._pg.ready(),
                 timeout=self.settings.placement_group_timeout_s)
-        coordinator = _coordinator_address()
 
         @ray.remote(num_cpus=self.cpus_per_worker,
                     num_gpus=self.gpus_per_worker or None)
         class _Worker:
+            def coordinator_address(self) -> str:
+                # jax.distributed starts the coordinator service inside
+                # rank 0's process, so the address must name *this actor's*
+                # node (and a port free here) — not the Ray driver's
+                # (ADVICE r1: driver-host addr hangs multi-node init).
+                import ray as _ray
+
+                from horovod_tpu.runner.common.network import free_port
+
+                host = _ray.util.get_node_ip_address()
+                return f"{host}:{free_port()}"
+
             def setup(self, rank: int, world: int, coord: str) -> None:
                 import os
 
@@ -119,6 +130,9 @@ class RayExecutor:
                 self._workers.append(_Worker.options(
                     placement_group=self._pg,
                     placement_group_bundle_index=bundle_idx).remote())
+        coordinator = ray.get(
+            self._workers[0].coordinator_address.remote(),
+            timeout=self.settings.timeout_s)
         ray.get([w.setup.remote(i, self.num_workers, coordinator)
                  for i, w in enumerate(self._workers)],
                 timeout=self.settings.timeout_s)
@@ -150,14 +164,3 @@ class RayExecutor:
 
             remove_placement_group(self._pg)
             self._pg = None
-
-
-def _coordinator_address() -> str:
-    import socket
-
-    from ..runner.common.network import resolvable_hostname
-
-    with socket.socket() as s:
-        s.bind(("0.0.0.0", 0))
-        port = s.getsockname()[1]
-    return f"{resolvable_hostname()}:{port}"

@@ -70,6 +70,14 @@ def resolvable_hostname() -> str:
         return "127.0.0.1"
 
 
+def free_port(host: str = "0.0.0.0") -> int:
+    """Probe a currently-free TCP port on this machine (the usual
+    bind-port-0 race applies: claim it promptly)."""
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
 def _sign(key: bytes, payload: bytes) -> bytes:
     return hmac.new(key, payload, hashlib.sha256).digest()
 

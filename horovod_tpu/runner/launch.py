@@ -32,9 +32,9 @@ from typing import Dict, List, Optional
 
 
 def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    from .common.network import free_port
+
+    return free_port("127.0.0.1")
 
 
 def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:

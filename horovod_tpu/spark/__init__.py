@@ -54,17 +54,25 @@ def run(fn: Callable, args: Sequence = (), kwargs: Optional[Dict] = None,
     if num_proc is None:
         num_proc = int(sc.defaultParallelism)
 
-    driver_host = _driver_host()
-    coord_port = _free_port()
-    coordinator = f"{driver_host}:{coord_port}"
     extra_env = dict(env or {})
 
     def mapper(index_iter):
         # Runs inside the Spark executor: become controller process
         # `index` of an `num_proc`-process jax.distributed world.
+        from pyspark import BarrierTaskContext
+
+        ctx = BarrierTaskContext.get()
         for index in index_iter:
             for k, v in extra_env.items():
                 os.environ[k] = str(v)
+            # jax.distributed binds the coordinator inside rank 0's task —
+            # which runs on an executor node, not the driver — so rank 0
+            # announces host:port from *its* node and the barrier
+            # allGather publishes it (ADVICE r1; upstream horovod.spark
+            # exchanges addresses the same way).
+            mine = f"{_local_host()}:{_free_port()}" if index == 0 else ""
+            addrs = ctx.allGather(mine)
+            coordinator = next(a for a in addrs if a)
             os.environ["HVD_TPU_COORDINATOR_ADDR"] = coordinator
             os.environ["HVD_TPU_NUM_PROCESSES"] = str(num_proc)
             os.environ["HVD_TPU_PROCESS_ID"] = str(index)
@@ -84,15 +92,15 @@ def run(fn: Callable, args: Sequence = (), kwargs: Optional[Dict] = None,
     return [r for _, r in sorted(results)]
 
 
-def _driver_host() -> str:
+def _local_host() -> str:
+    """Resolvable hostname of the machine this call runs on (an executor
+    node when called from inside the barrier stage)."""
     from ..runner.common.network import resolvable_hostname
 
     return resolvable_hostname()
 
 
 def _free_port() -> int:
-    import socket
+    from ..runner.common.network import free_port
 
-    with socket.socket() as s:
-        s.bind(("0.0.0.0", 0))
-        return s.getsockname()[1]
+    return free_port()

@@ -54,10 +54,21 @@ def _to_numpy(t) -> np.ndarray:
     return np.asarray(tf.convert_to_tensor(t).numpy())
 
 
+# Attribute holding each graph's last collective: TF's parallel executor
+# may otherwise run data-independent py_function collectives in
+# different orders on different workers, breaking the SPMD
+# dispatch-order contract stated above (ADVICE r1).  Serialized via
+# control dependencies in graph-construction order.  Stored as an
+# attribute ON the FuncGraph (not a dict keyed by it) so the tensor we
+# retain — which strongly references its graph — dies with the graph.
+_CHAIN_ATTR = "_hvd_tpu_collective_chain_tail"
+
+
 def _np_bridge(fn, inputs: Sequence, out_dtypes: Sequence,
                name: str) -> List:
     """Run ``fn(*numpy_inputs) -> [numpy...]`` on host tensors, eagerly
-    or as a ``tf.py_function`` node when tracing a graph."""
+    or as a ``tf.py_function`` node when tracing a graph (chained to the
+    graph's previous collective so execution order == trace order)."""
     if tf.executing_eagerly():
         outs = fn(*[_to_numpy(i) for i in inputs])
         return [tf.convert_to_tensor(o) for o in outs]
@@ -66,8 +77,14 @@ def _np_bridge(fn, inputs: Sequence, out_dtypes: Sequence,
         return [tf.convert_to_tensor(o)
                 for o in fn(*[np.asarray(a.numpy()) for a in args])]
 
-    return tf.py_function(eager_fn, list(inputs), list(out_dtypes),
-                          name=name.replace(":", "_"))
+    graph = tf.compat.v1.get_default_graph()
+    prev = getattr(graph, _CHAIN_ATTR, None)
+    with tf.control_dependencies([prev] if prev is not None else []):
+        outs = tf.py_function(eager_fn, list(inputs), list(out_dtypes),
+                              name=name.replace(":", "_"))
+    chain_tail = outs[0] if isinstance(outs, (list, tuple)) else outs
+    setattr(graph, _CHAIN_ATTR, chain_tail)
+    return outs
 
 
 # --- allreduce ---------------------------------------------------------------
@@ -93,10 +110,21 @@ def allreduce(tensor, *, op: str = Average, process_set=None,
     allgather of values and indices (averaging deferred to the dense
     apply), matching ``horovod.tensorflow._allreduce`` semantics."""
     if isinstance(tensor, tf.IndexedSlices):
+        if op == Adasum:
+            # Reference rejects Adasum for sparse tensors too
+            # (horovod.tensorflow._allreduce raises NotImplementedError).
+            raise NotImplementedError(
+                f"{name}: Adasum reduction does not support "
+                "tf.IndexedSlices; densify first (sparse_as_dense=True)")
         values = allgather(tensor.values, process_set=process_set,
                            name=f"{name}.values")
         indices = allgather(tensor.indices, process_set=process_set,
                             name=f"{name}.indices")
+        # The gather is linear and row-wise, so pre/post scaling commute
+        # to one factor on the gathered values.
+        scale = float(prescale_factor) * float(postscale_factor)
+        if scale != 1.0:
+            values = values * tf.cast(scale, values.dtype)
         if op == Average:
             n = _set_size(process_set)
             values = values / tf.cast(n, values.dtype)

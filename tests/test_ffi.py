@@ -1,0 +1,180 @@
+"""Native XLA typed-FFI custom-call path (native/src/ffi_ops.cc).
+
+Reference analogue being validated: the XLA custom-call adapter
+(``horovod/tensorflow/xla_mpi_ops.cc``, SURVEY.md §2.3 — mount empty,
+unverified) and the fusion buffer's batched scatter/gather memcpys
+(``fusion_buffer_manager.cc``, §2.1).  Here: pack/unpack handlers spliced
+into jitted CPU programs, plus the Adasum pairwise combine."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.native import ffi
+
+
+pytestmark = pytest.mark.skipif(not ffi.available(),
+                                reason="native FFI library unavailable")
+
+
+class TestBucketPackUnpack:
+    def test_roundtrip_eager(self):
+        rng = np.random.RandomState(0)
+        a = jnp.asarray(rng.randn(3, 5), jnp.float32)
+        b = jnp.asarray(rng.randn(3, 2), jnp.float32)
+        c = jnp.asarray(rng.randn(3, 7), jnp.float32)
+        flat = ffi.bucket_pack([a, b, c])
+        assert flat.shape == (3, 14)
+        np.testing.assert_array_equal(
+            np.asarray(flat), np.concatenate([a, b, c], axis=1))
+        outs = ffi.bucket_unpack(flat, [5, 2, 7])
+        for got, want in zip(outs, (a, b, c)):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_roundtrip_under_jit(self):
+        a = jnp.arange(12, dtype=jnp.float32).reshape(2, 6)
+        b = jnp.full((2, 3), 7.0, jnp.float32)
+
+        @jax.jit
+        def f(x, y):
+            return ffi.bucket_unpack(ffi.bucket_pack([x, y]), [6, 3])
+
+        outs = f(a, b)
+        np.testing.assert_array_equal(np.asarray(outs[0]), np.asarray(a))
+        np.testing.assert_array_equal(np.asarray(outs[1]), np.asarray(b))
+
+    @pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float16, jnp.int32,
+                                       jnp.int8])
+    def test_dtype_agnostic(self, dtype):
+        rng = np.random.RandomState(1)
+        a = jnp.asarray(rng.randn(2, 4) * 10, dtype)
+        b = jnp.asarray(rng.randn(2, 2) * 10, dtype)
+        outs = ffi.bucket_unpack(ffi.bucket_pack([a, b]), [4, 2])
+        np.testing.assert_array_equal(np.asarray(outs[0]), np.asarray(a))
+        np.testing.assert_array_equal(np.asarray(outs[1]), np.asarray(b))
+
+    def test_single_row(self):
+        a = jnp.asarray([[1.0, 2.0]], jnp.float32)
+        b = jnp.asarray([[3.0]], jnp.float32)
+        flat = ffi.bucket_pack([a, b])
+        np.testing.assert_array_equal(np.asarray(flat), [[1.0, 2.0, 3.0]])
+
+
+class TestAdasumCombine:
+    def _want(self, a, b):
+        from horovod_tpu.ops.adasum import _combine
+
+        return np.asarray(_combine(jnp.asarray(a), jnp.asarray(b)))
+
+    def test_matches_hlo_combine(self):
+        rng = np.random.RandomState(2)
+        a = jnp.asarray(rng.randn(4096), jnp.float32)
+        b = jnp.asarray(rng.randn(4096), jnp.float32)
+        got = np.asarray(ffi.adasum_combine(a, b))
+        np.testing.assert_allclose(got, self._want(a, b),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_identical_inputs_idempotent(self):
+        a = jnp.asarray(np.random.RandomState(3).randn(100), jnp.float32)
+        np.testing.assert_allclose(np.asarray(ffi.adasum_combine(a, a)),
+                                   np.asarray(a), rtol=1e-6)
+
+    def test_orthogonal_adds(self):
+        a = jnp.asarray([1.0, 0.0, 0.0, 0.0], jnp.float32)
+        b = jnp.asarray([0.0, 2.0, 0.0, 0.0], jnp.float32)
+        np.testing.assert_allclose(np.asarray(ffi.adasum_combine(a, b)),
+                                   [1.0, 2.0, 0.0, 0.0], rtol=1e-6)
+
+    def test_f64(self):
+        # The HLO _combine computes in f32 regardless of input dtype, so
+        # the f64 reference is plain numpy in double precision.
+        rng = np.random.RandomState(4)
+        a = rng.randn(512)
+        b = rng.randn(512)
+        dot, asq, bsq = a @ b, a @ a, b @ b
+        want = (1.0 - dot / (2 * asq)) * a + (1.0 - dot / (2 * bsq)) * b
+        with jax.enable_x64(True):
+            got = np.asarray(ffi.adasum_combine(jnp.asarray(a, jnp.float64),
+                                                jnp.asarray(b, jnp.float64)))
+        np.testing.assert_allclose(got, want, rtol=1e-12)
+
+
+class TestFusedApplyFfiPath:
+    """fused_apply routes its pack/split legs through the FFI handlers
+    inside manual SPMD regions on the CPU backend; results must match the
+    HLO path bit-for-bit, and the auto-partitioner tier must NOT take the
+    FFI route (an opaque custom call would force operand all-gathers)."""
+
+    def _shard_map_apply(self, leaves):
+        import horovod_tpu as hvd
+        from horovod_tpu._compat import shard_map
+        from horovod_tpu.ops import fusion
+        from jax.sharding import PartitionSpec as P
+
+        gm = hvd.global_mesh()
+
+        def body(ls):
+            return fusion.fused_apply(
+                ls, lambda x: jax.lax.psum(x, gm.axis_name), 1 << 20)
+
+        fn = shard_map(body, mesh=gm.mesh, in_specs=P(gm.axis_name),
+                       out_specs=P(gm.axis_name), check=False)
+        return jax.jit(fn)(leaves)
+
+    def test_matches_hlo_path_in_manual_mode(self, monkeypatch):
+        rng = np.random.RandomState(5)
+        leaves = [jnp.asarray(rng.randn(8, 3), jnp.float32),
+                  jnp.asarray(rng.randn(8, 5, 2), jnp.float32),
+                  jnp.asarray(rng.randn(8, 1), jnp.float32)]
+        with_ffi = self._shard_map_apply(leaves)
+        monkeypatch.setenv("HVD_TPU_USE_NATIVE_FFI", "0")
+        without = self._shard_map_apply(leaves)
+        for a, b in zip(with_ffi, without):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_auto_partitioned_tier_avoids_ffi(self):
+        """Slot-sharded grouped_allreduce under the auto partitioner must
+        lower without the custom call (and without all-gathers of the
+        operands)."""
+        import horovod_tpu  # noqa: F401  (ensures core init'able)
+        from horovod_tpu.ops import collectives as C
+        from horovod_tpu.ops.collectives import _grouped_allreduce_fn, _lift
+        from horovod_tpu.ops.compression import Compression
+
+        rng = np.random.RandomState(7)
+        vals = [rng.randn(8, 4).astype(np.float32),
+                rng.randn(8, 2, 3).astype(np.float32)]
+        lifted = tuple(_lift(v, "probe") for v in vals)
+        fn = _grouped_allreduce_fn(C.Sum, None, 1.0, 1.0,
+                                   Compression.none, 1 << 26, 2)
+        txt = fn.lower(lifted).compile().as_text()
+        assert "hvd_bucket_pack" not in txt
+        assert "all-gather" not in txt.lower()
+
+    def test_inside_spmd_allreduce(self):
+        """The gradient hot path: fused allreduce under shard_map with the
+        FFI pack/unpack inside the compiled program."""
+        import horovod_tpu as hvd
+        from horovod_tpu._compat import shard_map
+        from horovod_tpu.ops.fusion import fused_allreduce_pytree
+        from jax.sharding import PartitionSpec as P
+
+        gm = hvd.global_mesh()
+        n = hvd.size()
+        rng = np.random.RandomState(6)
+        tree = {"w": jnp.asarray(rng.randn(n, 4, 3), jnp.float32),
+                "b": jnp.asarray(rng.randn(n, 7), jnp.float32)}
+
+        def body(t):
+            return fused_allreduce_pytree(t, axis=gm.axis_name, op="sum")
+
+        fn = shard_map(body, mesh=gm.mesh,
+                       in_specs=P(gm.axis_name), out_specs=P(gm.axis_name),
+                       check=False)
+        out = jax.jit(fn)(tree)
+        for k in tree:
+            want = np.broadcast_to(
+                np.asarray(tree[k]).sum(0, keepdims=True),
+                np.asarray(tree[k]).shape)
+            np.testing.assert_allclose(np.asarray(out[k]), want, rtol=1e-5)
