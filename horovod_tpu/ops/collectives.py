@@ -38,6 +38,7 @@ allreduce — no sub-communicators to bootstrap.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Any, List, Optional, Sequence, Tuple
 
@@ -66,6 +67,22 @@ def _st():
     from .. import basics
 
     return basics._require_init()
+
+
+def x64_transport(*tensors):
+    """64-bit wire context: JAX downcasts f64/i64/u64 (and c128) arrays
+    to 32 bits on lift unless x64 mode is on; the reference's MPI/NCCL
+    path is exact for these dtypes, so match it for the duration of a
+    collective's lift + dispatch.  No-op for narrower wires."""
+    for t in tensors:
+        dt = getattr(t, "dtype", None)
+        if dt is None:
+            continue
+        dt = np.dtype(dt)
+        if (dt.kind in "fiu" and dt.itemsize == 8) or (
+                dt.kind == "c" and dt.itemsize == 16):
+            return jax.enable_x64(True)
+    return contextlib.nullcontext()
 
 
 def _members_key(process_set) -> Optional[Tuple[int, ...]]:
@@ -172,13 +189,16 @@ def _reduce_stack(x, op: str, members: Optional[Sequence[int]],
         orig_dtype = x.dtype
         x = _mask_for(members, size, 0, x)
         wire, ctx = compression.compress(x)
-        r = jnp.sum(wire, axis=0)
+        # jnp.sum widens integer accumulators under x64; the reference
+        # reduces in the wire dtype, so pin the result dtype.
+        r = jnp.sum(wire, axis=0).astype(wire.dtype)
         r = compression.decompress(r, ctx)
         if op == Average:
             if jnp.issubdtype(orig_dtype, jnp.floating):
                 r = (r / n).astype(orig_dtype)
             else:
                 r = r // n
+        r = r.astype(orig_dtype)
     elif op == Min:
         big = jnp.finfo(x.dtype).max if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).max
         r = jnp.min(_mask_for(members, size, big, x), axis=0)
@@ -186,7 +206,7 @@ def _reduce_stack(x, op: str, members: Optional[Sequence[int]],
         small = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         r = jnp.max(_mask_for(members, size, small, x), axis=0)
     elif op == Product:
-        r = jnp.prod(_mask_for(members, size, 1, x), axis=0)
+        r = jnp.prod(_mask_for(members, size, 1, x), axis=0).astype(x.dtype)
     else:
         raise ValueError(f"Unknown reduction op: {op!r}")
     if postscale != 1.0:
@@ -240,13 +260,15 @@ def allreduce(tensor, *, op: str = Average, process_set=None,
         raise ValueError(f"Unknown op {op!r}; expected one of {_REDUCE_OPS}")
     st = _st()
     _heartbeat(name)
-    with st.timeline.activity(name, "ENQUEUE", {"op": op}):
-        x = _lift(tensor, name)
-        fn = _allreduce_fn(op, _members_key(process_set),
-                           float(prescale_factor), float(postscale_factor),
-                           compression, st.config.mesh_axis_name)
-    with st.timeline.activity(name, "EXECUTE", {"op": op}):
-        return fn(x)
+    with x64_transport(tensor):
+        with st.timeline.activity(name, "ENQUEUE", {"op": op}):
+            x = _lift(tensor, name)
+            fn = _allreduce_fn(op, _members_key(process_set),
+                               float(prescale_factor),
+                               float(postscale_factor),
+                               compression, st.config.mesh_axis_name)
+        with st.timeline.activity(name, "EXECUTE", {"op": op}):
+            return fn(x)
 
 
 def allreduce_async(tensor, **kwargs) -> Handle:
@@ -286,19 +308,23 @@ def grouped_allreduce(tensors: Sequence[Any], *, op: str = Average,
         raise ValueError(f"Unknown op {op!r}; expected one of {_REDUCE_OPS}")
     st = _st()
     _heartbeat(name)
-    xs = tuple(_lift(t, f"{name}[{i}]") for i, t in enumerate(tensors))
-    if op == Adasum:
-        # Adasum's dot products are per-tensor: no flat-buffer fusion
-        # (same constraint as the reference; see ops/adasum.py).
-        return [allreduce(x, op=op, process_set=process_set,
-                          prescale_factor=prescale_factor,
-                          postscale_factor=postscale_factor,
-                          name=f"{name}[{i}]") for i, x in enumerate(xs)]
-    fn = _grouped_allreduce_fn(op, _members_key(process_set),
-                               float(prescale_factor), float(postscale_factor),
-                               compression, st.config.fusion_threshold, len(xs))
-    with st.timeline.activity(name, "EXECUTE", {"op": op, "ntensors": len(xs)}):
-        return list(fn(xs))
+    with x64_transport(*tensors):
+        xs = tuple(_lift(t, f"{name}[{i}]") for i, t in enumerate(tensors))
+        if op == Adasum:
+            # Adasum's dot products are per-tensor: no flat-buffer fusion
+            # (same constraint as the reference; see ops/adasum.py).
+            return [allreduce(x, op=op, process_set=process_set,
+                              prescale_factor=prescale_factor,
+                              postscale_factor=postscale_factor,
+                              name=f"{name}[{i}]") for i, x in enumerate(xs)]
+        fn = _grouped_allreduce_fn(op, _members_key(process_set),
+                                   float(prescale_factor),
+                                   float(postscale_factor),
+                                   compression, st.config.fusion_threshold,
+                                   len(xs))
+        with st.timeline.activity(name, "EXECUTE",
+                                  {"op": op, "ntensors": len(xs)}):
+            return list(fn(xs))
 
 
 def grouped_allreduce_async(tensors, **kwargs) -> Handle:
@@ -324,15 +350,16 @@ def allgather(tensor, *, process_set=None, name: str = "allgather"):
     see ``horovod_tpu.functions.allgather_object``."""
     st = _st()
     _heartbeat(name)
-    x = _lift(tensor, name)
-    if x.ndim < 2:
-        raise ValueError(
-            f"{name}: per-slot contributions must be at least rank-1; "
-            f"use shape [size, k, ...]"
-        )
-    fn = _allgather_fn(_members_key(process_set))
-    with st.timeline.activity(name, "EXECUTE"):
-        return fn(x)
+    with x64_transport(tensor):
+        x = _lift(tensor, name)
+        if x.ndim < 2:
+            raise ValueError(
+                f"{name}: per-slot contributions must be at least rank-1; "
+                f"use shape [size, k, ...]"
+            )
+        fn = _allgather_fn(_members_key(process_set))
+        with st.timeline.activity(name, "EXECUTE"):
+            return fn(x)
 
 
 def allgather_async(tensor, **kwargs) -> Handle:
@@ -363,14 +390,16 @@ def broadcast(tensor, root_rank: int = 0, *, process_set=None,
     returned array is what members observe."""
     st = _st()
     _heartbeat(name)
-    x = _lift(tensor, name)
-    if process_set is not None and root_rank not in process_set.ranks:
-        raise ValueError(
-            f"{name}: root rank {root_rank} is not a member of {process_set}"
-        )
-    fn = _broadcast_fn(int(root_rank))
-    with st.timeline.activity(name, "EXECUTE", {"root": root_rank}):
-        return fn(x)
+    with x64_transport(tensor):
+        x = _lift(tensor, name)
+        if process_set is not None and root_rank not in process_set.ranks:
+            raise ValueError(
+                f"{name}: root rank {root_rank} is not a member of "
+                f"{process_set}"
+            )
+        fn = _broadcast_fn(int(root_rank))
+        with st.timeline.activity(name, "EXECUTE", {"root": root_rank}):
+            return fn(x)
 
 
 def broadcast_async(tensor, root_rank: int = 0, **kwargs) -> Handle:
@@ -407,17 +436,18 @@ def alltoall(tensor, *, process_set=None, name: str = "alltoall"):
     ``MPI_Alltoallv``)."""
     st = _st()
     _heartbeat(name)
-    x = _lift(tensor, name)
-    members = _members_key(process_set)
-    n = len(members) if members else st.mesh.size
-    if x.ndim < 2 or x.shape[1] % n != 0:
-        raise ValueError(
-            f"{name}: per-slot contributions must have dim-0 divisible by "
-            f"group size {n}; got per-slot shape {tuple(x.shape[1:])}"
-        )
-    fn = _alltoall_fn(members, st.mesh.size)
-    with st.timeline.activity(name, "EXECUTE"):
-        return fn(x)
+    with x64_transport(tensor):
+        x = _lift(tensor, name)
+        members = _members_key(process_set)
+        n = len(members) if members else st.mesh.size
+        if x.ndim < 2 or x.shape[1] % n != 0:
+            raise ValueError(
+                f"{name}: per-slot contributions must have dim-0 divisible "
+                f"by group size {n}; got per-slot shape {tuple(x.shape[1:])}"
+            )
+        fn = _alltoall_fn(members, st.mesh.size)
+        with st.timeline.activity(name, "EXECUTE"):
+            return fn(x)
 
 
 def alltoall_async(tensor, **kwargs) -> Handle:
@@ -456,17 +486,18 @@ def reducescatter(tensor, *, op: str = Sum, process_set=None,
         raise ValueError(f"reducescatter supports Sum/Average, got {op!r}")
     st = _st()
     _heartbeat(name)
-    x = _lift(tensor, name)
-    members = _members_key(process_set)
-    n = len(members) if members else st.mesh.size
-    if x.ndim < 2 or x.shape[1] % n != 0:
-        raise ValueError(
-            f"{name}: per-slot contributions must have dim-0 divisible by "
-            f"group size {n}; got per-slot shape {tuple(x.shape[1:])}"
-        )
-    fn = _reducescatter_fn(op, members, st.mesh.size)
-    with st.timeline.activity(name, "EXECUTE", {"op": op}):
-        return fn(x)
+    with x64_transport(tensor):
+        x = _lift(tensor, name)
+        members = _members_key(process_set)
+        n = len(members) if members else st.mesh.size
+        if x.ndim < 2 or x.shape[1] % n != 0:
+            raise ValueError(
+                f"{name}: per-slot contributions must have dim-0 divisible "
+                f"by group size {n}; got per-slot shape {tuple(x.shape[1:])}"
+            )
+        fn = _reducescatter_fn(op, members, st.mesh.size)
+        with st.timeline.activity(name, "EXECUTE", {"op": op}):
+            return fn(x)
 
 
 def reducescatter_async(tensor, **kwargs) -> Handle:

@@ -1,0 +1,154 @@
+"""Collective bodies under real 2- and 3-process worlds (reference CI:
+the ``-np 2`` tier of test/parallel/test_tensorflow.py etc., SURVEY.md
+§4 — mount empty, unverified)."""
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+class TestAllreduceMP:
+    def test_ops_sum_min_max_product(self, world):
+        world(2, """
+        x = np.arange(4, dtype=np.float32).reshape(1, 4) + rank * 10
+        got = np.asarray(hvd.allreduce(x, op=hvd.Sum))
+        want = (np.arange(4) + np.arange(4) + 10).astype(np.float32)
+        assert np.allclose(got, want), (got, want)
+        got = np.asarray(hvd.allreduce(x, op=hvd.Min))
+        assert np.allclose(got, np.arange(4)), got
+        got = np.asarray(hvd.allreduce(x, op=hvd.Max))
+        assert np.allclose(got, np.arange(4) + 10), got
+        y = np.full((1, 3), float(rank + 2), np.float32)
+        got = np.asarray(hvd.allreduce(y, op=hvd.Product))
+        assert np.allclose(got, 6.0), got
+        """)
+
+    def test_average_and_scale_factors(self, world):
+        world(2, """
+        x = np.full((1, 5), float(rank + 1), np.float32)
+        got = np.asarray(hvd.allreduce(x))  # Average default
+        assert np.allclose(got, 1.5), got
+        got = np.asarray(hvd.allreduce(x, op=hvd.Sum, prescale_factor=2.0,
+                                       postscale_factor=0.5))
+        assert np.allclose(got, 3.0), got
+        """)
+
+    def test_grouped_allreduce_multi_dtype(self, world):
+        world(2, """
+        a = np.full((1, 3), float(rank + 1), np.float32)
+        b = np.full((1, 2), rank + 1, np.int32)
+        c = np.full((1, 4), float(rank + 1), np.float64)
+        outs = hvd.grouped_allreduce([a, b, c], op=hvd.Sum)
+        assert np.allclose(np.asarray(outs[0]), 3.0)
+        assert np.asarray(outs[1]).dtype == np.int32
+        assert np.all(np.asarray(outs[1]) == 3)
+        assert np.asarray(outs[2]).dtype == np.float64
+        assert np.allclose(np.asarray(outs[2]), 3.0)
+        """)
+
+    def test_adasum_two_processes(self, world):
+        world(2, """
+        # adasum(a, b) with a = ones, b = 2*ones (parallel): each vector
+        # shrinks by half its projection on the other -> 1.5*ones.
+        x = np.ones((1, 8), np.float32) * (rank + 1)
+        got = np.asarray(hvd.allreduce(x, op=hvd.Adasum))
+        assert np.allclose(got, 1.5, atol=1e-5), got
+        """)
+
+
+class TestAllgatherMP:
+    def test_ragged_allgather(self, world):
+        world(2, """
+        # rank r contributes r+1 rows labeled r -> MPI_Allgatherv shape
+        x = np.full((rank + 1, 3), float(rank), np.float32)
+        got = np.asarray(hvd.allgather(x))
+        assert got.shape == (3, 3), got.shape
+        assert np.allclose(got[:1], 0.0) and np.allclose(got[1:], 1.0), got
+        """)
+
+    def test_queued_async_allgathers_overlap(self, world):
+        world(2, """
+        # Two handles in flight; wait() order (same on both ranks) defines
+        # the deferred second-round dispatch order.
+        a = np.full((rank + 1, 2), 1.0 + rank, np.float32)
+        b = np.full((2 - rank, 2), 5.0 + rank, np.float32)
+        ha = hvd.allgather_async(a, name='ag_a')
+        hb = hvd.allgather_async(b, name='ag_b')
+        ga = np.asarray(hvd.synchronize(ha))
+        gb = np.asarray(hvd.synchronize(hb))
+        assert ga.shape == (3, 2) and gb.shape == (3, 2)
+        assert np.allclose(ga[:1], 1.0) and np.allclose(ga[1:], 2.0), ga
+        assert np.allclose(gb[:2], 5.0) and np.allclose(gb[2:], 6.0), gb
+        """)
+
+    def test_allgather_object(self, world):
+        world(2, """
+        objs = hvd.allgather_object({'rank': rank, 'payload': [rank] * 2})
+        assert objs == [{'rank': 0, 'payload': [0, 0]},
+                        {'rank': 1, 'payload': [1, 1]}], objs
+        """)
+
+
+class TestBroadcastMP:
+    def test_broadcast_nonzero_root(self, world):
+        world(2, """
+        x = np.full((1, 4), float(rank * 7 + 1), np.float32)
+        got = np.asarray(hvd.broadcast(x, root_rank=1))
+        assert np.allclose(got, 8.0), got
+        obj = hvd.broadcast_object({'from': rank} if rank == 1 else None,
+                                   root_rank=1)
+        assert obj == {'from': 1}, obj
+        """)
+
+
+class TestAlltoallMP:
+    def test_uneven_splits(self, world):
+        world(2, """
+        # rank 0 sends [1 row to r0, 3 rows to r1]; rank 1 sends [2, 1].
+        splits = np.array([1, 3]) if rank == 0 else np.array([2, 1])
+        n = int(splits.sum())
+        x = np.full((n, 2), float(rank), np.float32)
+        got, rsplits = hvd.alltoall(x, splits=splits)
+        if rank == 0:
+            assert list(rsplits) == [1, 2], rsplits
+            assert got.shape == (3, 2)
+            assert np.allclose(np.asarray(got)[:1], 0.0)
+            assert np.allclose(np.asarray(got)[1:], 1.0)
+        else:
+            assert list(rsplits) == [3, 1], rsplits
+            assert got.shape == (4, 2)
+            assert np.allclose(np.asarray(got)[:3], 0.0)
+            assert np.allclose(np.asarray(got)[3:], 1.0)
+        """)
+
+    def test_even_default_splits(self, world):
+        world(2, """
+        x = np.arange(4, dtype=np.float32).reshape(4, 1) + 10 * rank
+        got, rsplits = hvd.alltoall(x, splits=np.array([2, 2]))
+        assert list(rsplits) == [2, 2]
+        mine = np.concatenate([np.arange(2) + 2 * rank,
+                               np.arange(2) + 2 * rank + 10])
+        assert np.allclose(np.asarray(got).ravel(), mine), got
+        """)
+
+
+class TestReducescatterMP:
+    def test_reducescatter_sum(self, world):
+        world(2, """
+        x = np.arange(8, dtype=np.float32).reshape(4, 2) * (rank + 1)
+        got = np.asarray(hvd.reducescatter(x, op=hvd.Sum))
+        want = (np.arange(8).reshape(4, 2) * 3)[rank * 2:(rank + 1) * 2]
+        assert np.allclose(got, want), (got, want)
+        """)
+
+
+class TestBarrierJoinMP:
+    def test_barrier_and_join(self, world):
+        world(2, """
+        import time
+        if rank == 1:
+            time.sleep(0.5)  # skew arrival; barrier must still line up
+        hvd.barrier()
+        last = hvd.join()
+        assert last == hvd.size() - 1, last
+        """)

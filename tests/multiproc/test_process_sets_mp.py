@@ -1,0 +1,109 @@
+"""Process-set semantics observed from separate controller processes —
+the code paths the in-process suite can't reach (reference: process-set
+cases of test/parallel/*, SURVEY.md §4; mount empty, unverified).
+
+Includes the ADVICE-r1 regression: subset-set alltoall/reducescatter
+must read THIS process's head-slot row, not the row of the i-th member.
+"""
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+class TestSubsetProcessSets:
+    def test_allreduce_subset_and_non_member_raises(self, world):
+        world(3, """
+        ps = hvd.add_process_set(hvd.ProcessSet([0, 2]))
+        x = np.full((1, 4), float(rank + 1), np.float32)
+        if rank in (0, 2):
+            got = np.asarray(hvd.allreduce(x, op=hvd.Sum, process_set=ps))
+            assert np.allclose(got, 4.0), got   # ranks 0 and 2: 1 + 3
+        else:
+            # Non-member controllers dispatch the same program (SPMD)
+            # then surface the reference's not-a-member error.
+            try:
+                hvd.allreduce(x, op=hvd.Sum, process_set=ps)
+            except ValueError as e:
+                assert 'not a member' in str(e), e
+            else:
+                raise AssertionError('non-member allreduce did not raise')
+        """)
+
+    def test_alltoall_subset_reads_own_row(self, world):
+        # ADVICE r1 (high): heads[me] indexing returned another process's
+        # slot row for proper-subset sets.
+        world(3, """
+        ps = hvd.add_process_set(hvd.ProcessSet([0, 2]))
+        if rank in (0, 2):
+            me = 0 if rank == 0 else 1
+            # member m sends one row labeled (10*m + dest) to each member
+            x = np.stack([[10.0 * me + 0], [10.0 * me + 1]]).astype(np.float32)
+            got, rsplits = hvd.alltoall(x, splits=np.array([1, 1]),
+                                        process_set=ps)
+            got = np.asarray(got).ravel()
+            want = np.array([0.0 + me, 10.0 + me])
+            assert np.allclose(got, want), (rank, got, want)
+        else:
+            try:
+                hvd.alltoall(np.zeros((2, 1), np.float32),
+                             splits=np.array([1, 1]), process_set=ps)
+            except ValueError as e:
+                assert 'not a member' in str(e), e
+            else:
+                raise AssertionError('non-member alltoall did not raise')
+        """)
+
+    def test_reducescatter_subset_reads_own_row(self, world):
+        world(3, """
+        ps = hvd.add_process_set(hvd.ProcessSet([0, 2]))
+        if rank in (0, 2):
+            me = 0 if rank == 0 else 1
+            x = np.arange(4, dtype=np.float32).reshape(2, 2) * (me + 1)
+            got = np.asarray(hvd.reducescatter(x, op=hvd.Sum,
+                                               process_set=ps))
+            want = (np.arange(4).reshape(2, 2) * 3)[me:me + 1]
+            assert np.allclose(got, want), (rank, got, want)
+        else:
+            try:
+                hvd.reducescatter(np.zeros((2, 2), np.float32),
+                                  process_set=ps)
+            except ValueError as e:
+                assert 'not a member' in str(e), e
+            else:
+                raise AssertionError('non-member reducescatter did not raise')
+        """)
+
+    def test_broadcast_within_subset(self, world):
+        world(3, """
+        ps = hvd.add_process_set(hvd.ProcessSet([1, 2]))
+        x = np.full((1, 3), float(rank), np.float32)
+        if rank in (1, 2):
+            got = np.asarray(hvd.broadcast(x, root_rank=2, process_set=ps))
+            assert np.allclose(got, 2.0), got
+        else:
+            try:
+                hvd.broadcast(x, root_rank=2, process_set=ps)
+            except ValueError as e:
+                assert 'not a member' in str(e), e
+            else:
+                raise AssertionError('non-member broadcast did not raise')
+        """)
+
+    def test_grouped_allreduce_subset(self, world):
+        world(3, """
+        ps = hvd.add_process_set(hvd.ProcessSet([0, 1]))
+        xs = [np.full((1, 2), float(rank + 1), np.float32),
+              np.full((1, 3), float(rank + 1), np.float32)]
+        if rank in (0, 1):
+            outs = hvd.grouped_allreduce(xs, op=hvd.Sum, process_set=ps)
+            for o in outs:
+                assert np.allclose(np.asarray(o), 3.0), o
+        else:
+            try:
+                hvd.grouped_allreduce(xs, op=hvd.Sum, process_set=ps)
+            except ValueError as e:
+                assert 'not a member' in str(e), e
+            else:
+                raise AssertionError('non-member grouped did not raise')
+        """)
