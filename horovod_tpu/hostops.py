@@ -112,9 +112,14 @@ def slot_set(slot_ranks: Sequence[int]) -> ProcessSet:
     with _slot_sets_lock:
         ps = _slot_sets.get(key)
         if ps is None or ps.process_set_id is None:
-            from .process_sets import add_process_set
+            from .process_sets import add_process_set, _table
 
-            ps = add_process_set(ProcessSet(key))
+            # A user-registered set with the same ranks IS this slot set
+            # (e.g. a subset ProcessSet in a one-chip-per-process world);
+            # the core table rejects duplicate rank tuples.
+            ps = _table().find(key)
+            if ps is None:
+                ps = add_process_set(ProcessSet(key))
             _slot_sets[key] = ps
         return ps
 
@@ -192,6 +197,11 @@ class HostHandle:
             self._done_flag = True
         return self._result
 
+    # alias so hvd.synchronize() treats HostHandle and the jit-tier Handle
+    # uniformly
+    def result(self):
+        return self.wait()
+
     def done(self) -> bool:
         if self._done_flag:
             return True
@@ -215,6 +225,7 @@ def _average_finish(r: np.ndarray, op: str, n: int) -> np.ndarray:
 def allreduce_async(value: np.ndarray, *, op: str = Average,
                     process_set=None, prescale_factor: float = 1.0,
                     postscale_factor: float = 1.0,
+                    compression=None,
                     name: str = "allreduce") -> HostHandle:
     """Process-level allreduce of one host array; resolves to numpy."""
     if op not in REDUCE_OPS:
@@ -228,11 +239,16 @@ def allreduce_async(value: np.ndarray, *, op: str = Average,
     if ranks is not None:
         heads = head_slots()
         slot_ps = slot_set([heads[r] for r in ranks])
+    if compression is None:
+        from .ops.compression import Compression
+
+        compression = Compression.none
     with x64_if(block.dtype):
-        raw = C.allreduce(
+        raw = C.allreduce_slots(
             lift_local(block), op=core_op, process_set=slot_ps,
             prescale_factor=float(prescale_factor),
-            postscale_factor=float(postscale_factor), name=name)
+            postscale_factor=float(postscale_factor),
+            compression=compression, name=name)
     # Membership is checked *after* dispatch: every controller must issue
     # the same collective program or members would deadlock (SPMD); the
     # reference errors for non-members too (via the C++ status path).
@@ -247,6 +263,7 @@ def allreduce_async(value: np.ndarray, *, op: str = Average,
 def grouped_allreduce_async(values: Sequence[np.ndarray], *, op: str = Average,
                             process_set=None, prescale_factor: float = 1.0,
                             postscale_factor: float = 1.0,
+                            compression=None,
                             name: str = "grouped_allreduce") -> HostHandle:
     """Fused process-level allreduce of several host arrays; resolves to
     a list of numpy arrays."""
@@ -260,12 +277,17 @@ def grouped_allreduce_async(values: Sequence[np.ndarray], *, op: str = Average,
     if ranks is not None:
         heads = head_slots()
         slot_ps = slot_set([heads[r] for r in ranks])
+    if compression is None:
+        from .ops.compression import Compression
+
+        compression = Compression.none
     blocks = [lift_local(local_block(v, op, L)) for v in values]
     with x64_if(*[b.dtype for b in blocks]):
-        raws = C.grouped_allreduce(
+        raws = C.grouped_allreduce_slots(
             blocks, op=core_op, process_set=slot_ps,
             prescale_factor=float(prescale_factor),
-            postscale_factor=float(postscale_factor), name=name)
+            postscale_factor=float(postscale_factor),
+            compression=compression, name=name)
     require_member(ranks, name)
 
     def finish():
@@ -297,7 +319,7 @@ def allgather_async(value: np.ndarray, *, process_set=None,
     # (keep it consistent across workers, as with any collective).
     len_block = np.zeros((L, 1), np.int32)
     len_block[0, 0] = k_local
-    len_raw = C.allgather(lift_local(len_block), process_set=ps,
+    len_raw = C.allgather_slots(lift_local(len_block), process_set=ps,
                           name=f"{name}.lengths")
     require_member(ranks, name)
 
@@ -309,7 +331,7 @@ def allgather_async(value: np.ndarray, *, process_set=None,
         block = np.zeros((L,) + padded.shape, dtype=value.dtype)
         block[0] = padded
         with x64_if(block.dtype):
-            raw = C.allgather(lift_local(block), process_set=ps, name=name)
+            raw = C.allgather_slots(lift_local(block), process_set=ps, name=name)
         g = to_host(raw).reshape((len(members), k_max) + value.shape[1:])
         parts = [g[i, : int(lengths[i])] for i in range(len(members))]
         return np.concatenate(parts, axis=0)
@@ -329,7 +351,7 @@ def broadcast_async(value: np.ndarray, root_rank: int = 0, *,
     block = np.broadcast_to(value[None], (L,) + value.shape).copy()
     root_slot = head_slots()[root_rank]
     with x64_if(block.dtype):
-        raw = C.broadcast(lift_local(block), root_rank=root_slot, name=name)
+        raw = C.broadcast_slots(lift_local(block), root_rank=root_slot, name=name)
     require_member(ranks, name)
 
     def finish():
@@ -377,7 +399,7 @@ def alltoall(value: np.ndarray, splits: Optional[np.ndarray] = None, *,
     if is_member:
         sp_local[me] = split_sizes
     sp_block = local_block(sp_local, Sum, L)
-    S = to_host(C.allreduce(lift_local(sp_block), op=Sum,
+    S = to_host(C.allreduce_slots(lift_local(sp_block), op=Sum,
                             name=f"{name}.splits"))
     k_max = max(int(S.max()), 1)
 
@@ -389,7 +411,7 @@ def alltoall(value: np.ndarray, splits: Optional[np.ndarray] = None, *,
     block = np.zeros((L, n * k_max) + value.shape[1:], dtype=value.dtype)
     block[0] = chunks.reshape((n * k_max,) + value.shape[1:])
     with x64_if(block.dtype):
-        raw = C.alltoall(lift_local(block), process_set=ps, name=name)
+        raw = C.alltoall_slots(lift_local(block), process_set=ps, name=name)
     require_member(ranks, name)
 
     received_splits = S[:, me]
@@ -421,7 +443,7 @@ def reducescatter(value: np.ndarray, *, op: str = Sum, process_set=None,
     block = np.zeros((L,) + value.shape, dtype=value.dtype)
     block[0] = value
     with x64_if(block.dtype):
-        raw = C.reducescatter(lift_local(block), op=op, process_set=ps,
+        raw = C.reducescatter_slots(lift_local(block), op=op, process_set=ps,
                               name=name)
     require_member(ranks, name)
     # Average over member slots == over member processes (neutral rows),

@@ -251,11 +251,11 @@ def _allreduce_fn(op: str, members: Optional[Tuple[int, ...]], prescale: float,
     return jax.jit(fn, out_shardings=gm.replicated())
 
 
-def allreduce(tensor, *, op: str = Average, process_set=None,
-              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
-              compression=Compression.none, name: str = "allreduce"):
-    """Reduce per-slot contributions; returns the reduced tensor ``[*S]``,
-    replicated on every slot (reference: ``hvd.allreduce``)."""
+def allreduce_slots(tensor, *, op: str = Average, process_set=None,
+                    prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+                    compression=Compression.none, name: str = "allreduce"):
+    """Slot-tier core: reduce per-slot contributions; returns the reduced
+    tensor ``[*S]``, replicated on every slot (reference: ``hvd.allreduce``)."""
     if op not in _REDUCE_OPS:
         raise ValueError(f"Unknown op {op!r}; expected one of {_REDUCE_OPS}")
     st = _st()
@@ -271,9 +271,6 @@ def allreduce(tensor, *, op: str = Average, process_set=None,
             return fn(x)
 
 
-def allreduce_async(tensor, **kwargs) -> Handle:
-    """Reference: ``hvd.allreduce_async`` — returns a :class:`Handle`."""
-    return Handle(allreduce(tensor, **kwargs), kwargs.get("name", "allreduce"))
 
 
 @functools.lru_cache(maxsize=512)
@@ -295,13 +292,13 @@ def _grouped_allreduce_fn(op: str, members: Optional[Tuple[int, ...]],
     return jax.jit(fn, out_shardings=(gm.replicated(),) * nleaves)
 
 
-def grouped_allreduce(tensors: Sequence[Any], *, op: str = Average,
-                      process_set=None, prescale_factor: float = 1.0,
-                      postscale_factor: float = 1.0,
-                      compression=Compression.none,
-                      name: str = "grouped_allreduce") -> List[Any]:
-    """Fused allreduce of a list of tensors as one logical operation
-    (reference: ``hvd.grouped_allreduce`` + the GroupTable, which
+def grouped_allreduce_slots(tensors: Sequence[Any], *, op: str = Average,
+                            process_set=None, prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0,
+                            compression=Compression.none,
+                            name: str = "grouped_allreduce") -> List[Any]:
+    """Slot-tier core: fused allreduce of a list of tensors as one logical
+    operation (reference: ``hvd.grouped_allreduce`` + the GroupTable, which
     guarantees a declared group completes atomically — here trivially
     true: the group is one XLA program)."""
     if op not in _REDUCE_OPS:
@@ -313,10 +310,10 @@ def grouped_allreduce(tensors: Sequence[Any], *, op: str = Average,
         if op == Adasum:
             # Adasum's dot products are per-tensor: no flat-buffer fusion
             # (same constraint as the reference; see ops/adasum.py).
-            return [allreduce(x, op=op, process_set=process_set,
-                              prescale_factor=prescale_factor,
-                              postscale_factor=postscale_factor,
-                              name=f"{name}[{i}]") for i, x in enumerate(xs)]
+            return [allreduce_slots(x, op=op, process_set=process_set,
+                                    prescale_factor=prescale_factor,
+                                    postscale_factor=postscale_factor,
+                                    name=f"{name}[{i}]") for i, x in enumerate(xs)]
         fn = _grouped_allreduce_fn(op, _members_key(process_set),
                                    float(prescale_factor),
                                    float(postscale_factor),
@@ -327,9 +324,6 @@ def grouped_allreduce(tensors: Sequence[Any], *, op: str = Average,
             return list(fn(xs))
 
 
-def grouped_allreduce_async(tensors, **kwargs) -> Handle:
-    return Handle(grouped_allreduce(tensors, **kwargs),
-                  kwargs.get("name", "grouped_allreduce"))
 
 
 @functools.lru_cache(maxsize=128)
@@ -343,11 +337,12 @@ def _allgather_fn(members: Optional[Tuple[int, ...]]):
     return jax.jit(fn, out_shardings=gm.replicated())
 
 
-def allgather(tensor, *, process_set=None, name: str = "allgather"):
-    """Concatenate per-slot contributions along dim 0, result replicated
-    (reference: ``hvd.allgather``).  Input ``[size, k, *T]`` → output
-    ``[size·k, *T]``.  Ragged contributions are an object-level concern:
-    see ``horovod_tpu.functions.allgather_object``."""
+def allgather_slots(tensor, *, process_set=None, name: str = "allgather"):
+    """Slot-tier core: concatenate per-slot contributions along dim 0,
+    result replicated (reference: ``hvd.allgather``).  Input
+    ``[size, k, *T]`` → output ``[size·k, *T]``.  Ragged contributions at
+    this tier are an object-level concern; the process-level public API
+    (:func:`allgather`) handles raggedness via a two-round protocol."""
     st = _st()
     _heartbeat(name)
     with x64_transport(tensor):
@@ -362,15 +357,6 @@ def allgather(tensor, *, process_set=None, name: str = "allgather"):
             return fn(x)
 
 
-def allgather_async(tensor, **kwargs) -> Handle:
-    return Handle(allgather(tensor, **kwargs), kwargs.get("name", "allgather"))
-
-
-def grouped_allgather(tensors: Sequence[Any], *, process_set=None,
-                      name: str = "grouped_allgather") -> List[Any]:
-    """Reference: ``hvd.grouped_allgather``."""
-    return [allgather(t, process_set=process_set, name=f"{name}[{i}]")
-            for i, t in enumerate(tensors)]
 
 
 @functools.lru_cache(maxsize=128)
@@ -382,12 +368,12 @@ def _broadcast_fn(root_rank: int):
     return jax.jit(fn, out_shardings=gm.replicated())
 
 
-def broadcast(tensor, root_rank: int = 0, *, process_set=None,
-              name: str = "broadcast"):
-    """Every slot receives slot ``root_rank``'s row (reference:
-    ``hvd.broadcast``; root is a *global* rank even for process sets).
-    At host tier the process-set and global variants coincide: the single
-    returned array is what members observe."""
+def broadcast_slots(tensor, root_rank: int = 0, *, process_set=None,
+                    name: str = "broadcast"):
+    """Slot-tier core: every slot receives slot ``root_rank``'s row
+    (reference: ``hvd.broadcast``; root is a *global* rank even for
+    process sets).  At this tier the process-set and global variants
+    coincide: the single returned array is what members observe."""
     st = _st()
     _heartbeat(name)
     with x64_transport(tensor):
@@ -402,9 +388,6 @@ def broadcast(tensor, root_rank: int = 0, *, process_set=None,
             return fn(x)
 
 
-def broadcast_async(tensor, root_rank: int = 0, **kwargs) -> Handle:
-    return Handle(broadcast(tensor, root_rank, **kwargs),
-                  kwargs.get("name", "broadcast"))
 
 
 @functools.lru_cache(maxsize=128)
@@ -426,14 +409,14 @@ def _alltoall_fn(members: Optional[Tuple[int, ...]], size: int):
     return jax.jit(fn, out_shardings=gm.shard_leading())
 
 
-def alltoall(tensor, *, process_set=None, name: str = "alltoall"):
-    """Uniform all-to-all (reference: ``hvd.alltoall`` with equal
-    ``splits``).  Input ``[size, n·k, *T]`` (n = group size): slot *i*'s
-    row holds its n outgoing chunks; output row *i* holds the chunks
-    addressed to *i*, concatenated.  Ragged ``splits`` should be padded
-    to the max chunk by the caller — dynamic shapes don't exist under
-    XLA (deliberate design difference from the reference's
-    ``MPI_Alltoallv``)."""
+def alltoall_slots(tensor, *, process_set=None, name: str = "alltoall"):
+    """Slot-tier core: uniform all-to-all (reference: ``hvd.alltoall``
+    with equal ``splits``).  Input ``[size, n·k, *T]`` (n = group size):
+    slot *i*'s row holds its n outgoing chunks; output row *i* holds the
+    chunks addressed to *i*, concatenated.  Ragged ``splits`` ride a
+    max-pad exchange at the process tier (:func:`alltoall`) — dynamic
+    shapes don't exist under XLA (deliberate design difference from the
+    reference's ``MPI_Alltoallv``)."""
     st = _st()
     _heartbeat(name)
     with x64_transport(tensor):
@@ -450,8 +433,6 @@ def alltoall(tensor, *, process_set=None, name: str = "alltoall"):
             return fn(x)
 
 
-def alltoall_async(tensor, **kwargs) -> Handle:
-    return Handle(alltoall(tensor, **kwargs), kwargs.get("name", "alltoall"))
 
 
 @functools.lru_cache(maxsize=128)
@@ -477,11 +458,12 @@ def _reducescatter_fn(op: str, members: Optional[Tuple[int, ...]], size: int):
     return jax.jit(fn, out_shardings=gm.shard_leading())
 
 
-def reducescatter(tensor, *, op: str = Sum, process_set=None,
-                  name: str = "reducescatter"):
-    """Reduce and scatter shards (reference: ``hvd.reducescatter``, late
-    vintages).  Input ``[size, n·k, *T]`` → output ``[size, k, *T]``, row
-    *i* being slot *i*'s shard of the reduction (zeros on non-members)."""
+def reducescatter_slots(tensor, *, op: str = Sum, process_set=None,
+                        name: str = "reducescatter"):
+    """Slot-tier core: reduce and scatter shards (reference:
+    ``hvd.reducescatter``, late vintages).  Input ``[size, n·k, *T]`` →
+    output ``[size, k, *T]``, row *i* being slot *i*'s shard of the
+    reduction (zeros on non-members)."""
     if op not in (Sum, Average):
         raise ValueError(f"reducescatter supports Sum/Average, got {op!r}")
     st = _st()
@@ -500,15 +482,6 @@ def reducescatter(tensor, *, op: str = Sum, process_set=None,
             return fn(x)
 
 
-def reducescatter_async(tensor, **kwargs) -> Handle:
-    return Handle(reducescatter(tensor, **kwargs),
-                  kwargs.get("name", "reducescatter"))
-
-
-def grouped_reducescatter(tensors, *, op: str = Sum, process_set=None,
-                          name: str = "grouped_reducescatter"):
-    return [reducescatter(t, op=op, process_set=process_set,
-                          name=f"{name}[{i}]") for i, t in enumerate(tensors)]
 
 
 def barrier(process_set=None, name: str = "barrier") -> None:
@@ -519,9 +492,204 @@ def barrier(process_set=None, name: str = "barrier") -> None:
     # _lift expects the process-local block in multi-process runs and the
     # full per-slot stack in single-controller runs.
     rows = st.mesh.local_size if jax.process_count() > 1 else st.mesh.size
-    out = allreduce(np.ones((rows, 1), dtype=np.float32),
-                    op=Sum, process_set=process_set, name=name)
+    out = allreduce_slots(np.ones((rows, 1), dtype=np.float32),
+                          op=Sum, process_set=process_set, name=name)
     jax.block_until_ready(out)
+
+
+# --- public API: deployment dispatch -----------------------------------------
+# The reference has exactly one deployment shape: one controller process per
+# accelerator, collectives over *process* contributions.  This framework has
+# two:
+#
+#   single-controller (the canonical TPU shape)
+#       One Python process drives every chip.  The public API takes the
+#       per-slot stack ``[size, *S]`` and uses the ``*_slots`` core above.
+#   multi-controller (``horovodtpurun -np N``, one process per chip/host)
+#       The public API reproduces the reference's *process-level* semantics:
+#       each process passes its own contribution ``[*S]`` (ragged leading
+#       dims allowed where the reference's MPI_Allgatherv/Alltoallv allow
+#       them), and results resolve to host numpy.  Implemented by
+#       :mod:`horovod_tpu.hostops`, which maps process contributions onto
+#       head slots of the global mesh and enforces process-set membership
+#       (non-members dispatch the same XLA program — SPMD — then raise,
+#       mirroring the reference's not-a-member C++ status).
+#
+# Already-global jax.Arrays (not fully addressable) are always slot-tier:
+# they are laid out over the whole mesh and carry their own semantics.
+
+def _multicontroller_value(tensor) -> bool:
+    if jax.process_count() <= 1:
+        return False
+    if isinstance(tensor, jax.Array) and not tensor.is_fully_addressable:
+        return False
+    return True
+
+
+def _host():
+    from .. import hostops
+
+    return hostops
+
+
+def allreduce(tensor, *, op: str = Average, process_set=None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              compression=Compression.none, name: str = "allreduce"):
+    """Reference: ``hvd.allreduce``.  Single-controller: reduce the
+    per-slot stack ``[size, *S]`` → ``[*S]``.  Multi-controller: reduce
+    this process's contribution across processes (reference semantics);
+    raises for process-set non-members after dispatch."""
+    return allreduce_async(tensor, op=op, process_set=process_set,
+                           prescale_factor=prescale_factor,
+                           postscale_factor=postscale_factor,
+                           compression=compression, name=name).result()
+
+
+def allreduce_async(tensor, *, op: str = Average, process_set=None,
+                    prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+                    compression=Compression.none, name: str = "allreduce"):
+    """Reference: ``hvd.allreduce_async`` — returns a handle for
+    :func:`synchronize`."""
+    if _multicontroller_value(tensor):
+        return _host().allreduce_async(
+            np.asarray(tensor), op=op, process_set=process_set,
+            prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+            compression=compression, name=name)
+    return Handle(allreduce_slots(tensor, op=op, process_set=process_set,
+                                  prescale_factor=prescale_factor,
+                                  postscale_factor=postscale_factor,
+                                  compression=compression, name=name), name)
+
+
+def grouped_allreduce(tensors: Sequence[Any], *, op: str = Average,
+                      process_set=None, prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0,
+                      compression=Compression.none,
+                      name: str = "grouped_allreduce") -> List[Any]:
+    """Reference: ``hvd.grouped_allreduce`` — the group completes
+    atomically (one XLA program single-controller; one dispatch round
+    multi-controller)."""
+    return grouped_allreduce_async(
+        tensors, op=op, process_set=process_set,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        compression=compression, name=name).result()
+
+
+def grouped_allreduce_async(tensors: Sequence[Any], *, op: str = Average,
+                            process_set=None, prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0,
+                            compression=Compression.none,
+                            name: str = "grouped_allreduce"):
+    if all(_multicontroller_value(t) for t in tensors) and jax.process_count() > 1:
+        return _host().grouped_allreduce_async(
+            [np.asarray(t) for t in tensors], op=op, process_set=process_set,
+            prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+            compression=compression, name=name)
+    return Handle(grouped_allreduce_slots(
+        tensors, op=op, process_set=process_set,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        compression=compression, name=name), name)
+
+
+def allgather(tensor, *, process_set=None, name: str = "allgather"):
+    """Reference: ``hvd.allgather`` — concatenate contributions along
+    dim 0.  Multi-controller contributions may be ragged in dim 0 (the
+    reference's ``MPI_Allgatherv``): a two-round max-pad protocol rides
+    under the hood (lengths first, padded payload second)."""
+    return allgather_async(tensor, process_set=process_set, name=name).result()
+
+
+def allgather_async(tensor, *, process_set=None, name: str = "allgather"):
+    if _multicontroller_value(tensor):
+        return _host().allgather_async(np.asarray(tensor),
+                                       process_set=process_set, name=name)
+    return Handle(allgather_slots(tensor, process_set=process_set, name=name),
+                  name)
+
+
+def grouped_allgather(tensors: Sequence[Any], *, process_set=None,
+                      name: str = "grouped_allgather") -> List[Any]:
+    """Reference: ``hvd.grouped_allgather``."""
+    handles = [allgather_async(t, process_set=process_set, name=f"{name}[{i}]")
+               for i, t in enumerate(tensors)]
+    return [h.result() for h in handles]
+
+
+def broadcast(tensor, root_rank: int = 0, *, process_set=None,
+              name: str = "broadcast"):
+    """Reference: ``hvd.broadcast`` — every participant receives rank
+    ``root_rank``'s tensor (a process rank multi-controller, a slot rank
+    single-controller)."""
+    return broadcast_async(tensor, root_rank, process_set=process_set,
+                           name=name).result()
+
+
+def broadcast_async(tensor, root_rank: int = 0, *, process_set=None,
+                    name: str = "broadcast"):
+    if _multicontroller_value(tensor):
+        return _host().broadcast_async(np.asarray(tensor), root_rank,
+                                       process_set=process_set, name=name)
+    return Handle(broadcast_slots(tensor, root_rank,
+                                  process_set=process_set, name=name), name)
+
+
+def alltoall(tensor, splits=None, *, process_set=None, name: str = "alltoall"):
+    """Reference: ``hvd.alltoall(tensor, splits)`` — scatter dim-0 chunks
+    to every participant, gather the chunks addressed here.  Returns the
+    gathered tensor, plus ``received_splits`` when ``splits`` was given
+    (reference return contract).
+
+    Multi-controller: full ``MPI_Alltoallv`` semantics — ``splits`` may be
+    ragged; chunk sizes are negotiated via a replicated split-matrix
+    exchange so every controller dispatches the identical XLA program.
+    Single-controller: the slot-stack path needs static uniform chunks;
+    ragged splits require the multi-controller deployment (or manual
+    padding)."""
+    if jax.process_count() > 1 and _multicontroller_value(tensor):
+        gathered, received = _host().alltoall(
+            np.asarray(tensor),
+            None if splits is None else np.asarray(splits),
+            process_set=process_set, name=name)
+        return (gathered, received) if splits is not None else gathered
+    if splits is not None:
+        sp = np.asarray(splits).reshape(-1)
+        if sp.size and not np.all(sp == sp[0]):
+            raise ValueError(
+                f"{name}: ragged splits need one controller per process "
+                f"(multi-controller deployment); pad chunks to the max "
+                f"size for the single-controller slot path")
+        out = alltoall_slots(tensor, process_set=process_set, name=name)
+        return out, sp.astype(np.int64)
+    return alltoall_slots(tensor, process_set=process_set, name=name)
+
+
+def alltoall_async(tensor, splits=None, **kwargs) -> Handle:
+    return Handle(alltoall(tensor, splits, **kwargs),
+                  kwargs.get("name", "alltoall"))
+
+
+def reducescatter(tensor, *, op: str = Sum, process_set=None,
+                  name: str = "reducescatter"):
+    """Reference: ``hvd.reducescatter`` — reduce, then scatter dim-0
+    shards.  Multi-controller: input is this process's ``[n·k, *T]``
+    contribution and the result is *this process's* ``[k, *T]`` shard.
+    Single-controller: slot-stack in, ``[size, k, *T]`` all-shards out."""
+    if _multicontroller_value(tensor):
+        return _host().reducescatter(np.asarray(tensor), op=op,
+                                     process_set=process_set, name=name)
+    return reducescatter_slots(tensor, op=op, process_set=process_set,
+                               name=name)
+
+
+def reducescatter_async(tensor, **kwargs) -> Handle:
+    return Handle(reducescatter(tensor, **kwargs),
+                  kwargs.get("name", "reducescatter"))
+
+
+def grouped_reducescatter(tensors, *, op: str = Sum, process_set=None,
+                          name: str = "grouped_reducescatter"):
+    return [reducescatter(t, op=op, process_set=process_set,
+                          name=f"{name}[{i}]") for i, t in enumerate(tensors)]
 
 
 def join() -> int:

@@ -130,6 +130,15 @@ class ProcessSetTable:
         with self._lock:
             return self._table[process_set_id]
 
+    def find(self, ranks: Sequence[int]) -> Optional[ProcessSet]:
+        """The registered set with exactly these ranks, or None."""
+        key = tuple(sorted(int(r) for r in ranks))
+        with self._lock:
+            for ps in self._table.values():
+                if ps.ranks == key:
+                    return ps
+        return None
+
     def ids(self) -> List[int]:
         with self._lock:
             return sorted(self._table)

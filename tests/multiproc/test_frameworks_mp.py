@@ -26,20 +26,33 @@ class TestTorchMP:
 class TestElasticMP:
     def test_restore_after_internal_error(self, world):
         """A collective failure mid-epoch rolls the state back to the
-        last commit on every rank and training resumes in sync."""
+        last commit on every rank and training resumes in sync.
+
+        The retry-entry assertion is the discriminating check: a no-op
+        restore() would re-enter with (step=3, accum=6) and fail there.
+        The final total equals the no-failure total — rollback removes
+        the uncommitted step-2 contribution and the replay re-adds it
+        exactly once (an earlier version of this test expected +4 here,
+        double-counting the replayed step)."""
         world(2, """
         from horovod_tpu.elastic import (HorovodInternalError, ObjectState,
                                          run as elastic_run)
 
         state = ObjectState(step=0, accum=0.0)
         FAIL_AT = 3
-        log = []
+        replay_entry = []
 
         @elastic_run
         def train(state):
+            if getattr(train, 'failed', False) and not replay_entry:
+                # First entry after rollback: the last commit was at
+                # (step=2, accum=2); uncommitted step-2 progress is gone.
+                assert state.step == 2, state.step
+                assert abs(state.accum - 2.0) < 1e-6, state.accum
+                replay_entry.append((state.step, state.accum))
             while state.step < 6:
                 x = np.full((1, 2), float(state.step), np.float32)
-                out = float(np.asarray(hvd.allreduce(x, op=hvd.Sum))[0])
+                out = float(np.asarray(hvd.allreduce(x, op=hvd.Sum)).ravel()[0])
                 state.accum += out
                 state.step += 1
                 if state.step == FAIL_AT and not getattr(
@@ -50,16 +63,16 @@ class TestElasticMP:
                     raise HorovodInternalError('injected failure')
                 if state.step % 2 == 0:
                     state.commit()
-                log.append(state.step)
             return state.accum
 
         total = train(state)
         # steps 0..5 summed over 2 ranks: each step contributes 2*step;
-        # the injected rollback (step 3 -> last commit at 2) replays step
-        # 2 exactly once after restore.
-        want = sum(2.0 * s for s in range(6)) + 2.0 * 2
+        # the rolled-back step-2 contribution is replayed exactly once,
+        # so the total matches the failure-free run.
+        want = sum(2.0 * s for s in range(6))
         assert abs(total - want) < 1e-5, (total, want)
         assert state.step == 6
+        assert replay_entry, 'rollback retry path never entered'
         """)
 
     def test_sync_broadcasts_rank0_state(self, world):
