@@ -70,6 +70,7 @@ class _GlobalState:
         self.process_sets = None    # horovod_tpu.process_sets.ProcessSetTable
         self.timeline = None        # horovod_tpu.utils.timeline.Timeline
         self.stall_inspector = None
+        self.cross_monitor = None   # horovod_tpu.utils.cross_stall (multi-process)
         self.parameter_manager = None
         self.lock = threading.Lock()
 
@@ -124,6 +125,9 @@ def init(config: Optional[Config] = None) -> None:
             return
         _maybe_init_distributed()
         cfg = config or Config.from_env()
+        from .config import warn_noop_knobs
+
+        warn_noop_knobs(logger)
         _state.config = cfg
         _state.mesh = GlobalMesh.build(axis_name=cfg.mesh_axis_name)
         _state.process_sets = _ps.ProcessSetTable(_state.mesh)
@@ -134,10 +138,76 @@ def init(config: Optional[Config] = None) -> None:
             shutdown_after_s=cfg.stall_shutdown_time_seconds,
         )
         _state.initialized = True
+        _state.cross_monitor = _maybe_start_cross_monitor(cfg)
         logger.info(
             "horovod_tpu initialized: %d slot(s) on %d process(es), platform=%s",
             _state.mesh.size, jax.process_count(), jax.default_backend(),
         )
+
+
+def _maybe_start_cross_monitor(cfg):
+    """Start the native-Coordinator stall/failure monitor in
+    multi-controller worlds (reference: the rank-0 controller's
+    cross-rank stall attribution; see utils/cross_stall.py).
+
+    Fail-soft, with one hard rule: the ``broadcast_object`` port exchange
+    is a *collective*, so every rank must reach it exactly once no matter
+    what fails locally — a rank that skipped it would leave its peers
+    blocked inside ``hvd.init``.  Local bootstrap failures therefore ship
+    ``port = -1`` (rank 0) or ignore the received port (others); the only
+    remaining asymmetric case — a peer whose Coordinator connect fails
+    after a successful exchange — degrades via negotiate timeout, which
+    self-disables every monitor without touching the data plane."""
+    if jax.process_count() <= 1 or cfg.stall_check_disable \
+            or not cfg.native_coordinator:
+        return None
+    from .functions import broadcast_object
+
+    rank, nproc = jax.process_index(), jax.process_count()
+    coord_addr = os.environ.get("HVD_TPU_COORDINATOR_ADDR", "")
+    host = coord_addr.rsplit(":", 1)[0] if ":" in coord_addr else "127.0.0.1"
+    coord = None
+    port = -1
+    if rank == 0:
+        try:
+            from .native import runtime as native
+
+            if native.available():
+                coord = native.Coordinator(0, nproc, host=host, port=0,
+                                           timeout_s=30.0)
+                port = coord.bound_port
+        except Exception as e:
+            logger.info("cross-process stall monitor unavailable: %s", e)
+            coord = None
+            port = -1
+    try:
+        port = int(broadcast_object(port if rank == 0 else None, root_rank=0))
+    except Exception as e:
+        logger.info("cross-process monitor port exchange failed: %s", e)
+        port = -1
+    if port < 0:
+        if coord is not None:   # exchange failed after a successful bind
+            try:
+                coord.close()
+            except Exception:
+                pass
+        return None
+    if rank != 0:
+        try:
+            from .native import runtime as native
+
+            if native.available():
+                coord = native.Coordinator(rank, nproc, host=host, port=port,
+                                           timeout_s=30.0)
+        except Exception as e:
+            logger.info("cross-process stall monitor unavailable: %s", e)
+            coord = None
+    if coord is None:
+        return None
+    from .utils.cross_stall import CrossProcessMonitor
+
+    return CrossProcessMonitor(coord,
+                               warn_after_s=cfg.stall_check_time_seconds)
 
 
 def shutdown() -> None:
@@ -150,6 +220,9 @@ def shutdown() -> None:
             _state.timeline.close()
         if _state.stall_inspector is not None:
             _state.stall_inspector.stop()
+        if _state.cross_monitor is not None:
+            _state.cross_monitor.stop()
+            _state.cross_monitor = None
         _state.initialized = False
         # Compiled-collective caches hold the old mesh; drop them so a
         # re-init (elastic restart, tests) rebuilds against the new mesh.

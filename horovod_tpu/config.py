@@ -77,8 +77,9 @@ class Config:
 
     # --- collectives ---
     hierarchical_allreduce: bool = False      # HOROVOD_HIERARCHICAL_ALLREDUCE
-    hierarchical_allgather: bool = False      # HOROVOD_HIERARCHICAL_ALLGATHER
-    batch_d2d_memcopies: bool = True          # HOROVOD_BATCH_D2D_MEMCOPIES
+    hierarchical_allgather: bool = False      # HOROVOD_HIERARCHICAL_ALLGATHER (no-op: warns)
+    batch_d2d_memcopies: bool = True          # HOROVOD_BATCH_D2D_MEMCOPIES (no-op: warns)
+    hierarchical_inner_size: int = 0          # HVD_TPU_HIERARCHICAL_INNER (0 = slots/process)
 
     # --- observability ---
     timeline: Optional[str] = None            # HOROVOD_TIMELINE (trace file path)
@@ -106,6 +107,7 @@ class Config:
     # --- TPU-specific (no reference analogue) ---
     mesh_axis_name: str = "hvd"               # HVD_TPU_MESH_AXIS_NAME
     use_native_planner: bool = True           # HVD_TPU_USE_NATIVE_PLANNER (C++ fusion planner)
+    native_coordinator: bool = True           # HVD_TPU_NATIVE_COORD (cross-process stall monitor)
 
     @staticmethod
     def from_env() -> "Config":
@@ -117,6 +119,7 @@ class Config:
             hierarchical_allreduce=_env_bool("HIERARCHICAL_ALLREDUCE", False),
             hierarchical_allgather=_env_bool("HIERARCHICAL_ALLGATHER", False),
             batch_d2d_memcopies=_env_bool("BATCH_D2D_MEMCOPIES", True),
+            hierarchical_inner_size=_env_int("HIERARCHICAL_INNER", 0),
             timeline=timeline or None,
             timeline_mark_cycles=_env_bool("TIMELINE_MARK_CYCLES", False),
             log_level=(_env("LOG_LEVEL", "warning") or "warning").lower(),
@@ -132,4 +135,34 @@ class Config:
             cache_capacity=_env_int("CACHE_CAPACITY", 1024),
             mesh_axis_name=_env("MESH_AXIS_NAME", "hvd") or "hvd",
             use_native_planner=_env_bool("USE_NATIVE_PLANNER", True),
+            native_coordinator=_env_bool("NATIVE_COORD", True),
         )
+
+
+# Reference knobs that have no TPU meaning: accepted for drop-in env
+# compatibility, but setting them warns — silently ignoring a
+# behavior-changing reference env var is a correctness trap.
+_NOOP_KNOBS = {
+    "CYCLE_TIME": ("XLA's async dispatch replaces the background cycle "
+                   "loop; there is no cycle latency to tune on TPU"),
+    "BATCH_D2D_MEMCOPIES": ("XLA fuses device-to-device copies at compile "
+                            "time; there are no d2d memcopy launches to "
+                            "batch on TPU"),
+    "HIERARCHICAL_ALLGATHER": ("XLA lowers AllGather over the physical "
+                               "topology natively; use "
+                               "HOROVOD_HIERARCHICAL_ALLREDUCE for the "
+                               "two-level reduce path"),
+}
+
+
+def warn_noop_knobs(logger) -> list:
+    """Warn for each reference knob that is set but has no effect here;
+    returns the list of names warned about (called from ``hvd.init``)."""
+    hit = []
+    for name, why in _NOOP_KNOBS.items():
+        if _env(name) is not None:
+            hit.append(name)
+            logger.warning(
+                "HOROVOD_%s is set but is a no-op in horovod_tpu: %s",
+                name, why)
+    return hit

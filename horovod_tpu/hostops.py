@@ -125,11 +125,24 @@ def slot_set(slot_ranks: Sequence[int]) -> ProcessSet:
 
 
 def member_ranks(process_set) -> Optional[List[int]]:
-    """Process-level ranks of a user-supplied process set (None = all)."""
+    """Process-level ranks of a user-supplied process set (None = all).
+
+    Host-tier process sets are over *controller processes* (reference:
+    one process per accelerator); ranks outside the process world are a
+    caller error, reported eagerly rather than as an index crash in the
+    head-slot translation."""
     if process_set is None:
         return None
+    if getattr(process_set, "process_set_id", None) == 0:
+        return None  # the global set (id 0 holds every slot, not processes)
+    P_ = world()[0]
     ranks = list(process_set.ranks)
-    if len(ranks) == world()[0]:
+    if any(not 0 <= r < P_ for r in ranks):
+        raise ValueError(
+            f"Process set ranks {ranks} outside the process world "
+            f"0..{P_ - 1}: host-tier process sets name controller "
+            f"processes, not mesh slots")
+    if len(ranks) == P_:
         return None
     return ranks
 

@@ -1,48 +1,42 @@
-"""horovod_tpu.mxnet — MXNet binding (gated).
+"""horovod_tpu.mxnet — MXNet binding (import-gated).
 
-Reference: ``horovod/mxnet/`` (``DistributedTrainer``, per-dtype mpi_ops
+Reference: ``horovod/mxnet/`` (``DistributedTrainer``, NDArray mpi_ops
 through the MXNet engine — SURVEY.md §2.3/§2.4, mount empty,
-unverified).  MXNet reached end-of-life upstream (retired by Apache in
-2023) and is not installable in this environment; the binding surface
-is declared for reference parity and raises with guidance.  The
-implementation recipe, should it ever be needed, is the same as the
-torch binding: bridge ``mx.nd.NDArray`` host tensors through
-:mod:`horovod_tpu.hostops` and wrap ``gluon.Trainer`` the way
-``horovod_tpu.torch.DistributedOptimizer`` wraps torch optimizers.
+unverified).  Structure mirrors the torch tier: NDArrays bridge to
+numpy and ride the shared host-binding core (:mod:`horovod_tpu.hostops`).
+
+MXNet reached end-of-life upstream (retired by Apache in 2023) and is
+not installable in this image, so the binding cannot be exercised
+against real mxnet here; its bridge logic is covered by
+``tests/test_mxnet_api.py`` with a minimal NDArray/gluon API shim
+(waiver recorded in README.md).
 """
 
 from __future__ import annotations
 
-_MSG = ("horovod_tpu.mxnet requires mxnet, which is end-of-life and not "
-        "bundled in this environment; use horovod_tpu.torch, "
-        "horovod_tpu.tensorflow, or the pure-JAX API instead")
+try:
+    import mxnet  # noqa: F401
+except ImportError as _e:
+    raise ImportError(
+        "horovod_tpu.mxnet requires mxnet (end-of-life upstream; not "
+        "bundled in this environment) — use horovod_tpu.torch, "
+        "horovod_tpu.tensorflow, or the pure-JAX API instead"
+    ) from _e
 
-
-def _unavailable(name: str):
-    try:
-        import mxnet  # noqa: F401
-    except ImportError as e:
-        raise ImportError(_MSG) from e
-    # mxnet importable but the binding is deliberately not implemented —
-    # never fall through silently (a no-op broadcast would let ranks
-    # train from divergent state).
-    raise NotImplementedError(
-        f"horovod_tpu.mxnet.{name} is not implemented (mxnet is "
-        "end-of-life); see the module docstring for the porting recipe")
-
-
-def init(*args, **kwargs):
-    _unavailable("init")
-
-
-def DistributedTrainer(*args, **kwargs):
-    """Reference: ``hvd.DistributedTrainer(params, opt)``."""
-    _unavailable("DistributedTrainer")
-
-
-def broadcast_parameters(*args, **kwargs):
-    _unavailable("broadcast_parameters")
-
-
-def allreduce(*args, **kwargs):
-    _unavailable("allreduce")
+from ..basics import (  # noqa: F401
+    init, shutdown, is_initialized,
+    rank, size, local_rank, local_size, cross_rank, cross_size,
+    mpi_built, nccl_built, gloo_built, ccl_built, cuda_built, rocm_built,
+)
+from ..process_sets import (  # noqa: F401
+    ProcessSet, add_process_set, remove_process_set, global_process_set,
+)
+from .mpi_ops import (  # noqa: F401
+    Average, Sum, Adasum, Min, Max, Product,
+    allreduce, allreduce_, allreduce_async_,
+    grouped_allreduce, grouped_allreduce_, grouped_allreduce_async_,
+    allgather, broadcast, broadcast_, alltoall, reducescatter,
+    barrier, synchronize, poll, join, Handle,
+)
+from .functions import broadcast_parameters, broadcast_object  # noqa: F401
+from .trainer import DistributedTrainer  # noqa: F401

@@ -1,0 +1,35 @@
+"""MXNet state broadcast helpers.
+
+Reference: ``broadcast_parameters`` / ``broadcast_object`` in
+``horovod/mxnet/__init__.py`` (SURVEY.md §2.4, mount empty, unverified)
+— broadcast gluon parameters (or a plain name→NDArray dict) from the
+root so every worker starts identical.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from . import mpi_ops
+from ..functions import broadcast_object  # noqa: F401  (re-export)
+
+
+def _param_arrays(params):
+    """Yield (name, NDArray) pairs from a gluon ParameterDict or a plain
+    mapping of name → NDArray."""
+    for name, p in sorted(params.items()):
+        if hasattr(p, "list_data"):      # gluon.Parameter
+            for arr in p.list_data():
+                yield name, arr
+        elif hasattr(p, "data") and callable(getattr(p, "data")):
+            yield name, p.data()
+        else:                            # already an NDArray
+            yield name, p
+
+
+def broadcast_parameters(params: Any, root_rank: int = 0,
+                         prefix: str = "") -> None:
+    """Reference: ``hvd.broadcast_parameters(model.collect_params(), 0)``
+    — in-place broadcast of every parameter array from ``root_rank``."""
+    for name, arr in _param_arrays(params):
+        mpi_ops.broadcast_(arr, root_rank, name=f"{prefix}{name}")

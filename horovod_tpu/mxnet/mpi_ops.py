@@ -1,0 +1,215 @@
+"""MXNet-NDArray collective API — reference parity with ``horovod.mxnet``.
+
+Reference surface (``horovod/mxnet/mpi_ops.py`` + the C extension
+``horovod/mxnet/mpi_ops.cc`` pushing ops onto the MXNet engine — paths
+per SURVEY.md §2.3/§2.4, mount empty, unverified): ``allreduce[_]``,
+``grouped_allreduce[_]``, ``allgather``, ``broadcast[_]``, ``alltoall``,
+with op/prescale/postscale/process_set args.
+
+TPU-native redesign: as with the torch tier, an MXNet worker is a
+*controller process*; its NDArray is bridged to numpy and the shared
+host-binding core (:mod:`horovod_tpu.hostops`) maps the process-level op
+onto slot-stack SPMD collectives.  There is no engine-callback half —
+XLA's async dispatch replaces the MXNet engine's dependency tracking,
+and in-place variants write back through NDArray slice assignment.
+
+MXNet reached end-of-life upstream (retired by Apache in 2023) and is
+not installable in this image; the binding is import-gated and its
+bridge logic is exercised against a minimal API shim in
+``tests/test_mxnet_api.py`` (see the waiver note in README.md).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import mxnet as mx  # gated by horovod_tpu/mxnet/__init__.py
+
+from .. import hostops as H
+
+Average = H.Average
+Sum = H.Sum
+Adasum = H.Adasum
+Min = H.Min
+Max = H.Max
+Product = H.Product
+
+
+# --- NDArray <-> numpy bridge ------------------------------------------------
+
+def _to_numpy(t) -> np.ndarray:
+    return t.asnumpy()
+
+
+def _like(t, a: np.ndarray):
+    """Construct an NDArray like ``t`` holding ``a``."""
+    kwargs = {}
+    ctx = getattr(t, "context", None)
+    if ctx is not None:
+        kwargs["ctx"] = ctx
+    return mx.nd.array(a, dtype=a.dtype, **kwargs)
+
+
+def _write_back(t, a: np.ndarray):
+    t[:] = a
+    return t
+
+
+# --- handles -----------------------------------------------------------------
+
+class Handle:
+    """Async handle (reference: engine-tracked write dependency of the
+    pushed op).  Wraps the in-flight host handle and the NDArray
+    write-back applied at ``synchronize`` time."""
+
+    def __init__(self, host: H.HostHandle, finish, name: str = ""):
+        self._host = host
+        self._finish = finish
+        self._result = None
+        self._done_flag = False
+        self.name = name
+
+    def wait(self):
+        if not self._done_flag:
+            self._result = self._finish(self._host.wait())
+            self._done_flag = True
+        return self._result
+
+    def done(self) -> bool:
+        return self._done_flag or self._host.done()
+
+
+def synchronize(handle: Handle):
+    return handle.wait()
+
+
+def poll(handle: Handle) -> bool:
+    return handle.done()
+
+
+# --- allreduce ---------------------------------------------------------------
+
+def allreduce(tensor, *, op: str = Average, process_set=None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              name: str = "allreduce"):
+    """Reference: ``hvd.allreduce(tensor)`` — out-of-place."""
+    host = H.allreduce_async(
+        _to_numpy(tensor), op=op, process_set=process_set,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        name=name)
+    return Handle(host, lambda r: _like(tensor, r), name).wait()
+
+
+def allreduce_(tensor, *, op: str = Average, process_set=None,
+               prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+               name: str = "allreduce"):
+    """Reference: ``hvd.allreduce_`` — in-place."""
+    host = H.allreduce_async(
+        _to_numpy(tensor), op=op, process_set=process_set,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        name=name)
+    return Handle(host, lambda r: _write_back(tensor, r), name).wait()
+
+
+def allreduce_async_(tensor, *, op: str = Average, process_set=None,
+                     prescale_factor: float = 1.0,
+                     postscale_factor: float = 1.0,
+                     name: str = "allreduce") -> Handle:
+    """In-place async — the ``DistributedTrainer`` hot path."""
+    host = H.allreduce_async(
+        _to_numpy(tensor), op=op, process_set=process_set,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        name=name)
+    return Handle(host, lambda r: _write_back(tensor, r), name)
+
+
+def grouped_allreduce(tensors: Sequence, *, op: str = Average,
+                      process_set=None, prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0,
+                      name: str = "grouped_allreduce") -> List:
+    return _grouped_impl(tensors, False, op, process_set, prescale_factor,
+                         postscale_factor, name).wait()
+
+
+def grouped_allreduce_(tensors: Sequence, *, op: str = Average,
+                       process_set=None, prescale_factor: float = 1.0,
+                       postscale_factor: float = 1.0,
+                       name: str = "grouped_allreduce") -> List:
+    return _grouped_impl(tensors, True, op, process_set, prescale_factor,
+                         postscale_factor, name).wait()
+
+
+def grouped_allreduce_async_(tensors: Sequence, *, op: str = Average,
+                             process_set=None, prescale_factor: float = 1.0,
+                             postscale_factor: float = 1.0,
+                             name: str = "grouped_allreduce") -> Handle:
+    return _grouped_impl(tensors, True, op, process_set, prescale_factor,
+                         postscale_factor, name)
+
+
+def _grouped_impl(tensors, in_place, op, process_set, prescale_factor,
+                  postscale_factor, name) -> Handle:
+    host = H.grouped_allreduce_async(
+        [_to_numpy(t) for t in tensors], op=op, process_set=process_set,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        name=name)
+
+    def finish(results):
+        if in_place:
+            return [_write_back(t, r) for t, r in zip(tensors, results)]
+        return [_like(t, r) for t, r in zip(tensors, results)]
+
+    return Handle(host, finish, name)
+
+
+# --- allgather / broadcast / alltoall / reducescatter ------------------------
+
+def allgather(tensor, *, process_set=None, name: str = "allgather"):
+    """Reference: ``hvd.allgather`` — concat along dim 0; ragged first
+    dims supported (MPI_Allgatherv) via the host tier's two-round
+    protocol."""
+    host = H.allgather_async(_to_numpy(tensor), process_set=process_set,
+                             name=name)
+    return Handle(host, lambda r: _like(tensor, r), name).wait()
+
+
+def broadcast(tensor, root_rank: int = 0, *, process_set=None,
+              name: str = "broadcast"):
+    host = H.broadcast_async(_to_numpy(tensor), root_rank,
+                             process_set=process_set, name=name)
+    return Handle(host, lambda r: _like(tensor, r), name).wait()
+
+
+def broadcast_(tensor, root_rank: int = 0, *, process_set=None,
+               name: str = "broadcast"):
+    host = H.broadcast_async(_to_numpy(tensor), root_rank,
+                             process_set=process_set, name=name)
+    return Handle(host, lambda r: _write_back(tensor, r), name).wait()
+
+
+def alltoall(tensor, splits=None, *, process_set=None,
+             name: str = "alltoall"):
+    np_splits = None if splits is None else _to_numpy(splits).astype(np.int64)
+    gathered, received = H.alltoall(_to_numpy(tensor), np_splits,
+                                    process_set=process_set, name=name)
+    out = _like(tensor, gathered)
+    if splits is None:
+        return out
+    return out, _like(tensor, received)
+
+
+def reducescatter(tensor, *, op: str = Sum, process_set=None,
+                  name: str = "reducescatter"):
+    shard = H.reducescatter(_to_numpy(tensor), op=op,
+                            process_set=process_set, name=name)
+    return _like(tensor, shard)
+
+
+def barrier(process_set=None, name: str = "barrier") -> None:
+    H.barrier(process_set=process_set, name=name)
+
+
+def join() -> int:
+    return H.join()

@@ -17,8 +17,9 @@ the fused-gradient hot path of ``make_train_step`` on the CPU
 controller/test substrate — making the library load-bearing there;
 under the *auto* partitioner the plain-HLO path is kept (an opaque
 custom call would force operand all-gathers; measured in
-``benchmarks/ffi_bench.py``, where the FFI path wins ~1.3x in its
-manual-mode home).
+``benchmarks/ffi_bench.py``, where the FFI path measured 3.88x vs the
+HLO path in its manual-mode home — hlo 3334.5ms vs ffi 859.6ms, CPU
+controller tier).
 
 Registration uses ``jax.ffi.register_ffi_target`` with PyCapsules minted
 from ``dlsym`` addresses via ctypes — no pybind11 (not in this image).

@@ -38,6 +38,7 @@ from typing import Any, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 
@@ -59,8 +60,14 @@ def adasum_allreduce(x: jax.Array, axis: str = "hvd",
                      groups: Optional[List[List[int]]] = None) -> jax.Array:
     """Adasum-allreduce ``x`` across the mesh axis (inside ``shard_map``).
 
-    Requires a power-of-two reduction width, like the reference's VHDD
-    core.  ``groups`` (optional) is a list of equal-sized member groups to
+    Any reduction width is supported (reference VHDD handles arbitrary N,
+    ``adasum/adasum.h``): for non-power-of-two widths ``n = p + r`` with
+    ``p`` the largest power of two ≤ n, the ``r`` extra members fold
+    their contribution into a distinct partner in the low-``p`` block
+    before the doubling rounds and receive the final result after — the
+    same lopsided combine tree as the reference's pre/post phases.
+
+    ``groups`` (optional) is a list of equal-sized member groups to
     reduce within — unlike ``psum``'s ``axis_index_groups`` it need not
     partition the axis; slots outside every group end with zeros (their
     outputs are never observed by process-set semantics).
@@ -72,20 +79,43 @@ def adasum_allreduce(x: jax.Array, axis: str = "hvd",
         n = sizes.pop()
     else:
         n = lax.axis_size(axis)
-    if n & (n - 1):
-        raise ValueError(
-            f"Adasum requires a power-of-two reduction width, got {n}. "
-            "(Matches the reference's recursive-halving core.)"
-        )
+    if n <= 1:
+        return x
+    p = 1 << (n.bit_length() - 1)  # largest power of two <= n
+    r = n - p
     v = x
-    for level in range(int(math.log2(n))):
+    if r:
+        # Pre-fold: extra member p+e sends to partner e.  Slots that
+        # receive nothing get ppermute's zeros, and _combine(v, 0) == v,
+        # so one unmasked combine handles both cases.
+        if groups is None:
+            pre = [(p + e, e) for e in range(r)]
+        else:
+            pre = [(g[p + e], g[e]) for g in groups for e in range(r)]
+        v = _combine(v, lax.ppermute(v, axis, pre))
+    for level in range(int(math.log2(p))):
         d = 1 << level
         if groups is None:
-            perm = [(i, i ^ d) for i in range(n)]
+            perm = [(i, i ^ d) for i in range(p)]
         else:
-            perm = [(g[i], g[i ^ d]) for g in groups for i in range(n)]
+            perm = [(g[i], g[i ^ d]) for g in groups for i in range(p)]
         pv = lax.ppermute(v, axis, perm)
         v = _combine(v, pv)
+    if r:
+        # Post-scatter: partner e returns the converged result to the
+        # extra member p+e, which overwrites (not combines) its value.
+        axis_n = lax.axis_size(axis)
+        extra = np.zeros(axis_n, dtype=bool)
+        if groups is None:
+            post = [(e, p + e) for e in range(r)]
+            extra[p:n] = True
+        else:
+            post = [(g[e], g[p + e]) for g in groups for e in range(r)]
+            for g in groups:
+                extra[[g[p + e] for e in range(r)]] = True
+        rv = lax.ppermute(v, axis, post)
+        is_extra = jnp.asarray(extra)[lax.axis_index(axis)]
+        v = jnp.where(is_extra, rv, v)
     return v
 
 

@@ -100,6 +100,8 @@ def _heartbeat(name: str) -> None:
     st = _st()
     if st.stall_inspector is not None:
         st.stall_inspector.record_activity(name)
+    if st.cross_monitor is not None:
+        st.cross_monitor.record_dispatch(name)
 
 
 def _lift(x, name: str = "tensor") -> jax.Array:
@@ -214,6 +216,81 @@ def _reduce_stack(x, op: str, members: Optional[Sequence[int]],
     return r
 
 
+# --- hierarchical (two-level) allreduce --------------------------------------
+# Reference: HOROVOD_HIERARCHICAL_ALLREDUCE in nccl_operations.cc — NCCL
+# reduce-scatter intra-node, MPI allreduce inter-node, NCCL allgather
+# intra-node (SURVEY.md §2.2, mount empty, unverified).  TPU mapping: the
+# 1-D slot axis factors as (outer=slices-over-DCN, inner=chips-over-ICI);
+# stage 1 reduce-scatters within each inner group (ICI), stage 2
+# allreduces each shard across outer groups (DCN), stage 3 allgathers
+# within inner groups (ICI).  XLA usually reaches an equivalent schedule
+# for the flat AllReduce HLO on real topologies; the explicit form exists
+# for reference knob parity and for meshes where the flat lowering is
+# DCN-bound.
+
+def _resolve_hier_inner(st) -> int:
+    """Inner-group width for hierarchical allreduce: the configured
+    HVD_TPU_HIERARCHICAL_INNER, else slots-per-process (the ICI-connected
+    block in multi-host worlds).  0 disables (falls back to flat)."""
+    inner = st.config.hierarchical_inner_size
+    if inner <= 0:
+        ls = st.mesh.local_size
+        inner = ls if 1 < ls < st.mesh.size else 0
+    if inner <= 1 or inner >= st.mesh.size or st.mesh.size % inner != 0:
+        return 0
+    return inner
+
+
+def _hier_groups(size: int, inner: int):
+    outer = size // inner
+    inner_groups = [list(range(o * inner, (o + 1) * inner))
+                    for o in range(outer)]
+    outer_groups = [[o * inner + i for o in range(outer)]
+                    for i in range(inner)]
+    return inner_groups, outer_groups
+
+
+def _make_hier_allreduce(op: str, prescale: float, postscale: float,
+                         axis: str, inner: int):
+    gm = _st().mesh
+    size = gm.size
+    inner_groups, outer_groups = _hier_groups(size, inner)
+
+    def per_slot(xb):  # [1, *S] — this slot's contribution
+        v = xb[0]
+        if prescale != 1.0:
+            v = v * jnp.asarray(prescale, dtype=v.dtype)
+        flat = v.reshape(-1)
+        pad = (-flat.size) % inner
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        # ICI: reduce-scatter within the inner group.
+        rs = jax.lax.psum_scatter(flat, axis, scatter_dimension=0,
+                                  axis_index_groups=inner_groups, tiled=True)
+        # DCN: allreduce each shard across outer groups.
+        ar = jax.lax.psum(rs, axis, axis_index_groups=outer_groups)
+        # ICI: allgather the fully-reduced shards back.
+        full = jax.lax.all_gather(ar, axis, axis=0,
+                                  axis_index_groups=inner_groups, tiled=True)
+        r = full[: v.size].reshape(v.shape)
+        if op == Average:
+            if jnp.issubdtype(r.dtype, jnp.floating):
+                r = (r / size).astype(v.dtype)
+            else:
+                r = r // size
+        if postscale != 1.0:
+            r = r * jnp.asarray(postscale, dtype=r.dtype)
+        return r[None]
+
+    body = shard_map(per_slot, mesh=gm.mesh, in_specs=P(axis),
+                     out_specs=P(axis), check=False)
+
+    def fn(x):
+        return body(x)[0]
+
+    return jax.jit(fn, out_shardings=gm.replicated())
+
+
 # --- compiled-program cache --------------------------------------------------
 # jit caches per input shape/dtype; we memoize one jitted callable per
 # (kind, op, members, scale factors, compression) so repeated steps are
@@ -221,7 +298,10 @@ def _reduce_stack(x, op: str, members: Optional[Sequence[int]],
 
 @functools.lru_cache(maxsize=512)
 def _allreduce_fn(op: str, members: Optional[Tuple[int, ...]], prescale: float,
-                  postscale: float, compression, axis: str):
+                  postscale: float, compression, axis: str,
+                  hier_inner: int = 0):
+    if hier_inner:
+        return _make_hier_allreduce(op, prescale, postscale, axis, hier_inner)
     if op == Adasum:
         def adasum_fn(x):
             gm = _st().mesh
@@ -263,10 +343,16 @@ def allreduce_slots(tensor, *, op: str = Average, process_set=None,
     with x64_transport(tensor):
         with st.timeline.activity(name, "ENQUEUE", {"op": op}):
             x = _lift(tensor, name)
-            fn = _allreduce_fn(op, _members_key(process_set),
+            members = _members_key(process_set)
+            hier_inner = 0
+            if (st.config.hierarchical_allreduce and op in (Sum, Average)
+                    and members is None and compression is Compression.none):
+                hier_inner = _resolve_hier_inner(st)
+            fn = _allreduce_fn(op, members,
                                float(prescale_factor),
                                float(postscale_factor),
-                               compression, st.config.mesh_axis_name)
+                               compression, st.config.mesh_axis_name,
+                               hier_inner)
         with st.timeline.activity(name, "EXECUTE", {"op": op}):
             return fn(x)
 

@@ -118,6 +118,10 @@ def run(np_: int, command: List[str], *, coordinator: Optional[str] = None,
 
     exit_code = 0
     deadline = time.monotonic() + start_timeout
+    # A single-worker world never binds the jax.distributed coordinator
+    # (no rendezvous), so there is nothing to probe — treat it as started.
+    started = np_ == 1
+    last_probe = 0.0
     try:
         pending = set(range(np_))
         while pending:
@@ -125,6 +129,7 @@ def run(np_: int, command: List[str], *, coordinator: Optional[str] = None,
                 rc = procs[i].poll()
                 if rc is not None:
                     pending.discard(i)
+                    started = True  # a worker ran to an exit code
                     if rc != 0 and exit_code == 0:
                         exit_code = rc
                         # First failure kills the job (reference behavior).
@@ -133,10 +138,12 @@ def run(np_: int, command: List[str], *, coordinator: Optional[str] = None,
             if exit_code == 0 and not any(p.poll() is None for p in procs):
                 break
             time.sleep(0.1)
-            if (time.monotonic() > deadline
-                    and all(p.poll() is None for p in procs)
-                    and _none_started(procs)):
-                raise TimeoutError("workers failed to start in time")
+            now = time.monotonic()
+            if (not started and now > deadline and now - last_probe >= 2.0):
+                last_probe = now
+                if _none_started(coordinator):
+                    raise TimeoutError("workers failed to start in time")
+                started = True  # coordinator bound: probe never runs again
     except KeyboardInterrupt:
         for p in procs:
             p.send_signal(signal.SIGINT)
@@ -248,8 +255,19 @@ def run_elastic(command: List[str], *, min_np: int = 1,
                 return 1
 
 
-def _none_started(procs) -> bool:
-    return False  # liveness probe hook; processes self-report via exit
+def _none_started(coordinator: str) -> bool:
+    """Liveness probe behind ``--start-timeout`` (reference: gloo_run's
+    rendezvous-server timeout).  Rank 0 binds the ``jax.distributed``
+    coordinator service during ``hvd.init()``; if nothing is listening
+    on that address by the deadline, no worker reached init — a genuine
+    start failure, not a long-running world."""
+    host, _, port = coordinator.rpartition(":")
+    try:
+        with socket.create_connection((host or "127.0.0.1", int(port)),
+                                      timeout=2.0):
+            return False  # coordinator up: the world started
+    except OSError:
+        return True
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -1,0 +1,105 @@
+"""Cross-process stall/failure monitor over the native Coordinator.
+
+Reference: the some-but-not-all-ranks tracking of
+``horovod/common/stall_inspector.cc`` runs inside the rank-0 C++
+controller, which sees every rank's Requests and can therefore attribute
+a stall ("tensor X missing from ranks {...}") — SURVEY.md §2.1, mount
+empty, unverified.  The single-process :class:`~.stall.StallInspector`
+cannot see peers; this monitor restores the reference's cross-rank view
+in multi-controller deployments:
+
+* every controller's collective dispatch reports the tensor name here
+  (via ``ops.collectives._heartbeat``);
+* a daemon thread batches names into wire ``Request``s and drives the
+  native TCP :class:`~..native.runtime.Coordinator` (rank 0 hosts the
+  C++ ``Controller``, which computes global readiness exactly like the
+  reference's ``ComputeResponseList``);
+* a name this controller submitted that never becomes globally ready
+  within the stall window produces the reference's missing-rank warning;
+* a dead peer breaks the negotiate cycle, surfacing as a coordinator
+  failure — first-class failure detection for the control plane.
+
+Strictly a sidecar: the data plane (XLA collectives) never waits on it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Set
+
+from .logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class CrossProcessMonitor:
+    """Drives one negotiate cycle per ``interval_s``; see module doc."""
+
+    def __init__(self, coordinator, warn_after_s: float = 60.0,
+                 interval_s: float = 2.0) -> None:
+        self._coord = coordinator
+        self._warn_after = float(warn_after_s)
+        self._interval = float(interval_s)
+        self._pending: Dict[str, float] = {}   # name -> first-submit time
+        self._reported: Set[str] = set()
+        self._new: Set[str] = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.failure: Optional[str] = None
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="hvd-cross-stall")
+        self._thread.start()
+
+    # called from every collective dispatch (ops.collectives._heartbeat)
+    def record_dispatch(self, name: str) -> None:
+        with self._lock:
+            if name not in self._pending:
+                self._new.add(name)
+
+    def _loop(self) -> None:
+        from ..native.runtime import Request
+
+        while not self._stop.is_set():
+            with self._lock:
+                batch = sorted(self._new)
+                self._new.clear()
+            now = time.monotonic()
+            reqs = [Request(rank=self._coord.rank, name=n) for n in batch]
+            try:
+                resps = self._coord.negotiate(reqs)
+            except Exception as e:
+                if not self._stop.is_set():
+                    self.failure = str(e)
+                    logger.warning(
+                        "cross-process monitor lost the coordinator (%s): "
+                        "a peer process likely failed or shut down", e)
+                return
+            for n in batch:
+                self._pending.setdefault(n, now)
+            for resp in resps:
+                for n in resp.names:
+                    self._pending.pop(n, None)
+                    self._reported.discard(n)
+            for n, t0 in list(self._pending.items()):
+                if now - t0 > self._warn_after and n not in self._reported:
+                    self._reported.add(n)
+                    logger.warning(
+                        "collective %r was dispatched by this process but "
+                        "is not globally ready after %.0fs — one or more "
+                        "peer ranks have not dispatched it (reference: "
+                        "stall inspector missing-ranks warning)",
+                        n, now - t0)
+            self._stop.wait(self._interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._coord.shutdown()   # unblocks an in-flight negotiate
+        except Exception:
+            pass
+        self._thread.join(5.0)
+        try:
+            self._coord.close()
+        except Exception:
+            pass

@@ -55,6 +55,15 @@ class TestAllreduceMP:
         assert np.allclose(got, 1.5, atol=1e-5), got
         """)
 
+    def test_adasum_three_processes_fixed_point(self, world):
+        # Non-power-of-two world: the VHDD fold/scatter phases must
+        # preserve adasum(a, a, a) = a across real controllers.
+        world(3, """
+        row = np.arange(1.0, 7.0, dtype=np.float32)
+        got = np.asarray(hvd.allreduce(row[None], op=hvd.Adasum))
+        assert np.allclose(got, row, atol=1e-5), got
+        """)
+
 
 class TestAllgatherMP:
     def test_ragged_allgather(self, world):

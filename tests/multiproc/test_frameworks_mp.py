@@ -23,6 +23,69 @@ class TestTorchMP:
         """)
 
 
+class TestCrossProcessMonitorMP:
+    def test_stall_attribution_and_clean_cycles(self, world):
+        """The native-Coordinator sidecar (reference: rank-0 controller
+        stall attribution) warns for a name only this rank dispatched,
+        and drains names every rank dispatched."""
+        world(2, """
+        import time
+        from horovod_tpu import basics
+
+        # Re-init with a short stall window so the test is fast.
+        hvd.shutdown()
+        os.environ['HOROVOD_STALL_CHECK_TIME_SECONDS'] = '2'
+        hvd.init()
+        mon = basics._require_init().cross_monitor
+        if mon is None:
+            print('native runtime unavailable; monitor wiring not testable')
+            sys.exit(0)
+
+        np.asarray(hvd.allreduce(np.ones((1, 2), np.float32), op=hvd.Sum,
+                                 name='warm'))
+        if rank == 0:
+            mon.record_dispatch('phantom')
+            deadline = time.time() + 25
+            while time.time() < deadline and 'phantom' not in mon._reported:
+                time.sleep(0.25)
+            assert 'phantom' in mon._reported, (mon._pending, mon.failure)
+            assert 'warm' not in mon._pending, mon._pending
+        # Collective exit barrier keeps both monitors negotiating until
+        # rank 0 has observed the warning.
+        np.asarray(hvd.allreduce(np.ones((1, 1), np.float32), op=hvd.Sum,
+                                 name='done'))
+        """)
+
+
+class TestMXNetMP:
+    def test_allreduce_and_trainer_average(self, world):
+        """MXNet binding over real controllers (via the API shim — mxnet
+        is EOL; waiver in README.md): gradients average across workers."""
+        world(2, """
+        import horovod_tpu
+        tests_dir = os.path.join(
+            os.path.dirname(os.path.dirname(horovod_tpu.__file__)), 'tests')
+        sys.path.insert(0, tests_dir)
+        import mxnet_shim
+        mxnet_shim.install()
+        import horovod_tpu.mxnet as hmx
+        mx = sys.modules['mxnet']
+
+        x = mx.nd.array(np.full((3, 2), float(rank + 1), np.float32))
+        avg = hmx.allreduce(x)  # Average default
+        assert np.allclose(avg.asnumpy(), 1.5), avg.asnumpy()
+
+        p = mx.Parameter('w', np.zeros(4, np.float32),
+                         np.full(4, (rank + 1) * 4.0, np.float32))
+        trainer = hmx.DistributedTrainer({'w': p}, 'sgd',
+                                         {'learning_rate': 1.0})
+        trainer.step(batch_size=1)
+        # grads 4 and 8 sum to 12, /2 workers -> effective 6; w = -6
+        got = p.list_data()[0].asnumpy()
+        assert np.allclose(got, -6.0), got
+        """)
+
+
 class TestElasticMP:
     def test_restore_after_internal_error(self, world):
         """A collective failure mid-epoch rolls the state back to the

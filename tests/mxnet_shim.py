@@ -1,0 +1,129 @@
+"""Minimal mxnet API shim for exercising horovod_tpu.mxnet.
+
+MXNet is end-of-life upstream (retired by Apache in 2023) and not
+installable in this image; this shim implements just the NDArray /
+gluon.Trainer / optimizer surface the binding touches so its bridge
+logic runs for real (waiver recorded in README.md).  It is a test
+fixture, not a component.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+
+import numpy as np
+
+
+class NDArray:
+    def __init__(self, a):
+        self._a = np.array(a)
+
+    def asnumpy(self) -> np.ndarray:
+        return self._a.copy()
+
+    def __setitem__(self, key, value):
+        self._a[key] = value._a if isinstance(value, NDArray) else np.asarray(value)
+
+    def __getitem__(self, key):
+        return NDArray(self._a[key])
+
+    @property
+    def shape(self):
+        return self._a.shape
+
+    @property
+    def dtype(self):
+        return self._a.dtype
+
+    context = "cpu(0)"
+
+
+def array(a, dtype=None, ctx=None):
+    return NDArray(np.asarray(a, dtype=dtype))
+
+
+class Optimizer:
+    pass
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.1):
+        self.lr = learning_rate
+        self.rescale_grad = 1.0
+
+
+class Parameter:
+    """Just enough of gluon.Parameter: named data + grad arrays."""
+
+    def __init__(self, name, data, grad):
+        self.name = name
+        self._data = NDArray(data)
+        self._grad = NDArray(grad)
+        self.grad_req = "write"
+
+    def list_data(self):
+        return [self._data]
+
+    def list_grad(self):
+        return [self._grad]
+
+
+class Trainer:
+    """gluon.Trainer surface used by DistributedTrainer: _params,
+    _scale, step() -> _allreduce_grads() -> _update()."""
+
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device"):
+        self._params = (list(params.values()) if hasattr(params, "values")
+                        else list(params))
+        if not isinstance(optimizer, Optimizer):
+            optimizer = SGD(**(optimizer_params or {}))
+        self._optimizer = optimizer
+        self._scale = getattr(optimizer, "rescale_grad", 1.0)
+        self._kvstore = kvstore
+
+    def step(self, batch_size):
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update()
+
+    def _allreduce_grads(self):  # overridden by DistributedTrainer
+        pass
+
+    def _update(self):
+        opt = self._optimizer
+        for p in self._params:
+            if p.grad_req == "null":
+                continue
+            d, g = p.list_data()[0], p.list_grad()[0]
+            d._a = d._a - opt.lr * opt.rescale_grad * g._a
+
+
+def install():
+    """Install the shim as ``mxnet`` in sys.modules; returns the module."""
+    mx = types.ModuleType("mxnet")
+    nd = types.ModuleType("mxnet.ndarray")
+    nd.array = array
+    nd.NDArray = NDArray
+    mx.nd = nd
+    gluon = types.ModuleType("mxnet.gluon")
+    gluon.Trainer = Trainer
+    mx.gluon = gluon
+    opt_mod = types.ModuleType("mxnet.optimizer")
+    opt_mod.Optimizer = Optimizer
+    opt_mod.SGD = SGD
+    mx.optimizer = opt_mod
+    mx.Parameter = Parameter
+    sys.modules["mxnet"] = mx
+    sys.modules["mxnet.ndarray"] = nd
+    sys.modules["mxnet.gluon"] = gluon
+    sys.modules["mxnet.optimizer"] = opt_mod
+    return mx
+
+
+def uninstall():
+    for m in list(sys.modules):
+        if m == "mxnet" or m.startswith("mxnet.") \
+                or m.startswith("horovod_tpu.mxnet"):
+            sys.modules.pop(m, None)

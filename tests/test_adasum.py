@@ -22,14 +22,20 @@ def _adasum_pair_np(a, b):
 
 
 def _adasum_tree_np(rows):
-    """Recursive distance-doubling reference in numpy."""
+    """VHDD reference in numpy: fold extras into the low power-of-two
+    block, distance-double, result replicated (mirrors ops/adasum.py)."""
     n = len(rows)
     vals = [r.astype(np.float64) for r in rows]
+    p = 1 << (n.bit_length() - 1)  # largest power of two <= n
+    r = n - p
+    for e in range(r):
+        vals[e] = _adasum_pair_np(vals[e], vals[p + e])
+    core = vals[:p]
     d = 1
-    while d < n:
-        vals = [_adasum_pair_np(vals[i], vals[i ^ d]) for i in range(n)]
+    while d < p:
+        core = [_adasum_pair_np(core[i], core[i ^ d]) for i in range(p)]
         d *= 2
-    return vals[0]
+    return core[0]
 
 
 class TestCombineRule:
@@ -96,12 +102,30 @@ class TestAdasumAllreduce:
         finally:
             hvd.remove_process_set(ps)
 
-    def test_non_power_of_two_raises(self, world_size):
-        ps = hvd.add_process_set([0, 1, 2])
+    @pytest.mark.parametrize("members", [(0, 1, 2), (0, 1, 2, 3, 4),
+                                         (1, 2, 4, 6, 7, 5), (0, 2, 3, 4, 5, 6, 7)])
+    def test_non_power_of_two_worlds(self, world_size, members):
+        """Reference VHDD handles any N (adasum/adasum.h): n in {3,5,6,7}
+        via process sets, checked against the numpy fold+double tree."""
+        ps = hvd.add_process_set(list(members))
         try:
-            x = np.zeros((world_size, 4), np.float32)
-            with pytest.raises(ValueError, match="power-of-two"):
-                hvd.allreduce(x, op=hvd.Adasum, process_set=ps)
+            x = np.random.RandomState(len(members)).randn(
+                world_size, 11).astype(np.float32)
+            out = np.asarray(hvd.allreduce(x, op=hvd.Adasum, process_set=ps))
+            expected = _adasum_tree_np([x[m] for m in sorted(members)])
+            np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
+        finally:
+            hvd.remove_process_set(ps)
+
+    @pytest.mark.parametrize("n", [3, 5, 6, 7])
+    def test_non_power_of_two_fixed_point(self, world_size, n):
+        """adasum(a, a, ..., a) = a must survive the fold/scatter phases."""
+        ps = hvd.add_process_set(list(range(n)))
+        try:
+            row = np.random.RandomState(40 + n).randn(6).astype(np.float32)
+            x = np.tile(row, (world_size, 1))
+            out = np.asarray(hvd.allreduce(x, op=hvd.Adasum, process_set=ps))
+            np.testing.assert_allclose(out, row, rtol=1e-5)
         finally:
             hvd.remove_process_set(ps)
 

@@ -1,0 +1,115 @@
+"""Hierarchical (two-level) allreduce + no-op-knob warnings.
+
+Reference: HOROVOD_HIERARCHICAL_ALLREDUCE in ``nccl_operations.cc``
+(SURVEY.md §2.2, mount empty, unverified) — intra-node reduce-scatter,
+inter-node allreduce, intra-node allgather.  Here the 8-slot mesh is
+factored 2 (outer/DCN) x 4 (inner/ICI) via HVD_TPU_HIERARCHICAL_INNER.
+"""
+
+import dataclasses
+import logging
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import basics
+from horovod_tpu.ops import collectives as C
+
+
+@pytest.fixture
+def hier_config():
+    old = basics._require_init().config
+    basics._state.config = dataclasses.replace(
+        old, hierarchical_allreduce=True, hierarchical_inner_size=4)
+    yield
+    basics._state.config = old
+
+
+class TestHierarchicalAllreduce:
+    def test_sum_matches_flat(self, world_size, hier_config):
+        # 33 elements: exercises the inner-group padding path (33 % 4 != 0).
+        x = np.random.RandomState(0).randn(world_size, 33).astype(np.float32)
+        got = np.asarray(hvd.allreduce(x, op=hvd.Sum))
+        np.testing.assert_allclose(got, x.sum(axis=0), rtol=1e-4, atol=1e-5)
+
+    def test_average_matches_flat(self, world_size, hier_config):
+        x = np.random.RandomState(1).randn(world_size, 16).astype(np.float32)
+        got = np.asarray(hvd.allreduce(x))
+        np.testing.assert_allclose(got, x.mean(axis=0), rtol=1e-4, atol=1e-5)
+
+    def test_integer_average(self, world_size, hier_config):
+        x = np.arange(world_size * 4, dtype=np.int32).reshape(world_size, 4)
+        got = np.asarray(hvd.allreduce(x))
+        np.testing.assert_array_equal(got, x.sum(axis=0) // world_size)
+
+    def test_scale_factors(self, world_size, hier_config):
+        x = np.full((world_size, 5), 1.0, np.float32)
+        got = np.asarray(hvd.allreduce(x, op=hvd.Sum, prescale_factor=2.0,
+                                       postscale_factor=0.5))
+        np.testing.assert_allclose(got, world_size * 1.0, rtol=1e-5)
+
+    def test_program_is_three_stage(self, world_size, hier_config):
+        """The lowered program must contain the grouped reduce-scatter
+        and all-gather stages, not one flat AllReduce."""
+        fn = C._make_hier_allreduce(C.Sum, 1.0, 1.0,
+                                    basics.config().mesh_axis_name, 4)
+        x = np.zeros((world_size, 8), np.float32)
+        text = fn.lower(x).as_text().replace("-", "_")
+        assert "reduce_scatter" in text, "no reduce-scatter stage"
+        assert "all_gather" in text, "no all-gather stage"
+
+    def test_process_sets_fall_back_to_flat(self, world_size, hier_config):
+        ps = hvd.add_process_set([0, 1, 2, 5])
+        try:
+            x = np.random.RandomState(2).randn(world_size, 6).astype(np.float32)
+            got = np.asarray(hvd.allreduce(x, op=hvd.Sum, process_set=ps))
+            np.testing.assert_allclose(got, x[[0, 1, 2, 5]].sum(axis=0),
+                                       rtol=1e-4, atol=1e-5)
+        finally:
+            hvd.remove_process_set(ps)
+
+
+class TestInnerResolution:
+    def test_explicit_inner_wins(self, hier_config):
+        st = basics._require_init()
+        assert C._resolve_hier_inner(st) == 4
+
+    def test_invalid_inner_disables(self):
+        st = basics._require_init()
+        old = st.config
+        try:
+            basics._state.config = dataclasses.replace(
+                old, hierarchical_inner_size=3)  # 8 % 3 != 0
+            assert C._resolve_hier_inner(st) == 0
+            basics._state.config = dataclasses.replace(
+                old, hierarchical_inner_size=8)  # inner == size: no outer
+            assert C._resolve_hier_inner(st) == 0
+        finally:
+            basics._state.config = old
+
+
+class TestNoopKnobWarnings:
+    def test_set_knobs_warn(self, monkeypatch, caplog):
+        from horovod_tpu.config import warn_noop_knobs
+
+        monkeypatch.setenv("HOROVOD_CYCLE_TIME", "5")
+        monkeypatch.setenv("HOROVOD_BATCH_D2D_MEMCOPIES", "0")
+        monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLGATHER", "1")
+        logger = logging.getLogger("test_noop_knobs")
+        with caplog.at_level(logging.WARNING, logger="test_noop_knobs"):
+            hit = warn_noop_knobs(logger)
+        assert set(hit) == {"CYCLE_TIME", "BATCH_D2D_MEMCOPIES",
+                            "HIERARCHICAL_ALLGATHER"}
+        assert len([r for r in caplog.records if "no-op" in r.message]) == 3
+
+    def test_unset_knobs_silent(self, monkeypatch, caplog):
+        from horovod_tpu.config import warn_noop_knobs
+
+        for k in ("HOROVOD_CYCLE_TIME", "HVD_TPU_CYCLE_TIME",
+                  "HOROVOD_BATCH_D2D_MEMCOPIES",
+                  "HVD_TPU_BATCH_D2D_MEMCOPIES",
+                  "HOROVOD_HIERARCHICAL_ALLGATHER",
+                  "HVD_TPU_HIERARCHICAL_ALLGATHER"):
+            monkeypatch.delenv(k, raising=False)
+        assert warn_noop_knobs(logging.getLogger("test_noop_knobs")) == []

@@ -1,0 +1,72 @@
+"""In-process equivalent of the multiproc process-set membership tests.
+
+The multi-controller tier (tests/multiproc/test_process_sets_mp.py)
+proves non-member controllers raise after dispatch.  A single-controller
+world cannot *be* a non-member — the controller owns every slot — so
+this file asserts the same semantics on the shared primitives the
+multi-controller path runs through (reference: the not-a-member C++
+status path of ``process_set.cc``, SURVEY.md §2.1; mount empty,
+unverified).
+"""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import hostops
+
+
+class TestRequireMember:
+    def test_non_member_raises(self, world_size):
+        # This process is cross_rank 0; a member list without 0 is the
+        # exact condition every multiproc non-member hits.
+        with pytest.raises(ValueError, match="not a member"):
+            hostops.require_member([1, 2], "allreduce")
+
+    def test_member_and_global_pass(self, world_size):
+        hostops.require_member(None, "allreduce")
+        hostops.require_member([0, 1], "allreduce")
+
+
+class TestMemberRanks:
+    def test_global_set_is_none(self, world_size):
+        assert hostops.member_ranks(None) is None
+        # The global set (id 0) means "everyone" in every deployment,
+        # even though its ranks are slots, not processes.
+        assert hostops.member_ranks(hvd.global_process_set()) is None
+
+    def test_full_process_world_is_none(self, world_size):
+        ps = hvd.ProcessSet([0])
+        ps._attach(99, world_size)
+        assert hostops.member_ranks(ps) is None  # all 1 processes
+
+    def test_out_of_range_ranks_rejected(self, world_size):
+        ps = hvd.ProcessSet([1, 2])
+        ps._attach(98, world_size)
+        with pytest.raises(ValueError, match="process world"):
+            hostops.member_ranks(ps)
+
+
+class TestDispatchFirstDiscipline:
+    def test_public_api_checks_after_dispatch(self):
+        """The membership error must come from require_member AFTER the
+        collective dispatch (so members are never left hanging on a
+        program the non-member refused to issue).  Source-level check:
+        every hostops collective calls require_member after its C.*
+        dispatch."""
+        import inspect
+
+        import horovod_tpu.hostops as H
+
+        for fname in ("allreduce_async", "grouped_allreduce_async",
+                      "allgather_async", "broadcast_async", "alltoall",
+                      "reducescatter"):
+            src = inspect.getsource(getattr(H, fname))
+            dispatch = min(i for i in (
+                src.find("C.allreduce_slots"), src.find("C.grouped_allreduce_slots"),
+                src.find("C.allgather_slots"), src.find("C.broadcast_slots"),
+                src.find("C.alltoall_slots"), src.find("C.reducescatter_slots"),
+            ) if i != -1)
+            check = src.find("require_member(")
+            assert check > dispatch, (
+                f"{fname}: membership check precedes dispatch")

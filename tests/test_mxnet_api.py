@@ -1,0 +1,107 @@
+"""MXNet binding tests against the API shim (see tests/mxnet_shim.py:
+mxnet itself is EOL and uninstallable here; the waiver is recorded in
+README.md).  Reference pattern: test/parallel/test_mxnet.py (SURVEY.md
+§4; mount empty, unverified)."""
+
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_shim
+
+
+def test_import_gated_without_mxnet():
+    mxnet_shim.uninstall()
+    with pytest.raises(ImportError, match="mxnet"):
+        import horovod_tpu.mxnet  # noqa: F401
+
+
+@pytest.fixture()
+def mx():
+    mod = mxnet_shim.install()
+    # Re-import the binding against the shim.
+    for m in list(sys.modules):
+        if m.startswith("horovod_tpu.mxnet"):
+            del sys.modules[m]
+    yield mod
+    mxnet_shim.uninstall()
+
+
+def _hmx():
+    import horovod_tpu.mxnet as hmx
+
+    return hmx
+
+
+class TestMpiOps:
+    def test_allreduce_out_of_place(self, mx, world_size):
+        hmx = _hmx()
+        x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+        out = hmx.allreduce(x, op=hmx.Sum)
+        assert isinstance(out, mx.nd.NDArray)
+        np.testing.assert_allclose(out.asnumpy(), x.asnumpy())
+
+    def test_allreduce_in_place_writes_back(self, mx, world_size):
+        hmx = _hmx()
+        x = mx.nd.array(np.ones((3,), np.float32))
+        got = hmx.allreduce_(x, op=hmx.Sum, postscale_factor=2.0)
+        assert got is x
+        np.testing.assert_allclose(x.asnumpy(), 2.0)
+
+    def test_grouped_allreduce(self, mx, world_size):
+        hmx = _hmx()
+        xs = [mx.nd.array(np.full((2, 2), float(i + 1), np.float32))
+              for i in range(3)]
+        outs = hmx.grouped_allreduce(xs, op=hmx.Sum)
+        for i, o in enumerate(outs):
+            np.testing.assert_allclose(o.asnumpy(), i + 1.0)
+
+    def test_allgather_broadcast_alltoall(self, mx, world_size):
+        hmx = _hmx()
+        x = mx.nd.array(np.arange(4, dtype=np.float32).reshape(2, 2))
+        g = hmx.allgather(x)
+        np.testing.assert_allclose(g.asnumpy(), x.asnumpy())
+        b = hmx.broadcast(x, root_rank=0)
+        np.testing.assert_allclose(b.asnumpy(), x.asnumpy())
+        out, rs = hmx.alltoall(x, mx.nd.array(np.array([2.0])))
+        np.testing.assert_allclose(out.asnumpy(), x.asnumpy())
+        assert list(rs.asnumpy().astype(int)) == [2]
+
+    def test_broadcast_parameters(self, mx, world_size):
+        hmx = _hmx()
+        params = {
+            "w": mx.Parameter("w", np.ones((2, 2), np.float32),
+                              np.zeros((2, 2), np.float32)),
+            "b": mx.nd.array(np.zeros(2, np.float32)),
+        }
+        hmx.broadcast_parameters(params, root_rank=0)  # no raise, in place
+
+
+class TestDistributedTrainer:
+    def test_step_applies_averaged_grads(self, mx, world_size):
+        hmx = _hmx()
+        p = mx.Parameter("w", np.zeros((4,), np.float32),
+                         np.full((4,), 8.0, np.float32))
+        trainer = hmx.DistributedTrainer(
+            {"w": p}, "sgd", {"learning_rate": 0.5})
+        trainer.step(batch_size=1)
+        # single process: effective grad = grad / cross_size = 8.0
+        np.testing.assert_allclose(p.list_data()[0].asnumpy(), -4.0)
+
+    def test_num_groups_batches_grouped_calls(self, mx, world_size):
+        hmx = _hmx()
+        ps = {f"p{i}": mx.Parameter(f"p{i}", np.zeros(3, np.float32),
+                                    np.ones(3, np.float32))
+              for i in range(5)}
+        trainer = hmx.DistributedTrainer(ps, "sgd", {"learning_rate": 1.0},
+                                         num_groups=2)
+        trainer.step(batch_size=1)
+        for p in ps.values():
+            np.testing.assert_allclose(p.list_data()[0].asnumpy(), -1.0)
+
+    def test_optimizer_object_with_params_rejected(self, mx, world_size):
+        hmx = _hmx()
+        opt = mx.optimizer.SGD()
+        with pytest.raises(ValueError, match="optimizer_params"):
+            hmx.DistributedTrainer({}, opt, {"learning_rate": 1.0})

@@ -72,6 +72,14 @@ class TestLocalRun:
         )
         assert run(2, [sys.executable, str(script)]) == 7
 
+    def test_start_timeout_fires_when_no_worker_inits(self, tmp_path):
+        """Workers that never reach hvd.init() (coordinator never binds)
+        trip --start-timeout instead of hanging forever."""
+        script = tmp_path / "sleeper.py"
+        script.write_text("import time\ntime.sleep(300)\n")
+        with pytest.raises(TimeoutError, match="failed to start"):
+            run(2, [sys.executable, str(script)], start_timeout=3.0)
+
     def test_no_command_errors(self):
         from horovod_tpu.runner.launch import main
 
