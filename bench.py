@@ -38,6 +38,9 @@ def main() -> None:
     parser.add_argument("--steps-per-call", type=int, default=10,
                         help="training steps fused into one dispatch "
                              "(lax.scan) to amortize host dispatch latency")
+    parser.add_argument("--profile-dir", default=None,
+                        help="capture a jax.profiler trace of the timed "
+                             "region into this directory")
     args = parser.parse_args()
 
     import jax
@@ -100,6 +103,35 @@ def main() -> None:
             length=args.steps_per_call)
         return params, batch_stats, opt_state, losses[-1]
 
+    # Model FLOPs from the compiled program, for MFU reporting.
+    # cost_analysis() describes the post-SPMD-partitioning PER-DEVICE
+    # module, so chunk_flops = one chip's share of one chunk
+    # (= steps_per_call steps over the per-chip batch).  The AOT
+    # executable is reused for the run itself — lower().compile() does
+    # not populate the jit dispatch cache, and compiling ResNet-50
+    # twice would double startup.
+    chunk_flops = None
+    run_chunk = train_chunk
+    try:
+        compiled = train_chunk.lower(params, batch_stats, opt_state).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        chunk_flops = float(cost.get("flops", 0.0)) or None
+        run_chunk = compiled
+    except Exception:
+        pass
+
+    # Advertised dense bf16 peak per chip (MFU denominator); override
+    # with HVD_TPU_PEAK_TFLOPS for unlisted chips.
+    import os as _os
+
+    _PEAKS = {"TPU v4": 275.0, "TPU v5 lite": 197.0, "TPU v5e": 197.0,
+              "TPU v5": 459.0, "TPU v5p": 459.0, "TPU v6 lite": 918.0,
+              "TPU v6e": 918.0}
+    peak_tflops = float(_os.environ.get("HVD_TPU_PEAK_TFLOPS", 0)) or \
+        _PEAKS.get(jax.devices()[0].device_kind, 0.0)
+
     # NOTE: completion fences are scalar readbacks, not
     # block_until_ready — on the tunneled platform only an actual
     # device->host transfer is a reliable fence.  The timed region uses
@@ -107,22 +139,27 @@ def main() -> None:
     # tunnel round-trip is amortized over all iters instead of paid per
     # chunk.
     for _ in range(args.warmup):
-        params, batch_stats, opt_state, loss = train_chunk(
+        params, batch_stats, opt_state, loss = run_chunk(
             params, batch_stats, opt_state)
     if args.warmup:
         float(loss)  # fence: warmup fully done before the clock starts
 
-    t0 = time.perf_counter()
-    for _ in range(args.iters):
-        params, batch_stats, opt_state, loss = train_chunk(
-            params, batch_stats, opt_state)
-    float(loss)  # single end-of-run fence
-    dt = time.perf_counter() - t0
+    import contextlib
+
+    prof_ctx = (jax.profiler.trace(args.profile_dir)
+                if args.profile_dir else contextlib.nullcontext())
+    with prof_ctx:
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            params, batch_stats, opt_state, loss = run_chunk(
+                params, batch_stats, opt_state)
+        float(loss)  # single end-of-run fence
+        dt = time.perf_counter() - t0
 
     imgs_per_sec = batch * args.iters * args.steps_per_call / dt
     per_chip = imgs_per_sec / n_chips
     baseline_per_chip = 2500.0  # see module docstring
-    print(json.dumps({
+    out = {
         "metric": "resnet50_images_per_sec_per_chip"
                   if args.preset == "full" else "resnet18_tiny_images_per_sec",
         "value": round(per_chip, 2),
@@ -131,7 +168,18 @@ def main() -> None:
         # meaningful for the full preset.
         "vs_baseline": (round(per_chip / baseline_per_chip, 4)
                         if args.preset == "full" else None),
-    }))
+    }
+    if chunk_flops:
+        # chunk_flops is per-device (see above): per-chip rate directly.
+        per_chip_flops_s = chunk_flops * args.iters / dt
+        out["model_tflops_per_chip"] = round(per_chip_flops_s / 1e12, 2)
+        out["flops_per_image"] = round(
+            chunk_flops / (batch / n_chips * args.steps_per_call) / 1e9,
+            3)  # GFLOPs, per-chip flops over the per-chip batch share
+        if peak_tflops:
+            out["mfu_pct"] = round(
+                100.0 * per_chip_flops_s / (peak_tflops * 1e12), 2)
+    print(json.dumps(out))
     sys.stdout.flush()
 
 

@@ -1,19 +1,85 @@
 """Keras Spark estimator.
 
-Reference: ``horovod/spark/keras/`` (SURVEY.md §2.6, mount empty,
-unverified): ``KerasEstimator`` — a Spark ML Estimator that writes the
-DataFrame to the store as Parquet (Petastorm in the reference), runs a
-distributed ``model.fit`` over ``num_proc`` Spark tasks via
-``horovod_tpu.spark.run``, and returns a ``KerasModel`` transformer
-holding the trained weights.
+Reference: ``horovod/spark/keras/`` (``KerasEstimator`` → store Parquet
+→ distributed ``model.fit`` over Spark tasks → ``KerasModel``
+transformer; ``remote.py`` holds the per-worker training fn —
+SURVEY.md §2.6, mount empty, unverified).
+
+TPU-native redesign: the data tier is pyarrow Parquet in a Store
+directory (replacing Petastorm); the world is ``horovod_tpu.spark.run``
+when pyspark is present, and a single-controller in-process world
+otherwise — so the whole store → shard → fit → transformer loop runs
+(and is tested) without a Spark installation, pyspark gating only the
+DataFrame/cluster entry points.
 """
 
 from __future__ import annotations
 
-from typing import Any, List, Optional
+import os
+import pickle
+import uuid
+from typing import Any, Dict, List, Optional
 
+from ..common import datamodule as dm
 from ..common.params import EstimatorParams
 from ..common.store import Store
+
+
+def _serialize_keras(model, custom_objects=None) -> bytes:
+    return pickle.dumps({"json": model.to_json(),
+                         "weights": model.get_weights(),
+                         "custom_objects": custom_objects or {}})
+
+
+def _deserialize_keras(blob: bytes):
+    import tensorflow as tf
+
+    payload = pickle.loads(blob)
+    model = tf.keras.models.model_from_json(
+        payload["json"], custom_objects=payload.get("custom_objects") or None)
+    model.set_weights(payload["weights"])
+    return model
+
+
+def _train_fn(model_blob: bytes, train_path: str, val_path: Optional[str],
+              spec: Dict[str, Any]):
+    """Per-worker training body (reference: ``keras/remote.py``).  Runs
+    inside a ``spark.run`` task or directly in-process; returns
+    ``(history_dict, weights)`` from every rank (rank 0's is used)."""
+    import horovod_tpu as hvd
+    import horovod_tpu.tensorflow.keras as hvd_keras
+    import tensorflow as tf
+
+    if not hvd.is_initialized():
+        hvd.init()
+    rank, world = hvd.cross_rank(), hvd.cross_size()
+
+    data = dm.read_shard(train_path, rank, world)
+    x = dm.stack_features(data, spec["feature_cols"])
+    y = dm.stack_features(data, spec["label_cols"])
+    val = None
+    if val_path:
+        vdata = dm.read_shard(val_path, rank, world)
+        val = (dm.stack_features(vdata, spec["feature_cols"]),
+               dm.stack_features(vdata, spec["label_cols"]))
+
+    model = _deserialize_keras(model_blob)
+    opt = tf.keras.optimizers.get(spec["optimizer"])
+    opt = hvd_keras.DistributedOptimizer(
+        opt, backward_passes_per_step=spec["backward_passes_per_step"])
+    model.compile(optimizer=opt, loss=spec["loss"],
+                  metrics=list(spec["metrics"]))
+    # Workers must start identical (reference: broadcast at epoch 0);
+    # weights here come from the same serialized blob, which is the same
+    # guarantee.
+    hist = model.fit(x, y, batch_size=spec["batch_size"],
+                     epochs=spec["epochs"],
+                     steps_per_epoch=spec["train_steps_per_epoch"],
+                     validation_data=val,
+                     verbose=spec["verbose"] if rank == 0 else 0,
+                     shuffle=True)
+    history = {k: [float(v) for v in vs] for k, vs in hist.history.items()}
+    return history, model.get_weights()
 
 
 class KerasEstimator(EstimatorParams):
@@ -24,7 +90,7 @@ class KerasEstimator(EstimatorParams):
                  **params: Any) -> None:
         super().__init__(**params)
         self.model = model
-        self.optimizer = optimizer
+        self.optimizer = optimizer or "sgd"
         self.custom_objects = custom_objects or {}
 
     def _validate(self) -> None:
@@ -33,37 +99,92 @@ class KerasEstimator(EstimatorParams):
         if self._get("loss") is None:
             raise ValueError("KerasEstimator requires loss=")
         store = self._get("store")
-        if store is not None and not isinstance(store, Store):
+        if store is None:
+            raise ValueError("KerasEstimator requires store=")
+        if not isinstance(store, Store):
             raise TypeError("store must be a horovod_tpu.spark Store")
 
     def fit(self, df, params: Optional[dict] = None) -> "KerasModel":
-        """Distributed fit over a Spark DataFrame (requires pyspark)."""
+        """Materialize ``df`` to the store as Parquet, train over the
+        world, return the fitted :class:`KerasModel` transformer.
+        ``df`` may be a pyspark DataFrame (cluster path), or a pandas
+        DataFrame / dict-of-columns / list-of-dicts (local path — no
+        pyspark needed)."""
         self._validate()
-        from .. import _require_pyspark, run
+        for k, v in (params or {}).items():
+            self._set(k, v)
+        store: Store = self._get("store")
+        run_id = self._get("run_id") or f"keras-{uuid.uuid4().hex[:8]}"
+        num_proc = self._get("num_proc")
+        if num_proc is None:
+            # Cluster path: spark.run's own default; local path: 1.
+            num_proc = (df.sparkSession.sparkContext.defaultParallelism
+                        if dm._is_spark_df(df) else 1)
 
-        _require_pyspark()
-        raise NotImplementedError(
-            "DataFrame training requires the Parquet data-loader path, "
-            "which needs pyspark at build time; this environment does not "
-            "bundle pyspark.  Train with horovod_tpu.spark.run(fn) or the "
-            "native data pipeline (horovod_tpu.data) instead.")
+        train_path = store.get_train_data_path(run_id)
+        dm.materialize(df, train_path, num_shards=num_proc)
+        val_path = None
+        validation = self._get("validation")
+        if validation is not None:
+            val_path = store.get_val_data_path(run_id)
+            dm.materialize(validation, val_path, num_shards=num_proc)
+
+        spec = {
+            "feature_cols": self._get("feature_cols"),
+            "label_cols": self._get("label_cols"),
+            "batch_size": self._get("batch_size"),
+            "epochs": self._get("epochs"),
+            "loss": self._get("loss"),
+            "metrics": self._get("metrics"),
+            "optimizer": self.optimizer,
+            "backward_passes_per_step": self._get("backward_passes_per_step"),
+            "train_steps_per_epoch": self._get("train_steps_per_epoch"),
+            "verbose": self._get("verbose"),
+        }
+        blob = _serialize_keras(self.model, self.custom_objects)
+
+        if dm._is_spark_df(df):
+            from .. import run as spark_run
+
+            results = spark_run(_train_fn, args=(blob, train_path, val_path,
+                                                 spec), num_proc=num_proc)
+        else:
+            results = [_train_fn(blob, train_path, val_path, spec)]
+        history, weights = results[0]
+
+        trained = _deserialize_keras(blob)
+        trained.set_weights(weights)
+        store.write(os.path.join(store.get_checkpoint_path(run_id),
+                                 "model.pkl"),
+                    _serialize_keras(trained, self.custom_objects))
+        return KerasModel(model=trained, history=[history], run_id=run_id,
+                          feature_cols=self._get("feature_cols"))
 
 
 class KerasModel:
-    """Reference: the fitted Spark Transformer — holds trained weights
-    and applies the model to DataFrames."""
+    """The fitted Spark Transformer (reference: ``KerasModel``) — holds
+    trained weights and applies the model to datasets."""
 
     def __init__(self, model=None, history: Optional[List[dict]] = None,
-                 run_id: Optional[str] = None):
+                 run_id: Optional[str] = None,
+                 feature_cols: Optional[List[str]] = None):
         self.model = model
         self.history = history or []
         self.run_id = run_id
+        self.feature_cols = feature_cols or ["features"]
 
     def getModel(self):
         return self.model
 
     def transform(self, df):
-        from .. import _require_pyspark
+        """Append a ``prediction`` column.  pandas/dict/list datasets
+        work without pyspark; Spark DataFrames run through a pandas
+        round-trip on the driver (cluster-scale inference is out of
+        scope — the reference uses a pandas UDF there)."""
+        import numpy as np
 
-        _require_pyspark()
-        raise NotImplementedError("DataFrame inference requires pyspark")
+        pdf = df.toPandas() if dm._is_spark_df(df) else dm._to_pandas(df).copy()
+        x = dm.stack_features(dm.to_columns(pdf), self.feature_cols)
+        preds = self.model.predict(x, verbose=0)
+        pdf["prediction"] = [np.asarray(p).tolist() for p in preds]
+        return pdf

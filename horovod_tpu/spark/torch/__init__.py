@@ -1,16 +1,78 @@
 """Torch Spark estimator.
 
-Reference: ``horovod/spark/torch/`` (SURVEY.md §2.6, mount empty,
-unverified) — same estimator contract as the Keras one with a torch
-``model``/``optimizer``/``loss`` triple.
+Reference: ``horovod/spark/torch/`` (``TorchEstimator`` with a torch
+``model``/``optimizer``/``loss`` triple; ``remote.py`` holds the
+per-worker loop — SURVEY.md §2.6, mount empty, unverified).  Same
+store → Parquet shard → distributed fit → transformer pipeline as the
+Keras estimator (see ``spark/keras/__init__.py`` for the TPU-native
+design notes); the worker loop wraps the user optimizer in
+``horovod_tpu.torch.DistributedOptimizer``.
 """
 
 from __future__ import annotations
 
-from typing import Any, List, Optional
+import os
+import pickle
+import uuid
+from typing import Any, Dict, List, Optional
 
+from ..common import datamodule as dm
 from ..common.params import EstimatorParams
 from ..common.store import Store
+
+
+def _train_fn(blob: bytes, train_path: str, val_path: Optional[str],
+              spec: Dict[str, Any]):
+    """Per-worker loop (reference: ``torch/remote.py``): shard → minibatch
+    SGD with gradient allreduce → (history, state_dict)."""
+    import numpy as np
+    import torch
+
+    import horovod_tpu as hvd
+    import horovod_tpu.torch as hvt
+
+    if not hvd.is_initialized():
+        hvd.init()
+    rank, world = hvd.cross_rank(), hvd.cross_size()
+
+    model, optimizer, loss_fn = pickle.loads(blob)
+    hvt.broadcast_parameters(model.state_dict(), root_rank=0)
+    opt = hvt.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters(),
+        backward_passes_per_step=spec["backward_passes_per_step"])
+
+    data = dm.read_shard(train_path, rank, world)
+    x = torch.from_numpy(dm.stack_features(data, spec["feature_cols"]))
+    y = torch.from_numpy(dm.stack_features(data, spec["label_cols"]))
+    val = None
+    if val_path:
+        vdata = dm.read_shard(val_path, rank, world)
+        val = (torch.from_numpy(dm.stack_features(vdata, spec["feature_cols"])),
+               torch.from_numpy(dm.stack_features(vdata, spec["label_cols"])))
+
+    bs = spec["batch_size"]
+    history: Dict[str, List[float]] = {"loss": []}
+    if val is not None:
+        history["val_loss"] = []
+    g = torch.Generator().manual_seed(1234)  # same shuffle on every rank
+    for _ in range(spec["epochs"]):
+        model.train()
+        perm = torch.randperm(len(x), generator=g)
+        losses = []
+        for i in range(0, len(x), bs):
+            idx = perm[i:i + bs]
+            opt.zero_grad()
+            loss = loss_fn(model(x[idx]), y[idx])
+            loss.backward()
+            opt.step()
+            losses.append(float(loss.detach()))
+        history["loss"].append(float(np.mean(losses)))
+        if val is not None:
+            model.eval()
+            with torch.no_grad():
+                history["val_loss"].append(
+                    float(loss_fn(model(val[0]), val[1])))
+    return history, model.state_dict()
 
 
 class TorchEstimator(EstimatorParams):
@@ -27,34 +89,93 @@ class TorchEstimator(EstimatorParams):
     def _validate(self) -> None:
         if self.model is None:
             raise ValueError("TorchEstimator requires model=")
+        if self.optimizer is None:
+            raise ValueError("TorchEstimator requires optimizer=")
         if self._get("loss") is None:
             raise ValueError("TorchEstimator requires loss=")
         store = self._get("store")
-        if store is not None and not isinstance(store, Store):
+        if store is None:
+            raise ValueError("TorchEstimator requires store=")
+        if not isinstance(store, Store):
             raise TypeError("store must be a horovod_tpu.spark Store")
 
     def fit(self, df, params: Optional[dict] = None) -> "TorchModel":
+        """Materialize ``df`` to the store, train, return the fitted
+        :class:`TorchModel`.  ``df`` may be a pyspark DataFrame (cluster
+        path) or pandas/dict/list-of-dicts (local path, no pyspark)."""
         self._validate()
-        from .. import _require_pyspark
+        for k, v in (params or {}).items():
+            self._set(k, v)
+        store: Store = self._get("store")
+        run_id = self._get("run_id") or f"torch-{uuid.uuid4().hex[:8]}"
+        num_proc = self._get("num_proc")
+        if num_proc is None:
+            num_proc = (df.sparkSession.sparkContext.defaultParallelism
+                        if dm._is_spark_df(df) else 1)
 
-        _require_pyspark()
-        raise NotImplementedError(
-            "DataFrame training requires pyspark; train with "
-            "horovod_tpu.spark.run(fn) or horovod_tpu.torch directly.")
+        train_path = store.get_train_data_path(run_id)
+        dm.materialize(df, train_path, num_shards=num_proc)
+        val_path = None
+        if self._get("validation") is not None:
+            val_path = store.get_val_data_path(run_id)
+            dm.materialize(self._get("validation"), val_path,
+                           num_shards=num_proc)
+
+        spec = {
+            "feature_cols": self._get("feature_cols"),
+            "label_cols": self._get("label_cols"),
+            "batch_size": self._get("batch_size"),
+            "epochs": self._get("epochs"),
+            "backward_passes_per_step": self._get("backward_passes_per_step"),
+        }
+        # Model, optimizer, and loss travel as one pickle so the
+        # optimizer's parameter references stay bound to the same model
+        # instance on the worker (reference serializes them together too).
+        blob = pickle.dumps((self.model, self.optimizer, self._get("loss")))
+
+        if dm._is_spark_df(df):
+            from .. import run as spark_run
+
+            results = spark_run(_train_fn, args=(blob, train_path, val_path,
+                                                 spec), num_proc=num_proc)
+        else:
+            results = [_train_fn(blob, train_path, val_path, spec)]
+        history, state_dict = results[0]
+
+        trained, _, _ = pickle.loads(blob)
+        trained.load_state_dict(state_dict)
+        store.write_serialized(
+            os.path.join(store.get_checkpoint_path(run_id), "model.pt"),
+            {k: v.numpy() for k, v in state_dict.items()})
+        return TorchModel(model=trained, history=[history], run_id=run_id,
+                          feature_cols=self._get("feature_cols"))
 
 
 class TorchModel:
+    """The fitted Spark Transformer (reference: ``TorchModel``)."""
+
     def __init__(self, model=None, history: Optional[List[dict]] = None,
-                 run_id: Optional[str] = None):
+                 run_id: Optional[str] = None,
+                 feature_cols: Optional[List[str]] = None):
         self.model = model
         self.history = history or []
         self.run_id = run_id
+        self.feature_cols = feature_cols or ["features"]
 
     def getModel(self):
         return self.model
 
     def transform(self, df):
-        from .. import _require_pyspark
+        """Append a ``prediction`` column (see KerasModel.transform for
+        the pyspark gating contract)."""
+        import numpy as np
+        import torch
 
-        _require_pyspark()
-        raise NotImplementedError("DataFrame inference requires pyspark")
+        pdf = df.toPandas() if dm._is_spark_df(df) else dm._to_pandas(df).copy()
+        x = torch.from_numpy(dm.stack_features(dm.to_columns(pdf),
+                                               self.feature_cols))
+        self.model.eval()
+        with torch.no_grad():
+            preds = self.model(x).numpy()
+        pdf["prediction"] = [np.asarray(p).tolist() for p in preds]
+        return pdf

@@ -49,13 +49,15 @@ class TestEstimatorParams:
     def test_keras_estimator_validation(self, tmp_path):
         est = KerasEstimator(model=object(), loss="mse",
                              store=FilesystemStore(str(tmp_path)))
-        with pytest.raises((ImportError, NotImplementedError)):
+        with pytest.raises(TypeError):   # object() is not a dataset
             est.fit(None)
         with pytest.raises(ValueError, match="requires model"):
             KerasEstimator(loss="mse").fit(None)
+        with pytest.raises(ValueError, match="requires store"):
+            KerasEstimator(model=object(), loss="mse").fit(None)
 
     def test_torch_estimator_validation(self):
-        with pytest.raises(ValueError, match="requires loss"):
+        with pytest.raises(ValueError, match="requires optimizer"):
             TorchEstimator(model=object()).fit(None)
 
 
