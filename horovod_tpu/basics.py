@@ -88,8 +88,23 @@ def _maybe_init_distributed() -> None:
     coordinator = os.environ.get("HVD_TPU_COORDINATOR_ADDR")
     num_processes = os.environ.get("HVD_TPU_NUM_PROCESSES")
     process_id = os.environ.get("HVD_TPU_PROCESS_ID")
+    if process_id is None:
+        # Scheduler launches (jsrun/srun — runner/lsf.py) don't stamp a
+        # per-task id; the job-step manager's own rank env carries it.
+        for var in ("PMIX_RANK", "OMPI_COMM_WORLD_RANK", "SLURM_PROCID"):
+            if var in os.environ:
+                process_id = os.environ[var]
+                break
     if not (coordinator and num_processes and int(num_processes) > 1):
         return
+    if process_id is None:
+        # N tasks all claiming rank 0 would hang in rendezvous with no
+        # clue; fail loudly naming the contract instead.
+        raise RuntimeError(
+            f"HVD_TPU_NUM_PROCESSES={num_processes} but no per-task rank "
+            "was found: set HVD_TPU_PROCESS_ID, or launch through a "
+            "job-step manager that exports PMIX_RANK / "
+            "OMPI_COMM_WORLD_RANK / SLURM_PROCID")
     # NOTE: jax.distributed.initialize must run before anything touches a
     # backend (jax.devices()/process_count() would initialize XLA and make
     # it fail), so detect "already initialized" via the distributed client
@@ -101,11 +116,11 @@ def _maybe_init_distributed() -> None:
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=int(num_processes),
-        process_id=int(process_id or 0),
+        process_id=int(process_id),
     )
     logger.info(
         "jax.distributed initialized: process %d/%s via %s",
-        int(process_id or 0), num_processes, coordinator,
+        int(process_id), num_processes, coordinator,
     )
 
 

@@ -28,7 +28,7 @@ path is active).
 from . import bindings  # noqa: F401
 from . import planner  # noqa: F401
 from .runtime import (  # noqa: F401
-    Controller, Coordinator, NativeStallInspector, NativeTimeline,
-    NativeUnavailableError, Request, Response, available,
+    Controller, Coordinator, NativeStallInspector, NativeTensorQueue,
+    NativeTimeline, NativeUnavailableError, Request, Response, available,
     encode_requests, decode_requests, encode_responses, decode_responses,
 )

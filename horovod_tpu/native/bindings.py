@@ -38,6 +38,13 @@ _SIGNATURES = {
     "hvd_ctrl_submit": (c_int, [c_void, c_i32, c_char_p, c_i8, c_i8, c_i64,
                                 c_i32, c_i32]),
     "hvd_ctrl_compute": (c_i64, [c_void, c_u8p, c_i64]),
+    # tensor queue
+    "hvd_queue_create": (c_void, []),
+    "hvd_queue_destroy": (None, [c_void]),
+    "hvd_queue_push": (c_int, [c_void, c_i32, c_char_p, c_i8, c_i8, c_i64,
+                               c_i32, c_i32]),
+    "hvd_queue_size": (c_i64, [c_void]),
+    "hvd_queue_drain": (c_i64, [c_void, c_u8p, c_i64]),
     "hvd_ctrl_register_group": (c_i32, [c_void,
                                         ctypes.POINTER(c_char_p), c_i32]),
     "hvd_ctrl_cache_hits": (c_i64, [c_void]),

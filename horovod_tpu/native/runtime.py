@@ -242,6 +242,45 @@ class Controller:
             pass
 
 
+class NativeTensorQueue:
+    """Thread-safe pending-request queue (reference:
+    ``horovod/common/tensor_queue.cc`` — the framework-thread →
+    background-thread handoff).  Producers :meth:`push` from the eager
+    API threads; the monitor/coordinator cycle :meth:`drain`\\ s."""
+
+    def __init__(self) -> None:
+        self._lib = _lib()
+        self._h = self._lib.hvd_queue_create()
+        if not self._h:
+            raise RuntimeError("tensor queue allocation failed")
+
+    def push(self, req: Request) -> None:
+        ok = self._lib.hvd_queue_push(
+            self._h, req.rank, req.name.encode(), OP_CODES[req.op],
+            DTYPE_CODES[req.dtype], req.size_bytes, req.root_rank,
+            req.group_id)
+        if not ok:
+            raise ValueError("queue push failed")
+
+    def size(self) -> int:
+        return self._lib.hvd_queue_size(self._h)
+
+    def drain(self) -> List[Request]:
+        data = _call_filling(self._lib.hvd_queue_drain, self._h)
+        return decode_requests(data)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.hvd_queue_destroy(self._h)
+            self._h = None
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 # --- coordinator ------------------------------------------------------------
 
 class Coordinator:

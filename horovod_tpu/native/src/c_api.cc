@@ -27,6 +27,7 @@
 #include "stall_inspector.h"
 #include "timeline.h"
 #include "wire.h"
+#include "tensor_queue.h"
 
 namespace {
 
@@ -335,6 +336,55 @@ void hvd_tl_close_destroy(void* h) {
     w->Close();
     delete w;
   }
+}
+
+// ---- tensor queue ----------------------------------------------------------
+// The reference's framework-thread -> background-thread handoff
+// (horovod/common/tensor_queue.cc); here it stages collective-dispatch
+// reports between the Python API threads and the cross-process monitor
+// cycle (utils/cross_stall.py).
+
+struct QueueHandle {
+  hvdtpu::TensorQueue q;
+  std::string stash;  // drained-but-unfetched encoded Requests
+};
+
+void* hvd_queue_create() { return new QueueHandle; }
+
+void hvd_queue_destroy(void* h) { delete static_cast<QueueHandle*>(h); }
+
+int hvd_queue_push(void* h, int32_t rank, const char* name, int8_t op,
+                   int8_t dtype, int64_t size_bytes, int32_t root_rank,
+                   int32_t group_id) {
+  if (!h || !name) return 0;
+  hvdtpu::Request r;
+  r.rank = rank;
+  r.op = static_cast<hvdtpu::OpType>(op);
+  r.dtype = static_cast<hvdtpu::DataType>(dtype);
+  r.size_bytes = size_bytes;
+  r.root_rank = root_rank;
+  r.group_id = group_id;
+  r.name = name;
+  static_cast<QueueHandle*>(h)->q.Push(std::move(r));
+  return 1;
+}
+
+int64_t hvd_queue_size(void* h) {
+  if (!h) return -1;
+  return static_cast<int64_t>(static_cast<QueueHandle*>(h)->q.Size());
+}
+
+// Drains everything queued, encoded with the Request wire codec.
+// Stashed: a too-small buffer retries the copy, never loses the drain.
+int64_t hvd_queue_drain(void* h, uint8_t* out, int64_t cap) {
+  if (!h) return -1;
+  auto* qh = static_cast<QueueHandle*>(h);
+  if (qh->stash.empty()) {
+    auto reqs = qh->q.DrainAll();
+    auto enc = hvdtpu::wire::EncodeRequests(reqs);
+    qh->stash.assign(enc.begin(), enc.end());
+  }
+  return FillStashed(&qh->stash, out, cap);
 }
 
 }  // extern "C"

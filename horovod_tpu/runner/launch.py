@@ -43,8 +43,9 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         description="Launch a horovod_tpu training program "
                     "(reference CLI: horovodrun)",
     )
-    parser.add_argument("-np", "--num-proc", type=int, default=1,
-                        help="number of worker processes")
+    parser.add_argument("-np", "--num-proc", type=int, default=None,
+                        help="number of worker processes (default: 1 "
+                             "locally; the whole allocation under LSF)")
     parser.add_argument("-H", "--hosts", default=None,
                         help="host:slots[,host:slots...] — informational on "
                              "TPU pods (the platform places processes); "
@@ -294,23 +295,33 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"queued resources); non-local hosts given: {non_local}",
                   file=sys.stderr)
             return 2
-    if args.min_np is not None and args.num_proc < args.min_np:
-        print(f"error: -np {args.num_proc} < --min-np {args.min_np}",
+    num_proc = args.num_proc if args.num_proc is not None else 1
+    if args.min_np is not None and num_proc < args.min_np:
+        print(f"error: -np {num_proc} < --min-np {args.min_np}",
               file=sys.stderr)
         return 2
+    from . import lsf as _lsf
+
+    if args.hosts is None and not args.host_discovery_script \
+            and _lsf.in_lsf():
+        # LSF allocation: place tasks via jsrun (reference: horovodrun's
+        # lsf detection + js_run path); -np unset means "use the whole
+        # allocation", an explicit -np (including 1) is honored exactly.
+        return _lsf.run_lsf(command, np_=args.num_proc,
+                            verbose=args.verbose)
     if args.host_discovery_script:
         # Reference semantics: -np is the target size, bounded by
         # --min-np/--max-np; discovery grows the world only up to the
         # max, never past what the user asked for.
         return run_elastic(
-            command, min_np=args.min_np or args.num_proc,
-            max_np=args.max_np or args.num_proc,
+            command, min_np=args.min_np or num_proc,
+            max_np=args.max_np or num_proc,
             discovery_script=args.host_discovery_script,
             start_timeout=args.start_timeout,
             reset_limit=args.reset_limit,
             blacklist_after=args.blacklist_after,
             verbose=args.verbose)
-    return run(args.num_proc, command, coordinator=args.coordinator,
+    return run(num_proc, command, coordinator=args.coordinator,
                start_timeout=args.start_timeout, verbose=args.verbose)
 
 

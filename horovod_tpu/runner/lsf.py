@@ -1,0 +1,109 @@
+"""LSF / jsrun scheduler launch.
+
+Reference: ``horovod/runner/util/lsf.py`` (LSB host parsing) +
+``horovod/runner/js_run.py`` (jsrun command construction) — SURVEY.md
+§2.5, mount empty, unverified.  On LSF clusters ``horovodrun`` detects
+the allocation (``LSB_JOBID``), derives hosts/slots from
+``LSB_DJOB_HOSTFILE`` / ``LSB_MCPU_HOSTS``, and launches one task per
+slot through ``jsrun`` instead of ssh.
+
+TPU-native redesign: jsrun places the *controller processes* only; the
+rendezvous is still ``jax.distributed`` — rank 0's host (the first
+compute host of the allocation) serves the coordinator on a fixed port
+and every task derives its rank from the scheduler's own env
+(``PMIX_RANK`` / ``OMPI_COMM_WORLD_RANK``, consumed by
+``basics._maybe_init_distributed``), so no per-task env stamping is
+needed.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+DEFAULT_PORT = 29500
+
+
+def in_lsf() -> bool:
+    """True inside an LSF allocation (reference: ``lsf.check_lsf``)."""
+    return "LSB_JOBID" in os.environ
+
+
+def lsf_hosts() -> "OrderedDict[str, int]":
+    """Ordered ``{host: slots}`` of the allocation's *compute* hosts.
+
+    ``LSB_DJOB_HOSTFILE`` lists one line per slot (the batch/launch host
+    first — excluded, like the reference); ``LSB_MCPU_HOSTS`` is the
+    ``host1 n1 host2 n2 ...`` fallback form.
+    """
+    hostfile = os.environ.get("LSB_DJOB_HOSTFILE")
+    if hostfile and os.path.exists(hostfile):
+        counts: "OrderedDict[str, int]" = OrderedDict()
+        with open(hostfile) as f:
+            lines = [ln.strip() for ln in f if ln.strip()]
+        for host in lines[1:] or lines:   # first line = batch host
+            counts[host] = counts.get(host, 0) + 1
+        if counts:
+            return counts
+    mcpu = os.environ.get("LSB_MCPU_HOSTS", "")
+    parts = mcpu.split()
+    if parts and len(parts) % 2 == 0:
+        counts = OrderedDict()
+        # First pair = the batch/launch host, excluded like the
+        # hostfile path (unless it is the only entry).
+        pairs = list(zip(parts[::2], parts[1::2]))
+        for host, n in pairs[1:] or pairs:
+            counts[host] = counts.get(host, 0) + int(n)
+        return counts
+    raise RuntimeError(
+        "not inside a recognizable LSF allocation (no LSB_DJOB_HOSTFILE "
+        "or LSB_MCPU_HOSTS)")
+
+
+def world_size() -> int:
+    return sum(lsf_hosts().values())
+
+
+def jsrun_command(command: List[str], np_: int,
+                  coordinator: str) -> List[str]:
+    """The jsrun invocation: one task per slot, framework env forwarded
+    (reference: ``js_run.py`` assembles the same shape with smpiargs)."""
+    jsrun = shutil.which("jsrun") or "jsrun"
+    return [
+        jsrun,
+        "--np", str(np_),
+        "--tasks_per_rs", "1", "--cpu_per_rs", "1",
+        "-E", f"HVD_TPU_COORDINATOR_ADDR={coordinator}",
+        "-E", f"HVD_TPU_NUM_PROCESSES={np_}",
+    ] + list(command)
+
+
+def run_lsf(command: List[str], np_: Optional[int] = None, *,
+            port: int = DEFAULT_PORT,
+            env: Optional[Dict[str, str]] = None,
+            verbose: bool = False) -> int:
+    """Launch ``command`` across the LSF allocation via jsrun; returns
+    the jsrun exit code.  Rank assignment comes from the scheduler's
+    PMIX/OMPI rank env inside each task."""
+    hosts = lsf_hosts()
+    if np_ is None or np_ <= 0:
+        np_ = sum(hosts.values())
+    first_host = next(iter(hosts))
+    coordinator = f"{first_host}:{port}"
+    cmd = jsrun_command(command, np_, coordinator)
+    if verbose:
+        print(f"[horovodtpurun] LSF allocation {dict(hosts)}; "
+              f"exec: {' '.join(cmd)}", file=sys.stderr)
+    if shutil.which("jsrun") is None:
+        print("error: LSF allocation detected but `jsrun` is not on PATH; "
+              "load the job-step manager module or launch with "
+              "`horovodtpurun -np N` locally per host", file=sys.stderr)
+        return 2
+    run_env = dict(os.environ)
+    if env:
+        run_env.update(env)
+    return subprocess.call(cmd, env=run_env)

@@ -38,13 +38,22 @@ class CrossProcessMonitor:
 
     def __init__(self, coordinator, warn_after_s: float = 60.0,
                  interval_s: float = 2.0) -> None:
+        from ..native.runtime import NativeTensorQueue
+
         self._coord = coordinator
         self._warn_after = float(warn_after_s)
         self._interval = float(interval_s)
         self._pending: Dict[str, float] = {}   # name -> first-submit time
         self._reported: Set[str] = set()
-        self._new: Set[str] = set()
-        self._lock = threading.Lock()
+        # The reference's TensorQueue in its reference role: framework
+        # threads push dispatch reports, the background cycle drains.
+        # _inflight is the producer-side dedup (pushed or pending): a
+        # name is pushed at most once per unresolved flight, so the hot
+        # dispatch path costs one lock + set probe for repeats and the
+        # queue stays bounded by the distinct-name count.
+        self._queue = NativeTensorQueue()
+        self._inflight: Set[str] = set()
+        self._inflight_lock = threading.Lock()
         self._stop = threading.Event()
         self.failure: Optional[str] = None
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -53,19 +62,31 @@ class CrossProcessMonitor:
 
     # called from every collective dispatch (ops.collectives._heartbeat)
     def record_dispatch(self, name: str) -> None:
-        with self._lock:
-            if name not in self._pending:
-                self._new.add(name)
-
-    def _loop(self) -> None:
         from ..native.runtime import Request
 
+        try:
+            with self._inflight_lock:
+                if self._stop.is_set() or name in self._inflight:
+                    return
+                self._inflight.add(name)
+                # Under the lock: stop() holds it while tearing the
+                # queue down, so the handle cannot be freed mid-push.
+                self._queue.push(Request(rank=self._coord.rank, name=name))
+        except Exception:
+            pass  # a monitoring sidecar must never break a dispatch
+
+    def _resolve(self, name: str) -> None:
+        self._pending.pop(name, None)
+        self._reported.discard(name)
+        with self._inflight_lock:
+            self._inflight.discard(name)
+
+    def _loop(self) -> None:
         while not self._stop.is_set():
-            with self._lock:
-                batch = sorted(self._new)
-                self._new.clear()
+            drained = {r.name: r for r in self._queue.drain()}
+            batch = sorted(n for n in drained if n not in self._pending)
             now = time.monotonic()
-            reqs = [Request(rank=self._coord.rank, name=n) for n in batch]
+            reqs = [drained[n] for n in batch]
             try:
                 resps = self._coord.negotiate(reqs)
             except Exception as e:
@@ -79,8 +100,7 @@ class CrossProcessMonitor:
                 self._pending.setdefault(n, now)
             for resp in resps:
                 for n in resp.names:
-                    self._pending.pop(n, None)
-                    self._reported.discard(n)
+                    self._resolve(n)
             for n, t0 in list(self._pending.items()):
                 if now - t0 > self._warn_after and n not in self._reported:
                     self._reported.add(n)
@@ -103,3 +123,12 @@ class CrossProcessMonitor:
             self._coord.close()
         except Exception:
             pass
+        if self._thread.is_alive():
+            # The loop may still touch the queue: leaking one small
+            # native queue beats a use-after-free.
+            return
+        with self._inflight_lock:   # excludes a racing record_dispatch
+            try:
+                self._queue.close()
+            except Exception:
+                pass

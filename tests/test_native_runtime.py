@@ -217,6 +217,44 @@ class TestController:
         assert name == weird and missing == [1]
 
 
+class TestTensorQueue:
+    """Reference: tensor_queue.cc — the framework-thread handoff now
+    staging the cross-process monitor's dispatch reports."""
+
+    def test_push_drain_roundtrip(self):
+        q = native.NativeTensorQueue()
+        try:
+            for i in range(3):
+                q.push(native.Request(rank=1, name=f"t{i}", op="allgather",
+                                      dtype="bfloat16", size_bytes=64 * i))
+            assert q.size() == 3
+            reqs = q.drain()
+            assert [r.name for r in reqs] == ["t0", "t1", "t2"]
+            assert reqs[2].size_bytes == 128
+            assert reqs[0].op == "allgather"
+            assert q.size() == 0 and q.drain() == []
+        finally:
+            q.close()
+
+    def test_concurrent_producers(self):
+        import threading as th
+
+        q = native.NativeTensorQueue()
+        try:
+            def produce(k):
+                for i in range(50):
+                    q.push(native.Request(rank=k, name=f"p{k}.{i}"))
+
+            threads = [th.Thread(target=produce, args=(k,)) for k in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert len(q.drain()) == 200
+        finally:
+            q.close()
+
+
 class TestCoordinator:
     def _run_world(self, world_size, worker_fn):
         """Spawn world_size coordinator members on threads; returns
