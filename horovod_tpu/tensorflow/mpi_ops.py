@@ -18,9 +18,17 @@ world: host tensors bridge to the shared host-binding core
 framework's slot-stack SPMD collectives over ICI/DCN.  Inside
 ``tf.function`` graphs the bridge rides ``tf.py_function`` — the moral
 equivalent of the reference's async kernel, with XLA's dispatch queue
-playing the background thread.  Collective *order* must match across
-workers; grouped ops make a whole gradient set one ordered call (the
-reference's tensor-fusion guarantee).
+playing the background thread (proved multi-controller by
+``tests/multiproc/test_frameworks_mp.py::TestTensorFlowGraphModeMP``).
+Collective *order* must match across workers; grouped ops make a whole
+gradient set one ordered call (the reference's tensor-fusion guarantee).
+
+Known limit: ``tf.function(jit_compile=True)`` — an XLA-compiled TF
+graph — cannot host the bridge (XLA runs no py_function, the same
+constraint as user custom calls on XLA:TPU; see the FFI notes in
+README).  The reference's ``xla_mpi_ops.cc`` had the same job and the
+same boundary on TPU.  Train TF under plain ``tf.function`` graphs, or
+use the pure-JAX tier for fully-compiled steps.
 """
 
 from __future__ import annotations

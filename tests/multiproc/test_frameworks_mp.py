@@ -23,6 +23,47 @@ class TestTorchMP:
         """)
 
 
+class TestTensorFlowGraphModeMP:
+    def test_allreduce_inside_tf_function(self, world):
+        """The reference's custom op works inside tf.function graphs;
+        here the py_function bridge must hold the cross-worker dispatch
+        order when the graph executes (not when it traces)."""
+        world(2, """
+        import tensorflow as tf
+        import horovod_tpu.tensorflow as hvt
+
+        @tf.function
+        def step(x):
+            s = hvt.allreduce(x, op=hvt.Sum, name='g_sum')
+            a = hvt.allreduce(x * 2.0, name='g_avg')  # Average
+            b = hvt.broadcast(x, root_rank=1, name='g_bcast')
+            return s, a, b
+
+        x = tf.fill([2, 3], float(rank + 1))
+        for _ in range(3):  # re-execution keeps the chained order
+            s, a, b = step(x)
+        assert np.allclose(s.numpy(), 3.0), s.numpy()
+        assert np.allclose(a.numpy(), 3.0), a.numpy()   # (2+4)/2
+        assert np.allclose(b.numpy(), 2.0), b.numpy()
+
+        # Gradient-tape training path inside a graph
+        v = tf.Variable(tf.fill([4], float(rank)))
+        @tf.function
+        def train():
+            with tf.GradientTape() as tape:
+                loss = tf.reduce_sum(v * v)
+            g = tape.gradient(loss, v)
+            g = hvt.allreduce(g, name='grad')
+            v.assign_sub(0.1 * g)
+            return loss
+
+        train()
+        # grads 2*0=0 and 2*1=2 average to 1; v -= 0.1
+        want = float(rank) - 0.1
+        assert np.allclose(v.numpy(), want), (v.numpy(), want)
+        """)
+
+
 class TestCrossProcessMonitorMP:
     def test_stall_attribution_and_clean_cycles(self, world):
         """The native-Coordinator sidecar (reference: rank-0 controller
