@@ -50,13 +50,24 @@ def _env():
 @pytest.fixture
 def world(tmp_path):
     """Run ``body`` (worker-side python, after the standard prologue) on
-    ``nproc`` fresh controller processes; fail the test on nonzero rc."""
+    ``nproc`` fresh controller processes; fail the test on nonzero rc.
+    With ``expect_failure=True`` the assertion is skipped and
+    ``(rc, seconds)`` is returned for the caller to judge (fail-fast
+    error-contract tests)."""
 
-    def _run(nproc: int, body: str, timeout: float = 300.0):
+    def _run(nproc: int, body: str, timeout: float = 300.0,
+             expect_failure: bool = False):
+        import time
+
         script = tmp_path / "worker.py"
         script.write_text(PROLOGUE + textwrap.dedent(body) + "\n")
+        t0 = time.monotonic()
         rc = run(nproc, [sys.executable, str(script)],
                  start_timeout=timeout, env=_env())
+        dt = time.monotonic() - t0
+        if expect_failure:
+            return rc, dt
         assert rc == 0, f"worker world exited rc={rc}"
+        return rc, dt
 
     return _run

@@ -151,6 +151,40 @@ class TestReducescatterMP:
         """)
 
 
+class TestMismatchErrorsMP:
+    """Reference CI contract (SURVEY §4): mismatched shapes/dtypes
+    across ranks must fail the job fast — a controlled error on the
+    rank that detects it, peer teardown by the runtime (the launcher's
+    first-failure-kills-the-job rule), never a hang or a silent wrong
+    result."""
+
+    def _check(self, rc_dt) -> None:
+        rc, dt = rc_dt
+        assert rc != 0, "mismatched world must not exit clean"
+        # Exit 3 = a worker got PAST the mismatched collective: silent
+        # wrong result, the exact failure this test exists to catch.
+        assert rc != 3, "mismatched collective produced a silent result"
+        assert dt < 90, f"mismatch took {dt:.0f}s — fail-fast contract broken"
+
+    def test_mismatched_allreduce_shape_fails_fast(self, world):
+        self._check(world(2, """
+        import signal
+        signal.alarm(90)   # a hang must kill the worker, not pytest
+        x = np.ones((1, 4 if rank == 0 else 6), np.float32)
+        np.asarray(hvd.allreduce(x, op=hvd.Sum))
+        sys.exit(3)   # unconditionally: reaching here at all is the bug
+        """, timeout=120.0, expect_failure=True))
+
+    def test_mismatched_allreduce_dtype_fails_fast(self, world):
+        self._check(world(2, """
+        import signal
+        signal.alarm(90)
+        x = np.ones((1, 4), np.float32 if rank == 0 else np.float64)
+        np.asarray(hvd.allreduce(x, op=hvd.Sum))
+        sys.exit(3)
+        """, timeout=120.0, expect_failure=True))
+
+
 class TestBarrierJoinMP:
     def test_barrier_and_join(self, world):
         world(2, """
