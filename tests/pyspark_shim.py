@@ -89,7 +89,7 @@ class _BarrierRDD:
                     "PYSPARK_SHIM_SIZE": str(self._n),
                     "PYTHONPATH": os.pathsep.join(
                         [os.path.dirname(os.path.dirname(
-                            os.path.dirname(os.path.abspath(__file__)))),
+                            os.path.abspath(__file__))),    # repo root
                          os.path.dirname(os.path.abspath(__file__)),
                          env.get("PYTHONPATH", "")]),
                 })
