@@ -3,3 +3,4 @@
 from .distributed_optimizer import (  # noqa: F401
     DistributedOptimizer, make_train_step, DistributedOptimizerState,
 )
+from .zero import make_zero_train_step  # noqa: F401
