@@ -182,6 +182,17 @@ def DistributedOptimizer(
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+def resolve_mesh_axis(mesh, axis_name: Optional[str]):
+    """(mesh_obj, axis) for a train-step builder: the framework mesh by
+    default, or an explicit ``jax.sharding.Mesh`` with its first axis."""
+    from .. import basics
+
+    if mesh is None:
+        gm = basics.global_mesh()
+        return gm.mesh, (axis_name or gm.axis_name)
+    return mesh, (axis_name or list(mesh.axis_names)[0])
+
+
 def make_train_step(
     loss_fn: Callable,
     optimizer: optax.GradientTransformation,
@@ -211,14 +222,7 @@ def make_train_step(
     from .. import basics
 
     _check_reduce_args(op, compression)
-    gm = mesh
-    if gm is None:
-        gm = basics.global_mesh()
-        mesh_obj = gm.mesh
-        axis = axis_name or gm.axis_name
-    else:
-        mesh_obj = gm
-        axis = axis_name or list(gm.axis_names)[0]
+    mesh_obj, axis = resolve_mesh_axis(mesh, axis_name)
 
     # Does the optimizer itself allreduce?  Decided at trace time by
     # inspecting the *actual* optimizer state for a

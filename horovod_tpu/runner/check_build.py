@@ -75,13 +75,16 @@ def check_build_str() -> str:
         "flash engine)",
         "",
         "Parallelism:",
-        "    [X] data parallel (+Adasum, elastic, process sets)",
+        "    [X] data parallel (+Adasum any world size, elastic, "
+        "process sets, hierarchical allreduce)",
         "    [X] tensor parallel (Megatron column/row rules)",
         "    [X] sequence/context parallel (ring attention, Ulysses)",
+        "    [X] ZeRO-1 sharded optimizer state (make_zero_train_step)",
         "",
         "Launchers:",
         "    [X] local multi-process (-np N)",
         "    [X] elastic (--host-discovery-script, min/max-np)",
+        "    [X] LSF/jsrun (allocation auto-detect, PMIX rank pickup)",
         "    [X] TPU pod passthrough (platform-set coordination env)",
     ]
     return "\n".join(lines)
