@@ -98,6 +98,39 @@ def make_zero_train_step(
     init = jax.jit(shard_map(init_body, mesh=mesh_obj, in_specs=(P(),),
                              out_specs=P(axis), check=False))
 
+    def _plan_buckets(leaves, bucket_bytes):
+        """Static (trace-time) bucket plan: leaf indices grouped by
+        dtype (no promotion — mixed-precision trees keep each dtype's
+        wire width) and chunked so one bucket's transient concat buffer
+        stays under ``bucket_bytes`` (the fusion-threshold discipline of
+        ops/fusion.py — caps peak HBM instead of materializing one
+        full-gradient-size buffer).  Zero-size leaves join no bucket."""
+        by_dtype: dict = {}
+        for i, leaf in enumerate(leaves):
+            if leaf.size == 0:
+                continue
+            by_dtype.setdefault(jnp.dtype(leaf.dtype), []).append(i)
+        buckets = []
+        for dt, idxs in by_dtype.items():
+            cur, cur_bytes = [], 0
+            for i in idxs:
+                w = _flat_pad(leaves[i], n).size
+                nbytes = w * dt.itemsize
+                if cur and cur_bytes + nbytes > bucket_bytes:
+                    buckets.append(cur)
+                    cur, cur_bytes = [], 0
+                cur.append(i)
+                cur_bytes += nbytes
+            if cur:
+                buckets.append(cur)
+        return buckets
+
+    def _bucket_bytes():
+        from .. import basics
+
+        return (basics.config().fusion_threshold
+                if basics.is_initialized() else 64 * 1024 * 1024)
+
     def step_body(params, opt_state, batch):
         opt_state = jax.tree.map(lambda x: x[0], opt_state)
         grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
@@ -107,47 +140,51 @@ def make_zero_train_step(
             loss, grads = grad_fn(params, batch)
             aux = None
 
-        # Fused collectives: all leaves ride ONE reduce-scatter and ONE
-        # all-gather (they are all ready simultaneously under XLA, so
-        # there is no reference-style streaming reason to bucket).  The
-        # [n, L_i/n] interleave keeps per-leaf shard boundaries intact
-        # inside the concatenated bucket, so the optimizer still sees a
-        # structured per-leaf pytree of shards.
+        # Fused collectives: leaves ride one reduce-scatter + one
+        # all-gather per bucket (all gradients are ready simultaneously
+        # under XLA — bucketing here only bounds the concat transient).
+        # The [n, L_i/n] interleave keeps per-leaf shard boundaries
+        # intact inside a concatenated bucket, so the optimizer still
+        # sees a structured per-leaf pytree of shards.
         grad_leaves, treedef = jax.tree.flatten(grads)
+        param_leaves = jax.tree.leaves(params)
         widths = [_flat_pad(g, n).size // n for g in grad_leaves]
-        acc_dtype = jnp.result_type(*[g.dtype for g in grad_leaves])
-        bucket = jnp.concatenate(
-            [_flat_pad(g, n).astype(acc_dtype).reshape(n, -1)
-             for g in grad_leaves], axis=1).reshape(-1)
-        red = spmd.reducescatter(
-            bucket, op="average" if op == C.Average else "sum", axis=axis)
+        buckets = _plan_buckets(grad_leaves, _bucket_bytes())
 
-        def split_ws(flat):
-            out, off = [], 0
-            for w in widths:
-                out.append(lax.dynamic_slice(flat, (off,), (w,)))
-                off += w
-            return out
+        shard_grad_leaves = [
+            jnp.zeros((0,), g.dtype) if g.size == 0 else None
+            for g in grad_leaves]
+        for idxs in buckets:
+            bucket = jnp.concatenate(
+                [_flat_pad(grad_leaves[i], n).reshape(n, -1) for i in idxs],
+                axis=1).reshape(-1)
+            red = spmd.reducescatter(
+                bucket, op="average" if op == C.Average else "sum",
+                axis=axis)
+            off = 0
+            for i in idxs:
+                shard_grad_leaves[i] = lax.dynamic_slice(
+                    red, (off,), (widths[i],)).astype(grad_leaves[i].dtype)
+                off += widths[i]
 
-        shard_grads = treedef.unflatten(
-            [s.astype(g.dtype) for s, g in zip(split_ws(red), grad_leaves)])
+        shard_grads = treedef.unflatten(shard_grad_leaves)
         shard_params = jax.tree.map(my_shard, params)
         updates, opt_state = optimizer.update(shard_grads, opt_state,
                                               shard_params)
         new_shards = optax.apply_updates(shard_params, updates)
-
         shard_leaves = jax.tree.leaves(new_shards)
-        param_leaves = jax.tree.leaves(params)
-        out_bucket = jnp.concatenate(
-            [s.astype(acc_dtype) for s in shard_leaves])         # [W_total]
-        full = lax.all_gather(out_bucket, axis, axis=0, tiled=True)
-        full = full.reshape(n, -1)                               # [n, W_total]
-        new_leaves = []
-        off = 0
-        for w, orig in zip(widths, param_leaves):
-            leaf = full[:, off:off + w].reshape(-1)[: orig.size]
-            new_leaves.append(leaf.reshape(orig.shape).astype(orig.dtype))
-            off += w
+
+        new_leaves = list(param_leaves)   # zero-size leaves pass through
+        for idxs in buckets:
+            out_bucket = jnp.concatenate([shard_leaves[i] for i in idxs])
+            full = lax.all_gather(out_bucket, axis, axis=0, tiled=True)
+            full = full.reshape(n, -1)
+            off = 0
+            for i in idxs:
+                orig = param_leaves[i]
+                leaf = full[:, off:off + widths[i]].reshape(-1)[: orig.size]
+                new_leaves[i] = leaf.reshape(orig.shape).astype(orig.dtype)
+                off += widths[i]
         params = treedef.unflatten(new_leaves)
         loss = spmd.allreduce(loss, op="average", axis=axis)
         opt_state = jax.tree.map(lambda x: jnp.asarray(x)[None], opt_state)

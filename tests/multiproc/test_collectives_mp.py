@@ -151,6 +151,25 @@ class TestReducescatterMP:
         """)
 
 
+class TestHierarchicalAllreduceMP:
+    def test_two_level_across_controllers(self, world):
+        """HOROVOD_HIERARCHICAL_ALLREDUCE in a real 4-controller world
+        factored 2x2: the three-stage program must agree across
+        controllers and match the flat sum."""
+        world(4, """
+        hvd.shutdown()
+        os.environ['HOROVOD_HIERARCHICAL_ALLREDUCE'] = '1'
+        os.environ['HVD_TPU_HIERARCHICAL_INNER'] = '2'
+        hvd.init()
+        x = np.arange(5, dtype=np.float32)[None] * (rank + 1)
+        got = np.asarray(hvd.allreduce(x, op=hvd.Sum))
+        want = np.arange(5) * (1 + 2 + 3 + 4)
+        assert np.allclose(got, want), (got, want)
+        avg = np.asarray(hvd.allreduce(x))
+        assert np.allclose(avg, np.arange(5) * 2.5), avg
+        """)
+
+
 class TestMismatchErrorsMP:
     """Reference CI contract (SURVEY §4): mismatched shapes/dtypes
     across ranks must fail the job fast — a controlled error on the

@@ -106,3 +106,30 @@ class TestZeroTrainStep:
         params, loss_fn, _ = _toy_problem()
         with pytest.raises(ValueError, match="Average/Sum"):
             make_zero_train_step(loss_fn, optax.sgd(0.1), op=hvd.Adasum)
+
+    def test_zero_size_and_mixed_dtype_leaves(self, world_size):
+        """Zero-size leaves pass through untouched; mixed-precision trees
+        bucket per dtype (no promotion on the wire)."""
+        rng = np.random.RandomState(3)
+        params = {
+            "w16": jnp.asarray(rng.randn(8, 4), jnp.bfloat16),
+            "w32": jnp.asarray(rng.randn(8, 4), jnp.float32),
+            "empty": jnp.zeros((0,), jnp.float32),
+        }
+        wt = jnp.asarray(rng.randn(8, 4), jnp.float32)
+
+        def loss_fn(p, batch):
+            x, y = batch
+            pred = x @ (p["w16"].astype(jnp.float32) + p["w32"])
+            return jnp.mean((pred - y) ** 2) + jnp.sum(p["empty"])
+
+        x = jnp.asarray(rng.randn(16, 8), jnp.float32)
+        batch = (x, x @ wt)
+        init_z, step_z = make_zero_train_step(loss_fn, optax.sgd(0.05))
+        state = init_z(params)
+        p1, state, l1 = step_z(params, state, batch)
+        assert p1["empty"].shape == (0,)
+        assert p1["w16"].dtype == jnp.bfloat16
+        assert p1["w32"].dtype == jnp.float32
+        p2, state, l2 = step_z(p1, state, batch)
+        assert float(l2) < float(l1)
