@@ -1,0 +1,93 @@
+"""Shared estimator fit scaffolding.
+
+Reference: ``horovod/spark/common/backend.py`` (the SparkBackend the
+estimators dispatch through — SURVEY.md §2.6, mount empty, unverified).
+Every estimator's ``fit`` follows the same sequence: resolve the world
+size, materialize train/validation data to the store as Parquet, build
+the worker spec, and run the per-worker training fn over the cluster
+(pyspark DataFrame) or in-process (local datasets).  Keeping it here
+means the keras/torch/lightning tiers differ only in their training fn,
+serialization, and checkpoint format.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from . import datamodule as dm
+
+
+def dispatch_fit(estimator, df, blob: bytes, train_fn: Callable,
+                 run_id: str,
+                 extra_spec: Optional[Dict[str, Any]] = None) -> Tuple:
+    """Run the store → shard → distributed-fit sequence; returns rank
+    0's ``train_fn`` result."""
+    store = estimator._get("store")
+    num_proc = estimator._get("num_proc")
+    if num_proc is None:
+        # Cluster path: the scheduler's parallelism; local path: 1.
+        num_proc = (df.sparkSession.sparkContext.defaultParallelism
+                    if dm._is_spark_df(df) else 1)
+
+    train_path = store.get_train_data_path(run_id)
+    dm.materialize(df, train_path, num_shards=num_proc)
+    val_path = None
+    if estimator._get("validation") is not None:
+        val_path = store.get_val_data_path(run_id)
+        dm.materialize(estimator._get("validation"), val_path,
+                       num_shards=num_proc)
+
+    spec = {
+        "feature_cols": estimator._get("feature_cols"),
+        "label_cols": estimator._get("label_cols"),
+        "batch_size": estimator._get("batch_size"),
+        "epochs": estimator._get("epochs"),
+        "backward_passes_per_step": estimator._get("backward_passes_per_step"),
+    }
+    spec.update(extra_spec or {})
+
+    if dm._is_spark_df(df):
+        from .. import run as spark_run
+
+        results = spark_run(train_fn, args=(blob, train_path, val_path,
+                                            spec), num_proc=num_proc)
+    else:
+        results = [train_fn(blob, train_path, val_path, spec)]
+    return results[0]
+
+
+class PredictionTransformer:
+    """Shared fitted-model Transformer: forward-pass inference with a
+    ``prediction`` column appended (reference: the Spark Transformer
+    half of each estimator).  Subclasses override :meth:`_predict`."""
+
+    def __init__(self, model=None, history=None, run_id=None,
+                 feature_cols=None):
+        self.model = model
+        self.history = history or []
+        self.run_id = run_id
+        self.feature_cols = feature_cols or ["features"]
+
+    def getModel(self):
+        return self.model
+
+    def _predict(self, x):
+        """numpy features -> numpy predictions (torch forward default)."""
+        import torch
+
+        self.model.eval()
+        with torch.no_grad():
+            return self.model(torch.from_numpy(x)).numpy()
+
+    def transform(self, df):
+        """pandas/dict/list datasets work without pyspark; Spark
+        DataFrames round-trip through pandas on the driver (cluster-
+        scale inference is out of scope — the reference uses a pandas
+        UDF there)."""
+        import numpy as np
+
+        pdf = df.toPandas() if dm._is_spark_df(df) else dm._to_pandas(df).copy()
+        x = dm.stack_features(dm.to_columns(pdf), self.feature_cols)
+        preds = self._predict(x)
+        pdf["prediction"] = [np.asarray(p).tolist() for p in preds]
+        return pdf

@@ -21,6 +21,7 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from ..common import datamodule as dm
+from ..common.backend import PredictionTransformer, dispatch_fit
 from ..common.params import EstimatorParams
 from ..common.store import Store
 
@@ -115,42 +116,16 @@ class KerasEstimator(EstimatorParams):
             self._set(k, v)
         store: Store = self._get("store")
         run_id = self._get("run_id") or f"keras-{uuid.uuid4().hex[:8]}"
-        num_proc = self._get("num_proc")
-        if num_proc is None:
-            # Cluster path: spark.run's own default; local path: 1.
-            num_proc = (df.sparkSession.sparkContext.defaultParallelism
-                        if dm._is_spark_df(df) else 1)
-
-        train_path = store.get_train_data_path(run_id)
-        dm.materialize(df, train_path, num_shards=num_proc)
-        val_path = None
-        validation = self._get("validation")
-        if validation is not None:
-            val_path = store.get_val_data_path(run_id)
-            dm.materialize(validation, val_path, num_shards=num_proc)
-
-        spec = {
-            "feature_cols": self._get("feature_cols"),
-            "label_cols": self._get("label_cols"),
-            "batch_size": self._get("batch_size"),
-            "epochs": self._get("epochs"),
-            "loss": self._get("loss"),
-            "metrics": self._get("metrics"),
-            "optimizer": self.optimizer,
-            "backward_passes_per_step": self._get("backward_passes_per_step"),
-            "train_steps_per_epoch": self._get("train_steps_per_epoch"),
-            "verbose": self._get("verbose"),
-        }
         blob = _serialize_keras(self.model, self.custom_objects)
-
-        if dm._is_spark_df(df):
-            from .. import run as spark_run
-
-            results = spark_run(_train_fn, args=(blob, train_path, val_path,
-                                                 spec), num_proc=num_proc)
-        else:
-            results = [_train_fn(blob, train_path, val_path, spec)]
-        history, weights = results[0]
+        history, weights = dispatch_fit(
+            self, df, blob, _train_fn, run_id,
+            extra_spec={
+                "loss": self._get("loss"),
+                "metrics": self._get("metrics"),
+                "optimizer": self.optimizer,
+                "train_steps_per_epoch": self._get("train_steps_per_epoch"),
+                "verbose": self._get("verbose"),
+            })
 
         trained = _deserialize_keras(blob)
         trained.set_weights(weights)
@@ -161,30 +136,9 @@ class KerasEstimator(EstimatorParams):
                           feature_cols=self._get("feature_cols"))
 
 
-class KerasModel:
-    """The fitted Spark Transformer (reference: ``KerasModel``) — holds
-    trained weights and applies the model to datasets."""
+class KerasModel(PredictionTransformer):
+    """The fitted Spark Transformer (reference: ``KerasModel``) —
+    inference through ``model.predict`` on the shared transformer."""
 
-    def __init__(self, model=None, history: Optional[List[dict]] = None,
-                 run_id: Optional[str] = None,
-                 feature_cols: Optional[List[str]] = None):
-        self.model = model
-        self.history = history or []
-        self.run_id = run_id
-        self.feature_cols = feature_cols or ["features"]
-
-    def getModel(self):
-        return self.model
-
-    def transform(self, df):
-        """Append a ``prediction`` column.  pandas/dict/list datasets
-        work without pyspark; Spark DataFrames run through a pandas
-        round-trip on the driver (cluster-scale inference is out of
-        scope — the reference uses a pandas UDF there)."""
-        import numpy as np
-
-        pdf = df.toPandas() if dm._is_spark_df(df) else dm._to_pandas(df).copy()
-        x = dm.stack_features(dm.to_columns(pdf), self.feature_cols)
-        preds = self.model.predict(x, verbose=0)
-        pdf["prediction"] = [np.asarray(p).tolist() for p in preds]
-        return pdf
+    def _predict(self, x):
+        return self.model.predict(x, verbose=0)

@@ -1,0 +1,167 @@
+"""Lightning Spark estimator.
+
+Reference: ``horovod/spark/lightning/`` (``TorchEstimator`` over a
+``LightningModule`` — SURVEY.md §2.6, mount empty, unverified): the
+module self-describes its optimization (``configure_optimizers``) and
+step math (``training_step``/``validation_step``); the estimator
+supplies data, the distributed world, and the fit loop.
+
+TPU-native redesign: the estimator drives the **LightningModule
+protocol**, not the pytorch-lightning package — ``training_step``,
+``validation_step``, ``configure_optimizers`` are called duck-typed, so
+any real ``pl.LightningModule`` works when lightning is installed AND
+the whole pipeline is exercisable without it (same waiver pattern as
+the mxnet binding; pytorch-lightning is not in this image).  The world,
+data, and fit scaffolding are shared with the torch estimator
+(``spark/common/backend.py``, ``spark/common/datamodule.py``).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ..common.backend import dispatch_fit
+from ..common import datamodule as dm
+from ..common.params import EstimatorParams
+from ..common.store import Store
+from ..torch import TorchModel
+
+
+def _resolve_optimizer(module):
+    """``configure_optimizers`` contract forms (lightning docs): a bare
+    optimizer, a list/tuple of optimizers, ([optimizers], [schedulers]),
+    or {'optimizer': opt, ...}.  Single-optimizer training uses the
+    first; anything unresolvable raises with the contract named."""
+    cfg = module.configure_optimizers()
+    if isinstance(cfg, dict):
+        cfg = cfg.get("optimizer")
+    if isinstance(cfg, (list, tuple)):
+        if not cfg:
+            raise ValueError("configure_optimizers returned no optimizer")
+        first = cfg[0]
+        if isinstance(first, (list, tuple)):   # ([opts], [scheds])
+            if not first:
+                raise ValueError("configure_optimizers returned no optimizer")
+            first = first[0]
+        cfg = first
+    if cfg is None or not hasattr(cfg, "step"):
+        raise ValueError(
+            "configure_optimizers must yield a torch optimizer (got "
+            f"{type(cfg).__name__}); supported forms: optimizer, "
+            "[optimizers], ([optimizers], [schedulers]), "
+            "{'optimizer': ...}")
+    return cfg
+
+
+def _train_fn(blob: bytes, train_path: str, val_path: Optional[str],
+              spec: Dict[str, Any]):
+    """Per-worker loop (reference: ``lightning/remote.py``): the module's
+    own step math, our world and gradient reduction."""
+    import numpy as np
+    import torch
+
+    import horovod_tpu as hvd
+    import horovod_tpu.torch as hvt
+
+    if not hvd.is_initialized():
+        hvd.init()
+    rank, world = hvd.cross_rank(), hvd.cross_size()
+
+    module = pickle.loads(blob)
+    optimizer = _resolve_optimizer(module)
+    hvt.broadcast_parameters(module.state_dict(), root_rank=0)
+    opt = hvt.DistributedOptimizer(
+        optimizer, named_parameters=module.named_parameters(),
+        backward_passes_per_step=spec["backward_passes_per_step"])
+
+    data = dm.read_shard(train_path, rank, world)
+    x = torch.from_numpy(dm.stack_features(data, spec["feature_cols"]))
+    y = torch.from_numpy(dm.stack_features(data, spec["label_cols"]))
+    val = None
+    if val_path:
+        vdata = dm.read_shard(val_path, rank, world)
+        val = (torch.from_numpy(dm.stack_features(vdata, spec["feature_cols"])),
+               torch.from_numpy(dm.stack_features(vdata, spec["label_cols"])))
+
+    bs = spec["batch_size"]
+    history: Dict[str, List[float]] = {"loss": []}
+    g = torch.Generator().manual_seed(1234)  # same shuffle on every rank
+    for _ in range(spec["epochs"]):
+        module.train()
+        perm = torch.randperm(len(x), generator=g)
+        losses = []
+        for batch_idx, i in enumerate(range(0, len(x), bs)):
+            # batch_idx restarts each epoch (lightning contract)
+            idx = perm[i:i + bs]
+            opt.zero_grad()
+            loss = module.training_step((x[idx], y[idx]), batch_idx)
+            if isinstance(loss, dict):       # lightning allows {'loss': ...}
+                loss = loss["loss"]
+            loss.backward()
+            opt.step()
+            losses.append(float(loss.detach()))
+        history["loss"].append(float(np.mean(losses)))
+        if val is not None and callable(getattr(module, "validation_step",
+                                                None)):
+            module.eval()
+            with torch.no_grad():
+                vloss = module.validation_step(val, 0)
+            if isinstance(vloss, dict):
+                vloss = vloss.get("val_loss", vloss.get("loss"))
+            if vloss is not None:   # modules logging via self.log return None
+                history.setdefault("val_loss", []).append(float(vloss))
+    return history, module.state_dict()
+
+
+class LightningEstimator(EstimatorParams):
+    """Reference API shape: ``LightningEstimator(model=lightning_module,
+    store=..., num_proc=N).fit(df) -> LightningModel``."""
+
+    def __init__(self, model=None, input_shapes=None, **params: Any) -> None:
+        super().__init__(**params)
+        self.model = model
+        self.input_shapes = input_shapes
+
+    def _validate(self) -> None:
+        if self.model is None:
+            raise ValueError("LightningEstimator requires model=")
+        for hook in ("training_step", "configure_optimizers"):
+            if not callable(getattr(self.model, hook, None)):
+                raise TypeError(
+                    f"model must implement the LightningModule protocol "
+                    f"(missing {hook})")
+        store = self._get("store")
+        if store is None:
+            raise ValueError("LightningEstimator requires store=")
+        if not isinstance(store, Store):
+            raise TypeError("store must be a horovod_tpu.spark Store")
+
+    def fit(self, df, params: Optional[dict] = None) -> "LightningModel":
+        """Materialize ``df`` to the store, train with the module's own
+        step math, return the fitted :class:`LightningModel`."""
+        self._validate()
+        for k, v in (params or {}).items():
+            self._set(k, v)
+        store: Store = self._get("store")
+        run_id = self._get("run_id") or f"lightning-{uuid.uuid4().hex[:8]}"
+        import cloudpickle   # local/duck classes travel by value
+
+        blob = cloudpickle.dumps(self.model)
+        history, state_dict = dispatch_fit(self, df, blob, _train_fn, run_id)
+
+        trained = pickle.loads(blob)
+        trained.load_state_dict(state_dict)
+        store.write_serialized(
+            os.path.join(store.get_checkpoint_path(run_id), "model.pt"),
+            {k: v.numpy() for k, v in state_dict.items()})
+        return LightningModel(model=trained, history=[history],
+                              run_id=run_id,
+                              feature_cols=self._get("feature_cols"))
+
+
+class LightningModel(TorchModel):
+    """The fitted Spark Transformer — a LightningModule is an
+    ``nn.Module``, so inference is the torch transformer's forward."""

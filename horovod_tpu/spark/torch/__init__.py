@@ -5,8 +5,9 @@ Reference: ``horovod/spark/torch/`` (``TorchEstimator`` with a torch
 per-worker loop — SURVEY.md §2.6, mount empty, unverified).  Same
 store → Parquet shard → distributed fit → transformer pipeline as the
 Keras estimator (see ``spark/keras/__init__.py`` for the TPU-native
-design notes); the worker loop wraps the user optimizer in
-``horovod_tpu.torch.DistributedOptimizer``.
+design notes); the shared scaffolding lives in
+``spark/common/backend.py`` and the worker loop wraps the user
+optimizer in ``horovod_tpu.torch.DistributedOptimizer``.
 """
 
 from __future__ import annotations
@@ -17,6 +18,7 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from ..common import datamodule as dm
+from ..common.backend import PredictionTransformer, dispatch_fit
 from ..common.params import EstimatorParams
 from ..common.store import Store
 
@@ -108,39 +110,15 @@ class TorchEstimator(EstimatorParams):
             self._set(k, v)
         store: Store = self._get("store")
         run_id = self._get("run_id") or f"torch-{uuid.uuid4().hex[:8]}"
-        num_proc = self._get("num_proc")
-        if num_proc is None:
-            num_proc = (df.sparkSession.sparkContext.defaultParallelism
-                        if dm._is_spark_df(df) else 1)
-
-        train_path = store.get_train_data_path(run_id)
-        dm.materialize(df, train_path, num_shards=num_proc)
-        val_path = None
-        if self._get("validation") is not None:
-            val_path = store.get_val_data_path(run_id)
-            dm.materialize(self._get("validation"), val_path,
-                           num_shards=num_proc)
-
-        spec = {
-            "feature_cols": self._get("feature_cols"),
-            "label_cols": self._get("label_cols"),
-            "batch_size": self._get("batch_size"),
-            "epochs": self._get("epochs"),
-            "backward_passes_per_step": self._get("backward_passes_per_step"),
-        }
-        # Model, optimizer, and loss travel as one pickle so the
+        # Model, optimizer, and loss travel as one blob so the
         # optimizer's parameter references stay bound to the same model
-        # instance on the worker (reference serializes them together too).
-        blob = pickle.dumps((self.model, self.optimizer, self._get("loss")))
+        # instance on the worker (cloudpickle: locally-defined modules
+        # and losses travel by value, Spark's own transport).
+        import cloudpickle
 
-        if dm._is_spark_df(df):
-            from .. import run as spark_run
-
-            results = spark_run(_train_fn, args=(blob, train_path, val_path,
-                                                 spec), num_proc=num_proc)
-        else:
-            results = [_train_fn(blob, train_path, val_path, spec)]
-        history, state_dict = results[0]
+        blob = cloudpickle.dumps(
+            (self.model, self.optimizer, self._get("loss")))
+        history, state_dict = dispatch_fit(self, df, blob, _train_fn, run_id)
 
         trained, _, _ = pickle.loads(blob)
         trained.load_state_dict(state_dict)
@@ -151,31 +129,6 @@ class TorchEstimator(EstimatorParams):
                           feature_cols=self._get("feature_cols"))
 
 
-class TorchModel:
-    """The fitted Spark Transformer (reference: ``TorchModel``)."""
-
-    def __init__(self, model=None, history: Optional[List[dict]] = None,
-                 run_id: Optional[str] = None,
-                 feature_cols: Optional[List[str]] = None):
-        self.model = model
-        self.history = history or []
-        self.run_id = run_id
-        self.feature_cols = feature_cols or ["features"]
-
-    def getModel(self):
-        return self.model
-
-    def transform(self, df):
-        """Append a ``prediction`` column (see KerasModel.transform for
-        the pyspark gating contract)."""
-        import numpy as np
-        import torch
-
-        pdf = df.toPandas() if dm._is_spark_df(df) else dm._to_pandas(df).copy()
-        x = torch.from_numpy(dm.stack_features(dm.to_columns(pdf),
-                                               self.feature_cols))
-        self.model.eval()
-        with torch.no_grad():
-            preds = self.model(x).numpy()
-        pdf["prediction"] = [np.asarray(p).tolist() for p in preds]
-        return pdf
+class TorchModel(PredictionTransformer):
+    """The fitted Spark Transformer (reference: ``TorchModel``);
+    forward-pass inference via the shared transformer base."""

@@ -135,6 +135,82 @@ class TestTorchEstimator:
         assert len(fitted.history[0]["val_loss"]) == 2
 
 
+class TestLightningEstimator:
+    def _module(self):
+        import torch
+
+        class LinearModule(torch.nn.Module):
+            """LightningModule-protocol duck (pytorch-lightning is not
+            in this image; the estimator drives the protocol, not the
+            package — see the module docstring waiver)."""
+
+            def __init__(self):
+                super().__init__()
+                self.net = torch.nn.Linear(4, 1)
+
+            def forward(self, x):
+                return self.net(x)
+
+            def training_step(self, batch, batch_idx):
+                x, y = batch
+                return torch.nn.functional.mse_loss(self(x), y)
+
+            def validation_step(self, batch, batch_idx):
+                x, y = batch
+                return {"val_loss":
+                        torch.nn.functional.mse_loss(self(x), y)}
+
+            def configure_optimizers(self):
+                return torch.optim.SGD(self.parameters(), lr=0.05)
+
+        torch.manual_seed(0)
+        return LinearModule()
+
+    def test_fit_transform_roundtrip(self, tmp_path):
+        from horovod_tpu.spark.lightning import (LightningEstimator,
+                                                 LightningModel)
+
+        est = LightningEstimator(
+            model=self._module(), store=FilesystemStore(str(tmp_path)),
+            batch_size=16, epochs=8, run_id="l1",
+            validation=_regression_df(n=32, seed=9),
+        )
+        df = _regression_df()
+        fitted = est.fit(df)
+        assert isinstance(fitted, LightningModel)
+        losses = fitted.history[0]["loss"]
+        assert losses[-1] < losses[0] * 0.5, losses
+        assert len(fitted.history[0]["val_loss"]) == 8
+        assert os.path.exists(os.path.join(
+            str(tmp_path), "runs", "l1", "checkpoint", "model.pt"))
+        out = fitted.transform(df.head(6))
+        assert "prediction" in out.columns and len(out) == 6
+
+    def test_protocol_validation(self, tmp_path):
+        from horovod_tpu.spark.lightning import LightningEstimator
+
+        with pytest.raises(TypeError, match="LightningModule protocol"):
+            LightningEstimator(model=object(),
+                               store=FilesystemStore(str(tmp_path))).fit(None)
+        with pytest.raises(ValueError, match="requires model"):
+            LightningEstimator(store=FilesystemStore(str(tmp_path))).fit(None)
+
+    def test_configure_optimizers_tuple_form(self):
+        """([optimizers], [schedulers]) — the other lightning contract."""
+        import torch
+
+        from horovod_tpu.spark.lightning import _resolve_optimizer
+
+        lin = torch.nn.Linear(2, 1)
+        opt = torch.optim.SGD(lin.parameters(), lr=0.1)
+
+        class M:
+            def configure_optimizers(self):
+                return [opt], []
+
+        assert _resolve_optimizer(M()) is opt
+
+
 class TestKerasEstimator:
     def test_fit_transform_roundtrip(self, tmp_path):
         tf = pytest.importorskip("tensorflow")
