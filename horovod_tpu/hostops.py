@@ -147,6 +147,12 @@ def member_ranks(process_set) -> Optional[List[int]]:
     return ranks
 
 
+def set_size(process_set) -> int:
+    """Member count of a process set (the whole world for None/global)."""
+    ranks = member_ranks(process_set)
+    return len(ranks) if ranks is not None else world()[0]
+
+
 def require_member(ranks: Optional[List[int]], name: str) -> None:
     """Raise for callers outside the process set (reference semantics).
     Must only be called after every collective in the op has been

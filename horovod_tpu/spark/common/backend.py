@@ -56,6 +56,65 @@ def dispatch_fit(estimator, df, blob: bytes, train_fn: Callable,
     return results[0]
 
 
+def torch_fit_loop(model, optimizer, train_step, val_step,
+                   train_path: str, val_path: Optional[str],
+                   spec: Dict[str, Any]):
+    """Shared per-worker torch loop (reference: the ``remote.py`` of each
+    torch-family estimator): world init, rank-0 state broadcast,
+    DistributedOptimizer wrap, shard read, seeded same-on-every-rank
+    shuffle, epoch/batch history.  ``train_step(model, batch, batch_idx)``
+    returns the loss tensor; ``val_step(model, (x, y))`` returns a float
+    or None (skipped entry)."""
+    import numpy as np
+    import torch
+
+    import horovod_tpu as hvd
+    import horovod_tpu.torch as hvt
+
+    if not hvd.is_initialized():
+        hvd.init()
+    rank, world = hvd.cross_rank(), hvd.cross_size()
+
+    hvt.broadcast_parameters(model.state_dict(), root_rank=0)
+    opt = hvt.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters(),
+        backward_passes_per_step=spec["backward_passes_per_step"])
+
+    data = dm.read_shard(train_path, rank, world)
+    x = torch.from_numpy(dm.stack_features(data, spec["feature_cols"]))
+    y = torch.from_numpy(dm.stack_features(data, spec["label_cols"]))
+    val = None
+    if val_path:
+        vdata = dm.read_shard(val_path, rank, world)
+        val = (torch.from_numpy(dm.stack_features(vdata, spec["feature_cols"])),
+               torch.from_numpy(dm.stack_features(vdata, spec["label_cols"])))
+
+    bs = spec["batch_size"]
+    history: Dict[str, Any] = {"loss": []}
+    g = torch.Generator().manual_seed(1234)  # same shuffle on every rank
+    for _ in range(spec["epochs"]):
+        model.train()
+        perm = torch.randperm(len(x), generator=g)
+        losses = []
+        # batch_idx restarts each epoch (the lightning contract; harmless
+        # for the plain torch loss closure)
+        for batch_idx, i in enumerate(range(0, len(x), bs)):
+            idx = perm[i:i + bs]
+            opt.zero_grad()
+            loss = train_step(model, (x[idx], y[idx]), batch_idx)
+            loss.backward()
+            opt.step()
+            losses.append(float(loss.detach()))
+        history["loss"].append(float(np.mean(losses)))
+        if val is not None and val_step is not None:
+            model.eval()
+            with torch.no_grad():
+                vloss = val_step(model, val)
+            if vloss is not None:
+                history.setdefault("val_loss", []).append(float(vloss))
+    return history, model.state_dict()
+
+
 class PredictionTransformer:
     """Shared fitted-model Transformer: forward-pass inference with a
     ``prediction`` column appended (reference: the Spark Transformer

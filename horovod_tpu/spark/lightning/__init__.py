@@ -58,62 +58,31 @@ def _resolve_optimizer(module):
 
 def _train_fn(blob: bytes, train_path: str, val_path: Optional[str],
               spec: Dict[str, Any]):
-    """Per-worker loop (reference: ``lightning/remote.py``): the module's
-    own step math, our world and gradient reduction."""
-    import numpy as np
-    import torch
-
-    import horovod_tpu as hvd
-    import horovod_tpu.torch as hvt
-
-    if not hvd.is_initialized():
-        hvd.init()
-    rank, world = hvd.cross_rank(), hvd.cross_size()
+    """Per-worker body (reference: ``lightning/remote.py``): the shared
+    torch fit loop driven by the module's own step math."""
+    from ..common.backend import torch_fit_loop
 
     module = pickle.loads(blob)
     optimizer = _resolve_optimizer(module)
-    hvt.broadcast_parameters(module.state_dict(), root_rank=0)
-    opt = hvt.DistributedOptimizer(
-        optimizer, named_parameters=module.named_parameters(),
-        backward_passes_per_step=spec["backward_passes_per_step"])
 
-    data = dm.read_shard(train_path, rank, world)
-    x = torch.from_numpy(dm.stack_features(data, spec["feature_cols"]))
-    y = torch.from_numpy(dm.stack_features(data, spec["label_cols"]))
-    val = None
-    if val_path:
-        vdata = dm.read_shard(val_path, rank, world)
-        val = (torch.from_numpy(dm.stack_features(vdata, spec["feature_cols"])),
-               torch.from_numpy(dm.stack_features(vdata, spec["label_cols"])))
+    def train_step(m, batch, batch_idx):
+        loss = m.training_step(batch, batch_idx)
+        if isinstance(loss, dict):           # lightning allows {'loss': ...}
+            loss = loss["loss"]
+        return loss
 
-    bs = spec["batch_size"]
-    history: Dict[str, List[float]] = {"loss": []}
-    g = torch.Generator().manual_seed(1234)  # same shuffle on every rank
-    for _ in range(spec["epochs"]):
-        module.train()
-        perm = torch.randperm(len(x), generator=g)
-        losses = []
-        for batch_idx, i in enumerate(range(0, len(x), bs)):
-            # batch_idx restarts each epoch (lightning contract)
-            idx = perm[i:i + bs]
-            opt.zero_grad()
-            loss = module.training_step((x[idx], y[idx]), batch_idx)
-            if isinstance(loss, dict):       # lightning allows {'loss': ...}
-                loss = loss["loss"]
-            loss.backward()
-            opt.step()
-            losses.append(float(loss.detach()))
-        history["loss"].append(float(np.mean(losses)))
-        if val is not None and callable(getattr(module, "validation_step",
-                                                None)):
-            module.eval()
-            with torch.no_grad():
-                vloss = module.validation_step(val, 0)
-            if isinstance(vloss, dict):
-                vloss = vloss.get("val_loss", vloss.get("loss"))
-            if vloss is not None:   # modules logging via self.log return None
-                history.setdefault("val_loss", []).append(float(vloss))
-    return history, module.state_dict()
+    def val_step(m, val):
+        if not callable(getattr(m, "validation_step", None)):
+            return None
+        vloss = m.validation_step(val, 0)
+        if isinstance(vloss, dict):
+            vloss = vloss.get("val_loss", vloss.get("loss"))
+        # modules logging via self.log return None: skip the entry
+        return None if vloss is None else float(vloss)
+
+    return torch_fit_loop(module, optimizer, train_step=train_step,
+                          val_step=val_step, train_path=train_path,
+                          val_path=val_path, spec=spec)
 
 
 class LightningEstimator(EstimatorParams):

@@ -25,56 +25,16 @@ from ..common.store import Store
 
 def _train_fn(blob: bytes, train_path: str, val_path: Optional[str],
               spec: Dict[str, Any]):
-    """Per-worker loop (reference: ``torch/remote.py``): shard → minibatch
-    SGD with gradient allreduce → (history, state_dict)."""
-    import numpy as np
-    import torch
-
-    import horovod_tpu as hvd
-    import horovod_tpu.torch as hvt
-
-    if not hvd.is_initialized():
-        hvd.init()
-    rank, world = hvd.cross_rank(), hvd.cross_size()
+    """Per-worker body (reference: ``torch/remote.py``): the shared torch
+    fit loop with the user's loss closure."""
+    from ..common.backend import torch_fit_loop
 
     model, optimizer, loss_fn = pickle.loads(blob)
-    hvt.broadcast_parameters(model.state_dict(), root_rank=0)
-    opt = hvt.DistributedOptimizer(
-        optimizer, named_parameters=model.named_parameters(),
-        backward_passes_per_step=spec["backward_passes_per_step"])
-
-    data = dm.read_shard(train_path, rank, world)
-    x = torch.from_numpy(dm.stack_features(data, spec["feature_cols"]))
-    y = torch.from_numpy(dm.stack_features(data, spec["label_cols"]))
-    val = None
-    if val_path:
-        vdata = dm.read_shard(val_path, rank, world)
-        val = (torch.from_numpy(dm.stack_features(vdata, spec["feature_cols"])),
-               torch.from_numpy(dm.stack_features(vdata, spec["label_cols"])))
-
-    bs = spec["batch_size"]
-    history: Dict[str, List[float]] = {"loss": []}
-    if val is not None:
-        history["val_loss"] = []
-    g = torch.Generator().manual_seed(1234)  # same shuffle on every rank
-    for _ in range(spec["epochs"]):
-        model.train()
-        perm = torch.randperm(len(x), generator=g)
-        losses = []
-        for i in range(0, len(x), bs):
-            idx = perm[i:i + bs]
-            opt.zero_grad()
-            loss = loss_fn(model(x[idx]), y[idx])
-            loss.backward()
-            opt.step()
-            losses.append(float(loss.detach()))
-        history["loss"].append(float(np.mean(losses)))
-        if val is not None:
-            model.eval()
-            with torch.no_grad():
-                history["val_loss"].append(
-                    float(loss_fn(model(val[0]), val[1])))
-    return history, model.state_dict()
+    return torch_fit_loop(
+        model, optimizer,
+        train_step=lambda m, batch, _i: loss_fn(m(batch[0]), batch[1]),
+        val_step=lambda m, val: float(loss_fn(m(val[0]), val[1])),
+        train_path=train_path, val_path=val_path, spec=spec)
 
 
 class TorchEstimator(EstimatorParams):

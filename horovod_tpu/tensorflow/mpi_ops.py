@@ -282,5 +282,42 @@ def join() -> int:
 
 
 def _set_size(process_set) -> int:
+    return H.set_size(process_set)
+
+
+# --- graph-constant ops (reference: size_op/rank_op etc. in
+#     horovod/tensorflow/mpi_ops.py — world facts as TF ops for graph
+#     code; the world is fixed per init, so constants are exact) --------------
+
+def size_op(process_set=None, name=None):
+    """Reference: ``hvd.size_op()`` — world size as a tf op."""
+    return tf.constant(_set_size(process_set), tf.int32, name=name)
+
+
+def rank_op(name=None):
+    """Reference: ``hvd.rank_op()``."""
+    from .. import basics
+
+    return tf.constant(basics.cross_rank(), tf.int32, name=name)
+
+
+def local_rank_op(name=None):
+    from .. import basics
+
+    return tf.constant(basics.local_rank(), tf.int32, name=name)
+
+
+def local_size_op(name=None):
+    from .. import basics
+
+    return tf.constant(basics.local_size(), tf.int32, name=name)
+
+
+def process_set_included_op(process_set=None, name=None):
+    """Reference: ``hvd.process_set_included_op()`` — 1 if this worker
+    is a member, else 0."""
+    from .. import basics
+
     ranks = H.member_ranks(process_set)
-    return len(ranks) if ranks is not None else H.world()[0]
+    included = ranks is None or basics.cross_rank() in ranks
+    return tf.constant(int(included), tf.int32, name=name)

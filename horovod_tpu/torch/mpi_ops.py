@@ -235,6 +235,40 @@ def _grouped_allreduce_async_impl(tensors, in_place, *, op: str = Average,
     return Handle(host, finish, name)
 
 
+def sparse_allreduce_async(tensor: "torch.Tensor", *, op: str = Average,
+                           process_set=None, postscale_factor: float = 1.0,
+                           name: str = "sparse_allreduce") -> Handle:
+    """Sparse (COO) gradient allreduce (reference: the allgather-based
+    sparse path of ``horovod/torch/optimizer.py`` — values and indices
+    ride ``MPI_Allgatherv``; the sum happens by coalescing duplicate
+    indices, Average divides by the worker count, and
+    ``postscale_factor`` carries the optimizer's local-accumulation
+    scaling so sparse and dense params see the same effective rate)."""
+    t = tensor.coalesce() if not tensor.is_coalesced() else tensor
+    idx_handle = allgather_async(t._indices().t().contiguous(),
+                                 process_set=process_set,
+                                 name=f"{name}.indices")
+    val_handle = allgather_async(t._values(), process_set=process_set,
+                                 name=f"{name}.values")
+    n = H.set_size(process_set)
+
+    class _SparseHandle:
+        def wait(self_inner) -> "torch.Tensor":
+            indices = idx_handle.wait().t()
+            values = val_handle.wait()
+            if op == Average:
+                values = values / n
+            if postscale_factor != 1.0:
+                values = values * postscale_factor
+            return torch.sparse_coo_tensor(indices, values,
+                                           t.shape).coalesce()
+
+        def done(self_inner) -> bool:
+            return idx_handle.done() and val_handle.done()
+
+    return _SparseHandle()
+
+
 # --- allgather ---------------------------------------------------------------
 
 def allgather(tensor: "torch.Tensor", *, process_set=None,

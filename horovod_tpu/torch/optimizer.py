@@ -35,7 +35,8 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                  backward_passes_per_step: int = 1,
                  op: str = mpi_ops.Average,
                  gradient_predivide_factor: float = 1.0,
-                 process_set=None):
+                 process_set=None,
+                 sparse_as_dense: bool = False):
         super(self.__class__, self).__init__(params)
 
         if gradient_predivide_factor != 1.0 and op != mpi_ops.Average:
@@ -54,6 +55,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
         self._compression = compression
         self._op = op
+        self._sparse_as_dense = bool(sparse_as_dense)
         self._process_set = process_set
         self._predivide = float(gradient_predivide_factor)
         self.backward_passes_per_step = int(backward_passes_per_step)
@@ -110,6 +112,23 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
     def _enqueue_allreduce(self, p: torch.Tensor) -> None:
         name = self._param_names.get(p, f"param.{id(p)}")
+        if p.grad.is_sparse:
+            # Reference sparse path: densify when asked, else the
+            # allgather-based sparse allreduce (duplicate indices sum by
+            # coalescing) whose result replaces p.grad at synchronize.
+            if self._sparse_as_dense:
+                p.grad = p.grad.to_dense()
+            else:
+                if self._op == mpi_ops.Adasum:
+                    raise NotImplementedError(
+                        "op=Adasum does not support sparse gradients; "
+                        "pass sparse_as_dense=True")
+                handle = mpi_ops.sparse_allreduce_async(
+                    p.grad, op=self._op, process_set=self._process_set,
+                    postscale_factor=1.0 / self.backward_passes_per_step,
+                    name=f"sparse_allreduce.{name}")
+                self._handles[p] = ("sparse", handle)
+                return
         handle = mpi_ops.allreduce_async_(
             p.grad, name=f"allreduce.{name}", **self._allreduce_kwargs())
         self._handles[p] = handle
@@ -130,7 +149,10 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             if p.requires_grad and p.grad is not None and p not in self._handles:
                 self._enqueue_allreduce(p)
         for p, handle in self._handles.items():
-            mpi_ops.synchronize(handle)
+            if isinstance(handle, tuple) and handle[0] == "sparse":
+                p.grad = handle[1].wait()
+            else:
+                mpi_ops.synchronize(handle)
         self._handles.clear()
         self._grad_passes.clear()
         self._synchronized = True
@@ -167,7 +189,8 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          backward_passes_per_step: int = 1,
                          op: str = mpi_ops.Average,
                          gradient_predivide_factor: float = 1.0,
-                         process_set=None) -> torch.optim.Optimizer:
+                         process_set=None,
+                         sparse_as_dense: bool = False) -> torch.optim.Optimizer:
     """Reference: ``hvd.DistributedOptimizer`` — wraps any torch optimizer
     so ``step()`` applies gradients averaged across all workers.
 
@@ -181,4 +204,4 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                dict(_DistributedOptimizer.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression,
                backward_passes_per_step, op, gradient_predivide_factor,
-               process_set)
+               process_set, sparse_as_dense)

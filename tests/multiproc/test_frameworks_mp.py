@@ -23,6 +23,32 @@ class TestTorchMP:
         """)
 
 
+class TestTorchSparseMP:
+    def test_sparse_embedding_grads_average(self, world):
+        """Sparse (COO) gradient allreduce across real controllers:
+        values/indices allgather, coalesce-sum, divide by world."""
+        world(2, """
+        import torch
+        import horovod_tpu.torch as hvt
+
+        torch.manual_seed(0)
+        emb = torch.nn.Embedding(8, 3, sparse=True)
+        opt = hvt.DistributedOptimizer(
+            torch.optim.SGD(emb.parameters(), lr=0.1),
+            named_parameters=emb.named_parameters())
+        # rank 0 touches rows {0,2}; rank 1 touches rows {2,5}
+        idx = torch.tensor([0, 2]) if rank == 0 else torch.tensor([2, 5])
+        emb(idx).sum().backward()
+        opt.synchronize()
+        g = emb.weight.grad.to_dense()
+        # row 2 hit on both ranks: avg 1.0; rows 0/5 on one rank: avg 0.5
+        assert torch.allclose(g[2], torch.ones(3)), g[2]
+        assert torch.allclose(g[0], torch.full((3,), 0.5)), g[0]
+        assert torch.allclose(g[5], torch.full((3,), 0.5)), g[5]
+        assert torch.allclose(g[1], torch.zeros(3))
+        """)
+
+
 class TestTensorFlowGraphModeMP:
     def test_allreduce_inside_tf_function(self, world):
         """The reference's custom op works inside tf.function graphs;

@@ -357,3 +357,29 @@ class TestTwoWorkerIntegration:
                + os.environ.get("PYTHONPATH", "")}
         rc = run(2, [sys.executable, str(script)], start_timeout=300, env=env)
         assert rc == 0
+
+
+class TestOpConstants:
+    def test_world_fact_ops(self):
+        import tensorflow as tf
+
+        import horovod_tpu.tensorflow as hvt
+
+        assert int(hvt.size_op()) == 1          # one controller process
+        assert int(hvt.rank_op()) == 0
+        assert int(hvt.local_rank_op()) == 0
+        assert int(hvt.process_set_included_op()) == 1
+        assert hvt.size_op().dtype == tf.int32
+
+    def test_ops_usable_in_graph(self):
+        import tensorflow as tf
+
+        import horovod_tpu.tensorflow as hvt
+
+        @tf.function
+        def f(x):
+            return x * tf.cast(hvt.size_op(), tf.float32) + \
+                tf.cast(hvt.rank_op(), tf.float32)
+
+        out = f(tf.constant(3.0))
+        assert float(out) == 3.0
