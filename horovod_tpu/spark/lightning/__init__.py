@@ -21,10 +21,9 @@ from __future__ import annotations
 import os
 import pickle
 import uuid
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 from ..common.backend import dispatch_fit
-from ..common import datamodule as dm
 from ..common.params import EstimatorParams
 from ..common.store import Store
 from ..torch import TorchModel

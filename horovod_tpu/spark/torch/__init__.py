@@ -15,9 +15,8 @@ from __future__ import annotations
 import os
 import pickle
 import uuid
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
-from ..common import datamodule as dm
 from ..common.backend import PredictionTransformer, dispatch_fit
 from ..common.params import EstimatorParams
 from ..common.store import Store
