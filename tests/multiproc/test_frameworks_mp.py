@@ -23,6 +23,30 @@ class TestTorchMP:
         """)
 
 
+class TestTimelineMP:
+    def test_per_worker_timeline_json(self, world, tmp_path):
+        """Reference CI pattern (SURVEY §4): run 2-proc with
+        HOROVOD_TIMELINE set, then parse each worker's emitted
+        Chrome-trace JSON."""
+        world(2, f"""
+        import json
+        hvd.shutdown()
+        path = r'{tmp_path}' + f'/timeline_{{rank}}.json'
+        os.environ['HOROVOD_TIMELINE'] = path
+        hvd.init()
+        np.asarray(hvd.allreduce(np.ones((1, 4), np.float32), op=hvd.Sum,
+                                 name='traced_op'))
+        hvd.shutdown()
+        events = json.load(open(path))
+        assert isinstance(events, list) and events, 'no timeline events'
+        tensors = {{e.get('args', {{}}).get('tensor') for e in events}}
+        assert 'traced_op' in tensors, tensors
+        phases = {{e.get('name') for e in events}}
+        assert phases & {{'ENQUEUE', 'EXECUTE'}}, phases
+        assert all(e.get('ph') in ('X', 'i') for e in events), events[:3]
+        """)
+
+
 class TestTorchSparseMP:
     def test_sparse_embedding_grads_average(self, world):
         """Sparse (COO) gradient allreduce across real controllers:
