@@ -6,6 +6,12 @@ The reference ships models only as examples/benchmarks
 SURVEY.md §6); these are their TPU-native counterparts in flax.
 """
 
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertEncoder,
+    BertForMaskedLM,
+    BertForSequenceClassification,
+)
 from .mlp import MLP  # noqa: F401
 from .resnet import ResNet18, ResNet50, ResNet101, SyncBatchNorm  # noqa: F401
 from .transformer import GPT, GPTConfig  # noqa: F401

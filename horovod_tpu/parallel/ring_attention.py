@@ -29,11 +29,15 @@ from .._compat import shard_map
 _NEG_INF = -1e30
 
 
-def full_attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None):
+def full_attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None,
+                   key_mask=None):
     """Plain softmax attention — the single-chip reference used by tests
     and by models when no ``sp`` axis is in play.
 
     Shapes: q ``[B, Tq, H, D]``, k/v ``[B, Tk, H, D]`` → ``[B, Tq, H, D]``.
+    ``key_mask``: optional ``[B, Tk]`` bool; False keys (padding) are
+    excluded from every query's softmax (BERT-style bidirectional
+    encoders over padded batches).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -43,6 +47,8 @@ def full_attention(q, k, v, *, causal: bool = False, scale: Optional[float] = No
         qpos = jnp.arange(tq)[:, None]
         kpos = jnp.arange(tk)[None, :]
         scores = jnp.where(qpos >= kpos, scores, _NEG_INF)
+    if key_mask is not None:
+        scores = jnp.where(key_mask[:, None, None, :], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
 

@@ -164,3 +164,120 @@ class TestGPT:
         # and a second step with the updated params still works
         params3, opt_state, loss2 = step(params2, opt_state, batch)
         assert np.isfinite(float(loss2))
+
+
+class TestBert:
+    """BERT family — the reference's 'BERT-Large fine-tune with tensor
+    fusion + fp16 Compression' baseline config (BASELINE.json #4) on a
+    tiny config."""
+
+    def _tiny(self, **kw):
+        from horovod_tpu.models import BertConfig
+
+        kw.setdefault("vocab_size", 64)
+        kw.setdefault("n_layer", 2)
+        kw.setdefault("n_head", 2)
+        kw.setdefault("d_model", 16)
+        kw.setdefault("d_ff", 32)
+        kw.setdefault("max_seq_len", 16)
+        kw.setdefault("dtype", jnp.float32)
+        return BertConfig(**kw)
+
+    def test_classifier_forward_shape(self):
+        from horovod_tpu.models import BertForSequenceClassification
+
+        model = BertForSequenceClassification(self._tiny(), num_classes=3)
+        ids = jnp.zeros((2, 8), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids)["params"]
+        logits = model.apply({"params": params}, ids)
+        assert logits.shape == (2, 3)
+        assert logits.dtype == jnp.float32
+
+    def test_padding_mask_blocks_padded_keys(self):
+        # The [CLS] output (hence the classifier logits) must not depend
+        # on the *content* of positions masked out by attention_mask.
+        from horovod_tpu.models import BertForSequenceClassification
+
+        model = BertForSequenceClassification(self._tiny())
+        rng = np.random.RandomState(0)
+        ids_a = jnp.asarray(rng.randint(0, 64, (2, 8)), jnp.int32)
+        ids_b = ids_a.at[:, 6:].set(jnp.asarray(
+            rng.randint(0, 64, (2, 2)), jnp.int32))
+        mask = jnp.asarray([[1] * 6 + [0] * 2] * 2, jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids_a)["params"]
+        la = model.apply({"params": params}, ids_a, None, mask)
+        lb = model.apply({"params": params}, ids_b, None, mask)
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=1e-5, atol=1e-6)
+        # ...and with the mask open the padded-position content matters.
+        lc = model.apply({"params": params}, ids_b)
+        assert not np.allclose(np.asarray(la), np.asarray(lc), atol=1e-4)
+
+    def test_mlm_tied_decoder(self):
+        # MLM logits come from Embed.attend: no separate [V, d] decoder
+        # matrix exists, and the embedding receives gradient from the
+        # head (both directions of the tie).
+        from horovod_tpu.models import BertForMaskedLM
+
+        model = BertForMaskedLM(self._tiny())
+        ids = jnp.zeros((1, 8), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids)["params"]
+        logits = model.apply({"params": params}, ids)
+        assert logits.shape == (1, 8, 64)
+        flat = jax.tree_util.tree_leaves_with_path(params)
+        decoders = [jax.tree_util.keystr(k) for k, v in flat
+                    if v.ndim == 2 and v.shape == (64, 16)]
+        assert decoders == ["['bert']['tok_embed']['embedding']"], decoders
+
+        def loss(p):
+            lg = model.apply({"params": p}, ids)
+            return -jnp.mean(jax.nn.log_softmax(lg)[..., 0])
+
+        g = jax.grad(loss)(params)
+        assert float(jnp.abs(
+            g["bert"]["tok_embed"]["embedding"]).sum()) > 0.0
+
+    def test_finetune_step_with_fusion_and_fp16(self, world_size):
+        # The baseline config end to end: DistributedOptimizer with
+        # tensor fusion + Compression.fp16 over the mesh.
+        from horovod_tpu.models import BertForSequenceClassification
+        from horovod_tpu.models.bert import classification_loss_fn
+
+        model = BertForSequenceClassification(self._tiny(), num_classes=4)
+        rng = np.random.RandomState(1)
+        ids = jnp.asarray(rng.randint(0, 64, (16, 8)), jnp.int32)
+        labels = jnp.asarray(rng.randint(0, 4, 16), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids[:1])["params"]
+        tx = hvd.DistributedOptimizer(optax.adam(5e-3),
+                                      compression=hvd.Compression.fp16)
+        step = hvd.make_train_step(classification_loss_fn(model), tx,
+                                   donate=False)
+        state = tx.init(params)
+        losses = []
+        for _ in range(12):
+            params, state, loss = step(params, state, (ids, labels))
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.8, losses
+
+    def test_masked_batch_loss_path(self, world_size):
+        # (input_ids, attention_mask, labels) batches reach the model's
+        # key-padding mask through the shipped training path.
+        from horovod_tpu.models import BertForSequenceClassification
+        from horovod_tpu.models.bert import classification_loss_fn
+
+        model = BertForSequenceClassification(self._tiny())
+        rng = np.random.RandomState(2)
+        ids = jnp.asarray(rng.randint(0, 64, (8, 8)), jnp.int32)
+        mask = jnp.ones((8, 8), jnp.int32).at[:, 6:].set(0)
+        labels = jnp.asarray(rng.randint(0, 2, 8), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids[:1])["params"]
+        loss_fn = classification_loss_fn(model)
+        l_masked = loss_fn(params, (ids, mask, labels))
+        # Padded-token identity must not affect the masked loss.
+        ids_b = ids.at[:, 6:].set(jnp.asarray(
+            rng.randint(0, 64, (8, 2)), jnp.int32))
+        l_masked_b = loss_fn(params, (ids_b, mask, labels))
+        np.testing.assert_allclose(float(l_masked), float(l_masked_b),
+                                   rtol=1e-5)
+        l_open = loss_fn(params, (ids_b, labels))
+        assert abs(float(l_open) - float(l_masked_b)) > 1e-6
