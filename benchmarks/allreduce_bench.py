@@ -20,6 +20,10 @@ from __future__ import annotations
 import argparse
 import json
 import time
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> None:
@@ -41,8 +45,6 @@ def main() -> None:
     args = parser.parse_args()
 
     if args.cpu_mesh:
-        import os
-
         os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                                    + " --xla_force_host_platform_device_count=8")
         import jax

@@ -22,7 +22,8 @@ from .basics import (  # noqa: F401
     rank, size, local_rank, local_size, cross_rank, cross_size,
     is_homogeneous,
     mpi_built, nccl_built, gloo_built, ccl_built, cuda_built, rocm_built,
-    xla_built, mpi_threads_supported,
+    ddl_built, xla_built, mpi_enabled, gloo_enabled, xla_enabled,
+    mpi_threads_supported,
     config, global_mesh, start_timeline, stop_timeline,
     NotInitializedError,
 )

@@ -341,8 +341,32 @@ def rocm_built() -> bool:
     return False
 
 
+def ddl_built() -> bool:
+    """Always False (IBM DDL is a legacy GPU backend)."""
+    return False
+
+
 def xla_built() -> bool:
     """True: XLA *is* the collective backend here."""
+    return True
+
+
+def mpi_enabled() -> bool:
+    """Reference: built-AND-enabled-at-runtime check; always False here."""
+    return False
+
+
+def gloo_enabled() -> bool:
+    """Always False — honest matrix: enabled implies built, and no Gloo
+    is built here.  The controller role belongs to `jax.distributed`;
+    see :func:`xla_enabled`."""
+    return False
+
+
+def xla_enabled() -> bool:
+    """The reference's 'some controller is enabled' invariant lands
+    here: XLA collectives + `jax.distributed` rendezvous are always
+    available."""
     return True
 
 

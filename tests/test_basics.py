@@ -41,7 +41,12 @@ def test_feature_matrix():
     assert not hvd.gloo_built()
     assert hvd.nccl_built() == 0
     assert not hvd.cuda_built()
+    assert not hvd.ddl_built()
     assert hvd.xla_built()
+    # Honest matrix: enabled implies built everywhere.
+    assert not hvd.mpi_enabled() and not hvd.gloo_enabled()
+    # The reference's 'some controller is enabled' invariant lands on XLA.
+    assert hvd.xla_enabled() and hvd.xla_built()
 
 
 def test_double_init_is_idempotent():
