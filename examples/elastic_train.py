@@ -13,6 +13,8 @@ adds the durable tier (resume after full-job preemption).
 
 import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import tempfile
 
 if "--tpu" not in sys.argv:

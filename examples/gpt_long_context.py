@@ -9,6 +9,8 @@ Run (CPU, 8 virtual slots → mesh dp=2 sp=2 tp=2):
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 if "--tpu" not in sys.argv:
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
