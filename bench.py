@@ -43,6 +43,20 @@ def main() -> None:
                              "region into this directory")
     args = parser.parse_args()
 
+    if args.preset == "tiny":
+        # CPU smoke: sitecustomize pins jax_platforms to the tunneled
+        # TPU plugin, which can block when the tunnel is unhealthy; the
+        # tiny preset is defined as the CPU-mesh check, so pin it back
+        # (same dance as tests/conftest.py and benchmarks/*).
+        import os as _os
+
+        _os.environ["XLA_FLAGS"] = (
+            _os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
     import jax
     import jax.numpy as jnp
     import optax
@@ -103,34 +117,13 @@ def main() -> None:
             length=args.steps_per_call)
         return params, batch_stats, opt_state, losses[-1]
 
-    # Model FLOPs from the compiled program, for MFU reporting.
-    # cost_analysis() describes the post-SPMD-partitioning PER-DEVICE
-    # module, so chunk_flops = one chip's share of one chunk
-    # (= steps_per_call steps over the per-chip batch).  The AOT
-    # executable is reused for the run itself — lower().compile() does
-    # not populate the jit dispatch cache, and compiling ResNet-50
-    # twice would double startup.
-    chunk_flops = None
-    run_chunk = train_chunk
-    try:
-        compiled = train_chunk.lower(params, batch_stats, opt_state).compile()
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        chunk_flops = float(cost.get("flops", 0.0)) or None
-        run_chunk = compiled
-    except Exception:
-        pass
+    # Model FLOPs (per-device, one chunk = steps_per_call steps over the
+    # per-chip batch) + advertised peak, via the shared MFU harness.
+    from horovod_tpu.utils.mfu import aot_compile_with_flops, peak_tflops
 
-    # Advertised dense bf16 peak per chip (MFU denominator); override
-    # with HVD_TPU_PEAK_TFLOPS for unlisted chips.
-    import os as _os
-
-    _PEAKS = {"TPU v4": 275.0, "TPU v5 lite": 197.0, "TPU v5e": 197.0,
-              "TPU v5": 459.0, "TPU v5p": 459.0, "TPU v6 lite": 918.0,
-              "TPU v6e": 918.0}
-    peak_tflops = float(_os.environ.get("HVD_TPU_PEAK_TFLOPS", 0)) or \
-        _PEAKS.get(jax.devices()[0].device_kind, 0.0)
+    run_chunk, chunk_flops = aot_compile_with_flops(
+        train_chunk, params, batch_stats, opt_state)
+    peak = peak_tflops(jax.devices()[0])
 
     # NOTE: completion fences are scalar readbacks, not
     # block_until_ready — on the tunneled platform only an actual
@@ -176,9 +169,9 @@ def main() -> None:
         out["flops_per_image"] = round(
             chunk_flops / (batch / n_chips * args.steps_per_call) / 1e9,
             3)  # GFLOPs, per-chip flops over the per-chip batch share
-        if peak_tflops:
+        if peak:
             out["mfu_pct"] = round(
-                100.0 * per_chip_flops_s / (peak_tflops * 1e12), 2)
+                100.0 * per_chip_flops_s / (peak * 1e12), 2)
     print(json.dumps(out))
     sys.stdout.flush()
 

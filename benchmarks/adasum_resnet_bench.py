@@ -94,11 +94,9 @@ def main() -> None:
                                            (images, labels))
         return params, opt_state, loss
 
-    run_chunk = chunk
-    try:
-        run_chunk = chunk.lower(params, opt_state).compile()
-    except Exception:
-        pass
+    from horovod_tpu.utils.mfu import aot_compile_with_flops
+
+    run_chunk, _ = aot_compile_with_flops(chunk, params, opt_state)
 
     for _ in range(args.warmup):
         params, opt_state, loss = run_chunk(params, opt_state)

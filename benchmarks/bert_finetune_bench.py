@@ -101,17 +101,9 @@ def main() -> None:
                                                  (ids, labels))
         return params, opt_state, loss
 
-    chunk_flops = None
-    run_chunk = chunk
-    try:
-        compiled = chunk.lower(params, opt_state).compile()
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        chunk_flops = float(cost.get("flops", 0.0)) or None
-        run_chunk = compiled
-    except Exception:
-        pass
+    from horovod_tpu.utils.mfu import aot_compile_with_flops
+
+    run_chunk, chunk_flops = aot_compile_with_flops(chunk, params, opt_state)
 
     for _ in range(args.warmup):
         params, opt_state, loss = run_chunk(params, opt_state)

@@ -1,0 +1,144 @@
+"""GPT training-throughput benchmark (tokens/sec/chip + MFU).
+
+No single-number reference analogue (the reference's transformer config
+is the BERT fine-tune — see ``bert_finetune_bench.py``); this is the
+flagship-model vehicle for the TPU-first perf story: decoder-only GPT
+with the Pallas flash-attention path, bf16 activations, full training
+step (forward + backward + AdamW), `6 * n_params * tokens`-style model
+FLOPs read from the compiled program for MFU.
+
+    python benchmarks/gpt_bench.py                 # TPU chip (GPT ~350M)
+    python benchmarks/gpt_bench.py --preset tiny   # CPU smoke
+
+Prints ONE JSON line like ``bench.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--preset", choices=["full", "tiny"], default="full")
+    parser.add_argument("--batch-size", type=int, default=None)
+    parser.add_argument("--seq-len", type=int, default=None)
+    parser.add_argument("--attention", default=None,
+                        help="full|flash (default: flash on TPU, full on cpu)")
+    parser.add_argument("--vocab-chunk", type=int, default=0,
+                        help=">0: chunked-vocab cross-entropy "
+                             "(ops/xent.py) — [B,T,V] logits never "
+                             "materialized; enables larger batch")
+    parser.add_argument("--warmup", type=int, default=1)
+    parser.add_argument("--iters", type=int, default=4)
+    parser.add_argument("--steps-per-call", type=int, default=5)
+    args = parser.parse_args()
+
+    if args.preset == "tiny":
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=8")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import GPT, GPTConfig
+    from horovod_tpu.models.transformer import lm_loss_fn
+    from horovod_tpu.parallel.train import shard_batch
+
+    hvd.init()
+    gm = hvd.global_mesh()
+    n_chips = hvd.size()
+
+    if args.preset == "tiny":
+        cfg = GPTConfig(vocab_size=512, n_layer=2, n_head=2, d_model=32,
+                        d_ff=64, max_seq_len=128,
+                        attention=args.attention or "full",
+                        dtype=jnp.float32)
+        batch = args.batch_size or 4 * n_chips
+        seq = args.seq_len or 128
+    else:
+        # ~350M-param GPT-medium shape; flash attention on-chip.
+        cfg = GPTConfig(vocab_size=32000, n_layer=24, n_head=16,
+                        d_model=1024, d_ff=4096, max_seq_len=1024,
+                        attention=args.attention or "flash")
+        batch = args.batch_size or 8 * n_chips
+        seq = args.seq_len or 1024
+
+    model = GPT(cfg)
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, cfg.vocab_size, (batch, seq + 1))
+    inputs = jnp.asarray(tokens[:, :-1], jnp.int32)
+    targets = jnp.asarray(tokens[:, 1:], jnp.int32)
+    inputs = shard_batch(inputs, gm.mesh, P(gm.axis_name))
+    targets = shard_batch(targets, gm.mesh, P(gm.axis_name))
+
+    params = model.init(jax.random.PRNGKey(0), inputs[:1])["params"]
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    tx = optax.adamw(3e-4)
+    loss_fn = lm_loss_fn(model, vocab_chunk_size=args.vocab_chunk)
+    step = hvd.make_train_step(loss_fn, tx, donate=False)
+    opt_state = tx.init(params)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def chunk(params, opt_state):
+        loss = jnp.zeros((), jnp.float32)
+        for _ in range(args.steps_per_call):
+            params, opt_state, loss = step(params, opt_state,
+                                           (inputs, targets))
+        return params, opt_state, loss
+
+    from horovod_tpu.utils.mfu import aot_compile_with_flops, peak_tflops
+
+    run_chunk, chunk_flops = aot_compile_with_flops(chunk, params, opt_state)
+    peak = peak_tflops(jax.devices()[0])
+
+    for _ in range(args.warmup):
+        params, opt_state, loss = run_chunk(params, opt_state)
+    if args.warmup:
+        float(loss)  # fence (scalar readback; see bench.py)
+
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        params, opt_state, loss = run_chunk(params, opt_state)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * args.iters * args.steps_per_call / dt
+    out = {
+        "metric": ("gpt_medium_train_tokens_per_sec_per_chip"
+                   if args.preset == "full"
+                   else "gpt_tiny_train_tokens_per_sec_per_chip"),
+        "value": round(tokens_per_sec / n_chips, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": None,
+        "n_params": n_params,
+        "seq_len": seq,
+        "attention": cfg.attention,
+        "vocab_chunk": args.vocab_chunk,
+    }
+    if chunk_flops:
+        per_chip_flops_s = chunk_flops * args.iters / dt
+        out["model_tflops_per_chip"] = round(per_chip_flops_s / 1e12, 2)
+        if peak:
+            out["mfu_pct"] = round(
+                100.0 * per_chip_flops_s / (peak * 1e12), 2)
+    print(json.dumps(out))
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -145,7 +145,7 @@ class GPT(nn.Module):
     mesh: Optional[Mesh] = None
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, return_hidden: bool = False):
         cfg = self.config
         B, T = tokens.shape
         tok_emb = nn.Embed(cfg.vocab_size, cfg.d_model,
@@ -162,15 +162,37 @@ class GPT(nn.Module):
             x = Block(cfg, self.mesh, use_moe=use_moe,
                       name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        if return_hidden:
+            # Pre-head activations for the chunked-vocab loss
+            # (ops/xent.py) — the lm_head matmul happens inside the
+            # chunk loop there instead of materializing [B, T, V] here.
+            return x
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
                           param_dtype=cfg.param_dtype, name="lm_head")(x)
         return logits
 
 
-def lm_loss_fn(model: GPT):
+def lm_loss_fn(model: GPT, *, vocab_chunk_size: int = 0):
     """Next-token cross-entropy: ``loss_fn(params, (inputs, targets))``
     with both ``[B, T]`` (pre-shifted by the data pipeline, so ``T`` stays
-    divisible by the ``sp`` axis under sequence sharding)."""
+    divisible by the ``sp`` axis under sequence sharding).
+
+    ``vocab_chunk_size > 0`` switches to the memory-efficient chunked
+    head (``ops/xent.py``): the ``[B, T, V]`` logits tensor is never
+    materialized — the head matmul + softmax run per token-chunk under
+    remat.  Numerically equal to the dense path at float32 tolerance.
+    """
+    if vocab_chunk_size:
+        from ..ops.xent import chunked_lm_xent
+
+        def loss_fn(params, batch):
+            inputs, targets = batch
+            hidden = model.apply({"params": params}, inputs,
+                                 return_hidden=True)
+            return chunked_lm_xent(hidden, params["lm_head"]["kernel"],
+                                   targets, chunk_size=vocab_chunk_size)
+
+        return loss_fn
 
     def loss_fn(params, batch):
         inputs, targets = batch

@@ -1,0 +1,84 @@
+"""Memory-efficient LM cross-entropy (chunked over tokens).
+
+No reference analogue — the reference delegates losses to the host
+framework (SURVEY.md §2.9: data-parallel only, models are user code).
+This is TPU-first machinery for the in-tree LM family: with a 32k-256k
+vocab, the ``[B, T, V]`` float32 logits tensor is routinely the largest
+activation in the whole step (8×1024×32000×4 B = 1 GiB per chip held
+from forward to backward).  Computing the loss in token chunks under
+``jax.checkpoint`` keeps only ``[chunk, V]`` logits live at any moment;
+the backward pass recomputes each chunk's logits on the fly — the
+standard remat trade: ~1 extra head matmul (MXU-cheap) for an O(T/chunk)
+activation-memory cut (HBM-expensive).
+
+The chunk loop is a ``lax.scan`` (compiler-friendly: one traced body,
+static shapes, no Python unrolling), so compile time stays flat in T.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def chunked_lm_xent(hidden, kernel, targets, *, chunk_size: int = 512,
+                    bias: Optional[jax.Array] = None,
+                    mask: Optional[jax.Array] = None,
+                    compute_dtype=jnp.float32) -> jax.Array:
+    """Mean next-token cross-entropy without materializing full logits.
+
+    Args:
+      hidden: ``[B, T, D]`` pre-head activations (any float dtype).
+      kernel: ``[D, V]`` output-embedding / lm-head matrix.
+      targets: ``[B, T]`` int labels.
+      chunk_size: tokens per chunk (the live-logits budget is
+        ``chunk_size × V × 4`` bytes).
+      bias: optional ``[V]`` head bias.
+      mask: optional ``[B, T]`` float mask (1 = real token); mean is
+        taken over real tokens only.
+      compute_dtype: head-matmul compute dtype.  The float32 default
+        matches the dense ``nn.Dense(dtype=float32)`` lm_head bit-for-
+        bit in spirit (same-precision matmul), keeping the equivalence
+        contract below even for bf16 activations.  Pass
+        ``jnp.bfloat16`` to trade ~1e-2 relative gradient error for the
+        MXU-native fast path.
+
+    Equals ``-mean(log_softmax(hidden @ kernel + bias)[targets])`` to
+    float32 tolerance (softmax statistics are computed in float32).
+    """
+    B, T, D = hidden.shape
+    V = kernel.shape[-1]
+    n = B * T
+    h = hidden.reshape(n, D)
+    t = targets.reshape(n)
+    m = (jnp.ones((n,), jnp.float32) if mask is None
+         else mask.reshape(n).astype(jnp.float32))
+
+    c = max(1, min(chunk_size, n))
+    pad = (-n) % c
+    if pad:
+        h = jnp.concatenate([h, jnp.zeros((pad, D), h.dtype)], axis=0)
+        t = jnp.concatenate([t, jnp.zeros((pad,), t.dtype)], axis=0)
+        m = jnp.concatenate([m, jnp.zeros((pad,), m.dtype)], axis=0)
+    n_chunks = (n + pad) // c
+    hs = h.reshape(n_chunks, c, D)
+    ts = t.reshape(n_chunks, c)
+    ms = m.reshape(n_chunks, c)
+
+    def body(total, xs):
+        hc, tc, mc = xs
+        logits = jnp.dot(hc.astype(compute_dtype),
+                         kernel.astype(compute_dtype),
+                         preferred_element_type=jnp.float32)
+        if bias is not None:
+            logits = logits + bias.astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
+        return total + ((lse - tgt) * mc).sum(), None
+
+    total, _ = lax.scan(jax.checkpoint(body), jnp.zeros((), jnp.float32),
+                        (hs, ts, ms))
+    return total / jnp.maximum(m.sum(), 1.0)

@@ -1,0 +1,52 @@
+"""MFU accounting shared by the benchmarks.
+
+One place for (a) the advertised dense-bf16 peak table and (b) the
+AOT-compile + ``cost_analysis`` flops readout, so every benchmark
+reports a consistent ``mfu_pct`` for the same hardware.
+
+``cost_analysis()`` describes the post-SPMD-partitioning PER-DEVICE
+module, so the returned flops are one chip's share of one call.  The
+compiled executable is returned for reuse — ``lower().compile()`` does
+not populate the jit dispatch cache, and compiling twice would double
+benchmark startup.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+# Advertised dense bf16 peak TFLOP/s per chip; override with
+# HVD_TPU_PEAK_TFLOPS for unlisted chips.
+PEAK_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v5": 459.0,
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+}
+
+
+def peak_tflops(device) -> float:
+    """Peak for ``device`` (a jax Device), env override first; 0.0 when
+    unknown (callers then omit mfu_pct rather than report nonsense)."""
+    env = float(os.environ.get("HVD_TPU_PEAK_TFLOPS", 0) or 0)
+    if env:
+        return env
+    return PEAK_TFLOPS.get(getattr(device, "device_kind", ""), 0.0)
+
+
+def aot_compile_with_flops(jitted, *args) -> Tuple[Any, Optional[float]]:
+    """AOT-compile ``jitted(*args)``; returns ``(runnable, flops)`` where
+    ``runnable`` is the compiled executable (or ``jitted`` unchanged if
+    AOT fails) and ``flops`` the per-device flops of one call (or None)."""
+    try:
+        compiled = jitted.lower(*args).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        return compiled, (float(cost.get("flops", 0.0)) or None)
+    except Exception:
+        return jitted, None
