@@ -73,6 +73,10 @@ def check_build_str() -> str:
         f"    [{'X' if 'built' in native_line and 'not' not in native_line else ' '}] {native_line}",
         "    [X] Pallas kernels (flash attention; ring-attention "
         "flash engine)",
+        "    [X] wire compression (fp16, bf16, int8 "
+        "transport-quantized allreduce)",
+        "    [X] chunked-vocab LM cross-entropy (no [B,T,V] logits "
+        "materialization)",
         "",
         "Parallelism:",
         "    [X] data parallel (+Adasum any world size, elastic, "
