@@ -54,7 +54,8 @@ from .version import __version__  # noqa: F401
 # The optimizer layer depends on optax; keep it a lazy attribute (PEP 562)
 # so collectives-only usage works in optax-less environments.
 _OPTIM_EXPORTS = ("DistributedOptimizer", "make_train_step",
-                  "DistributedOptimizerState", "make_zero_train_step")
+                  "DistributedOptimizerState", "make_zero_train_step",
+                  "make_fsdp_train_step")
 
 
 def __getattr__(name):

@@ -1,0 +1,119 @@
+"""FSDP / ZeRO-3: parameters, gradients AND optimizer state sharded.
+
+Beyond the reference (SURVEY.md §2.9: FSDP/ZeRO absent in Horovod).
+Where ZeRO-1 (:mod:`.zero`) shards only optimizer state via explicit
+reduce-scatter/all-gather inside ``shard_map``, full FSDP is expressed
+the GSPMD way: **parameters live sharded** (each leaf's largest
+divisible axis split over the mesh), the batch is sharded over the same
+axis, and XLA's SPMD partitioner inserts the FSDP communication pattern
+itself — all-gather each layer's parameters just before use, discard
+after, reduce-scatter the gradients back to the owning shard.  That is
+the entire FSDP recipe; there is no wrapper class because the compiler
+does the orchestration the reference-era frameworks hand-build.
+
+Per-chip memory: parameters, gradients and optimizer state all drop to
+~1/n (+ one transiently gathered layer), vs 1/n optimizer-state-only
+for ZeRO-1.  Unlike ZeRO-1's flat-shard update, the optimizer here
+operates on *global logical arrays* (GSPMD partitions the update
+under the hood), so whole-tensor transforms — ``clip_by_global_norm``,
+LAMB trust ratios — compute correctly and match DP exactly.
+
+Usage::
+
+    shard, step = make_fsdp_train_step(loss_fn, optax.adamw(3e-4))
+    params, opt_state = shard(params)        # leaves land sharded
+    params, opt_state, loss = step(params, opt_state, batch)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def fsdp_spec(leaf, n: int, axis: str) -> P:
+    """PartitionSpec sharding ``leaf``'s largest ``n``-divisible axis;
+    replicated when nothing divides (small biases/scalars — their bytes
+    don't matter)."""
+    shape = getattr(leaf, "shape", ())
+    candidates = [(s, i) for i, s in enumerate(shape) if s % n == 0 and s >= n]
+    if not candidates:
+        return P()
+    _, dim = max(candidates)
+    spec = [None] * len(shape)
+    spec[dim] = axis
+    return P(*spec)
+
+
+def make_fsdp_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    *,
+    mesh=None,
+    axis_name: Optional[str] = None,
+    has_aux: bool = False,
+    donate: bool = True,
+):
+    """Build ``(shard, step)`` for FSDP training over the framework mesh.
+
+    ``shard(params)`` places parameters sharded per :func:`fsdp_spec`
+    and returns ``(params, opt_state)`` (optimizer state inherits each
+    parameter's sharding).  ``step(params, opt_state, batch)`` is one
+    compiled SPMD program returning ``(params, opt_state, loss[, aux])``
+    with everything still sharded; ``batch`` shards along its leading
+    axis.  Gradient averaging over the data axis is implicit in GSPMD
+    (the batch is sharded, so the partitioner emits the reduce-scatter).
+    """
+    from .distributed_optimizer import resolve_mesh_axis
+
+    mesh_obj, axis = resolve_mesh_axis(mesh, axis_name)
+    n = mesh_obj.shape[axis]
+
+    def _sharding(leaf):
+        return NamedSharding(mesh_obj, fsdp_spec(leaf, n, axis))
+
+    def shard(params):
+        params = jax.tree.map(
+            lambda l: jax.device_put(l, _sharding(l)), params)
+        opt_state = jax.jit(
+            optimizer.init,
+            out_shardings=jax.tree.map(_sharding, jax.eval_shape(
+                optimizer.init, params)),
+        )(params)
+        return params, opt_state
+
+    batch_sharding = NamedSharding(mesh_obj, P(axis))
+
+    def step_fn(params, opt_state, batch):
+        # Pin the parameter layout so the partitioner gathers per-use
+        # and reduce-scatters grads back to the owner shard (FSDP), and
+        # can't decide to keep anything replicated.
+        params = jax.tree.map(
+            lambda l: lax.with_sharding_constraint(
+                l, _sharding(l)), params)
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
+        if has_aux:
+            (loss, aux), grads = grad_fn(params, batch)
+        else:
+            loss, grads = grad_fn(params, batch)
+        grads = jax.tree.map(
+            lambda g, l: lax.with_sharding_constraint(g, _sharding(l)),
+            grads, params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        if has_aux:
+            return params, opt_state, loss, aux
+        return params, opt_state, loss
+
+    step = jax.jit(
+        step_fn,
+        # Prefix semantics: one sharding applies to every batch leaf;
+        # None keeps params/opt_state wherever shard() placed them.
+        in_shardings=(None, None, batch_sharding),
+        donate_argnums=(0, 1) if donate else (),
+    )
+    return shard, step

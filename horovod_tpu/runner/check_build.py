@@ -84,6 +84,8 @@ def check_build_str() -> str:
         "    [X] tensor parallel (Megatron column/row rules)",
         "    [X] sequence/context parallel (ring attention, Ulysses)",
         "    [X] ZeRO-1 sharded optimizer state (make_zero_train_step)",
+        "    [X] FSDP / ZeRO-3 (make_fsdp_train_step, GSPMD-sharded "
+        "params+grads+state)",
         "",
         "Launchers:",
         "    [X] local multi-process (-np N)",
