@@ -56,6 +56,7 @@ def size() -> int:
 from ..process_sets import (  # noqa: F401
     ProcessSet, add_process_set, remove_process_set, global_process_set,
 )
+from . import elastic  # noqa: F401  (TensorFlowKerasState)
 from .compression import Compression  # noqa: F401
 from .functions import (  # noqa: F401
     allgather_object, broadcast_model, broadcast_object, broadcast_variables,

@@ -1,0 +1,78 @@
+"""TF/Keras elastic state — reference parity with
+``horovod.tensorflow.elastic``.
+
+Reference: ``horovod/tensorflow/elastic.py`` (``TensorFlowKerasState``
+holding host copies of model weights + optimizer variables) — path per
+SURVEY.md §2.4, mount empty, unverified.  Keras-callback companions live
+in :mod:`horovod_tpu.tensorflow.keras.elastic`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..elastic.state import ObjectState
+from .functions import broadcast_object, broadcast_variables
+
+
+def _optimizer_variables(optimizer):
+    """Keras-3 optimizers expose ``variables`` (list); tf.keras legacy
+    exposed ``variables()``.  Normalize to a list."""
+    v = getattr(optimizer, "variables", None)
+    if callable(v):
+        v = v()
+    return list(v or [])
+
+
+class TensorFlowKerasState(ObjectState):
+    """Elastic state over a Keras model/optimizer + plain attributes
+    (reference: ``hvd.elastic.TensorFlowKerasState(model, optimizer,
+    batch=0, epoch=0)``)."""
+
+    def __init__(self, model=None, optimizer=None, **kwargs: Any) -> None:
+        self._model = model
+        self._optimizer = optimizer
+        self._weights_saved: Optional[list] = None
+        self._opt_saved: Optional[list] = None
+        super().__init__(**kwargs)  # calls commit()
+
+    def commit(self) -> None:
+        import numpy as np
+
+        if self._model is not None:
+            self._weights_saved = [np.array(w)
+                                   for w in self._model.get_weights()]
+        if self._optimizer is not None:
+            self._opt_saved = [np.array(v.numpy())
+                               for v in _optimizer_variables(self._optimizer)]
+        super().commit()
+
+    def restore(self) -> None:
+        import tensorflow as tf
+
+        if self._model is not None and self._weights_saved is not None:
+            # set_weights copies; no defensive deepcopy needed.
+            self._model.set_weights(self._weights_saved)
+        if self._optimizer is not None and self._opt_saved is not None:
+            opt_vars = _optimizer_variables(self._optimizer)
+            for var, saved in zip(opt_vars, self._opt_saved):
+                var.assign(saved)
+            # Slot variables created AFTER the commit (e.g. momentum
+            # slots materialized by the first train step) did not exist
+            # at the committed moment: reset them to their zero init so
+            # optimizer state matches the rolled-back weights.
+            for var in opt_vars[len(self._opt_saved):]:
+                var.assign(tf.zeros_like(var))
+        super().restore()
+
+    def sync(self) -> None:
+        if self._model is not None:
+            broadcast_variables(self._model.variables, root_rank=0)
+        if self._optimizer is not None:
+            opt_vars = _optimizer_variables(self._optimizer)
+            if opt_vars:
+                broadcast_variables(opt_vars, root_rank=0)
+        synced = broadcast_object(self._public_attrs(), root_rank=0)
+        for k, v in synced.items():
+            setattr(self, k, v)
+        self.commit()

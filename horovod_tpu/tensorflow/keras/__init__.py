@@ -17,6 +17,7 @@ from .. import rank, size  # noqa: F401  (process-level, not slot-level)
 from ..compression import Compression  # noqa: F401
 from ..functions import broadcast_model, broadcast_variables  # noqa: F401
 from . import callbacks  # noqa: F401
+from . import elastic  # noqa: F401  (CommitState/UpdateBatchState/UpdateEpochState)
 
 
 def DistributedOptimizer(optimizer, **kwargs):

@@ -1,0 +1,65 @@
+"""Elastic Keras callbacks — reference parity with
+``horovod.tensorflow.keras.elastic`` (``hvd.elastic.keras`` tier).
+
+Reference: ``CommitStateCallback`` (commit the elastic state every N
+batches), ``UpdateBatchStateCallback`` / ``UpdateEpochStateCallback``
+(track training position in the state so a restored worker resumes
+mid-epoch) — path per SURVEY.md §2.4, mount empty, unverified.
+"""
+
+from __future__ import annotations
+
+try:
+    import tensorflow as tf
+except ImportError as _e:  # pragma: no cover - tf is baked into the image
+    raise ImportError("horovod_tpu.tensorflow.keras requires tensorflow") \
+        from _e
+
+
+class CommitStateCallback(tf.keras.callbacks.Callback):
+    """Commit ``state`` every ``batches_per_commit`` batches (reference
+    default: every batch — frequent commits trade step time for smaller
+    rollback windows)."""
+
+    def __init__(self, state, batches_per_commit: int = 1) -> None:
+        super().__init__()
+        self.state = state
+        self.batches_per_commit = max(1, int(batches_per_commit))
+
+    def on_batch_end(self, batch, logs=None):
+        if (batch + 1) % self.batches_per_commit == 0:
+            self.state.commit()
+
+
+class UpdateBatchStateCallback(tf.keras.callbacks.Callback):
+    """Track the batch position in ``state.batch``; resets to 0 when the
+    epoch completes.
+
+    Note: unlike the reference's graph-era callback, this does NOT try
+    to shorten the resumed epoch — Keras 3's training loop ignores
+    ``Callback.params`` mutations, so fast-forwarding past the
+    ``state.batch`` already-trained batches belongs to the data
+    pipeline (e.g. ``dataset.skip(state.batch)`` before the resumed
+    ``fit``)."""
+
+    def __init__(self, state) -> None:
+        super().__init__()
+        self.state = state
+
+    def on_batch_end(self, batch, logs=None):
+        self.state.batch = batch + 1
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.state.batch = 0
+
+
+class UpdateEpochStateCallback(tf.keras.callbacks.Callback):
+    """Track the epoch position in ``state.epoch`` (resume training from
+    the interrupted epoch, not epoch 0)."""
+
+    def __init__(self, state) -> None:
+        super().__init__()
+        self.state = state
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.state.epoch = epoch + 1

@@ -49,6 +49,7 @@ from .functions import (  # noqa: F401
 from .optimizer import DistributedOptimizer  # noqa: F401
 from .sync_batch_norm import SyncBatchNorm  # noqa: F401
 from ..elastic.sampler import ElasticSampler  # noqa: F401
+from . import elastic  # noqa: F401  (hvd.torch.elastic.TorchState/run)
 
 
 def rank() -> int:

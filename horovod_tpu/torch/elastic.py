@@ -1,0 +1,70 @@
+"""Torch elastic state — reference parity with ``horovod.torch.elastic``.
+
+Reference: ``horovod/torch/elastic/state.py`` (``TorchState`` holding
+CPU-side copies of module/optimizer state dicts, restored on rollback,
+broadcast on sync) — path per SURVEY.md §2.4, mount empty, unverified.
+
+Same commit/restore/sync contract as the core :class:`.state.ObjectState`:
+``commit()`` deep-copies ``state_dict()``s to host memory, ``restore()``
+loads them back, ``sync()`` broadcasts rank 0's tensors and plain
+attributes to everyone.  Use with ``@hvd.elastic.run`` exactly like the
+reference::
+
+    state = TorchState(model=model, optimizer=opt, batch=0, epoch=0)
+
+    @hvd.elastic.run
+    def train(state):
+        for state.batch in range(state.batch, n_batches):
+            ...
+            state.commit()
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Optional
+
+from ..elastic.sampler import ElasticSampler  # noqa: F401  (reference layout)
+from ..elastic.state import ObjectState, run  # noqa: F401  (hvd.torch.elastic.run)
+from .functions import (
+    broadcast_object,
+    broadcast_optimizer_state,
+    broadcast_parameters,
+)
+
+
+class TorchState(ObjectState):
+    """Elastic state over torch modules/optimizers + plain attributes."""
+
+    def __init__(self, model=None, optimizer=None, **kwargs: Any) -> None:
+        self._model = model
+        self._optimizer = optimizer
+        self._model_saved: Optional[dict] = None
+        self._opt_saved: Optional[dict] = None
+        super().__init__(**kwargs)  # calls commit()
+
+    def commit(self) -> None:
+        if self._model is not None:
+            self._model_saved = copy.deepcopy(self._model.state_dict())
+        if self._optimizer is not None:
+            self._opt_saved = copy.deepcopy(self._optimizer.state_dict())
+        super().commit()
+
+    def restore(self) -> None:
+        # load_state_dict copies tensor data (module) / deep-copies its
+        # input (optimizer) — no defensive deepcopy on top.
+        if self._model is not None and self._model_saved is not None:
+            self._model.load_state_dict(self._model_saved)
+        if self._optimizer is not None and self._opt_saved is not None:
+            self._optimizer.load_state_dict(self._opt_saved)
+        super().restore()
+
+    def sync(self) -> None:
+        if self._model is not None:
+            broadcast_parameters(self._model.state_dict(), root_rank=0)
+        if self._optimizer is not None:
+            broadcast_optimizer_state(self._optimizer, root_rank=0)
+        synced = broadcast_object(self._public_attrs(), root_rank=0)
+        for k, v in synced.items():
+            setattr(self, k, v)
+        self.commit()

@@ -1,0 +1,156 @@
+"""Framework-tier elastic states (reference: horovod.torch.elastic
+TorchState, horovod.tensorflow.elastic TensorFlowKerasState, and the
+hvd.elastic.keras callbacks — SURVEY.md §2.4, mount empty, unverified).
+"""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+
+class TestTorchState:
+    def _setup(self):
+        import torch
+
+        model = torch.nn.Linear(4, 2)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+        return torch, model, opt
+
+    def test_commit_restore_roundtrip(self, world_size):
+        torch, model, opt = self._setup()
+        from horovod_tpu.torch.elastic import TorchState
+
+        state = TorchState(model=model, optimizer=opt, batch=3, epoch=1)
+        w0 = {k: v.clone() for k, v in model.state_dict().items()}
+
+        # take a real step so optimizer state materializes, then commit
+        loss = model(torch.randn(8, 4)).sum()
+        loss.backward()
+        opt.step()
+        state.batch = 5
+        state.commit()
+        w1 = {k: v.clone() for k, v in model.state_dict().items()}
+
+        # corrupt everything, then roll back to the commit
+        with torch.no_grad():
+            for p in model.parameters():
+                p.add_(100.0)
+        state.batch = 99
+        state.restore()
+        for k, v in model.state_dict().items():
+            assert torch.allclose(v, w1[k]), k
+            assert not torch.allclose(v, w0[k] + 100.0), k
+        assert state.batch == 5 and state.epoch == 1
+        # momentum buffers restored too
+        assert opt.state_dict()["state"], "optimizer state missing"
+
+    def test_sync_broadcast_runs(self, world_size):
+        torch, model, opt = self._setup()
+        from horovod_tpu.torch.elastic import TorchState
+
+        state = TorchState(model=model, optimizer=opt, batch=0)
+        state.sync()  # single controller: broadcast is identity; must not raise
+        assert state.batch == 0
+
+    def test_reference_module_layout(self, world_size):
+        # hvd.torch.elastic.{TorchState, run, ElasticSampler} — the
+        # reference import shape.
+        import horovod_tpu.torch as hvt
+
+        assert hasattr(hvt.elastic, "TorchState")
+        assert hasattr(hvt.elastic, "run")
+        assert hasattr(hvt.elastic, "ElasticSampler")
+
+
+class TestTensorFlowKerasState:
+    def _setup(self):
+        import tensorflow as tf
+
+        model = tf.keras.Sequential(
+            [tf.keras.layers.Dense(2, input_shape=(4,))])
+        opt = tf.keras.optimizers.SGD(0.1, momentum=0.9)
+        model.compile(optimizer=opt, loss="mse")
+        return tf, model, opt
+
+    def test_commit_restore_roundtrip(self, world_size):
+        tf, model, opt = self._setup()
+        from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
+
+        x = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+        y = np.zeros((16, 2), np.float32)
+        model.fit(x, y, epochs=1, verbose=0)
+        state = TensorFlowKerasState(model=model, optimizer=opt,
+                                     batch=2, epoch=1)
+        w1 = [w.copy() for w in model.get_weights()]
+
+        model.set_weights([w + 100.0 for w in model.get_weights()])
+        state.batch = 77
+        state.restore()
+        for got, want in zip(model.get_weights(), w1):
+            np.testing.assert_allclose(got, want)
+        assert state.batch == 2 and state.epoch == 1
+
+    def test_sync_runs(self, world_size):
+        tf, model, opt = self._setup()
+        from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
+
+        state = TensorFlowKerasState(model=model, batch=0)
+        state.sync()
+        assert state.batch == 0
+
+
+    def test_restore_resets_late_created_slot_vars(self, world_size):
+        # Commit BEFORE the first train step (documented pattern): the
+        # momentum slots don't exist yet.  After a step creates them, a
+        # rollback must zero them (the committed moment had none) —
+        # review-r3 regression for the zip()-truncation bug.
+        tf, model, opt = self._setup()
+        from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
+
+        state = TensorFlowKerasState(model=model, optimizer=opt, batch=0)
+        n_saved = len(state._opt_saved)
+        x = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+        y = np.ones((16, 2), np.float32)
+        model.fit(x, y, epochs=1, verbose=0)
+
+        from horovod_tpu.tensorflow.elastic import _optimizer_variables
+        assert len(_optimizer_variables(opt)) > n_saved, \
+            "test premise: fit must create slot variables"
+        state.restore()
+        for var in _optimizer_variables(opt)[n_saved:]:
+            np.testing.assert_allclose(np.asarray(var), 0.0, atol=0,
+                                       err_msg=var.name)
+
+
+class TestElasticKerasCallbacks:
+    def test_fit_with_elastic_callbacks(self, world_size):
+        import tensorflow as tf
+
+        from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
+        from horovod_tpu.tensorflow.keras.elastic import (
+            CommitStateCallback,
+            UpdateBatchStateCallback,
+            UpdateEpochStateCallback,
+        )
+
+        model = tf.keras.Sequential(
+            [tf.keras.layers.Dense(1, input_shape=(4,))])
+        model.compile(optimizer="sgd", loss="mse")
+        state = TensorFlowKerasState(model=model, batch=0, epoch=0)
+
+        commits = []
+        orig_commit = state.commit
+        state.commit = lambda: (commits.append(True), orig_commit())[1]
+
+        x = np.random.RandomState(0).randn(32, 4).astype(np.float32)
+        y = np.zeros((32, 1), np.float32)
+        model.fit(x, y, batch_size=8, epochs=2, verbose=0, callbacks=[
+            CommitStateCallback(state, batches_per_commit=2),
+            UpdateBatchStateCallback(state),
+            UpdateEpochStateCallback(state),
+        ])
+        # 4 batches/epoch x 2 epochs, committed every 2nd batch
+        assert len(commits) == 4, commits
+        assert state.epoch == 2
+        assert state.batch == 0  # reset at each epoch end
