@@ -13,10 +13,11 @@ Layering (TPU-native redesign of reference ``horovod/common/ops/`` — SURVEY.md
 from .collectives import (  # noqa: F401
     Sum, Average, Adasum, Min, Max, Product,
     allreduce, allreduce_async, grouped_allreduce, grouped_allreduce_async,
-    allgather, allgather_async, grouped_allgather,
+    allgather, allgather_async, grouped_allgather, grouped_allgather_async,
     broadcast, broadcast_async,
     alltoall, alltoall_async,
     reducescatter, reducescatter_async, grouped_reducescatter,
+    grouped_reducescatter_async,
     barrier, synchronize, poll, join,
     Handle,
 )

@@ -696,9 +696,30 @@ def allgather_async(tensor, *, process_set=None, name: str = "allgather"):
 def grouped_allgather(tensors: Sequence[Any], *, process_set=None,
                       name: str = "grouped_allgather") -> List[Any]:
     """Reference: ``hvd.grouped_allgather``."""
-    handles = [allgather_async(t, process_set=process_set, name=f"{name}[{i}]")
-               for i, t in enumerate(tensors)]
-    return [h.result() for h in handles]
+    return grouped_allgather_async(tensors, process_set=process_set,
+                                   name=name).result()
+
+
+class _GroupHandle(Handle):
+    """Aggregate of per-member handles (works over both the slot-tier
+    :class:`Handle` and the multi-controller ``HostHandle`` — both
+    expose ``result()``/``done()``)."""
+
+    def result(self) -> List[Any]:
+        return [h.result() for h in self._value]
+
+    def done(self) -> bool:
+        return all(h.done() for h in self._value)
+
+
+def grouped_allgather_async(tensors: Sequence[Any], *, process_set=None,
+                            name: str = "grouped_allgather") -> Handle:
+    """Reference: ``hvd.grouped_allgather_async`` — one handle for the
+    whole group; members dispatch back-to-back in list order (the
+    cross-controller ordering contract)."""
+    return _GroupHandle(
+        [allgather_async(t, process_set=process_set, name=f"{name}[{i}]")
+         for i, t in enumerate(tensors)], name)
 
 
 def broadcast(tensor, root_rank: int = 0, *, process_set=None,
@@ -776,6 +797,15 @@ def grouped_reducescatter(tensors, *, op: str = Sum, process_set=None,
                           name: str = "grouped_reducescatter"):
     return [reducescatter(t, op=op, process_set=process_set,
                           name=f"{name}[{i}]") for i, t in enumerate(tensors)]
+
+
+def grouped_reducescatter_async(tensors, *, op: str = Sum, process_set=None,
+                                name: str = "grouped_reducescatter") -> Handle:
+    """Reference: ``hvd.grouped_reducescatter_async``."""
+    return _GroupHandle(
+        [reducescatter_async(t, op=op, process_set=process_set,
+                             name=f"{name}[{i}]")
+         for i, t in enumerate(tensors)], name)
 
 
 def join() -> int:

@@ -258,3 +258,28 @@ class TestProcessSets:
     def test_out_of_range_rank_raises(self, world_size):
         with pytest.raises(ValueError, match="out of range"):
             hvd.add_process_set([0, world_size])
+
+
+def test_grouped_allgather_async(world_size):
+    xs = [_per_slot(world_size, 1, np.float32, seed=i) for i in range(3)]
+    h = hvd.grouped_allgather_async([jnp.asarray(x) for x in xs])
+    assert isinstance(hvd.poll(h), bool)
+    outs = hvd.synchronize(h)
+    assert len(outs) == 3
+    for x, out in zip(xs, outs):
+        np.testing.assert_allclose(np.asarray(out),
+                                   x.reshape(-1, *x.shape[2:]))
+
+
+def test_grouped_reducescatter_async(world_size):
+    rng = np.random.RandomState(11)
+    xs = [rng.randn(world_size, world_size * 2, 3).astype(np.float32)
+          for _ in range(2)]
+    h = hvd.grouped_reducescatter_async([jnp.asarray(x) for x in xs],
+                                        op=hvd.Sum)
+    outs = hvd.synchronize(h)
+    assert len(outs) == 2
+    for x, out in zip(xs, outs):
+        np.testing.assert_allclose(
+            np.asarray(out), x.sum(axis=0).reshape(world_size, 2, 3),
+            rtol=1e-4)
