@@ -31,6 +31,16 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--preset", choices=["full", "tiny"], default="full",
                         help="tiny = CPU smoke test (small model/batch)")
+    parser.add_argument("--model", default="resnet50",
+                        choices=["resnet50", "resnet101", "vgg16",
+                                 "inception3"],
+                        help="full-preset model (reference benchmark "
+                             "family: docs/benchmarks.rst rows)")
+    parser.add_argument("--fp16-allreduce", action="store_true",
+                        help="reference flag: explicit DistributedOptimizer "
+                             "gradient allreduce with Compression.fp16 "
+                             "(instead of the implicit GSPMD batch-grad "
+                             "psum)")
     parser.add_argument("--batch-size", type=int, default=None)
     parser.add_argument("--warmup", type=int, default=2)
     parser.add_argument("--iters", type=int, default=6,
@@ -63,7 +73,9 @@ def main() -> None:
     from jax.sharding import PartitionSpec as P
 
     import horovod_tpu as hvd
-    from horovod_tpu.models import ResNet18, ResNet50
+    from horovod_tpu.models import (
+        InceptionV3, ResNet18, ResNet50, ResNet101, VGG16,
+    )
     from horovod_tpu.parallel.train import shard_batch
 
     hvd.init()
@@ -75,9 +87,16 @@ def main() -> None:
         batch = args.batch_size or 8 * n_chips
         hw = 32
     else:
-        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
-        batch = args.batch_size or 256 * n_chips
-        hw = 224
+        # The reference benchmark family (docs/benchmarks.rst rows).
+        # Default per-chip batches sized to v5e-class HBM.
+        cls, hw, per_chip = {
+            "resnet50": (ResNet50, 224, 256),
+            "resnet101": (ResNet101, 224, 160),
+            "vgg16": (VGG16, 224, 128),
+            "inception3": (InceptionV3, 299, 128),
+        }[args.model]
+        model = cls(num_classes=1000, dtype=jnp.bfloat16)
+        batch = args.batch_size or per_chip * n_chips
 
     rng = np.random.RandomState(0)
     images = jnp.asarray(rng.randn(batch, hw, hw, 3), jnp.bfloat16
@@ -88,41 +107,94 @@ def main() -> None:
     labels = shard_batch(labels, gm.mesh, P(gm.axis_name))
 
     variables = model.init(jax.random.PRNGKey(0), images[:2])
-    params, batch_stats = variables["params"], variables["batch_stats"]
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats")  # None for BN-free VGG
     tx = optax.sgd(0.1, momentum=0.9)
-    opt_state = tx.init(params)
 
-    def train_step(carry, _):
-        params, batch_stats, opt_state = carry
+    def apply_model(p, stats, imgs):
+        if stats is None:
+            return model.apply({"params": p}, imgs), None
+        logits, mutated = model.apply(
+            {"params": p, "batch_stats": stats}, imgs,
+            mutable=["batch_stats"])
+        return logits, mutated["batch_stats"]
 
-        def loss_fn(p):
-            logits, mutated = model.apply(
-                {"params": p, "batch_stats": batch_stats}, images,
-                mutable=["batch_stats"])
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
-            loss = -jnp.mean(
-                jnp.take_along_axis(logp, labels[:, None], axis=-1))
-            return loss, mutated["batch_stats"]
+    def xent(logits, labs):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        return -jnp.mean(jnp.take_along_axis(logp, labs[:, None], axis=-1))
 
-        (loss, new_stats), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return (params, new_stats, opt_state), loss
+    if args.fp16_allreduce:
+        # The reference's --fp16-allreduce: explicit gradient allreduce
+        # through DistributedOptimizer with fp16 wire compression (BN
+        # statistics frozen for the throughput run, like the adasum
+        # benchmark).  make_train_step shards the batch per slot.
+        def loss_fn(p, batch_):
+            logits, _ = apply_model(p, batch_stats, batch_[0])
+            return xent(logits, batch_[1])
 
-    @partial(jax.jit, donate_argnums=(0, 1, 2))
-    def train_chunk(params, batch_stats, opt_state):
-        (params, batch_stats, opt_state), losses = jax.lax.scan(
-            train_step, (params, batch_stats, opt_state), None,
-            length=args.steps_per_call)
-        return params, batch_stats, opt_state, losses[-1]
+        dtx = hvd.DistributedOptimizer(tx,
+                                       compression=hvd.Compression.fp16)
+        inner = hvd.make_train_step(loss_fn, dtx, donate=False)
+        opt_state = dtx.init(params)
 
-    # Model FLOPs (per-device, one chunk = steps_per_call steps over the
-    # per-chip batch) + advertised peak, via the shared MFU harness.
+        def make_chunk(length):
+            @partial(jax.jit, donate_argnums=(0, 1))
+            def train_chunk(params, opt_state):
+                def body(carry, _):
+                    p, o = carry
+                    p, o, loss = inner(p, o, (images, labels))
+                    return (p, o), loss
+
+                (params, opt_state), losses = jax.lax.scan(
+                    body, (params, opt_state), None, length=length)
+                return params, opt_state, losses[-1]
+
+            return train_chunk
+
+        state = (params, opt_state)
+    else:
+        opt_state = tx.init(params)
+
+        def train_step(carry, _):
+            params, stats, opt_state = carry
+
+            def loss_fn(p):
+                logits, new_stats = apply_model(p, stats, images)
+                return xent(logits, labels), new_stats
+
+            (loss, new_stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, new_stats if new_stats is not None else stats,
+                    opt_state), loss
+
+        def make_chunk(length):
+            @partial(jax.jit, donate_argnums=(0, 1, 2))
+            def train_chunk(params, stats, opt_state):
+                (params, stats, opt_state), losses = jax.lax.scan(
+                    train_step, (params, stats, opt_state), None,
+                    length=length)
+                return params, stats, opt_state, losses[-1]
+
+            return train_chunk
+
+        state = (params, batch_stats, opt_state)
+
+    def unpack(out):  # (*state, loss) -> state tuple, loss
+        return out[:-1], out[-1]
+
+    # Model FLOPs + advertised peak, via the shared MFU harness.
+    # cost_analysis() counts a lax.scan BODY ONCE regardless of trip
+    # count (measured: flops_per_image scaled as 1/steps_per_call), so
+    # flops come from an AOT-lowered length-1 chunk, scaled by
+    # steps_per_call; the length-N chunk is what actually runs.
     from horovod_tpu.utils.mfu import aot_compile_with_flops, peak_tflops
 
-    run_chunk, chunk_flops = aot_compile_with_flops(
-        train_chunk, params, batch_stats, opt_state)
+    run_chunk, _ = aot_compile_with_flops(
+        make_chunk(args.steps_per_call), *state)
+    _, step_flops = aot_compile_with_flops(make_chunk(1), *state)
+    chunk_flops = (step_flops * args.steps_per_call) if step_flops else None
     peak = peak_tflops(jax.devices()[0])
 
     # NOTE: completion fences are scalar readbacks, not
@@ -132,8 +204,7 @@ def main() -> None:
     # tunnel round-trip is amortized over all iters instead of paid per
     # chunk.
     for _ in range(args.warmup):
-        params, batch_stats, opt_state, loss = run_chunk(
-            params, batch_stats, opt_state)
+        state, loss = unpack(run_chunk(*state))
     if args.warmup:
         float(loss)  # fence: warmup fully done before the clock starts
 
@@ -144,8 +215,7 @@ def main() -> None:
     with prof_ctx:
         t0 = time.perf_counter()
         for _ in range(args.iters):
-            params, batch_stats, opt_state, loss = run_chunk(
-                params, batch_stats, opt_state)
+            state, loss = unpack(run_chunk(*state))
         float(loss)  # single end-of-run fence
         dt = time.perf_counter() - t0
 
@@ -153,15 +223,19 @@ def main() -> None:
     per_chip = imgs_per_sec / n_chips
     baseline_per_chip = 2500.0  # see module docstring
     out = {
-        "metric": "resnet50_images_per_sec_per_chip"
-                  if args.preset == "full" else "resnet18_tiny_images_per_sec",
+        "metric": (f"{args.model}_images_per_sec_per_chip"
+                   if args.preset == "full"
+                   else "resnet18_tiny_images_per_sec"),
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         # The 2500 img/s denominator is a ResNet-50/224px number — only
-        # meaningful for the full preset.
+        # meaningful for the default full preset.
         "vs_baseline": (round(per_chip / baseline_per_chip, 4)
-                        if args.preset == "full" else None),
+                        if args.preset == "full"
+                        and args.model == "resnet50" else None),
     }
+    if args.fp16_allreduce:
+        out["fp16_allreduce"] = True
     if chunk_flops:
         # chunk_flops is per-device (see above): per-chip rate directly.
         per_chip_flops_s = chunk_flops * args.iters / dt

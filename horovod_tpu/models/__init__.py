@@ -12,6 +12,7 @@ from .bert import (  # noqa: F401
     BertForMaskedLM,
     BertForSequenceClassification,
 )
+from .convnets import InceptionV3, VGG16  # noqa: F401
 from .mlp import MLP  # noqa: F401
 from .resnet import ResNet18, ResNet50, ResNet101, SyncBatchNorm  # noqa: F401
 from .transformer import GPT, GPTConfig  # noqa: F401

@@ -1,0 +1,172 @@
+"""VGG-16 and Inception-V3 — the reference's scaling-benchmark models.
+
+Reference: ``docs/benchmarks.rst`` / the Horovod paper's headline table
+(SURVEY.md §6, mount empty, unverified) reports scaling efficiency for
+ResNet-101, **Inception-V3** (~90% of linear) and **VGG-16** (~68%,
+communication-bound — the fp16-compression showcase).  ResNet lives in
+``resnet.py``; these two complete the benchmark family so every row of
+the reference's table has an in-tree vehicle (``bench.py --model``).
+
+TPU-first: NHWC, bfloat16-friendly, BatchNorm everywhere Inception uses
+it upstream; VGG kept faithfully BN-free (its huge dense head is what
+makes it communication-bound — exactly why the reference uses it to
+demonstrate fp16 allreduce compression).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class VGG16(nn.Module):
+    """VGG-16 (configuration D).  ~138M params, most of them in the
+    fc6/fc7 head — the communication-bound scaling case."""
+
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        del train  # no BN/dropout state in the benchmark configuration
+        cfg: Sequence = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+                         512, 512, 512, "M", 512, 512, 512, "M")
+        for i, c in enumerate(cfg):
+            if c == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(c, (3, 3), padding="SAME", dtype=self.dtype,
+                            param_dtype=self.param_dtype,
+                            name=f"conv_{i}")(x)
+                x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype,
+                             param_dtype=self.param_dtype, name="fc6")(x))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype,
+                             param_dtype=self.param_dtype, name="fc7")(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=self.param_dtype, name="fc8")(x)
+
+
+class _ConvBN(nn.Module):
+    """Inception's conv+BN+relu cell."""
+
+    features: int
+    kernel: tuple
+    strides: tuple = (1, 1)
+    padding: Any = "SAME"
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.features, self.kernel, strides=self.strides,
+                    padding=self.padding, use_bias=False, dtype=self.dtype,
+                    param_dtype=self.param_dtype, name="conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=self.dtype, name="bn")(x)
+        return nn.relu(x)
+
+
+class InceptionV3(nn.Module):
+    """Inception-V3 (Szegedy et al., 2015), faithful block structure:
+    3× InceptionA (35×35), reduction, 4× InceptionB (17×17, factorized
+    7×1/1×7), reduction, 2× InceptionC (8×8); aux head omitted (the
+    benchmark methodology trains without it).  299×299×3 inputs
+    upstream; any H,W ≥ 75 works."""
+
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    def _cell(self, f, k, s=(1, 1), p="SAME", name=None):
+        return _ConvBN(f, k, s, p, self.dtype, self.param_dtype, name=name)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        c = self._cell
+        # stem
+        x = c(32, (3, 3), (2, 2), "VALID", "stem1")(x, train)
+        x = c(32, (3, 3), (1, 1), "VALID", "stem2")(x, train)
+        x = c(64, (3, 3), name="stem3")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = c(80, (1, 1), (1, 1), "VALID", "stem4")(x, train)
+        x = c(192, (3, 3), (1, 1), "VALID", "stem5")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+
+        def inception_a(x, pool_f, name):
+            b1 = c(64, (1, 1), name=f"{name}_b1")(x, train)
+            b2 = c(48, (1, 1), name=f"{name}_b2a")(x, train)
+            b2 = c(64, (5, 5), name=f"{name}_b2b")(b2, train)
+            b3 = c(64, (1, 1), name=f"{name}_b3a")(x, train)
+            b3 = c(96, (3, 3), name=f"{name}_b3b")(b3, train)
+            b3 = c(96, (3, 3), name=f"{name}_b3c")(b3, train)
+            b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+            b4 = c(pool_f, (1, 1), name=f"{name}_b4")(b4, train)
+            return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+        x = inception_a(x, 32, "mixed5a")
+        x = inception_a(x, 64, "mixed5b")
+        x = inception_a(x, 64, "mixed5c")
+
+        # reduction A
+        b1 = c(384, (3, 3), (2, 2), "VALID", "red_a_b1")(x, train)
+        b2 = c(64, (1, 1), name="red_a_b2a")(x, train)
+        b2 = c(96, (3, 3), name="red_a_b2b")(b2, train)
+        b2 = c(96, (3, 3), (2, 2), "VALID", "red_a_b2c")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = jnp.concatenate([b1, b2, b3], axis=-1)
+
+        def inception_b(x, f7, name):
+            b1 = c(192, (1, 1), name=f"{name}_b1")(x, train)
+            b2 = c(f7, (1, 1), name=f"{name}_b2a")(x, train)
+            b2 = c(f7, (1, 7), name=f"{name}_b2b")(b2, train)
+            b2 = c(192, (7, 1), name=f"{name}_b2c")(b2, train)
+            b3 = c(f7, (1, 1), name=f"{name}_b3a")(x, train)
+            b3 = c(f7, (7, 1), name=f"{name}_b3b")(b3, train)
+            b3 = c(f7, (1, 7), name=f"{name}_b3c")(b3, train)
+            b3 = c(f7, (7, 1), name=f"{name}_b3d")(b3, train)
+            b3 = c(192, (1, 7), name=f"{name}_b3e")(b3, train)
+            b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+            b4 = c(192, (1, 1), name=f"{name}_b4")(b4, train)
+            return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+        x = inception_b(x, 128, "mixed6b")
+        x = inception_b(x, 160, "mixed6c")
+        x = inception_b(x, 160, "mixed6d")
+        x = inception_b(x, 192, "mixed6e")
+
+        # reduction B
+        b1 = c(192, (1, 1), name="red_b_b1a")(x, train)
+        b1 = c(320, (3, 3), (2, 2), "VALID", "red_b_b1b")(b1, train)
+        b2 = c(192, (1, 1), name="red_b_b2a")(x, train)
+        b2 = c(192, (1, 7), name="red_b_b2b")(b2, train)
+        b2 = c(192, (7, 1), name="red_b_b2c")(b2, train)
+        b2 = c(192, (3, 3), (2, 2), "VALID", "red_b_b2d")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = jnp.concatenate([b1, b2, b3], axis=-1)
+
+        def inception_c(x, name):
+            b1 = c(320, (1, 1), name=f"{name}_b1")(x, train)
+            b2 = c(384, (1, 1), name=f"{name}_b2a")(x, train)
+            b2 = jnp.concatenate([
+                c(384, (1, 3), name=f"{name}_b2b")(b2, train),
+                c(384, (3, 1), name=f"{name}_b2c")(b2, train)], axis=-1)
+            b3 = c(448, (1, 1), name=f"{name}_b3a")(x, train)
+            b3 = c(384, (3, 3), name=f"{name}_b3b")(b3, train)
+            b3 = jnp.concatenate([
+                c(384, (1, 3), name=f"{name}_b3c")(b3, train),
+                c(384, (3, 1), name=f"{name}_b3d")(b3, train)], axis=-1)
+            b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+            b4 = c(192, (1, 1), name=f"{name}_b4")(b4, train)
+            return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+        x = inception_c(x, "mixed7a")
+        x = inception_c(x, "mixed7b")
+
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=self.param_dtype, name="logits")(x)

@@ -4,11 +4,18 @@ One place for (a) the advertised dense-bf16 peak table and (b) the
 AOT-compile + ``cost_analysis`` flops readout, so every benchmark
 reports a consistent ``mfu_pct`` for the same hardware.
 
-``cost_analysis()`` describes the post-SPMD-partitioning PER-DEVICE
-module, so the returned flops are one chip's share of one call.  The
-compiled executable is returned for reuse — ``lower().compile()`` does
-not populate the jit dispatch cache, and compiling twice would double
-benchmark startup.
+``cost_analysis()`` caveats (measured on this jax/XLA version):
+
+* A ``lax.scan`` BODY IS COUNTED ONCE regardless of trip count — cost a
+  length-1 chunk and scale by steps yourself (see bench.py).
+* Partitioning semantics differ by lowering path: through ``shard_map``
+  the count is the post-partitioning per-device module; through plain
+  GSPMD jit it can be the whole-module count.  On the headline config
+  (one real chip) the two coincide, which is where mfu_pct is read.
+
+The compiled executable is returned for reuse — ``lower().compile()``
+does not populate the jit dispatch cache, and compiling twice would
+double benchmark startup.
 """
 
 from __future__ import annotations
