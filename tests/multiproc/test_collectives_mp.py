@@ -46,6 +46,18 @@ class TestAllreduceMP:
         assert np.allclose(np.asarray(outs[2]), 3.0)
         """)
 
+    def test_compression_fp16_and_int8(self, world):
+        world(2, """
+        x = np.full((1, 64), float(rank + 1), np.float32)
+        got = np.asarray(hvd.allreduce(x, op=hvd.Average,
+                                       compression=hvd.Compression.fp16))
+        assert np.allclose(got, 1.5, atol=1e-2), got
+        # int8 transport tier (beyond reference): ~1/127-relative error
+        got = np.asarray(hvd.allreduce(x, op=hvd.Average,
+                                       compression=hvd.Compression.int8))
+        assert np.allclose(got, 1.5, atol=0.05), got
+        """)
+
     def test_adasum_two_processes(self, world):
         world(2, """
         # adasum(a, b) with a = ones, b = 2*ones (parallel): each vector
