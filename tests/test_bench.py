@@ -1,0 +1,39 @@
+"""The headline benchmark artifact itself: ``bench.py`` must always
+print its one-line JSON contract (the driver consumes it blindly at
+round end — a crash there loses the round's perf datapoint)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(*flags):
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--preset", "tiny",
+         "--iters", "1", "--steps-per-call", "1", "--warmup", "0", *flags],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "XLA_FLAGS": "", "JAX_PLATFORMS": ""},
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    line = out.stdout.strip().splitlines()[-1]
+    return json.loads(line)
+
+
+@pytest.mark.slow
+def test_bench_json_contract():
+    row = _run_bench()
+    assert row["unit"] == "images/sec/chip"
+    assert row["value"] > 0
+    assert "metric" in row and "vs_baseline" in row
+
+
+@pytest.mark.slow
+def test_bench_fp16_allreduce_flag():
+    row = _run_bench("--fp16-allreduce")
+    assert row["fp16_allreduce"] is True
+    assert row["value"] > 0
