@@ -11,6 +11,8 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+import numpy as np
+
 from ..elastic.state import ObjectState
 from .functions import broadcast_object, broadcast_variables
 
@@ -37,8 +39,6 @@ class TensorFlowKerasState(ObjectState):
         super().__init__(**kwargs)  # calls commit()
 
     def commit(self) -> None:
-        import numpy as np
-
         if self._model is not None:
             self._weights_saved = [np.array(w)
                                    for w in self._model.get_weights()]
@@ -76,3 +76,22 @@ class TensorFlowKerasState(ObjectState):
         for k, v in synced.items():
             setattr(self, k, v)
         self.commit()
+
+    # --- durable tier (mirrors TpuState.save_to/load_from) -----------------
+
+    def save_to(self, checkpointer, step: int) -> None:
+        """Persist the committed snapshot durably (weights/optimizer
+        variables are plain numpy — orbax-native)."""
+        if self._weights_saved is None and self._opt_saved is None:
+            self.commit()
+        checkpointer.save(step, {"weights": self._weights_saved or [],
+                                 "opt": self._opt_saved or [],
+                                 "plain": self._saved})
+
+    def load_from(self, checkpointer, step=None) -> None:
+        """Load a durable checkpoint into this state and restore it."""
+        payload = checkpointer.restore(step)
+        self._weights_saved = [np.asarray(w) for w in payload["weights"]]
+        self._opt_saved = [np.asarray(v) for v in payload["opt"]]
+        self._saved = dict(payload["plain"])
+        self.restore()

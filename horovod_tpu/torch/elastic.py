@@ -68,3 +68,39 @@ class TorchState(ObjectState):
         for k, v in synced.items():
             setattr(self, k, v)
         self.commit()
+
+    # --- durable tier (mirrors TpuState.save_to/load_from; reference
+    # --- delegates durability to the framework — torch.save here) ----------
+
+    def save_to(self, checkpointer, step: int) -> None:
+        """Persist the committed snapshot durably.  Torch state dicts
+        (tensors, int-keyed optimizer state) ride as one torch.save
+        payload inside the orbax tree."""
+        import io
+
+        import numpy as np
+        import torch
+
+        if self._model_saved is None and self._opt_saved is None:
+            self.commit()
+        buf = io.BytesIO()
+        torch.save({"model": self._model_saved, "opt": self._opt_saved,
+                    "plain": self._saved}, buf)
+        checkpointer.save(step, {
+            "torch_state_bytes": np.frombuffer(buf.getvalue(), np.uint8)})
+
+    def load_from(self, checkpointer, step=None) -> None:
+        """Load a durable checkpoint into this state and restore it."""
+        import io
+
+        import numpy as np
+        import torch
+
+        payload = checkpointer.restore(step)
+        raw = bytes(np.asarray(payload["torch_state_bytes"]))
+        d = torch.load(io.BytesIO(raw), map_location="cpu",
+                       weights_only=False)
+        self._model_saved = d["model"]
+        self._opt_saved = d["opt"]
+        self._saved = d["plain"]
+        self.restore()

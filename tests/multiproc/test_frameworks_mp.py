@@ -273,3 +273,33 @@ class TestFSDPMP:
             losses.append(float(loss))
         assert losses[-1] < losses[0], losses
         """)
+
+
+class TestZeroMP:
+    def test_zero1_two_controllers(self, world):
+        # ZeRO-1: explicit reduce-scatter/all-gather shard_map program
+        # across 2 real controller processes.
+        world(2, """
+        import jax.numpy as jnp
+        import optax
+        from horovod_tpu.optim.zero import make_zero_train_step
+
+        rng = np.random.RandomState(0)
+        d = 8
+        X = jnp.asarray(rng.randn(16, d), jnp.float32)
+        y = jnp.asarray(rng.randn(16), jnp.float32)
+        params = {"w": jnp.asarray(rng.randn(d, d) * 0.1, jnp.float32),
+                  "v": jnp.asarray(rng.randn(d) * 0.1, jnp.float32)}
+
+        def loss_fn(p, b):
+            return jnp.mean((jnp.tanh(b[0] @ p["w"]) @ p["v"] - b[1]) ** 2)
+
+        init, step = make_zero_train_step(loss_fn, optax.adamw(1e-2),
+                                          donate=False)
+        st = init(params)
+        losses = []
+        for _ in range(10):
+            params, st, loss = step(params, st, (X, y))
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
+        """)
