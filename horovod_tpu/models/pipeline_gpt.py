@@ -74,7 +74,7 @@ class PipelinedGPT:
 
     def __init__(self, config: GPTConfig, mesh: Mesh, *,
                  n_micro: int = 2, pp_axis: str = "pp",
-                 dp_axis: Optional[str] = "dp"):
+                 dp_axis: Optional[str] = "dp", remat: bool = False):
         if config.attention not in ("full", "flash"):
             raise ValueError(
                 "PipelinedGPT stages run attention per-microbatch; use "
@@ -85,6 +85,7 @@ class PipelinedGPT:
         self.n_micro = n_micro
         self.pp_axis = pp_axis
         self.dp_axis = dp_axis
+        self.remat = remat
         self.n_stages = int(mesh.shape[pp_axis])
         if config.n_layer % self.n_stages:
             raise ValueError(
@@ -113,7 +114,7 @@ class PipelinedGPT:
 
         x = pipeline_apply(stage_fn, params["stages"], x, mesh=self.mesh,
                            n_micro=self.n_micro, pp_axis=self.pp_axis,
-                           dp_axis=self.dp_axis)
+                           dp_axis=self.dp_axis, remat=self.remat)
         return self._head.apply({"params": params["head"]}, x)
 
 

@@ -32,7 +32,7 @@ from .._compat import shard_map
 
 def pipeline_apply(stage_fn: Callable, stage_params: Any, x, *,
                    mesh: Mesh, n_micro: int, pp_axis: str = "pp",
-                   dp_axis: Optional[str] = "dp"):
+                   dp_axis: Optional[str] = "dp", remat: bool = False):
     """Run ``x`` through ``pp`` pipeline stages.
 
     ``stage_fn(params_one_stage, activation) -> activation`` — one
@@ -43,8 +43,15 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x, *,
     ``x`` — ``[B, ...]`` global batch; split into ``n_micro``
     microbatches along dim 0 (``B`` divisible by ``n_micro`` × the dp
     size).  Returns the pipelined result, same shape as ``x``.
+
+    ``remat=True`` wraps each stage in ``jax.checkpoint``: the backward
+    pipeline recomputes stage activations instead of keeping all
+    ``n_ticks`` of them live — the standard GPipe memory trade (peak
+    activation memory drops ~``n_micro``-fold for one extra forward).
     """
     axes = set(mesh.axis_names)
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
     if pp_axis not in axes:
         raise ValueError(f"mesh has no axis {pp_axis!r}: {mesh.axis_names}")
     dp = dp_axis if (dp_axis and dp_axis in axes) else None

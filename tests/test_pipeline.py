@@ -175,3 +175,38 @@ class TestPipelinedGPT:
         mesh = make_mesh({"pp": 4})
         with pytest.raises(ValueError, match="n_layer"):
             self._build(mesh, n_layer=6)
+
+
+def test_remat_matches_non_remat(world_size):
+    # jax.checkpoint on the stage must be numerically invisible: same
+    # loss and gradients, only the memory/compute trade changes.
+    import optax
+    from horovod_tpu.models import GPTConfig
+    from horovod_tpu.models.pipeline_gpt import (
+        PipelinedGPT, pipelined_lm_loss_fn,
+    )
+    from horovod_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"dp": 2, "pp": 4})
+    cfg = GPTConfig(vocab_size=64, n_layer=4, n_head=2, d_model=16,
+                    d_ff=32, max_seq_len=8, attention="full",
+                    dtype=jnp.float32)
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, 64, (8, 9))
+    data = (jnp.asarray(tokens[:, :-1], jnp.int32),
+            jnp.asarray(tokens[:, 1:], jnp.int32))
+
+    models = [PipelinedGPT(cfg, mesh, n_micro=2, remat=r)
+              for r in (False, True)]
+    params = models[0].init(jax.random.PRNGKey(0),
+                            jnp.asarray(tokens[:, :8], jnp.int32))
+    losses, grads = [], []
+    for m in models:
+        loss_fn = pipelined_lm_loss_fn(m)
+        l, g = jax.value_and_grad(loss_fn)(params, data)
+        losses.append(float(l))
+        grads.append(g)
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(grads[0]), jax.tree.leaves(grads[1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
