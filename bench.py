@@ -54,18 +54,11 @@ def main() -> None:
     args = parser.parse_args()
 
     if args.preset == "tiny":
-        # CPU smoke: sitecustomize pins jax_platforms to the tunneled
-        # TPU plugin, which can block when the tunnel is unhealthy; the
-        # tiny preset is defined as the CPU-mesh check, so pin it back
-        # (same dance as tests/conftest.py and benchmarks/*).
-        import os as _os
+        # CPU smoke: the tiny preset is defined as the CPU-mesh check
+        # (see utils/platform.py for why env vars alone aren't enough).
+        from horovod_tpu.utils.platform import force_cpu_mesh
 
-        _os.environ["XLA_FLAGS"] = (
-            _os.environ.get("XLA_FLAGS", "")
-            + " --xla_force_host_platform_device_count=8")
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+        force_cpu_mesh()
 
     import jax
     import jax.numpy as jnp

@@ -45,11 +45,9 @@ def main() -> None:
     args = parser.parse_args()
 
     if args.cpu_mesh:
-        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                                   + " --xla_force_host_platform_device_count=8")
-        import jax
+        from horovod_tpu.utils.platform import force_cpu_mesh
 
-        jax.config.update("jax_platforms", "cpu")
+        force_cpu_mesh()
 
     import jax
     import jax.numpy as jnp

@@ -37,14 +37,9 @@ def main() -> None:
     args = parser.parse_args()
 
     if args.preset == "tiny":
-        # CPU smoke: this image's sitecustomize pins jax_platforms to the
-        # TPU plugin regardless of JAX_PLATFORMS; pin it back (same dance
-        # as tests/conftest.py and benchmarks/allreduce_bench.py).
-        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                                   + " --xla_force_host_platform_device_count=8")
-        import jax
+        from horovod_tpu.utils.platform import force_cpu_mesh
 
-        jax.config.update("jax_platforms", "cpu")
+        force_cpu_mesh()
 
     import jax
     import jax.numpy as jnp

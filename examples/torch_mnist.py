@@ -15,13 +15,9 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 if "--tpu" not in sys.argv:
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=8"
-    )
-    import jax
+    from horovod_tpu.utils.platform import force_cpu_mesh
 
-    jax.config.update("jax_platforms", "cpu")
+    force_cpu_mesh()
 
 import numpy as np
 import torch

@@ -1,0 +1,24 @@
+"""Platform pinning helper for scripts and smoke tests.
+
+This image's ``sitecustomize`` pins ``jax_platforms`` to the tunneled
+TPU plugin regardless of the ``JAX_PLATFORMS`` env var, and an unhealthy
+tunnel BLOCKS (rather than fails) backend init.  Every CPU-mesh script
+needs the same dance — append the virtual-device flag, then pin the
+platform back via ``jax.config`` — so it lives here once.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_mesh(n_devices: int = 8) -> None:
+    """Pin this process to the CPU backend with ``n_devices`` virtual
+    devices.  Call before any jax device use (backend init)."""
+    flag = "--xla_force_host_platform_device_count"
+    if flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + f" {flag}={n_devices}")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
