@@ -303,3 +303,24 @@ class TestZeroMP:
             losses.append(float(loss))
         assert losses[-1] < losses[0], losses
         """)
+
+
+class TestFrameworkElasticStatesMP:
+    def test_torch_state_sync_broadcasts_rank0(self, world):
+        # TorchState.sync() must make rank 1's model/attrs match rank 0's
+        # across real controller processes.
+        world(2, """
+        import torch
+        from horovod_tpu.torch.elastic import TorchState
+
+        torch.manual_seed(rank)  # deliberately different initial weights
+        model = torch.nn.Linear(4, 2)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        state = TorchState(model=model, optimizer=opt, batch=rank * 10)
+        state.sync()
+        assert state.batch == 0, state.batch  # rank 0's value everywhere
+        # weights identical across ranks: allgather a fingerprint
+        fp = float(sum(p.abs().sum() for p in model.parameters()))
+        fps = hvd.allgather_object(fp)
+        assert abs(fps[0] - fps[1]) < 1e-6, fps
+        """)
