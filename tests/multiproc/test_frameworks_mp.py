@@ -136,7 +136,9 @@ class TestCrossProcessMonitorMP:
                                  name='warm'))
         if rank == 0:
             mon.record_dispatch('phantom')
-            deadline = time.time() + 25
+            # generous deadline: under a full-suite run the CPU is
+            # contended and the 2 s stall window can take a while to fire
+            deadline = time.time() + 60
             while time.time() < deadline and 'phantom' not in mon._reported:
                 time.sleep(0.25)
             assert 'phantom' in mon._reported, (mon._pending, mon.failure)
