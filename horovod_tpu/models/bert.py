@@ -180,11 +180,67 @@ class BertForMaskedLM(nn.Module):
         self.mlm_bias = self.param("mlm_bias", nn.initializers.zeros,
                                    (cfg.vocab_size,), jnp.float32)
 
-    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 return_hidden: bool = False):
         seq, _ = self.bert(input_ids, token_type_ids, attention_mask)
         h = self.mlm_ln(nn.gelu(self.mlm_transform(seq)))
+        if return_hidden:
+            # Pre-decoder activations for the chunked-vocab loss — the
+            # tied-decoder matmul happens inside ops/xent.py's chunk
+            # loop instead of materializing [B, T, V] here.
+            return h
         logits = self.bert.tok_embed.attend(h).astype(jnp.float32)
         return logits + self.mlm_bias
+
+
+def masked_lm_loss_fn(model: BertForMaskedLM, *, vocab_chunk_size: int = 0):
+    """MLM pre-training loss.
+
+    Batch is ``(input_ids, labels, label_mask)`` or — for padded
+    batches — ``(input_ids, attention_mask, labels, label_mask)``
+    (attention_mask per the HuggingFace convention, like
+    :func:`classification_loss_fn`).  Cross-entropy over positions with
+    ``label_mask=1`` (the 15% masked tokens), mean over masked
+    positions.
+
+    ``vocab_chunk_size > 0`` routes through the chunked-vocab head
+    (``ops/xent.py``): the tied decoder is the token embedding, so the
+    ``[B, T, V]`` MLM logits — the largest tensor of BERT pre-training —
+    are never materialized.
+    """
+
+    def unpack(batch):
+        if len(batch) == 4:
+            input_ids, attention_mask, labels, label_mask = batch
+        else:
+            input_ids, labels, label_mask = batch
+            attention_mask = None
+        return input_ids, attention_mask, labels, label_mask
+
+    def dense_loss(params, batch):
+        input_ids, attention_mask, labels, label_mask = unpack(batch)
+        logits = model.apply({"params": params}, input_ids, None,
+                             attention_mask)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        m = label_mask.astype(jnp.float32)
+        return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0)
+
+    if not vocab_chunk_size:
+        return dense_loss
+
+    from ..ops.xent import chunked_lm_xent
+
+    def chunked_loss(params, batch):
+        input_ids, attention_mask, labels, label_mask = unpack(batch)
+        h = model.apply({"params": params}, input_ids, None,
+                        attention_mask, return_hidden=True)
+        kernel = params["bert"]["tok_embed"]["embedding"].T  # tied [D, V]
+        return chunked_lm_xent(h, kernel, labels,
+                               chunk_size=vocab_chunk_size,
+                               bias=params["mlm_bias"], mask=label_mask)
+
+    return chunked_loss
 
 
 def classification_loss_fn(model: BertForSequenceClassification):

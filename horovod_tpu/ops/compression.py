@@ -21,7 +21,11 @@ import jax.numpy as jnp
 
 
 class Compressor:
-    """Interface parity with the reference's ``Compressor`` base."""
+    """Interface parity with the reference's ``Compressor`` base, plus
+    the SPMD *transport* hooks: a compressor owns how a collective
+    moves its bytes.  Defaults compose ``compress → HLO collective →
+    decompress``; transport-level compressors (int8) override with
+    their own collective decomposition."""
 
     @staticmethod
     def compress(tensor):
@@ -30,6 +34,22 @@ class Compressor:
     @staticmethod
     def decompress(tensor, ctx):
         raise NotImplementedError
+
+    @classmethod
+    def spmd_allreduce(cls, x, *, op, axis, groups=None):
+        from . import spmd
+
+        wire, ctx = cls.compress(x)
+        red = spmd.allreduce(wire, op=op, axis=axis, groups=groups)
+        return cls.decompress(red, ctx)
+
+    @classmethod
+    def spmd_reducescatter(cls, x, *, op, axis, groups=None):
+        from . import spmd
+
+        wire, ctx = cls.compress(x)
+        red = spmd.reducescatter(wire, op=op, axis=axis, groups=groups)
+        return cls.decompress(red, ctx)
 
 
 class NoneCompressor(Compressor):
@@ -93,11 +113,24 @@ class Int8Compressor(Compressor):
     def decompress(tensor, ctx):
         return tensor
 
-    @staticmethod
-    def spmd_reduce(x, *, op, axis, groups=None):
-        from .quantization import int8_allreduce
+    @classmethod
+    def spmd_allreduce(cls, x, *, op, axis, groups=None):
+        if op in ("sum", "average") and jnp.issubdtype(x.dtype,
+                                                       jnp.floating):
+            from .quantization import int8_allreduce
 
-        return int8_allreduce(x, op=op, axis=axis, groups=groups)
+            return int8_allreduce(x, op=op, axis=axis, groups=groups)
+        return super().spmd_allreduce(x, op=op, axis=axis, groups=groups)
+
+    @classmethod
+    def spmd_reducescatter(cls, x, *, op, axis, groups=None):
+        if op in ("sum", "average") and jnp.issubdtype(x.dtype,
+                                                       jnp.floating):
+            from .quantization import int8_reducescatter
+
+            return int8_reducescatter(x, op=op, axis=axis, groups=groups)
+        return super().spmd_reducescatter(x, op=op, axis=axis,
+                                          groups=groups)
 
 
 class Compression:

@@ -181,17 +181,10 @@ def fused_allreduce_pytree(
         x = flat
         if prescale_factor != 1.0:
             x = x * prescale_factor
-        if (hasattr(compression, "spmd_reduce")
-                and op in ("sum", "average")
-                and jnp.issubdtype(x.dtype, jnp.floating)):
-            # Transport-aware compressor (int8 tier): the compressor
-            # owns the whole reduce — quantized alltoall + f32
-            # accumulate + quantized allgather.
-            x = compression.spmd_reduce(x, op=op, axis=axis, groups=groups)
-        else:
-            x, ctx = compression.compress(x)
-            x = spmd.allreduce(x, op=op, axis=axis, groups=groups)
-            x = compression.decompress(x, ctx)
+        # The compressor owns the transport (Compressor.spmd_allreduce:
+        # compress -> HLO -> decompress by default; int8 overrides with
+        # its quantized alltoall/allgather decomposition).
+        x = compression.spmd_allreduce(x, op=op, axis=axis, groups=groups)
         if postscale_factor != 1.0:
             x = x * postscale_factor
         return x

@@ -50,9 +50,82 @@ def _quantize_blocks(blocks):
     return q.astype(jnp.int8), scale.astype(jnp.float32)
 
 
+def _group_size(axis, groups):
+    return len(groups[0]) if groups else lax.axis_size(axis)
+
+
+def int8_reducescatter(x, *, op: str = "sum", axis: str = "hvd",
+                       groups=None, block_size: int = 1024):
+    """Reduce-scatter with int8 transport: quantized ``all_to_all`` +
+    f32 dequantize-accumulate (phases 1–2 of the module docstring).
+
+    ``x`` is a flat per-chip vector whose static size divides the group
+    size; returns this chip's fully-reduced ``size/n`` shard in ``x``'s
+    dtype.  Also the drop-in wire for ZeRO's gradient reduce-scatter.
+    """
+    if op not in ("sum", "average"):
+        raise ValueError(
+            f"int8 transport supports op=sum/average, got {op!r} "
+            "(min/max/product need exact comparisons; drop compression)")
+    n = _group_size(axis, groups)
+    flat = x.astype(jnp.float32).reshape(-1)
+    if flat.size % n:
+        raise ValueError(f"size {flat.size} not divisible by group {n}")
+    if n == 1:
+        return flat.astype(x.dtype)  # degenerate world
+    k = flat.size // n
+    b = max(1, min(block_size, k))
+    pad = (-k) % b
+    chunks = flat.reshape(n, k)
+    if pad:  # pad each destination chunk's tail to whole blocks
+        chunks = jnp.concatenate(
+            [chunks, jnp.zeros((n, pad), jnp.float32)], axis=1)
+    m = (k + pad) // b
+
+    # Blockwise-quantize; alltoall hands chunk j's rows to rank j, so I
+    # receive m blocks from each peer for MY shard (peer-major).  The
+    # f32 scale sidecar travels the same route.
+    q1, s1 = _quantize_blocks(chunks.reshape(n * m, b))
+    rows = spmd.alltoall(q1, axis=axis, groups=groups).reshape(n, m, b)
+    s1_rows = spmd.alltoall(s1, axis=axis, groups=groups).reshape(n, m, 1)
+    partial = jnp.sum(rows.astype(jnp.float32) * s1_rows, axis=0)
+    partial = partial.reshape(-1)
+    if pad:
+        partial = partial[:-pad]
+    if op == "average":
+        partial = partial / n
+    return partial.astype(x.dtype)
+
+
+def int8_allgather(shard, *, axis: str = "hvd", groups=None,
+                   block_size: int = 1024):
+    """All-gather with int8 transport (phase 3): quantize my flat shard,
+    gather everyone's, dequantize.  Returns ``[n * size]`` flat in the
+    shard's dtype (rank-major, matching ``lax.all_gather(tiled=True)``)."""
+    n = _group_size(axis, groups)
+    flat = shard.astype(jnp.float32).reshape(-1)
+    if n == 1:
+        return flat.astype(shard.dtype)
+    k = flat.size
+    b = max(1, min(block_size, k))
+    pad = (-k) % b
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    m = flat.size // b
+    q, s = _quantize_blocks(flat.reshape(m, b))
+    gathered = spmd.allgather(q.reshape(-1), axis=axis,
+                              groups=groups).reshape(n, m, b)
+    s_all = spmd.allgather(s, axis=axis, groups=groups).reshape(n, m, 1)
+    out = (gathered.astype(jnp.float32) * s_all).reshape(n, -1)
+    if pad:
+        out = out[:, :-pad]
+    return out.reshape(-1).astype(shard.dtype)
+
+
 def int8_allreduce(x, *, op: str = "sum", axis: str = "hvd", groups=None,
                    block_size: int = 1024):
-    """Allreduce with int8 transport (see module docstring).
+    """Allreduce with int8 transport (see module docstring) — composed
+    as :func:`int8_reducescatter` + :func:`int8_allgather`.
 
     Use inside a ``shard_map``/SPMD region over ``axis``.  ``op`` is
     sum or average (order ops and Adasum need exact values).  Result
@@ -62,41 +135,19 @@ def int8_allreduce(x, *, op: str = "sum", axis: str = "hvd", groups=None,
         raise ValueError(
             f"int8 transport supports op=sum/average, got {op!r} "
             "(min/max/product need exact comparisons; drop compression)")
-    n = len(groups[0]) if groups else lax.axis_size(axis)
+    n = _group_size(axis, groups)
     if n == 1:
         return x
     orig_dtype = x.dtype
     orig_shape = x.shape
     flat = x.astype(jnp.float32).reshape(-1)
-    b = max(1, min(block_size, flat.size))
-    # Pad so each of the n shards is a whole number of blocks.
-    pad = (-flat.size) % (n * b)
+    pad = (-flat.size) % n
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
-    k = flat.size // n          # elements per shard
-    m = k // b                  # blocks per shard
-
-    # Phase 1: blockwise-quantize my full vector; exchange shards.
-    # q1 [n*m, b] is block-major per destination: rows [j*m:(j+1)*m]
-    # are my blocks for shard j — alltoall hands shard j's rows to
-    # rank j, so I receive [n*m, b] = m blocks from each peer for MY
-    # shard, peer-major.  The scale sidecar travels the same route.
-    q1, s1 = _quantize_blocks(flat.reshape(n * m, b))
-    rows = spmd.alltoall(q1, axis=axis, groups=groups)
-    s1_rows = spmd.alltoall(s1, axis=axis, groups=groups)
-
-    # Phase 2: dequantize + accumulate in f32 (no int8 overflow).
-    contrib = rows.reshape(n, m, b).astype(jnp.float32)
-    partial = jnp.sum(contrib * s1_rows.reshape(n, m, 1), axis=0)  # [m, b]
-    if op == "average":
-        partial = partial / n
-
-    # Phase 3: requantize my shard; gather everyone's.
-    q2, s2 = _quantize_blocks(partial)                  # [m, b], [m]
-    gathered = spmd.allgather(q2.reshape(-1), axis=axis,
-                              groups=groups).reshape(n, m, b)
-    s2_all = spmd.allgather(s2, axis=axis, groups=groups).reshape(n, m, 1)
-    out = (gathered.astype(jnp.float32) * s2_all).reshape(-1)
+    shard = int8_reducescatter(flat, op=op, axis=axis, groups=groups,
+                               block_size=block_size)
+    out = int8_allgather(shard, axis=axis, groups=groups,
+                         block_size=block_size)
     if pad:
         out = out[:-pad]
     return out.reshape(orig_shape).astype(orig_dtype)

@@ -237,6 +237,28 @@ class TestBert:
         assert float(jnp.abs(
             g["bert"]["tok_embed"]["embedding"]).sum()) > 0.0
 
+    def test_mlm_loss_attention_mask_path(self, world_size):
+        # 4-tuple batches thread attention_mask into the encoder: the
+        # loss must ignore pad-token *content* (review-r3: the 3-tuple
+        # contract had no way to pass it).
+        from horovod_tpu.models import BertForMaskedLM
+        from horovod_tpu.models.bert import masked_lm_loss_fn
+
+        model = BertForMaskedLM(self._tiny())
+        rng = np.random.RandomState(10)
+        ids_a = jnp.asarray(rng.randint(0, 64, (2, 8)), jnp.int32)
+        ids_b = ids_a.at[:, 6:].set(jnp.asarray(
+            rng.randint(0, 64, (2, 2)), jnp.int32))
+        attn = jnp.asarray([[1] * 6 + [0] * 2] * 2, jnp.int32)
+        labels = jnp.asarray(rng.randint(0, 64, (2, 8)), jnp.int32)
+        lmask = jnp.asarray([[1, 1, 0, 0, 0, 0, 0, 0]] * 2, jnp.float32)
+        params = model.init(jax.random.PRNGKey(0), ids_a)["params"]
+        for chunk in (0, 5):
+            fn = masked_lm_loss_fn(model, vocab_chunk_size=chunk)
+            la = fn(params, (ids_a, attn, labels, lmask))
+            lb = fn(params, (ids_b, attn, labels, lmask))
+            np.testing.assert_allclose(float(la), float(lb), rtol=1e-5)
+
     def test_finetune_step_with_fusion_and_fp16(self, world_size):
         # The baseline config end to end: DistributedOptimizer with
         # tensor fusion + Compression.fp16 over the mesh.
@@ -281,6 +303,28 @@ class TestBert:
                                    rtol=1e-5)
         l_open = loss_fn(params, (ids_b, labels))
         assert abs(float(l_open) - float(l_masked_b)) > 1e-6
+
+
+    def test_mlm_loss_chunked_matches_dense(self, world_size):
+        from horovod_tpu.models import BertForMaskedLM
+        from horovod_tpu.models.bert import masked_lm_loss_fn
+
+        model = BertForMaskedLM(self._tiny())
+        rng = np.random.RandomState(9)
+        ids = jnp.asarray(rng.randint(0, 64, (2, 8)), jnp.int32)
+        labels = jnp.asarray(rng.randint(0, 64, (2, 8)), jnp.int32)
+        mask = jnp.asarray(rng.rand(2, 8) < 0.25, jnp.float32)
+        params = model.init(jax.random.PRNGKey(0), ids)["params"]
+        batch = (ids, labels, mask)
+        dense = masked_lm_loss_fn(model)
+        chunked = masked_lm_loss_fn(model, vocab_chunk_size=5)
+        np.testing.assert_allclose(float(chunked(params, batch)),
+                                   float(dense(params, batch)), rtol=1e-5)
+        gd = jax.grad(dense)(params, batch)
+        gc = jax.grad(chunked)(params, batch)
+        for a, b in zip(jax.tree.leaves(gd), jax.tree.leaves(gc)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=1e-6)
 
 
 class TestBenchmarkConvnets:

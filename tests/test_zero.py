@@ -133,3 +133,71 @@ class TestZeroTrainStep:
         assert p1["w32"].dtype == jnp.float32
         p2, state, l2 = step_z(p1, state, batch)
         assert float(l2) < float(l1)
+
+
+class TestZeroCompression:
+    def _toy(self, seed=0):
+        rng = np.random.RandomState(seed)
+        d = 16
+        X = jnp.asarray(rng.randn(32, d), jnp.float32)
+        y = jnp.asarray(rng.randn(32), jnp.float32)
+        params = {"w": jnp.asarray(rng.randn(d, d) * 0.1, jnp.float32),
+                  "v": jnp.asarray(rng.randn(d) * 0.1, jnp.float32)}
+
+        def loss_fn(p, b):
+            return jnp.mean((jnp.tanh(b[0] @ p["w"]) @ p["v"] - b[1]) ** 2)
+
+        return params, loss_fn, (X, y)
+
+    @pytest.mark.parametrize("comp", ["bf16", "fp16", "int8"])
+    def test_compressed_wire_tracks_uncompressed(self, world_size, comp):
+        params, loss_fn, batch = self._toy()
+        tx = optax.adamw(1e-2)
+        runs = {}
+        for name, compression in [("none", None),
+                                  (comp, getattr(hvd.Compression, comp))]:
+            init, step = make_zero_train_step(loss_fn, tx,
+                                              compression=compression,
+                                              donate=False)
+            p, st = dict(params), init(params)
+            for _ in range(15):
+                p, st, loss = step(p, st, batch)
+            runs[name] = (p, float(loss))
+        # Both converge, and the compressed run tracks the exact one.
+        assert runs[comp][1] < 1.0
+        for a, b in zip(jax.tree.leaves(runs["none"][0]),
+                        jax.tree.leaves(runs[comp][0])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=0.15)
+
+    def test_int8_wire_actually_engaged(self, world_size):
+        # The lowered program must carry int8 (xi8 tensors) collective
+        # operands — proof the quantized transport, not the f32 HLO
+        # path, is what runs.
+        params, loss_fn, batch = self._toy()
+        init, step = make_zero_train_step(loss_fn, optax.sgd(1e-2),
+                                          compression=hvd.Compression.int8,
+                                          donate=False)
+        st = init(params)
+        txt = step.lower(params, st, batch).as_text()
+        assert "xi8" in txt, "no int8 operands in the lowered program"
+        assert "all_to_all" in txt
+
+    def test_small_updates_survive_int8_wire(self, world_size):
+        # Review-r3 regression: with the param all-gather quantized,
+        # updates smaller than the wire resolution of the WEIGHT were
+        # rounded away every step and params froze.  With the gather
+        # exact (only the gradient wire compressed), tiny-lr training
+        # must still accumulate movement.
+        params, loss_fn, batch = self._toy(seed=3)
+        init, step = make_zero_train_step(loss_fn, optax.sgd(1e-5),
+                                          compression=hvd.Compression.int8,
+                                          donate=False)
+        p, st = dict(params), init(params)
+        w0 = np.asarray(params["w"]).copy()
+        for _ in range(10):
+            p, st, _ = step(p, st, batch)
+        drift = np.abs(np.asarray(p["w"]) - w0).max()
+        # weight scale ~0.3 -> int8 grid ~2.4e-3; per-step updates are
+        # ~1e-5: movement must be far below one grid step yet nonzero.
+        assert 0 < drift < 1e-3, drift
