@@ -50,7 +50,11 @@ def main() -> None:
     import horovod_tpu as hvd
     from horovod_tpu.models import ResNet18, ResNet50
 
-    hvd.init()
+    from horovod_tpu.utils.backend_probe import guarded_init
+
+    # Outage-proof acquisition (see utils/backend_probe.py).
+    guarded_init("resnet_adasum_images_per_sec_per_chip", "images/sec/chip",
+                 skip=args.preset == "tiny")
     n_chips = hvd.size()
 
     if args.preset == "tiny":

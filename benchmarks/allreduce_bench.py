@@ -54,8 +54,11 @@ def main() -> None:
 
     import horovod_tpu as hvd
     from horovod_tpu.ops import collectives as C
+    from horovod_tpu.utils.backend_probe import guarded_init
 
-    hvd.init()
+    # Outage-proof acquisition (round-3 postmortem — see
+    # horovod_tpu/utils/backend_probe.py).
+    guarded_init("allreduce_busbw_peak", "GB/s", skip=args.cpu_mesh)
     n = hvd.size()
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     bytes_per = 2 if args.dtype == "bfloat16" else 4

@@ -57,7 +57,11 @@ def main() -> None:
     from horovod_tpu.models.transformer import lm_loss_fn
     from horovod_tpu.parallel.train import shard_batch
 
-    hvd.init()
+    from horovod_tpu.utils.backend_probe import guarded_init
+
+    # Outage-proof acquisition (see utils/backend_probe.py).
+    guarded_init("gpt_train_tokens_per_sec_per_chip", "tokens/sec/chip",
+                 skip=args.preset == "tiny")
     gm = hvd.global_mesh()
     n_chips = hvd.size()
 

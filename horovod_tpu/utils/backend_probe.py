@@ -1,0 +1,233 @@
+"""Outage-proof backend acquisition for the benchmark entrypoints.
+
+Round-3 postmortem: the driver's bench capture hit a transient TPU
+outage (``jax.errors.JaxRuntimeError: UNAVAILABLE`` — and, reproduced
+interactively, ``jax.devices()`` *hanging*), and ``bench.py`` called
+``hvd.init()`` exactly once with no retry and no structured failure
+output, so the round's only hardware artifact was an rc=1 traceback.
+
+Two failure modes need two defenses:
+
+* **Hang** — on the tunneled platform an unhealthy tunnel can block
+  backend init indefinitely.  No in-process retry helps; the probe must
+  run in a *subprocess* with a hard timeout.
+* **Fail-then-recover** — XLA caches backend-discovery failure for the
+  life of the process, so even a clean ``UNAVAILABLE`` cannot be
+  retried in-process.  Recovery therefore re-execs the script
+  (``os.execv``) with an attempt counter once the subprocess probe says
+  the backend is healthy again.
+
+Both defenses are bounded: after ``attempts`` failed probes the caller
+gets a :class:`BackendUnavailableError` carrying the full attempt log,
+which the benchmarks serialize as ONE structured JSON line so the
+driver's artifact records *why* there is no number instead of a bare
+traceback.  (No reference analogue: the reference's benchmarks assume
+CUDA is local and never down — SURVEY.md §6.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+from .logging import get_logger
+
+logger = get_logger(__name__)
+
+_PROBE_SRC = (
+    "import json, jax; d = jax.devices(); "
+    "print(json.dumps({'platform': jax.default_backend(), "
+    "'device_kind': d[0].device_kind, 'n_devices': len(d)}))"
+)
+
+# Env var carrying the re-exec attempt count (see retry_via_exec).
+_EXEC_ATTEMPT_ENV = "HVD_TPU_BENCH_EXEC_ATTEMPT"
+
+
+class BackendUnavailableError(RuntimeError):
+    """Backend never came up within the probe budget; ``attempts`` holds
+    one dict per probe (rc / elapsed / output tail)."""
+
+    def __init__(self, attempts: List[dict]) -> None:
+        super().__init__(
+            f"backend unavailable after {len(attempts)} probe attempt(s)")
+        self.attempts = attempts
+
+
+def probe_once(timeout_s: float = 120.0) -> dict:
+    """Run ``jax.devices()`` in a subprocess with a hard timeout.
+
+    Returns ``{"ok": True, "platform": ..., "device_kind": ...,
+    "n_devices": N, "elapsed_s": t}`` on success, else ``{"ok": False,
+    "rc": ..., "elapsed_s": t, "tail": last-400-chars}`` (rc is None on
+    timeout).  The subprocess inherits the environment, so platform
+    pinning (JAX_PLATFORMS etc.) applies to the probe too.
+    """
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True, text=True, timeout=timeout_s)
+        elapsed = time.monotonic() - t0
+        if proc.returncode == 0:
+            try:
+                info = json.loads(proc.stdout.strip().splitlines()[-1])
+                info.update(ok=True, elapsed_s=round(elapsed, 1))
+                return info
+            except (ValueError, IndexError):
+                pass
+        return {"ok": False, "rc": proc.returncode,
+                "elapsed_s": round(elapsed, 1),
+                "tail": (proc.stderr or proc.stdout)[-400:]}
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "rc": None,
+                "elapsed_s": round(time.monotonic() - t0, 1),
+                "tail": f"probe timed out after {timeout_s:.0f}s "
+                        "(backend init hung)"}
+
+
+def wait_for_backend(attempts: int = 5, backoff_s: float = 60.0,
+                     probe_timeout_s: float = 120.0) -> dict:
+    """Probe until the backend answers, with bounded linear backoff.
+
+    Returns the successful probe's info dict (platform / device_kind /
+    n_devices) with the failed-attempt log under ``"probe_attempts"``.
+    Raises :class:`BackendUnavailableError` after ``attempts`` failures.
+    """
+    log: List[dict] = []
+    for i in range(attempts):
+        info = probe_once(timeout_s=probe_timeout_s)
+        if info.get("ok"):
+            info["probe_attempts"] = log
+            if log:
+                logger.info("backend healthy after %d failed probe(s)",
+                            len(log))
+            return info
+        info["attempt"] = i + 1
+        log.append(info)
+        logger.warning("backend probe %d/%d failed (%s); %s",
+                       i + 1, attempts, info.get("tail", "")[-120:],
+                       f"retrying in {backoff_s:.0f}s"
+                       if i + 1 < attempts else "giving up")
+        if i + 1 < attempts:
+            time.sleep(backoff_s)
+    raise BackendUnavailableError(log)
+
+
+def exec_attempt() -> int:
+    """How many times the current script has re-exec'd itself (0 = first
+    run)."""
+    try:
+        return int(os.environ.get(_EXEC_ATTEMPT_ENV, "0"))
+    except ValueError:
+        return 0
+
+
+def retry_via_exec(max_execs: int = 2, backoff_s: float = 60.0) -> None:
+    """Re-exec the running script to retry in-process backend init.
+
+    XLA caches discovery failure per-process, so when ``hvd.init()``
+    itself dies with UNAVAILABLE *after* a healthy probe, the only real
+    retry is a fresh process.  Bounded by ``max_execs``; re-raises
+    (returns to the caller's except block) once exhausted.
+    """
+    n = exec_attempt()
+    if n >= max_execs:
+        return
+    os.environ[_EXEC_ATTEMPT_ENV] = str(n + 1)
+    logger.warning("in-process backend init failed after healthy probe; "
+                   "re-exec attempt %d/%d in %.0fs", n + 1, max_execs,
+                   backoff_s)
+    time.sleep(backoff_s)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.execv(sys.executable, [sys.executable] + sys.argv)
+
+
+def is_backend_unavailable_error(exc: BaseException) -> bool:
+    """True when ``exc`` looks like XLA backend-acquisition failure (as
+    opposed to a bug in the benchmark itself)."""
+    text = f"{type(exc).__name__}: {exc}"
+    return ("UNAVAILABLE" in text or "Unable to initialize backend" in text
+            or "backend" in text.lower() and "unavail" in text.lower())
+
+
+def emit_failure_line(metric: str, unit: str,
+                      attempts: Optional[List[dict]] = None,
+                      error: str = "tpu_backend_unavailable",
+                      vs_baseline: Optional[float] = None) -> None:
+    """Print the ONE structured JSON failure line the driver records when
+    the backend never comes up — value 0.0 (worst case), error + attempt
+    log attached so the artifact explains itself.  ``vs_baseline`` is
+    only present when the metric defines one (the headline resnet50
+    run), mirroring the success-path schema."""
+    line = {
+        "metric": metric, "value": 0.0, "unit": unit,
+        "error": error, "probe_attempts": attempts or [],
+    }
+    if vs_baseline is not None:
+        line["vs_baseline"] = vs_baseline
+    print(json.dumps(line))
+    sys.stdout.flush()
+
+
+def guarded_init(metric: str, unit: str, skip: bool = False,
+                 attempts: int = 5, backoff_s: float = 60.0,
+                 probe_timeout_s: float = 120.0,
+                 init_timeout_s: float = 300.0,
+                 vs_baseline_on_failure: Optional[float] = None) -> None:
+    """The full outage defense around ``hvd.init()``, shared by every
+    benchmark entrypoint:
+
+    1. bounded subprocess probes with backoff (hang-safe via timeout);
+    2. ``hvd.init()`` under a watchdog — a tunnel that dies *between* a
+       healthy probe and init would otherwise hang in-process forever
+       with no artifact; the watchdog emits the failure line and
+       hard-exits;
+    3. a clean UNAVAILABLE from init (XLA caches the failure, so no
+       in-process retry exists) re-execs the script, bounded;
+    4. exhaustion always ends in ONE structured JSON failure line.
+
+    ``skip=True`` (CPU-mesh / tiny presets) runs a bare ``hvd.init()``.
+    """
+    import horovod_tpu as hvd
+
+    if skip:
+        hvd.init()
+        return
+    try:
+        wait_for_backend(attempts=attempts, backoff_s=backoff_s,
+                         probe_timeout_s=probe_timeout_s)
+    except BackendUnavailableError as e:
+        emit_failure_line(metric, unit, attempts=e.attempts,
+                          vs_baseline=vs_baseline_on_failure)
+        sys.exit(1)
+
+    import threading
+
+    def _watchdog() -> None:
+        emit_failure_line(
+            metric, unit,
+            error=f"init_hang: hvd.init() exceeded {init_timeout_s:.0f}s "
+                  "after a healthy probe",
+            vs_baseline=vs_baseline_on_failure)
+        os._exit(1)
+
+    timer = threading.Timer(init_timeout_s, _watchdog)
+    timer.daemon = True
+    timer.start()
+    try:
+        hvd.init()
+    except Exception as e:
+        timer.cancel()
+        if is_backend_unavailable_error(e):
+            retry_via_exec(max_execs=2, backoff_s=backoff_s)  # no return
+            emit_failure_line(metric, unit, error=f"init_failed: {e}",
+                              vs_baseline=vs_baseline_on_failure)
+            sys.exit(1)
+        raise
+    timer.cancel()

@@ -39,10 +39,32 @@ PEAK_TFLOPS = {
 def peak_tflops(device) -> float:
     """Peak for ``device`` (a jax Device), env override first; 0.0 when
     unknown (callers then omit mfu_pct rather than report nonsense)."""
+    return peak_tflops_info(device)[0]
+
+
+def peak_tflops_info(device) -> Tuple[float, str]:
+    """``(peak, source)`` where source is ``"env_override"``,
+    ``"device_kind_table"``, or ``"unknown_device_kind:<kind>"``.
+
+    The source string goes into the bench artifact so a missing
+    ``mfu_pct`` is loud (the tunneled platform's device kind may not map
+    to a known peak — set ``HVD_TPU_PEAK_TFLOPS`` there)."""
     env = float(os.environ.get("HVD_TPU_PEAK_TFLOPS", 0) or 0)
     if env:
-        return env
-    return PEAK_TFLOPS.get(getattr(device, "device_kind", ""), 0.0)
+        return env, "env_override"
+    kind = getattr(device, "device_kind", "")
+    peak = PEAK_TFLOPS.get(kind, 0.0)
+    if peak:
+        return peak, "device_kind_table"
+    # Unlisted kinds are often suffixed strings ("TPU v5e chip", …);
+    # fall back to the longest table key the kind STARTS with, and only
+    # when the next char isn't alphanumeric — "TPU v4i" (different
+    # family, different peak) must NOT match "TPU v4".
+    for known in sorted(PEAK_TFLOPS, key=len, reverse=True):
+        if kind.startswith(known) and (len(kind) == len(known)
+                                       or not kind[len(known)].isalnum()):
+            return PEAK_TFLOPS[known], f"device_kind_prefix:{known}"
+    return 0.0, f"unknown_device_kind:{kind or '<none>'}"
 
 
 def aot_compile_with_flops(jitted, *args) -> Tuple[Any, Optional[float]]:

@@ -1,0 +1,144 @@
+"""Outage-proof backend acquisition (utils/backend_probe.py).
+
+The real defense was exercised live against a TPU-tunnel outage; these
+tests pin the mechanics on CPU: subprocess probe success/failure/timeout
+classification, bounded backoff, the structured failure line, and the
+re-exec attempt counter.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from horovod_tpu.utils import backend_probe as bp
+
+
+def test_probe_once_success_on_cpu(monkeypatch):
+    # Force the probe subprocess onto CPU (it inherits env).
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setattr(bp, "_PROBE_SRC",
+                        "import json, jax; jax.config.update('jax_platforms', 'cpu'); "
+                        "d = jax.devices(); "
+                        "print(json.dumps({'platform': jax.default_backend(), "
+                        "'device_kind': d[0].device_kind, 'n_devices': len(d)}))")
+    info = bp.probe_once(timeout_s=120.0)
+    assert info["ok"] is True
+    assert info["platform"] == "cpu"
+    assert info["n_devices"] >= 1
+    assert info["elapsed_s"] >= 0
+
+
+def test_probe_once_failure_classified(monkeypatch):
+    monkeypatch.setattr(bp, "_PROBE_SRC", "import sys; sys.exit(3)")
+    info = bp.probe_once(timeout_s=30.0)
+    assert info == {"ok": False, "rc": 3, "elapsed_s": info["elapsed_s"],
+                    "tail": ""}
+
+
+def test_probe_once_timeout_classified(monkeypatch):
+    monkeypatch.setattr(bp, "_PROBE_SRC", "import time; time.sleep(60)")
+    info = bp.probe_once(timeout_s=1.0)
+    assert info["ok"] is False
+    assert info["rc"] is None
+    assert "hung" in info["tail"]
+
+
+def test_wait_for_backend_bounded_and_logged(monkeypatch):
+    monkeypatch.setattr(bp, "_PROBE_SRC", "import sys; sys.exit(1)")
+    with pytest.raises(bp.BackendUnavailableError) as ei:
+        bp.wait_for_backend(attempts=3, backoff_s=0.0, probe_timeout_s=10.0)
+    assert len(ei.value.attempts) == 3
+    assert [a["attempt"] for a in ei.value.attempts] == [1, 2, 3]
+
+
+def test_wait_for_backend_recovers_midway(monkeypatch):
+    calls = {"n": 0}
+    real = bp.probe_once
+
+    def flaky(timeout_s):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            return {"ok": False, "rc": 1, "elapsed_s": 0.1, "tail": "boom"}
+        return {"ok": True, "platform": "cpu", "device_kind": "cpu",
+                "n_devices": 8, "elapsed_s": 0.1}
+
+    monkeypatch.setattr(bp, "probe_once", flaky)
+    info = bp.wait_for_backend(attempts=5, backoff_s=0.0)
+    assert info["ok"] and len(info["probe_attempts"]) == 2
+    monkeypatch.setattr(bp, "probe_once", real)
+
+
+def test_emit_failure_line_is_one_parseable_json(capsys):
+    bp.emit_failure_line("m", "u", attempts=[{"attempt": 1, "ok": False}])
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    parsed = json.loads(out[0])
+    assert parsed["value"] == 0.0
+    # Only metrics that define a baseline carry the key (schema parity
+    # with the success path).
+    assert "vs_baseline" not in parsed
+    assert parsed["error"] == "tpu_backend_unavailable"
+    assert parsed["probe_attempts"][0]["attempt"] == 1
+
+
+def test_emit_failure_line_headline_carries_baseline(capsys):
+    bp.emit_failure_line("resnet50_images_per_sec_per_chip",
+                         "images/sec/chip", vs_baseline=0.0)
+    parsed = json.loads(capsys.readouterr().out.strip())
+    assert parsed["vs_baseline"] == 0.0
+
+
+def test_guarded_init_skip_runs_bare_init():
+    # Inside the test session hvd is already initialized; skip=True must
+    # be a no-op second init (idempotent), touching no probes.
+    import horovod_tpu as hvd
+
+    bp.guarded_init("m", "u", skip=True)
+    assert hvd.is_initialized()
+
+
+def test_guarded_init_probe_exhaustion_exits_with_line(monkeypatch, capsys):
+    monkeypatch.setattr(bp, "_PROBE_SRC", "import sys; sys.exit(1)")
+    with pytest.raises(SystemExit):
+        bp.guarded_init("m", "u", attempts=2, backoff_s=0.0,
+                        probe_timeout_s=10.0)
+    parsed = json.loads(capsys.readouterr().out.strip())
+    assert parsed["error"] == "tpu_backend_unavailable"
+    assert len(parsed["probe_attempts"]) == 2
+
+
+def test_peak_tflops_prefix_matching(monkeypatch):
+    from horovod_tpu.utils.mfu import peak_tflops_info
+
+    class Dev:
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    monkeypatch.delenv("HVD_TPU_PEAK_TFLOPS", raising=False)
+    assert peak_tflops_info(Dev("TPU v4"))[1] == "device_kind_table"
+    peak, src = peak_tflops_info(Dev("TPU v5e chip"))
+    assert peak == 197.0 and src == "device_kind_prefix:TPU v5e"
+    # Different family must NOT prefix-match ("TPU v4i" vs "TPU v4").
+    assert peak_tflops_info(Dev("TPU v4i"))[0] == 0.0
+    assert peak_tflops_info(Dev(""))[1] == "unknown_device_kind:<none>"
+    monkeypatch.setenv("HVD_TPU_PEAK_TFLOPS", "123.5")
+    assert peak_tflops_info(Dev("whatever")) == (123.5, "env_override")
+
+
+def test_exec_attempt_counter(monkeypatch):
+    monkeypatch.delenv(bp._EXEC_ATTEMPT_ENV, raising=False)
+    assert bp.exec_attempt() == 0
+    monkeypatch.setenv(bp._EXEC_ATTEMPT_ENV, "2")
+    assert bp.exec_attempt() == 2
+    # Exhausted budget: returns instead of exec'ing.
+    assert bp.retry_via_exec(max_execs=2, backoff_s=0.0) is None
+
+
+def test_is_backend_unavailable_error():
+    assert bp.is_backend_unavailable_error(
+        RuntimeError("UNAVAILABLE: TPU backend setup/compile error"))
+    assert bp.is_backend_unavailable_error(
+        RuntimeError("Unable to initialize backend 'axon'"))
+    assert not bp.is_backend_unavailable_error(ValueError("shape mismatch"))
