@@ -25,6 +25,7 @@ from .basics import (  # noqa: F401
     ddl_built, xla_built, mpi_enabled, gloo_enabled, xla_enabled,
     mpi_threads_supported,
     config, global_mesh, start_timeline, stop_timeline,
+    parameter_manager,
     NotInitializedError,
 )
 from .config import Config  # noqa: F401

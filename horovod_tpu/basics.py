@@ -143,6 +143,10 @@ def init(config: Optional[Config] = None) -> None:
         from .config import warn_noop_knobs
 
         warn_noop_knobs(logger)
+        from .utils.logging import set_level
+
+        set_level(cfg.log_level)
+        _apply_cache_capacity(cfg.cache_capacity)
         _state.config = cfg
         _state.mesh = GlobalMesh.build(axis_name=cfg.mesh_axis_name)
         _state.process_sets = _ps.ProcessSetTable(_state.mesh)
@@ -152,12 +156,92 @@ def init(config: Optional[Config] = None) -> None:
             warn_after_s=cfg.stall_check_time_seconds,
             shutdown_after_s=cfg.stall_shutdown_time_seconds,
         )
+        _state.parameter_manager = _maybe_build_parameter_manager(cfg)
         _state.initialized = True
         _state.cross_monitor = _maybe_start_cross_monitor(cfg)
         logger.info(
             "horovod_tpu initialized: %d slot(s) on %d process(es), platform=%s",
             _state.mesh.size, jax.process_count(), jax.default_backend(),
         )
+
+
+_default_cache_sizes: dict = {}
+
+
+def _apply_cache_capacity(capacity: Optional[int]) -> None:
+    """``HOROVOD_CACHE_CAPACITY`` bounds the compiled-collective
+    dispatch caches — the role the reference's response cache capacity
+    plays for its negotiated-response LRU (``response_cache.cc``,
+    SURVEY.md §2.1, mount empty).  Unset (None): each dispatch cache
+    keeps its per-op tuned size (restored across re-inits); any explicit
+    value rebinds them all to the requested capacity."""
+    import functools
+
+    from .ops import collectives as _c
+
+    for name in ("_allreduce_fn", "_grouped_allreduce_fn", "_allgather_fn",
+                 "_broadcast_fn", "_alltoall_fn", "_reducescatter_fn"):
+        fn = getattr(_c, name)
+        wrapped = getattr(fn, "__wrapped__", None)
+        if wrapped is None:
+            continue
+        current = fn.cache_info().maxsize
+        default = _default_cache_sizes.setdefault(name, current)
+        target = default if capacity is None else capacity
+        if target != current:
+            setattr(_c, name,
+                    functools.lru_cache(maxsize=target)(wrapped))
+
+
+def _maybe_build_parameter_manager(cfg):
+    """``HOROVOD_AUTOTUNE=1`` → construct the online knob tuner
+    (reference: ``ParameterManager`` in the background thread,
+    ``parameter_manager.cc`` per SURVEY.md §2.1, mount empty).
+
+    The TPU tunable surface is the fusion threshold — the bucket size
+    that trades collective latency hiding against pipelining inside the
+    compiled step.  ``make_train_step`` feeds windowed samples/sec and
+    re-jits when the manager proposes a new value (the re-jit boundary
+    replaces the reference's next-cycle knob application); see
+    ``optim/autotune.py``."""
+    if not cfg.autotune:
+        return None
+    from .optim.parameter_manager import ParameterManager
+
+    pm = ParameterManager(
+        knobs={"fusion_threshold": (1 << 20, 1 << 28)},
+        warmup_samples=cfg.autotune_warmup_samples,
+        steps_per_sample=cfg.autotune_steps_per_sample,
+        max_samples=cfg.autotune_max_samples,
+        log_path=cfg.autotune_log,
+        # Scores are attributed to the manager's current point — seed it
+        # with the threshold the first windows will actually run.
+        initial={"fusion_threshold": cfg.fusion_threshold},
+    )
+    logger.info(
+        "autotune enabled: tuning fusion_threshold over [1MiB, 256MiB], "
+        "%d warmup + %d scored windows of %d steps%s",
+        cfg.autotune_warmup_samples, cfg.autotune_max_samples,
+        cfg.autotune_steps_per_sample,
+        f", log={cfg.autotune_log}" if cfg.autotune_log else "")
+    return pm
+
+
+def parameter_manager():
+    """The active autotuner, or None unless ``HOROVOD_AUTOTUNE=1``."""
+    return _require_init().parameter_manager
+
+
+def _apply_autotuned_fusion_threshold(value: float) -> None:
+    """Apply an autotune proposal: swap the frozen Config for one with
+    the new fusion threshold.  Callers must rebuild (re-jit) their train
+    step afterwards — trace-time reads of ``config().fusion_threshold``
+    pick the new value up on the next trace."""
+    import dataclasses
+
+    st = _require_init()
+    st.config = dataclasses.replace(st.config,
+                                    fusion_threshold=int(value))
 
 
 def _maybe_start_cross_monitor(cfg):
@@ -188,8 +272,9 @@ def _maybe_start_cross_monitor(cfg):
             from .native import runtime as native
 
             if native.available():
-                coord = native.Coordinator(0, nproc, host=host, port=0,
-                                           timeout_s=30.0)
+                coord = native.Coordinator(
+                    0, nproc, host=host, port=0,
+                    fusion_threshold=cfg.fusion_threshold, timeout_s=30.0)
                 port = coord.bound_port
         except Exception as e:
             logger.info("cross-process stall monitor unavailable: %s", e)
@@ -212,8 +297,9 @@ def _maybe_start_cross_monitor(cfg):
             from .native import runtime as native
 
             if native.available():
-                coord = native.Coordinator(rank, nproc, host=host, port=port,
-                                           timeout_s=30.0)
+                coord = native.Coordinator(
+                    rank, nproc, host=host, port=port,
+                    fusion_threshold=cfg.fusion_threshold, timeout_s=30.0)
         except Exception as e:
             logger.info("cross-process stall monitor unavailable: %s", e)
             coord = None
@@ -246,10 +332,13 @@ def shutdown() -> None:
         for fn in (_c._allreduce_fn, _c._grouped_allreduce_fn, _c._allgather_fn,
                    _c._broadcast_fn, _c._alltoall_fn, _c._reducescatter_fn):
             fn.cache_clear()
+        if _state.parameter_manager is not None:
+            _state.parameter_manager.close()
         _state.mesh = None
         _state.process_sets = None
         _state.timeline = None
         _state.stall_inspector = None
+        _state.parameter_manager = None
 
 
 atexit.register(shutdown)

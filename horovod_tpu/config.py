@@ -96,13 +96,16 @@ class Config:
     autotune_log: Optional[str] = None        # HOROVOD_AUTOTUNE_LOG
     autotune_warmup_samples: int = 3          # HOROVOD_AUTOTUNE_WARMUP_SAMPLES
     autotune_steps_per_sample: int = 10       # HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE
+    autotune_max_samples: int = 20            # HVD_TPU_AUTOTUNE_MAX_SAMPLES (tune budget, then freeze)
 
     # --- elastic (reference: runner/elastic/) ---
     elastic_timeout_seconds: float = 600.0    # HOROVOD_ELASTIC_TIMEOUT
     reset_limit: int = 0                      # HOROVOD_ELASTIC_RESET_LIMIT (0 = unlimited)
 
     # --- cache (reference: response_cache.cc) ---
-    cache_capacity: int = 1024                # HOROVOD_CACHE_CAPACITY
+    # None = unset: each dispatch cache keeps its per-op tuned size.  An
+    # explicit value (even 1024) applies to all dispatch caches.
+    cache_capacity: Optional[int] = None      # HOROVOD_CACHE_CAPACITY
 
     # --- TPU-specific (no reference analogue) ---
     mesh_axis_name: str = "hvd"               # HVD_TPU_MESH_AXIS_NAME
@@ -130,9 +133,11 @@ class Config:
             autotune_log=autotune_log or None,
             autotune_warmup_samples=_env_int("AUTOTUNE_WARMUP_SAMPLES", 3),
             autotune_steps_per_sample=_env_int("AUTOTUNE_STEPS_PER_SAMPLE", 10),
+            autotune_max_samples=_env_int("AUTOTUNE_MAX_SAMPLES", 20),
             elastic_timeout_seconds=_env_float("ELASTIC_TIMEOUT", 600.0),
             reset_limit=_env_int("ELASTIC_RESET_LIMIT", 0),
-            cache_capacity=_env_int("CACHE_CAPACITY", 1024),
+            cache_capacity=(int(_env("CACHE_CAPACITY"))
+                            if _env("CACHE_CAPACITY") is not None else None),
             mesh_axis_name=_env("MESH_AXIS_NAME", "hvd") or "hvd",
             use_native_planner=_env_bool("USE_NATIVE_PLANNER", True),
             native_coordinator=_env_bool("NATIVE_COORD", True),

@@ -167,9 +167,16 @@ class ElasticDriver:
             self._thread = None
 
     def wait_for_available_slots(self, min_slots: int,
-                                 timeout_s: float = 600.0) -> Dict[str, int]:
+                                 timeout_s: float = None) -> Dict[str, int]:
         """Block until discovery reports at least ``min_slots`` (reference:
-        driver startup barrier with HOROVOD_ELASTIC_TIMEOUT)."""
+        driver startup barrier with HOROVOD_ELASTIC_TIMEOUT).  Default
+        timeout = ``config().elastic_timeout_seconds`` (that env knob),
+        600s when uninitialized."""
+        if timeout_s is None:
+            from .. import basics
+
+            timeout_s = (basics.config().elastic_timeout_seconds
+                         if basics.is_initialized() else 600.0)
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             self.poll_once()

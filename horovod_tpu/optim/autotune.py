@@ -1,0 +1,123 @@
+"""Online autotuning of the training step (``HOROVOD_AUTOTUNE=1``).
+
+Reference behavior (``horovod/common/parameter_manager.cc`` driven from
+the background thread — SURVEY.md §2.1, mount empty, unverified): with
+``HOROVOD_AUTOTUNE=1`` the runtime scores training samples/sec per
+tuning window, proposes new knob values (Bayesian optimization over
+fusion threshold / cycle time), applies them to the *next* cycle, and
+freezes at the best point after the sample budget.
+
+TPU-native redesign
+-------------------
+There is no background thread or cycle loop to re-parameterize: the
+fusion threshold is baked into the compiled program at trace time (it
+decides the gradient bucketing of the fused allreduce).  The knob
+application point is therefore the **re-jit boundary**: the wrapper
+below times windows of ``steps_per_sample`` dispatches with ONE device
+fence per window (per-step wall times are meaningless under async
+dispatch), feeds samples/sec to the :class:`ParameterManager`, and when
+a proposal arrives writes the new threshold into the live Config and
+rebuilds the jitted step.  Once the manager freezes, the wrapper
+becomes a zero-overhead passthrough (no more fences).
+
+``hvd.make_train_step`` returns one of these automatically when
+autotune is on; nothing else in user code changes — the reference's
+set-the-env-var-and-it-tunes contract.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+import jax
+
+from ..utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _global_batch_size(batch) -> int:
+    """Samples per step = leading dim of the first batch leaf."""
+    leaves = jax.tree.leaves(batch)
+    return int(leaves[0].shape[0]) if leaves else 0
+
+
+class AutotunedTrainStep:
+    """Call-compatible wrapper over a jitted train step that re-jits as
+    the :class:`ParameterManager` proposes fusion thresholds.
+
+    ``rebuild()`` must return a fresh jitted step that reads the live
+    ``hvd.config().fusion_threshold`` at trace time (make_train_step's
+    builder does).  ``applied`` records every threshold the tuner
+    actually installed, for inspection/tests.
+    """
+
+    def __init__(self, rebuild: Callable[[], Callable], pm) -> None:
+        self._rebuild = rebuild
+        self._pm = pm
+        self._step = rebuild()
+        self._window_steps = 0
+        self._window_samples = 0.0
+        self._t0 = 0.0
+        # The first call on a fresh jit pays trace+compile; that call is
+        # a real training step but must never land inside a timed window
+        # or the GP scores compile speed, not throughput.
+        self._burn_in = True
+        self._warned_traced = False
+        self.applied: list = []
+
+    @property
+    def frozen(self) -> bool:
+        return self._pm.frozen
+
+    def __call__(self, params, opt_state, batch, *rest):
+        if self._pm.frozen:
+            return self._step(params, opt_state, batch, *rest)
+        if any(isinstance(leaf, jax.core.Tracer)
+               for leaf in jax.tree.leaves((params, opt_state, batch))):
+            # Consumed inside an enclosing jit/scan: __call__ runs once
+            # at trace time, so wall-clock timing and window counting
+            # are meaningless — bypass instrumentation entirely.
+            if not self._warned_traced:
+                self._warned_traced = True
+                logger.warning(
+                    "autotuned train step is being traced inside an "
+                    "enclosing jit/scan; autotune is disabled for this "
+                    "step (call it directly to tune)")
+            return self._step(params, opt_state, batch, *rest)
+        if self._burn_in:
+            # Unscored compile step: train, fence, leave window closed.
+            out = self._step(params, opt_state, batch, *rest)
+            jax.block_until_ready(out)
+            self._burn_in = False
+            return out
+        if self._window_steps == 0:
+            # Window start.  The previous window (or burn-in) ended with
+            # a fence, so the queue is empty and t0 is honest.
+            self._t0 = time.perf_counter()
+        out = self._step(params, opt_state, batch, *rest)
+        self._window_steps += 1
+        self._window_samples += _global_batch_size(batch)
+        if self._window_steps >= self._pm.steps_per_sample:
+            jax.block_until_ready(out)
+            dt = time.perf_counter() - self._t0
+            suggestion = self._pm.record_window(self._window_samples, dt)
+            self._window_steps = 0
+            self._window_samples = 0.0
+            if suggestion is not None:
+                self._apply(suggestion)
+        return out
+
+    def _apply(self, suggestion) -> None:
+        from .. import basics
+
+        threshold = int(suggestion["fusion_threshold"])
+        basics._apply_autotuned_fusion_threshold(threshold)
+        self._step = self._rebuild()
+        self._burn_in = True   # next call compiles; keep it unscored
+        self.applied.append(threshold)
+        logger.info(
+            "autotune %s fusion_threshold=%d (%d applied so far)",
+            "froze at" if self._pm.frozen else "trying", threshold,
+            len(self.applied))

@@ -283,4 +283,17 @@ def make_train_step(
         check=False,
     )
     donate_argnums = (0, 1) if donate else ()
-    return jax.jit(body, donate_argnums=donate_argnums)
+
+    def build():
+        # A fresh jit wrapper re-traces, so trace-time reads of
+        # config().fusion_threshold (here and inside a wrapped
+        # DistributedOptimizer) pick up autotune proposals.
+        return jax.jit(body, donate_argnums=donate_argnums)
+
+    pm = (basics._state.parameter_manager
+          if basics.is_initialized() else None)
+    if pm is not None and not pm.frozen:
+        from .autotune import AutotunedTrainStep
+
+        return AutotunedTrainStep(build, pm)
+    return build()

@@ -97,7 +97,8 @@ class ParameterManager:
     def __init__(self, knobs: Dict[str, Tuple[float, float]],
                  *, warmup_samples: int = 3, steps_per_sample: int = 10,
                  max_samples: int = 20, candidates_per_round: int = 64,
-                 log_path: Optional[str] = None, seed: int = 0) -> None:
+                 log_path: Optional[str] = None, seed: int = 0,
+                 initial: Optional[Dict[str, float]] = None) -> None:
         if not knobs:
             raise ValueError("ParameterManager needs at least one knob")
         self.knob_names = sorted(knobs)
@@ -112,7 +113,17 @@ class ParameterManager:
         self._gp = GaussianProcess(length_scale=2.0)
         self._x: List[np.ndarray] = []
         self._y: List[float] = []
-        self._current = self.bounds.mean(axis=1)
+        # Scores are recorded against _current, so it MUST match the
+        # knob values the caller is actually running — seed it with the
+        # live values when given (clamped into bounds), else the
+        # midpoint is just the conventional first candidate.
+        if initial:
+            self._current = np.array([
+                np.clip(math.log2(initial.get(k, 2 ** self.bounds[i].mean())),
+                        self.bounds[i, 0], self.bounds[i, 1])
+                for i, k in enumerate(self.knob_names)])
+        else:
+            self._current = self.bounds.mean(axis=1)
         self._records: List[float] = []
         self._samples_seen = 0
         self._frozen = False
@@ -123,6 +134,13 @@ class ParameterManager:
     @property
     def frozen(self) -> bool:
         return self._frozen
+
+    def close(self) -> None:
+        """Flush and close the autotune log (idempotent; called from
+        ``hvd.shutdown``)."""
+        if self._log:
+            self._log.close()
+            self._log = None
 
     def current_values(self) -> Dict[str, float]:
         return {k: float(2 ** v)
@@ -138,6 +156,25 @@ class ParameterManager:
             return None
         score = float(np.median(self._records))
         self._records = []
+        return self._ingest(score)
+
+    def record_window(self, samples: float,
+                      seconds: float) -> Optional[Dict[str, float]]:
+        """Feed one aggregated window: ``steps_per_sample`` steps fenced
+        ONCE (one device sync per window instead of per step — the right
+        cadence for async XLA dispatch, where per-step wall times are
+        meaningless).  Equivalent to :meth:`record` fed per-step timings
+        of identical rate; returns new knob values or None, same
+        contract."""
+        if self._frozen or seconds <= 0:
+            return None
+        return self._ingest(samples / seconds)
+
+    # --- internals ---------------------------------------------------------
+
+    def _ingest(self, score: float) -> Optional[Dict[str, float]]:
+        """Shared score-ingestion tail of record/record_window: warmup
+        discard → observe (x=current, y=score) → freeze or propose."""
         self._samples_seen += 1
         if self._samples_seen <= self.warmup_samples:
             return None  # discard warmup; keep current knobs
@@ -148,8 +185,6 @@ class ParameterManager:
             return self._freeze()
         self._current = self._propose()
         return self.current_values()
-
-    # --- internals ---------------------------------------------------------
 
     def _propose(self) -> np.ndarray:
         y = np.asarray(self._y)

@@ -56,3 +56,13 @@ def get_logger(name: str) -> logging.Logger:
     if not name.startswith("horovod_tpu"):
         name = f"horovod_tpu.{name}"
     return logging.getLogger(name)
+
+
+def set_level(level_name: str) -> None:
+    """Apply a log level by reference name (trace/debug/info/warning/
+    error/fatal).  Called from ``hvd.init`` so a programmatic
+    ``Config(log_level=...)`` works like the env var; unknown names fall
+    back to warning (the reference's env parser is equally lenient)."""
+    _configure_root()
+    logging.getLogger("horovod_tpu").setLevel(
+        _LEVELS.get(level_name.lower(), logging.WARNING))
