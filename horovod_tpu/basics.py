@@ -179,6 +179,15 @@ def _apply_cache_capacity(capacity: Optional[int]) -> None:
 
     from .ops import collectives as _c
 
+    if capacity == 0:
+        # The reference's CACHE_CAPACITY=0 disables its negotiation
+        # response cache; here the "cache" holds compiled XLA programs,
+        # and maxsize=0 would re-trace+recompile every collective call.
+        logger.warning(
+            "HOROVOD_CACHE_CAPACITY=0 would recompile every collective "
+            "on TPU (the cache holds compiled XLA programs, not "
+            "negotiation responses); keeping the default capacities")
+        capacity = None
     for name in ("_allreduce_fn", "_grouped_allreduce_fn", "_allgather_fn",
                  "_broadcast_fn", "_alltoall_fn", "_reducescatter_fn"):
         fn = getattr(_c, name)
@@ -206,18 +215,35 @@ def _maybe_build_parameter_manager(cfg):
     ``optim/autotune.py``."""
     if not cfg.autotune:
         return None
+    import dataclasses
+
     from .optim.parameter_manager import ParameterManager
 
+    lo, hi = 1 << 20, 1 << 28
+    # Scores are attributed to the manager's current point — seed it
+    # with the threshold the first windows will actually run.  A live
+    # value outside the search space (e.g. HOROVOD_FUSION_THRESHOLD=0,
+    # the reference's fusion-off setting) can't seed it; the tuner's
+    # start point becomes the live value instead — autotune overriding
+    # a manual threshold is its purpose.
+    seedable = lo <= cfg.fusion_threshold <= hi
     pm = ParameterManager(
-        knobs={"fusion_threshold": (1 << 20, 1 << 28)},
+        knobs={"fusion_threshold": (lo, hi)},
         warmup_samples=cfg.autotune_warmup_samples,
         steps_per_sample=cfg.autotune_steps_per_sample,
         max_samples=cfg.autotune_max_samples,
         log_path=cfg.autotune_log,
-        # Scores are attributed to the manager's current point — seed it
-        # with the threshold the first windows will actually run.
-        initial={"fusion_threshold": cfg.fusion_threshold},
+        initial=({"fusion_threshold": cfg.fusion_threshold}
+                 if seedable else None),
     )
+    if not seedable:
+        start = int(pm.current_values()["fusion_threshold"])
+        logger.warning(
+            "HOROVOD_AUTOTUNE=1 overrides fusion_threshold=%d (outside "
+            "the tunable range [%d, %d]): starting from %d",
+            cfg.fusion_threshold, lo, hi, start)
+        _state.config = dataclasses.replace(_state.config,
+                                            fusion_threshold=start)
     logger.info(
         "autotune enabled: tuning fusion_threshold over [1MiB, 256MiB], "
         "%d warmup + %d scored windows of %d steps%s",

@@ -52,6 +52,14 @@ def _env_int(name: str, default: int) -> int:
         raise ValueError(f"Integer env var {name!r} has unparseable value {val!r}") from e
 
 
+def _env_opt_int(name: str) -> Optional[int]:
+    """Like :func:`_env_int` but unset stays ``None`` (knobs where
+    unset and any explicit value mean different things)."""
+    if _env(name) is None:
+        return None
+    return _env_int(name, 0)
+
+
 def _env_float(name: str, default: float) -> float:
     val = _env(name)
     if val is None:
@@ -136,8 +144,7 @@ class Config:
             autotune_max_samples=_env_int("AUTOTUNE_MAX_SAMPLES", 20),
             elastic_timeout_seconds=_env_float("ELASTIC_TIMEOUT", 600.0),
             reset_limit=_env_int("ELASTIC_RESET_LIMIT", 0),
-            cache_capacity=(int(_env("CACHE_CAPACITY"))
-                            if _env("CACHE_CAPACITY") is not None else None),
+            cache_capacity=_env_opt_int("CACHE_CAPACITY"),
             mesh_axis_name=_env("MESH_AXIS_NAME", "hvd") or "hvd",
             use_native_planner=_env_bool("USE_NATIVE_PLANNER", True),
             native_coordinator=_env_bool("NATIVE_COORD", True),

@@ -96,3 +96,124 @@ class ShardedBatchIterator:
                 outs.append(p)
             yield tuple(outs), mask
         self.epoch += 1
+
+
+# --- join: ragged per-rank datasets ----------------------------------------
+#
+# Reference: the JOIN message type (``hvd.join()`` — a rank out of data
+# keeps answering collectives with zero tensors until every rank has
+# joined; SURVEY.md §2.1, mount empty, unverified).  Under XLA SPMD a
+# rank that stops entering the compiled step stops entering its
+# collectives — so the join point moves from the runtime to the input
+# pipeline: negotiate the global step count up front, then exhausted
+# ranks feed zero batches with zero masks (the neutral element) for the
+# remaining steps.  Combined with :func:`global_masked_mean` the result
+# is *exact* — masked rows contribute nothing to the loss or gradient,
+# and averages are over real samples only (the reference's Average
+# over joined ranks divides by the active-rank count; dividing by the
+# real-sample count is the per-example-exact version of that).
+
+
+def negotiate_steps(local_steps: int) -> int:
+    """The JOIN negotiation: one collective exchange of per-rank step
+    counts; every rank returns the global maximum.  Works in-process and
+    across real controllers (``allgather_object`` rides the framework's
+    byte-tensor allgather)."""
+    from .functions import allgather_object
+
+    return int(max(allgather_object(int(local_steps))))
+
+
+class JoinedBatchIterator:
+    """Iterate a rank's *ragged* local shard for the negotiated global
+    step count — the drop-in replacement for the reference's
+
+    .. code-block:: python
+
+        for batch in my_uneven_dataset: train(batch)
+        hvd.join()
+
+    Every rank constructs this over its own arrays (any leading-dim
+    size, including zero rows); iteration yields ``(batch_tuple, mask)``
+    of identical static shapes on every rank for exactly
+    ``negotiate_steps(ceil(local_rows / batch_size))`` steps.  After the
+    local shard is exhausted, batches and mask are all zeros — feed the
+    mask through :func:`global_masked_mean` (or :func:`masked_mean`) so
+    padded rows are neutral.
+
+    Negotiation is collective, so it only happens at symmetric points
+    every rank reaches: construction and each ``__iter__`` (an epoch) —
+    shards may grow or shrink between epochs (elastic restarts
+    re-negotiate).  ``len()`` is a pure read of the last negotiated
+    count (rank-asymmetric ``len()`` calls — a tqdm on rank 0 only —
+    must never issue a collective or the world deadlocks).
+    """
+
+    def __init__(self, *arrays: np.ndarray, batch_size: int,
+                 shuffle: bool = False, seed: int = 0) -> None:
+        n = arrays[0].shape[0]
+        for a in arrays:
+            if a.shape[0] != n:
+                raise ValueError("all arrays need equal leading dims")
+        self.arrays = arrays
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.local_steps = math.ceil(n / batch_size) if n else 0
+        self.global_steps = negotiate_steps(self.local_steps)
+
+    def __len__(self) -> int:
+        return self.global_steps
+
+    def __iter__(self) -> Iterator[Tuple[Tuple[np.ndarray, ...], np.ndarray]]:
+        self.global_steps = negotiate_steps(self.local_steps)
+        n = self.arrays[0].shape[0]
+        order = np.arange(n)
+        if self.shuffle:
+            np.random.RandomState(self.seed + self.epoch).shuffle(order)
+        zero_mask = np.zeros((self.batch_size,), np.float32)
+        for s in range(self.global_steps):
+            if s < self.local_steps:
+                idx = order[s * self.batch_size:(s + 1) * self.batch_size]
+                outs, mask = [], None
+                for a in self.arrays:
+                    p, mask = pad_batch(a[idx], self.batch_size)
+                    outs.append(p)
+                yield tuple(outs), mask
+            else:
+                # Joined: neutral elements keep the compiled step (and
+                # its collectives) running on this rank.
+                yield tuple(np.zeros((self.batch_size,) + a.shape[1:],
+                                     a.dtype) for a in self.arrays), zero_mask
+        self.epoch += 1
+
+
+def global_masked_mean(values, mask, axis_name: Optional[str] = None,
+                       groups=None):
+    """Exact mean over real entries across ALL slots, inside an SPMD
+    region: ``psum(sum(values*mask)) / psum(sum(mask))``.
+
+    Use as the loss reduction with :class:`JoinedBatchIterator` and the
+    DEFAULT ``op=hvd.Average`` gradient reduction — jax transposes
+    ``psum`` to ``psum``, so each slot's gradient of this loss is
+    already the full global-mean gradient and averaging identical
+    values is exact.  A run over ragged shards then computes exactly
+    the same gradients as a single process over the concatenated data
+    (tested in ``tests/test_data.py`` and
+    ``tests/multiproc/test_join_mp.py``)."""
+    import jax.numpy as jnp
+
+    from .ops import spmd
+
+    if axis_name is None:
+        from . import basics
+
+        axis_name = (basics.config().mesh_axis_name
+                     if basics.is_initialized() else "hvd")
+    mask = mask.astype(values.dtype)
+    total = spmd.allreduce(jnp.sum(values * mask), op="sum",
+                           axis=axis_name, groups=groups)
+    count = spmd.allreduce(jnp.sum(mask), op="sum",
+                           axis=axis_name, groups=groups)
+    return total / jnp.maximum(count, 1)

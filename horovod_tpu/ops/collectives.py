@@ -812,11 +812,16 @@ def join() -> int:
     """Reference: ``hvd.join()`` — lets a rank that ran out of data keep
     participating in collectives with zero contributions.
 
-    Deliberate design difference: under XLA SPMD every slot executes the
-    same program, so ranks cannot run uneven step counts within one
-    compiled loop — uneven *data* is handled by padding/masking at the
-    input pipeline.  ``join`` therefore only synchronizes and reports the
-    last rank, for API compatibility.
+    TPU redesign: under XLA SPMD a rank that stops entering the compiled
+    step stops entering its collectives, so the join point moves from
+    the runtime to the input pipeline — ``hvd.data.JoinedBatchIterator``
+    negotiates the global step count and feeds exhausted ranks zero
+    batches with zero masks (``hvd.data.global_masked_mean`` keeps the
+    averages exact); see docs/migration.md.  Calling ``join()`` itself
+    is then only the epoch-end synchronization point: it barriers and,
+    like the reference, reports the last rank to reach it (with
+    pre-negotiated step counts every rank arrives at the same step, so
+    the highest rank stands in for "last joined").
     """
     st = _st()
     barrier(name="join")

@@ -102,12 +102,34 @@ class AutotunedTrainStep:
         if self._window_steps >= self._pm.steps_per_sample:
             jax.block_until_ready(out)
             dt = time.perf_counter() - self._t0
-            suggestion = self._pm.record_window(self._window_samples, dt)
+            suggestion = self._record_synchronized(self._window_samples, dt)
             self._window_steps = 0
             self._window_samples = 0.0
             if suggestion is not None:
                 self._apply(suggestion)
         return out
+
+    def _record_synchronized(self, samples: float, dt: float):
+        """Feed the window score and return the proposal — identically
+        on every controller.  Ranks reach window boundaries in lockstep
+        (same steps_per_sample, same step sequence), but their wall
+        clocks differ, so letting each rank run its own GP would freeze
+        different thresholds and re-jit DIVERGENT collective programs
+        (hang/corruption).  Like the reference's coordinator, rank 0
+        decides and broadcasts; peers mirror its manager state."""
+        if jax.process_count() == 1:
+            return self._pm.record_window(samples, dt)
+        from ..functions import broadcast_object
+
+        if jax.process_index() == 0:
+            suggestion = self._pm.record_window(samples, dt)
+            payload = (suggestion, self._pm.frozen)
+        else:
+            payload = None
+        suggestion, frozen = broadcast_object(payload, root_rank=0)
+        if jax.process_index() != 0:
+            self._pm.mirror(suggestion, frozen)
+        return suggestion
 
     def _apply(self, suggestion) -> None:
         from .. import basics

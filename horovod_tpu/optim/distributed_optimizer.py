@@ -293,7 +293,18 @@ def make_train_step(
     pm = (basics._state.parameter_manager
           if basics.is_initialized() else None)
     if pm is not None and not pm.frozen:
+        if pm.claimed:
+            # A second concurrent train step feeding the same manager
+            # would cross-pollute scores and never see re-jits; only
+            # the first step tunes.
+            from ..utils.logging import get_logger
+
+            get_logger(__name__).warning(
+                "autotune is already driving another train step; this "
+                "step runs untuned (one tuner per process)")
+            return build()
         from .autotune import AutotunedTrainStep
 
+        pm.claimed = True
         return AutotunedTrainStep(build, pm)
     return build()

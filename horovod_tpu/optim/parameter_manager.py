@@ -115,15 +115,27 @@ class ParameterManager:
         self._y: List[float] = []
         # Scores are recorded against _current, so it MUST match the
         # knob values the caller is actually running — seed it with the
-        # live values when given (clamped into bounds), else the
-        # midpoint is just the conventional first candidate.
+        # live values when given, else the midpoint is just the
+        # conventional first candidate.  Out-of-bounds seeds would break
+        # that invariant silently (and 0 breaks log2); reject them so
+        # the caller decides (basics falls back to adopting the
+        # manager's start point as the live value).
         if initial:
-            self._current = np.array([
-                np.clip(math.log2(initial.get(k, 2 ** self.bounds[i].mean())),
-                        self.bounds[i, 0], self.bounds[i, 1])
-                for i, k in enumerate(self.knob_names)])
+            vals = []
+            for i, k in enumerate(self.knob_names):
+                v = initial.get(k, float(2 ** self.bounds[i].mean()))
+                if not (2 ** self.bounds[i, 0] <= v <= 2 ** self.bounds[i, 1]):
+                    raise ValueError(
+                        f"initial value {v} for knob {k!r} is outside the "
+                        f"search bounds [{2 ** self.bounds[i, 0]:.0f}, "
+                        f"{2 ** self.bounds[i, 1]:.0f}]")
+                vals.append(math.log2(v))
+            self._current = np.array(vals)
         else:
             self._current = self.bounds.mean(axis=1)
+        # One manager drives one train step (make_train_step claims it);
+        # concurrent consumers would cross-pollute scores.
+        self.claimed = False
         self._records: List[float] = []
         self._samples_seen = 0
         self._frozen = False
@@ -141,6 +153,18 @@ class ParameterManager:
         if self._log:
             self._log.close()
             self._log = None
+
+    def mirror(self, values: Optional[Dict[str, float]],
+               frozen: bool) -> None:
+        """Adopt a peer's tuner decision (multi-controller worlds: rank
+        0 tunes, everyone else mirrors — the reference's coordinator
+        broadcast).  ``values`` of None leaves the current point."""
+        if values:
+            self._current = np.array(
+                [math.log2(values[k]) for k in self.knob_names])
+        self._frozen = frozen
+        if frozen:
+            self.close()
 
     def current_values(self) -> Dict[str, float]:
         return {k: float(2 ** v)

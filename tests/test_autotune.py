@@ -258,3 +258,93 @@ class TestAutotuneEndToEnd:
         step = hvd.make_train_step(
             lambda p, b: (p["w"] * b).sum(), optax.sgd(0.1))
         assert not isinstance(step, AutotunedTrainStep)
+
+
+class TestAutotuneRobustness:
+    """Round-4 review findings: out-of-bounds seeds, double claim,
+    multi-controller synchronization."""
+
+    def test_out_of_bounds_seed_raises(self):
+        with pytest.raises(ValueError, match="outside the search bounds"):
+            ParameterManager({"fusion_threshold": (1 << 20, 1 << 28)},
+                             initial={"fusion_threshold": 0})
+
+    def test_fusion_off_plus_autotune_adopts_tuner_start(self):
+        hvd.shutdown()
+        try:
+            # HOROVOD_FUSION_THRESHOLD=0 (reference fusion-off) must not
+            # crash init; the tuner's start point becomes the live value.
+            hvd.init(Config(autotune=True, fusion_threshold=0))
+            assert hvd.parameter_manager() is not None
+            live = hvd.config().fusion_threshold
+            assert (1 << 20) <= live <= (1 << 28)
+            assert live == int(hvd.parameter_manager()
+                               .current_values()["fusion_threshold"])
+        finally:
+            hvd.shutdown()
+            hvd.init()
+
+    def test_second_train_step_runs_untuned(self):
+        import optax
+
+        from horovod_tpu.optim.autotune import AutotunedTrainStep
+
+        hvd.shutdown()
+        try:
+            hvd.init(Config(autotune=True))
+            tx = hvd.DistributedOptimizer(optax.sgd(0.1))
+            s1 = hvd.make_train_step(lambda p, b: (p["w"] * b).sum(), tx)
+            s2 = hvd.make_train_step(lambda p, b: (p["w"] * b).sum(), tx)
+            assert isinstance(s1, AutotunedTrainStep)
+            assert not isinstance(s2, AutotunedTrainStep)
+        finally:
+            hvd.shutdown()
+            hvd.init()
+
+    def test_mirror_adopts_peer_decision(self):
+        pm = ParameterManager({"fusion_threshold": (1 << 20, 1 << 28)})
+        pm.mirror({"fusion_threshold": float(1 << 22)}, frozen=False)
+        assert pm.current_values()["fusion_threshold"] == float(1 << 22)
+        assert not pm.frozen
+        pm.mirror(None, frozen=True)
+        assert pm.frozen
+
+    def test_multi_controller_rank0_decides(self, monkeypatch):
+        """Window scoring across controllers: rank 0 runs the GP and
+        broadcasts; peers mirror — both sides exercised with a faked
+        2-process world."""
+        import jax
+
+        from horovod_tpu import functions as F
+        from horovod_tpu.optim.autotune import AutotunedTrainStep
+
+        pm0 = ParameterManager({"fusion_threshold": (1 << 20, 1 << 28)},
+                               warmup_samples=0, steps_per_sample=1,
+                               max_samples=1)
+        wrapper = AutotunedTrainStep.__new__(AutotunedTrainStep)
+        wrapper._pm = pm0
+        sent = {}
+
+        def fake_broadcast(payload, root_rank=0):
+            if payload is not None:
+                sent["payload"] = payload
+            return sent["payload"]
+
+        monkeypatch.setattr(F, "broadcast_object", fake_broadcast)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        # Rank 0: records for real, broadcasts its decision (freeze at
+        # the single sample).
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        suggestion = wrapper._record_synchronized(100.0, 1.0)
+        assert pm0.frozen and suggestion is not None
+        assert sent["payload"] == (suggestion, True)
+        # Rank 1: same boundary, mirrors rank 0's state.
+        pm1 = ParameterManager({"fusion_threshold": (1 << 20, 1 << 28)},
+                               warmup_samples=0, steps_per_sample=1,
+                               max_samples=1)
+        wrapper._pm = pm1
+        monkeypatch.setattr(jax, "process_index", lambda: 1)
+        s2 = wrapper._record_synchronized(999.0, 1.0)  # local score unused
+        assert s2 == suggestion
+        assert pm1.frozen
+        assert pm1.current_values() == pm0.current_values()

@@ -109,3 +109,26 @@ class TestNoUnconsumedFields:
         assert not unconsumed, (
             f"parsed-but-unconsumed Config fields: {unconsumed} — wire "
             "them or add to the warn-on-set no-op list")
+
+
+class TestCacheCapacityEdges:
+    def test_unparseable_env_names_the_knob(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_CACHE_CAPACITY", "abc")
+        with pytest.raises(ValueError, match="CACHE_CAPACITY"):
+            Config.from_env()
+
+    def test_zero_warns_and_keeps_defaults(self, restore_session_init,
+                                           caplog):
+        from horovod_tpu.ops import collectives as C
+
+        # The framework logger is propagate=False (own stderr handler);
+        # route records to caplog for the assertion.
+        root = logging.getLogger("horovod_tpu")
+        root.propagate = True
+        try:
+            with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+                _reinit(Config(cache_capacity=0))
+        finally:
+            root.propagate = False
+        assert C._allreduce_fn.cache_info().maxsize == 512
+        assert any("CACHE_CAPACITY=0" in r.message for r in caplog.records)

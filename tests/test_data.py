@@ -72,3 +72,115 @@ class TestShardedBatchIterator:
     def test_mismatched_arrays_raise(self):
         with pytest.raises(ValueError):
             ShardedBatchIterator(np.ones(3), np.ones(4), batch_size=2)
+
+
+class TestJoinedBatchIterator:
+    """hvd.join() semantics at the input pipeline (reference: JOIN
+    message type): negotiated global step count, neutral batches after
+    local exhaustion."""
+
+    def test_single_controller_negotiates_local(self):
+        import horovod_tpu as hvd
+        from horovod_tpu.data import JoinedBatchIterator
+
+        assert hvd.is_initialized()
+        it = JoinedBatchIterator(np.arange(10, dtype=np.float32),
+                                 batch_size=4)
+        assert len(it) == 3  # ceil(10/4); one controller → local is global
+        steps = list(it)
+        assert len(steps) == 3
+        (last,), mask = steps[-1]
+        assert mask.tolist() == [1, 1, 0, 0]  # tail padding
+
+    def test_exhausted_rank_yields_neutral_batches(self, monkeypatch):
+        from horovod_tpu import data as D
+
+        # Simulate a 3-rank negotiation where a peer has 9 batches.
+        monkeypatch.setattr(D, "negotiate_steps", lambda n: 9)
+        it = D.JoinedBatchIterator(np.ones((20, 2), np.float32),
+                                   np.ones((20,), np.float32), batch_size=4)
+        out = list(it)
+        assert len(out) == 9
+        for (xb, yb), mask in out[:5]:
+            assert mask.sum() == 4 and xb.shape == (4, 2)
+        for (xb, yb), mask in out[5:]:   # joined: zeros everywhere
+            assert mask.sum() == 0
+            assert not xb.any() and not yb.any()
+            assert xb.shape == (4, 2) and yb.shape == (4,)
+
+    def test_zero_row_rank_participates(self, monkeypatch):
+        from horovod_tpu import data as D
+
+        monkeypatch.setattr(D, "negotiate_steps", lambda n: 2)
+        it = D.JoinedBatchIterator(np.zeros((0, 3), np.float32),
+                                   batch_size=2)
+        assert it.local_steps == 0
+        out = list(it)
+        assert len(out) == 2 and all(m.sum() == 0 for _, m in out)
+
+
+class TestGlobalMaskedMean:
+    def test_exact_ragged_gradients_match_numpy(self):
+        """The join recipe (JoinedBatchIterator + global_masked_mean +
+        the default op=Average) computes exactly the full-data gradient:
+        one step over a ragged 8-slot batch equals the numpy gradient
+        over real rows.  (Average, not Sum: jax transposes psum to
+        psum, so each slot's gradient of a psum'd loss is already the
+        full global gradient — averaging identical values is exact.)"""
+        import jax.numpy as jnp
+        import optax
+
+        import horovod_tpu as hvd
+        from horovod_tpu.data import global_masked_mean
+
+        n_slots = hvd.size()
+        per_slot = 2
+        rng = np.random.RandomState(0)
+        X = rng.randn(n_slots * per_slot, 3).astype(np.float32)
+        Y = rng.randn(n_slots * per_slot, 1).astype(np.float32)
+        # Ragged: the last 5 rows are padding (last 2.5 slots joined).
+        mask = np.ones((n_slots * per_slot,), np.float32)
+        mask[-5:] = 0.0
+        X_in = X * mask[:, None]   # joined rows are zero batches
+        Y_in = Y * mask[:, None]
+
+        def loss_fn(params, batch):
+            xb, yb, mb = batch
+            per_row = jnp.sum((xb @ params["w"] - yb) ** 2, axis=-1)
+            return global_masked_mean(per_row, mb)
+
+        lr = 0.1
+        step = hvd.make_train_step(loss_fn, optax.sgd(lr), donate=False)
+        w0 = np.zeros((3, 1), np.float32)
+        params = {"w": jnp.asarray(w0)}
+        opt_state = optax.sgd(lr).init(params)
+        params, _, loss = step(params, opt_state,
+                               (jnp.asarray(X_in), jnp.asarray(Y_in),
+                                jnp.asarray(mask)))
+
+        real = mask.astype(bool)
+        grad = 2.0 * X[real].T @ (X[real] @ w0 - Y[real]) / real.sum()
+        np.testing.assert_allclose(np.asarray(params["w"]), w0 - lr * grad,
+                                   rtol=1e-5, atol=1e-6)
+        exp_loss = float(np.mean(np.sum((X[real] @ w0 - Y[real]) ** 2, -1)))
+        np.testing.assert_allclose(float(loss), exp_loss, rtol=1e-5)
+
+    def test_all_masked_is_finite(self):
+        import jax
+        import jax.numpy as jnp
+
+        from horovod_tpu._compat import shard_map
+        from horovod_tpu.data import global_masked_mean
+        import horovod_tpu as hvd
+        from jax.sharding import PartitionSpec as P
+
+        gm = hvd.global_mesh()
+
+        def body(v, m):
+            return global_masked_mean(v, m)[None]
+
+        out = shard_map(body, mesh=gm.mesh, in_specs=(P(gm.axis_name),
+                                                      P(gm.axis_name)),
+                        out_specs=P(gm.axis_name), check=False)(
+            jnp.ones((hvd.size() * 2,)), jnp.zeros((hvd.size() * 2,)))
+        assert np.isfinite(np.asarray(out)).all()
