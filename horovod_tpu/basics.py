@@ -179,14 +179,15 @@ def _apply_cache_capacity(capacity: Optional[int]) -> None:
 
     from .ops import collectives as _c
 
-    if capacity == 0:
+    if capacity is not None and capacity <= 0:
         # The reference's CACHE_CAPACITY=0 disables its negotiation
         # response cache; here the "cache" holds compiled XLA programs,
-        # and maxsize=0 would re-trace+recompile every collective call.
+        # and maxsize<=0 would re-trace+recompile every collective call.
         logger.warning(
-            "HOROVOD_CACHE_CAPACITY=0 would recompile every collective "
+            "HOROVOD_CACHE_CAPACITY=%d would recompile every collective "
             "on TPU (the cache holds compiled XLA programs, not "
-            "negotiation responses); keeping the default capacities")
+            "negotiation responses); keeping the default capacities",
+            capacity)
         capacity = None
     for name in ("_allreduce_fn", "_grouped_allreduce_fn", "_allgather_fn",
                  "_broadcast_fn", "_alltoall_fn", "_reducescatter_fn"):

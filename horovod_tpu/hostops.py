@@ -340,17 +340,27 @@ def allgather_async(value: np.ndarray, *, process_set=None,
     len_block[0, 0] = k_local
     len_raw = C.allgather_slots(lift_local(len_block), process_set=ps,
                           name=f"{name}.lengths")
-    require_member(ranks, name)
 
     def finish():
+        # NOTE: the not-a-member raise must wait until BOTH rounds are
+        # dispatched — this is a two-collective op, and a non-member
+        # controller that bails between rounds leaves the members
+        # hanging in round 2 (found by the np=4 non-contiguous-subset
+        # tier, tests/multiproc/test_process_sets_mp.py).  SPMD rule:
+        # every controller dispatches every program, members or not.
         lengths = to_host(len_raw).reshape(-1)
         k_max = int(lengths.max())
         padded = np.zeros((k_max,) + value.shape[1:], dtype=value.dtype)
-        padded[:k_local] = value
+        # k_max spans MEMBER lengths only; a non-member's longer local
+        # value must truncate (its rows are discarded by the groups
+        # anyway) — overflowing here would bail before the round-2
+        # dispatch and hang the members.
+        padded[:min(k_local, k_max)] = value[:k_max]
         block = np.zeros((L,) + padded.shape, dtype=value.dtype)
         block[0] = padded
         with x64_if(block.dtype):
             raw = C.allgather_slots(lift_local(block), process_set=ps, name=name)
+        require_member(ranks, name)
         g = to_host(raw).reshape((len(members), k_max) + value.shape[1:])
         parts = [g[i, : int(lengths[i])] for i in range(len(members))]
         return np.concatenate(parts, axis=0)

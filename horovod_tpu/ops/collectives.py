@@ -331,6 +331,24 @@ def _allreduce_fn(op: str, members: Optional[Tuple[int, ...]], prescale: float,
     return jax.jit(fn, out_shardings=gm.replicated())
 
 
+def _check_compression_op(op: str, compression) -> None:
+    """Compression composes only with Sum/Average: exact-comparison ops
+    would silently ignore (or, for int8, perturb) the wire compression,
+    and Adasum's pairwise projections need full-precision dot products.
+    Shared by the single and grouped slot-tier entries so the two can't
+    drift (review r4)."""
+    if compression is Compression.none or op in (Sum, Average):
+        return
+    if op == Adasum:
+        raise ValueError(
+            "compression is not supported with op=Adasum (the pairwise "
+            "projections need full-precision dot products); drop the "
+            "compression argument")
+    raise ValueError(
+        f"compression is not supported with op={op!r} (min/max/product "
+        "need exact comparisons; drop the compression argument)")
+
+
 def allreduce_slots(tensor, *, op: str = Average, process_set=None,
                     prescale_factor: float = 1.0, postscale_factor: float = 1.0,
                     compression=Compression.none, name: str = "allreduce"):
@@ -338,6 +356,7 @@ def allreduce_slots(tensor, *, op: str = Average, process_set=None,
     tensor ``[*S]``, replicated on every slot (reference: ``hvd.allreduce``)."""
     if op not in _REDUCE_OPS:
         raise ValueError(f"Unknown op {op!r}; expected one of {_REDUCE_OPS}")
+    _check_compression_op(op, compression)
     st = _st()
     _heartbeat(name)
     with x64_transport(tensor):
@@ -389,6 +408,7 @@ def grouped_allreduce_slots(tensors: Sequence[Any], *, op: str = Average,
     true: the group is one XLA program)."""
     if op not in _REDUCE_OPS:
         raise ValueError(f"Unknown op {op!r}; expected one of {_REDUCE_OPS}")
+    _check_compression_op(op, compression)
     st = _st()
     _heartbeat(name)
     with x64_transport(*tensors):

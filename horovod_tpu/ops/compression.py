@@ -113,21 +113,49 @@ class Int8Compressor(Compressor):
     def decompress(tensor, ctx):
         return tensor
 
+    @staticmethod
+    def _check_op(op, x) -> bool:
+        """True → quantized path applies.  Exact-comparison ops must NOT
+        fall through to the noisy compress() default (silent result
+        perturbation — ADVICE r3); reject them with the same contract as
+        ``int8_allreduce``.  Non-float dtypes pass through uncompressed
+        (exact)."""
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            return False
+        if op not in ("sum", "average"):
+            raise ValueError(
+                f"int8 transport supports op=sum/average, got {op!r} "
+                "(min/max/product need exact comparisons; drop "
+                "compression)")
+        return True
+
     @classmethod
     def spmd_allreduce(cls, x, *, op, axis, groups=None):
-        if op in ("sum", "average") and jnp.issubdtype(x.dtype,
-                                                       jnp.floating):
+        if cls._check_op(op, x):
             from .quantization import int8_allreduce
 
             return int8_allreduce(x, op=op, axis=axis, groups=groups)
+        # Non-float: exact pass-through (compress() is identity there).
         return super().spmd_allreduce(x, op=op, axis=axis, groups=groups)
 
     @classmethod
     def spmd_reducescatter(cls, x, *, op, axis, groups=None):
-        if op in ("sum", "average") and jnp.issubdtype(x.dtype,
-                                                       jnp.floating):
+        if cls._check_op(op, x):
             from .quantization import int8_reducescatter
 
+            # CONTRACT (narrower than the base class, asserted in
+            # int8_reducescatter): input is treated as a FLAT vector
+            # whose size divides the group width and the result is this
+            # chip's flat shard — not a dim-0 scatter of a multi-dim
+            # tensor.  In-tree callers (ZeRO rs_wire, fused buckets)
+            # pass flat buffers; reshape before swapping fp16→int8 at a
+            # non-flat call site (ADVICE r3).
+            if x.ndim != 1:
+                raise ValueError(
+                    f"Int8Compressor.spmd_reducescatter requires a flat "
+                    f"1-D input (got shape {x.shape}); it scatters the "
+                    "flattened vector, not dim 0 — reshape(-1) first or "
+                    "use Compression.fp16/bf16 for dim-0 semantics")
             return int8_reducescatter(x, op=op, axis=axis, groups=groups)
         return super().spmd_reducescatter(x, op=op, axis=axis,
                                           groups=groups)

@@ -51,7 +51,18 @@ def _quantize_blocks(blocks):
 
 
 def _group_size(axis, groups):
-    return len(groups[0]) if groups else lax.axis_size(axis)
+    """Members per reduction group.  The chunked alltoall layout bakes
+    this into data movement, so heterogeneous group sizes would corrupt
+    every group but the first — reject them (ADVICE r3)."""
+    if not groups:
+        return lax.axis_size(axis)
+    sizes = {len(g) for g in groups}
+    if len(sizes) > 1:
+        raise ValueError(
+            f"int8 transport requires equal-size axis_index_groups; got "
+            f"sizes {sorted(sizes)} (the chunk split and alltoall layout "
+            "assume one group width)")
+    return len(groups[0])
 
 
 def int8_reducescatter(x, *, op: str = "sum", axis: str = "hvd",

@@ -26,6 +26,30 @@ def _optimizer_variables(optimizer):
     return list(v or [])
 
 
+def _named_optimizer_variables(optimizer):
+    """``[(key, var)]`` with stable unique keys (Keras-3 ``path`` when
+    present, else ``name``; duplicates suffixed by occurrence).  Keys —
+    not list positions — pair committed snapshots with live variables:
+    the variables list grows and reorders as slots materialize, so a
+    positional prefix silently mispairs (ADVICE r3)."""
+    seen: dict = {}
+    out = []
+    for var in _optimizer_variables(optimizer):
+        key = getattr(var, "path", None) or getattr(var, "name", "var")
+        n = seen.get(key, 0)
+        seen[key] = n + 1
+        out.append((f"{key}#{n}" if n else key, var))
+    return out
+
+
+# Variables that are configuration inputs, not accumulated state: when
+# absent from the committed snapshot they keep their live value (zeroing
+# a learning-rate variable created after commit would corrupt training;
+# accumulators and counters created after commit correctly roll back to
+# their zero init — ADVICE r3).
+_NON_STATE_HINTS = ("learning_rate",)
+
+
 class TensorFlowKerasState(ObjectState):
     """Elastic state over a Keras model/optimizer + plain attributes
     (reference: ``hvd.elastic.TensorFlowKerasState(model, optimizer,
@@ -43,8 +67,9 @@ class TensorFlowKerasState(ObjectState):
             self._weights_saved = [np.array(w)
                                    for w in self._model.get_weights()]
         if self._optimizer is not None:
-            self._opt_saved = [np.array(v.numpy())
-                               for v in _optimizer_variables(self._optimizer)]
+            self._opt_saved = {
+                key: np.array(var.numpy())
+                for key, var in _named_optimizer_variables(self._optimizer)}
         super().commit()
 
     def restore(self) -> None:
@@ -54,15 +79,17 @@ class TensorFlowKerasState(ObjectState):
             # set_weights copies; no defensive deepcopy needed.
             self._model.set_weights(self._weights_saved)
         if self._optimizer is not None and self._opt_saved is not None:
-            opt_vars = _optimizer_variables(self._optimizer)
-            for var, saved in zip(opt_vars, self._opt_saved):
-                var.assign(saved)
-            # Slot variables created AFTER the commit (e.g. momentum
-            # slots materialized by the first train step) did not exist
-            # at the committed moment: reset them to their zero init so
-            # optimizer state matches the rolled-back weights.
-            for var in opt_vars[len(self._opt_saved):]:
-                var.assign(tf.zeros_like(var))
+            for key, var in _named_optimizer_variables(self._optimizer):
+                if key in self._opt_saved:
+                    var.assign(self._opt_saved[key])
+                elif any(h in key for h in _NON_STATE_HINTS):
+                    continue  # config input (e.g. lr): keep live value
+                else:
+                    # State materialized AFTER the commit (momentum
+                    # slots from the first train step, iteration
+                    # counters): the committed moment predates it, so
+                    # its zero init is the rolled-back value.
+                    var.assign(tf.zeros_like(var))
         super().restore()
 
     def sync(self) -> None:
@@ -85,13 +112,21 @@ class TensorFlowKerasState(ObjectState):
         if self._weights_saved is None and self._opt_saved is None:
             self.commit()
         checkpointer.save(step, {"weights": self._weights_saved or [],
-                                 "opt": self._opt_saved or [],
+                                 "opt": self._opt_saved or {},
                                  "plain": self._saved})
 
     def load_from(self, checkpointer, step=None) -> None:
         """Load a durable checkpoint into this state and restore it."""
         payload = checkpointer.restore(step)
         self._weights_saved = [np.asarray(w) for w in payload["weights"]]
-        self._opt_saved = [np.asarray(v) for v in payload["opt"]]
+        opt = payload["opt"]
+        if isinstance(opt, dict):
+            self._opt_saved = {k: np.asarray(v) for k, v in opt.items()}
+        else:
+            # Pre-r4 checkpoints stored a positional list; pair it with
+            # the live ordering once (best effort for old artifacts).
+            self._opt_saved = {
+                key: np.asarray(v) for (key, _), v in
+                zip(_named_optimizer_variables(self._optimizer), opt)}
         self._saved = dict(payload["plain"])
         self.restore()

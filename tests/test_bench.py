@@ -9,6 +9,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.slow  # end-to-end bench harness runs (50-60s each)
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

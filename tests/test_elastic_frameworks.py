@@ -114,13 +114,44 @@ class TestTensorFlowKerasState:
         y = np.ones((16, 2), np.float32)
         model.fit(x, y, epochs=1, verbose=0)
 
-        from horovod_tpu.tensorflow.elastic import _optimizer_variables
-        assert len(_optimizer_variables(opt)) > n_saved, \
-            "test premise: fit must create slot variables"
+        from horovod_tpu.tensorflow.elastic import (
+            _NON_STATE_HINTS, _named_optimizer_variables,
+        )
+        late = [(k, v) for k, v in _named_optimizer_variables(opt)
+                if k not in state._opt_saved]
+        assert late, "test premise: fit must create slot variables"
         state.restore()
-        for var in _optimizer_variables(opt)[n_saved:]:
-            np.testing.assert_allclose(np.asarray(var), 0.0, atol=0,
-                                       err_msg=var.name)
+        for key, var in late:
+            if any(h in key for h in _NON_STATE_HINTS):
+                # Config inputs (learning rate) keep their live value —
+                # zeroing them would corrupt training (ADVICE r3).
+                assert float(np.asarray(var)) != 0.0, key
+            else:
+                np.testing.assert_allclose(np.asarray(var), 0.0, atol=0,
+                                           err_msg=key)
+
+    def test_restore_matches_by_name_not_position(self, world_size):
+        # ADVICE r3: the committed snapshot pairs with live variables by
+        # key, so growth/reorder of the variables list cannot mispair a
+        # counter with a momentum slot.  Commit AFTER a step, train
+        # more, restore: every committed variable (iteration counter
+        # included) returns to its committed value by name.
+        tf, model, opt = self._setup()
+        from horovod_tpu.tensorflow.elastic import (
+            TensorFlowKerasState, _named_optimizer_variables,
+        )
+
+        x = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+        y = np.ones((16, 2), np.float32)
+        model.fit(x, y, epochs=1, verbose=0)
+        state = TensorFlowKerasState(model=model, optimizer=opt, batch=0)
+        committed = {k: np.array(v)
+                     for k, v in _named_optimizer_variables(opt)}
+        model.fit(x, y, epochs=2, verbose=0)
+        state.restore()
+        for key, var in _named_optimizer_variables(opt):
+            np.testing.assert_allclose(np.asarray(var), committed[key],
+                                       err_msg=key)
 
 
 class TestElasticKerasCallbacks:

@@ -327,6 +327,8 @@ class TestBert:
                                        rtol=2e-4, atol=1e-6)
 
 
+
+@pytest.mark.slow
 class TestBenchmarkConvnets:
     """VGG-16 + Inception-V3 — the reference's scaling-table models
     (docs/benchmarks.rst rows; bench.py --model vehicles)."""

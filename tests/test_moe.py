@@ -59,6 +59,7 @@ class TestMoELayer:
         out = layer.apply(params, x)
         assert bool(jnp.isfinite(out).all())
 
+    @pytest.mark.slow
     def test_capacity_drops_overflow(self):
         """A tiny capacity forces drops without NaNs."""
         layer = MoEMlp(d_model=8, d_ff=16, n_experts=2, top_k=1,
@@ -86,6 +87,7 @@ class TestMoEGPT:
         assert "mlp" in params["block_0"]
         assert params["block_1"]["moe"]["w_up"].shape == (4, 32, 64)
 
+    @pytest.mark.slow
     def test_ep_sharded_training_loss_decreases(self):
         """dp×ep×tp mesh: expert weights sharded over ep, one full
         training loop, loss decreases."""

@@ -57,6 +57,7 @@ class TestPipeline:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.slow
     def test_grads_match_serial(self):
         mesh = make_mesh({"pp": 4})
         stacked, per_stage = _make_stages(4, d=6)
@@ -119,6 +120,7 @@ class TestPipelinedGPT:
                         attention="full", dtype=jnp.float32, **cfg_kw)
         return PipelinedGPT(cfg, mesh, n_micro=n_micro), cfg
 
+    @pytest.mark.slow
     def test_matches_nonpipelined(self):
         """Same weights: pp=4 pipelined logits == plain GPT logits."""
         from horovod_tpu.models import GPT
@@ -177,6 +179,7 @@ class TestPipelinedGPT:
             self._build(mesh, n_layer=6)
 
 
+@pytest.mark.slow
 def test_remat_matches_non_remat(world_size):
     # jax.checkpoint on the stage must be numerically invisible: same
     # loss and gradients, only the memory/compute trade changes.

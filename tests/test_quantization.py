@@ -168,3 +168,73 @@ class TestFusedMixedMagnitude:
         # bucket absmax, yet w2 must have moved.
         assert np.abs(np.asarray(params["w2"])).max() > 0, \
             "w2 never moved: small-magnitude grads were quantized to zero"
+
+
+class TestInt8ContractGuards:
+    """ADVICE r3: exact-comparison ops and shape/group contracts must
+    fail loudly instead of silently perturbing or corrupting results."""
+
+    def test_spmd_allreduce_min_raises_not_degrades(self, world_size):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        import horovod_tpu as hvd
+        from horovod_tpu._compat import shard_map
+        from horovod_tpu.ops.compression import Compression
+
+        gm = hvd.global_mesh()
+
+        def body(x):
+            return Compression.int8.spmd_allreduce(
+                x, op="min", axis=gm.axis_name)[None]
+
+        with pytest.raises(ValueError, match="min/max/product"):
+            shard_map(body, mesh=gm.mesh, in_specs=P(gm.axis_name),
+                      out_specs=P(gm.axis_name), check=False)(
+                jnp.ones((world_size,)))
+
+    def test_spmd_reducescatter_requires_flat(self, world_size):
+        import horovod_tpu as hvd
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu._compat import shard_map
+        from horovod_tpu.ops.compression import Compression
+
+        gm = hvd.global_mesh()
+
+        def body(x):
+            return Compression.int8.spmd_reducescatter(
+                x[0], op="sum", axis=gm.axis_name)[None]
+
+        with pytest.raises(ValueError, match="flat 1-D"):
+            shard_map(body, mesh=gm.mesh, in_specs=P(gm.axis_name),
+                      out_specs=P(gm.axis_name), check=False)(
+                jnp.ones((world_size, 2, world_size * 4)))
+
+    def test_heterogeneous_groups_rejected(self, world_size):
+        from horovod_tpu.ops.quantization import _group_size
+
+        with pytest.raises(ValueError, match="equal-size"):
+            _group_size("hvd", [[0, 1, 2], [3, 4], [5, 6, 7]])
+        assert _group_size("hvd", [[0, 1], [2, 3]]) == 2
+
+    def test_public_allreduce_compressed_min_raises(self, world_size):
+        import horovod_tpu as hvd
+        from horovod_tpu.ops.compression import Compression
+
+        for comp in (Compression.fp16, Compression.int8):
+            with pytest.raises(ValueError, match="min/max/product"):
+                hvd.allreduce(jnp.ones((world_size, 4)), op=hvd.Min,
+                              compression=comp)
+            # Grouped entry shares the guard (review r4: it silently
+            # perturbed min and silently dropped Adasum compression).
+            with pytest.raises(ValueError, match="min/max/product"):
+                hvd.grouped_allreduce([jnp.ones((world_size, 4))],
+                                      op=hvd.Min, compression=comp)
+        with pytest.raises(ValueError, match="Adasum"):
+            hvd.grouped_allreduce([jnp.ones((world_size, 4))],
+                                  op=hvd.Adasum,
+                                  compression=Compression.fp16)
+        with pytest.raises(ValueError, match="Adasum"):
+            hvd.allreduce(jnp.ones((world_size, 4)), op=hvd.Adasum,
+                          compression=Compression.fp16)

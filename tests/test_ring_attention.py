@@ -53,6 +53,7 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow
     def test_flash_engine_grads(self, sp_mesh):
         q, k, v = _qkv(t=16, d=8)
 
@@ -94,6 +95,7 @@ class TestRingAttention:
             np.asarray(full_attention(q, k, v, causal=True)),
             rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow
     def test_grad_flows(self, sp_mesh):
         q, k, v = _qkv(t=8)
 

@@ -35,6 +35,7 @@ class TestCheckBuild:
         assert "XLA collectives" in out
         assert "sequence/context parallel" in out
 
+    @pytest.mark.slow
     def test_cli_check_build(self):
         res = subprocess.run(
             [sys.executable, "-m", "horovod_tpu.runner", "--check-build"],

@@ -383,3 +383,40 @@ class TestOpConstants:
 
         out = f(tf.constant(3.0))
         assert float(out) == 3.0
+
+
+class TestJitCompileWaiver:
+    """tf.function(jit_compile=True) WAIVER (pinned, not silent).
+
+    An XLA-compiled TF graph cannot host the py_function bridge — XLA
+    runs no host callbacks, the same boundary the reference's custom op
+    hits on XLA:TPU (its ``xla_mpi_ops.cc`` covered XLA:GPU only; see
+    README "TensorFlow under jit_compile").  This test pins the failure
+    so the capability edge is explicit and any TF release that lifts
+    the constraint flips this test and retires the waiver.
+    """
+
+    def test_allreduce_under_jit_compile_fails_loudly(self):
+        import tensorflow as tf
+
+        import horovod_tpu.tensorflow as hvt
+
+        @tf.function(jit_compile=True)
+        def f(x):
+            return hvt.allreduce(x, op=hvt.Sum)
+
+        with pytest.raises(tf.errors.InvalidArgumentError,
+                           match="EagerPyFunc"):
+            f(tf.ones((4,)))
+
+    def test_plain_tf_function_is_the_supported_path(self):
+        import tensorflow as tf
+
+        import horovod_tpu.tensorflow as hvt
+
+        @tf.function  # no jit_compile: the documented alternative
+        def f(x):
+            return hvt.allreduce(x, op=hvt.Sum)
+
+        out = f(tf.ones((4,)))
+        assert float(tf.reduce_sum(out)) == 4.0
