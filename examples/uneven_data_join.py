@@ -1,0 +1,94 @@
+"""Uneven per-rank data — the reference's ``hvd.join()`` pattern.
+
+Reference analogue (`examples/pytorch/pytorch_mnist.py` + join docs,
+SURVEY.md §6; mount empty, unverified): each rank iterates its own
+ragged shard; ranks that run out of data keep collectives alive until
+everyone finishes.  Here the join point is the input pipeline
+(docs/migration.md "Uneven data"): the iterator negotiates the global
+step count, exhausted ranks feed neutral zero batches, and
+``global_masked_mean`` keeps gradients exactly equal to a run over the
+concatenated real rows.
+
+Run single-process (8-slot CPU mesh)::
+
+    python examples/uneven_data_join.py
+
+or across real controllers (each gets a different-sized shard)::
+
+    python -m horovod_tpu.runner -np 3 python examples/uneven_data_join.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_single = os.environ.get("HVD_TPU_NUM_PROCESSES") is None
+if _single or os.environ.get("JAX_PLATFORMS") == "cpu":
+    # Single-process: an 8-slot virtual CPU mesh.  Launched via the
+    # runner: one CPU device per controller when JAX_PLATFORMS=cpu is
+    # exported (on a real TPU pod, drop that and this block is skipped).
+    if _single:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+
+
+def main() -> None:
+    hvd.init()
+    rank, world = hvd.cross_rank(), max(hvd.cross_size(), 1)
+
+    # Ragged shards: rank r owns 40 + 24*r rows — nothing divides evenly.
+    rng = np.random.RandomState(100 + rank)
+    n_rows = 40 + 24 * rank
+    w_true = np.random.RandomState(7).randn(16, 1).astype(np.float32)
+    X = rng.randn(n_rows, 16).astype(np.float32)
+    Y = (X @ w_true + 0.05 * rng.randn(n_rows, 1)).astype(np.float32)
+
+    it = hvd.data.JoinedBatchIterator(X, Y, batch_size=8, shuffle=True)
+    print(f"[rank {rank}/{world}] local rows={n_rows} "
+          f"local steps={it.local_steps} negotiated steps={len(it)}")
+
+    def loss_fn(params, batch):
+        (xb, yb), mask = batch
+        per_row = jnp.sum((xb @ params["w"] - yb) ** 2, axis=-1)
+        return hvd.data.global_masked_mean(per_row, mask)
+
+    tx = hvd.DistributedOptimizer(optax.adam(0.1))
+    step = hvd.make_train_step(loss_fn, tx, donate=False)
+    params = {"w": jnp.zeros((16, 1))}
+    opt_state = tx.init(params)
+
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.parallel.train import shard_batch
+
+    gm = hvd.global_mesh()
+    for epoch in range(int(os.environ.get("EPOCHS", "8"))):
+        for (xb, yb), mask in it:
+            # Single-controller: the global batch splits over the slots.
+            # Multi-controller (local=True): THIS process's rows;
+            # shard_batch assembles the global array across controllers.
+            batch = shard_batch(((xb, yb), mask), gm.mesh, P(gm.axis_name),
+                                local=True)
+            params, opt_state, loss = step(params, opt_state, batch)
+        last = hvd.join()  # epoch-end sync (reference: returns last rank)
+        print(f"[rank {rank}] epoch {epoch}: loss={float(loss):.5f} "
+              f"(join -> last rank {last})")
+
+    err = float(np.linalg.norm(np.asarray(params["w"]) - w_true))
+    print(f"[rank {rank}] final ||w - w_true|| = {err:.4f}")
+    assert err < 1.0, "training did not converge"
+
+
+if __name__ == "__main__":
+    main()

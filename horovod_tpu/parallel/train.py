@@ -17,17 +17,42 @@ from __future__ import annotations
 from typing import Any, Callable, Optional, Tuple
 
 import jax
+import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def shard_batch(batch: Any, mesh: Mesh, spec: P) -> Any:
+def shard_batch(batch: Any, mesh: Mesh, spec: P, *,
+                local: bool = False) -> Any:
     """Place every leaf of ``batch`` with ``spec`` (e.g. ``P('dp', 'sp')``
     for ``[B, T]`` token arrays).  Axes absent from the mesh are
-    dropped so the same call works on smaller meshes."""
+    dropped so the same call works on smaller meshes.
+
+    By default the input is the GLOBAL batch on every controller (the
+    benchmarks' convention: identical seeded data everywhere).  Single
+    controller: a plain ``device_put`` split.  Multi-controller:
+    ``device_put`` cannot address peer-process devices, so each process
+    materializes only its addressable shards via
+    ``make_array_from_callback`` — same semantics, no duplication.
+
+    ``local=True`` switches to the per-process convention (each
+    controller passes its OWN rows; the global array is assembled
+    across controllers) — the natural fit for per-rank input pipelines
+    like ``hvd.data.JoinedBatchIterator``."""
     from .sharding import drop_missing_axes
 
     sharding = NamedSharding(mesh, drop_missing_axes(spec, mesh))
+    if local and jax.process_count() > 1:
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(
+                sharding, np.asarray(x)), batch)
+    if jax.process_count() > 1:
+        def lift(x):
+            x = np.asarray(x)
+            return jax.make_array_from_callback(
+                x.shape, sharding, lambda idx: x[idx])
+
+        return jax.tree.map(lift, batch)
     return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
 
 

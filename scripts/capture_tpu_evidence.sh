@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# One-command TPU evidence capture (round-4 verdict items 1+3).
+#
+# Runs, on the real chip:
+#   1. bench.py (headline ResNet-50) with a jax.profiler trace
+#   2. benchmarks/allreduce_bench.py --out BUSBW_r04_tpu.json
+#   3. bench.py --fp16-allreduce (the reference's flag)
+#
+# Every entrypoint already carries the outage defense (bounded probes,
+# watchdog, structured failure line) — see utils/backend_probe.py.
+# Artifacts land in the repo root / profiles/.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+STAMP=$(date +%Y%m%d_%H%M%S)
+mkdir -p profiles
+
+echo "=== [1/3] headline bench + profile trace ==="
+python bench.py --profile-dir "profiles/resnet50_${STAMP}" \
+    | tee "BENCH_tpu_${STAMP}.json"
+
+echo "=== [2/3] allreduce busbw sweep ==="
+python benchmarks/allreduce_bench.py --out BUSBW_r04_tpu.json \
+    | tail -3
+
+echo "=== [3/3] fp16-allreduce variant ==="
+python bench.py --fp16-allreduce | tee -a "BENCH_tpu_${STAMP}.json"
+
+echo "=== done: $(ls -d profiles/resnet50_${STAMP} 2>/dev/null) BUSBW_r04_tpu.json BENCH_tpu_${STAMP}.json ==="
