@@ -128,7 +128,10 @@ class TestJoinRagged:
         for epoch in range(6):
             for (xb, yb), mask in it:
                 batch = shard_batch(((xb, yb), mask), gm.mesh,
-                                    P(gm.axis_name))
+                                    P(gm.axis_name), local=True)
+                # Per-process assembly: 3 local rows per controller
+                # concatenate into the 9-row global batch.
+                assert batch[0][0].shape[0] == 3 * hvd.cross_size()
                 params, opt, loss = step(params, opt, batch)
         w = np.asarray(params['w'])
         assert np.linalg.norm(w - w_true) < 0.5, w.ravel()
