@@ -98,8 +98,9 @@ def main() -> None:
     n_chips = hvd.size()
 
     if args.batch_size is not None and args.batch_size % n_chips:
-        sys.exit(f"--batch-size {args.batch_size} must divide the chip "
-                 f"count ({n_chips}): each chip takes an equal shard")
+        sys.exit(f"--batch-size {args.batch_size} must be a multiple of "
+                 f"the chip count ({n_chips}): each chip takes an equal "
+                 "shard")
     if args.preset == "tiny":
         model = ResNet18(num_classes=100, width=16)
         default_per_chip = (args.batch_size or 8 * n_chips) // n_chips
@@ -290,7 +291,14 @@ def main() -> None:
             sweep_log.append({"per_chip_batch": cand,
                               "rate": round(rate, 1)})
             if rate > best_rate:
+                # Evict the dethroned leader's device state (params,
+                # optimizer state, batch, executable) — retained losers
+                # would squat in HBM, OOMing larger candidates or the
+                # final measurement.
+                _compiled.pop((per_chip_batch, args.steps_per_call), None)
                 best_rate, per_chip_batch = rate, cand
+            else:
+                _compiled.pop((cand, args.steps_per_call), None)
         print(f"auto-batch sweep: {sweep_log} -> {per_chip_batch}/chip",
               file=sys.stderr)
 

@@ -45,6 +45,7 @@ def test_probe_once_timeout_classified(monkeypatch):
     assert "hung" in info["tail"]
 
 
+@pytest.mark.slow
 def test_wait_for_backend_bounded_and_logged(monkeypatch):
     monkeypatch.setattr(bp, "_PROBE_SRC", "import sys; sys.exit(1)")
     with pytest.raises(bp.BackendUnavailableError) as ei:

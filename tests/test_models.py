@@ -183,6 +183,7 @@ class TestBert:
         kw.setdefault("dtype", jnp.float32)
         return BertConfig(**kw)
 
+    @pytest.mark.slow
     def test_classifier_forward_shape(self):
         from horovod_tpu.models import BertForSequenceClassification
 
@@ -213,6 +214,7 @@ class TestBert:
         lc = model.apply({"params": params}, ids_b)
         assert not np.allclose(np.asarray(la), np.asarray(lc), atol=1e-4)
 
+    @pytest.mark.slow
     def test_mlm_tied_decoder(self):
         # MLM logits come from Embed.attend: no separate [V, d] decoder
         # matrix exists, and the embedding receives gradient from the

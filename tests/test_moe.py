@@ -30,6 +30,7 @@ class TestMoELayer:
         aux = moe_aux_loss(inter)
         assert np.isfinite(float(aux)) and float(aux) > 0
 
+    @pytest.mark.slow
     def test_single_expert_equals_dense(self):
         """n_experts=1, top_k=1, ample capacity: every token goes to the
         one expert with weight 1 — output must equal the plain FFN with
@@ -45,6 +46,7 @@ class TestMoELayer:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-6, atol=1e-6)
 
+    @pytest.mark.slow
     def test_routing_weights_normalized(self):
         """With capacity for everything, each token's combine weights
         sum to 1 (the top-k gates renormalized)."""

@@ -51,7 +51,8 @@ class TestFlashForward:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
 
-    @pytest.mark.parametrize("t", [24, 48, 100])
+    @pytest.mark.parametrize("t", [
+        24, 48, pytest.param(100, marks=pytest.mark.slow)])
     def test_padded_odd_lengths(self, t):
         # Non-block-multiple causal self-attention via the padded entry.
         q, k, v = _qkv(t=t, d=8)
@@ -60,6 +61,7 @@ class TestFlashForward:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
 
+    @pytest.mark.slow
     def test_padded_grads(self):
         q, k, v = _qkv(t=24, d=8)
 

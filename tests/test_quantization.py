@@ -55,6 +55,7 @@ class TestInt8Allreduce:
         err = np.abs(np.asarray(out[0]) - exact).max()
         assert err <= hop1 + hop2 + 1e-5, (err, hop1, hop2)
 
+    @pytest.mark.slow
     def test_average(self, world_size):
         rng = np.random.RandomState(2)
         x = jnp.asarray(rng.randn(world_size, 257), jnp.float32)  # odd size
@@ -80,6 +81,7 @@ class TestInt8Allreduce:
         tol = 2.0 * np.abs(np.asarray(x)).max() / 127.0 * half
         np.testing.assert_allclose(np.asarray(out[0]), exact_a, atol=tol)
 
+    @pytest.mark.slow
     def test_bf16_input_dtype_preserved(self, world_size):
         x = jnp.asarray(np.random.RandomState(4).randn(world_size, 16),
                         jnp.bfloat16)

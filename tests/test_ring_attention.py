@@ -118,7 +118,8 @@ class TestRingAttention:
 
 
 class TestUlyssesAttention:
-    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("causal", [
+        False, pytest.param(True, marks=pytest.mark.slow)])
     def test_matches_full(self, dp_sp_mesh, causal):
         q, k, v = _qkv(b=4, t=8, h=4)   # h=4 divisible by sp=4
         ref = full_attention(q, k, v, causal=causal)

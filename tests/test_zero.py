@@ -37,7 +37,8 @@ def _toy_problem(seed=0, d_in=6, d_out=4):
 
 
 class TestZeroTrainStep:
-    @pytest.mark.parametrize("tx_name", ["sgd", "adamw"])
+    @pytest.mark.parametrize("tx_name", [
+        "sgd", pytest.param("adamw", marks=pytest.mark.slow)])
     def test_matches_plain_dp(self, world_size, tx_name):
         """ZeRO-1 must be numerically equivalent to replicated DP (the
         sharding is an implementation detail of where state lives)."""
@@ -149,7 +150,9 @@ class TestZeroCompression:
 
         return params, loss_fn, (X, y)
 
-    @pytest.mark.parametrize("comp", ["bf16", "fp16", "int8"])
+    @pytest.mark.parametrize("comp", [
+        pytest.param("bf16", marks=pytest.mark.slow),
+        pytest.param("fp16", marks=pytest.mark.slow), "int8"])
     def test_compressed_wire_tracks_uncompressed(self, world_size, comp):
         params, loss_fn, batch = self._toy()
         tx = optax.adamw(1e-2)
