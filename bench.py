@@ -294,8 +294,11 @@ def main() -> None:
                 # Evict the dethroned leader's device state (params,
                 # optimizer state, batch, executable) — retained losers
                 # would squat in HBM, OOMing larger candidates or the
-                # final measurement.
-                _compiled.pop((per_chip_batch, args.steps_per_call), None)
+                # final measurement.  (Guard: on the first iteration the
+                # "leader" slot still names cand itself.)
+                if per_chip_batch != cand:
+                    _compiled.pop((per_chip_batch, args.steps_per_call),
+                                  None)
                 best_rate, per_chip_batch = rate, cand
             else:
                 _compiled.pop((cand, args.steps_per_call), None)

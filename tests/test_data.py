@@ -184,3 +184,32 @@ class TestGlobalMaskedMean:
                         out_specs=P(gm.axis_name), check=False)(
             jnp.ones((hvd.size() * 2,)), jnp.zeros((hvd.size() * 2,)))
         assert np.isfinite(np.asarray(out)).all()
+
+    def test_batch_larger_than_shard(self, monkeypatch):
+        from horovod_tpu import data as D
+
+        monkeypatch.setattr(D, "negotiate_steps", lambda n: max(n, 1))
+        it = D.JoinedBatchIterator(np.arange(5, dtype=np.float32),
+                                   batch_size=8)
+        ((b,), mask), = list(it)
+        assert b.shape == (8,) and mask.tolist() == [1] * 5 + [0] * 3
+
+    def test_epoch_renegotiates_for_peers(self, monkeypatch):
+        # Peers' shards may change between epochs (elastic resize); each
+        # __iter__ renegotiates while len() stays a pure read.
+        from horovod_tpu import data as D
+
+        calls = {"n": 0}
+
+        def fake_negotiate(local):
+            calls["n"] += 1
+            return [2, 2, 5][min(calls["n"] - 1, 2)]
+
+        monkeypatch.setattr(D, "negotiate_steps", fake_negotiate)
+        it = D.JoinedBatchIterator(np.ones((4, 2), np.float32),
+                                   batch_size=2)
+        assert len(it) == 2          # constructor negotiation
+        assert len(list(it)) == 2    # epoch 1
+        assert len(list(it)) == 5    # epoch 2: a peer grew
+        assert len(it) == 5          # pure read of the last negotiation
+        assert calls["n"] == 3       # len() never issued a collective

@@ -59,6 +59,7 @@ class TestRunElastic:
                          discovery=FixedDiscovery({"localhost": 2}),
                          env=_env(), poll_interval_s=0.2)
         assert rc == 0
+
     def test_world_sized_to_discovery(self, tmp_path):
         out = tmp_path / "np.txt"
         script = _worker_script(
@@ -71,6 +72,7 @@ class TestRunElastic:
                          env=_env(), poll_interval_s=0.2)
         assert rc == 0
         assert out.read_text().splitlines() == ["3", "3", "3"]
+
     def test_restart_on_failure_until_reset_limit(self, tmp_path):
         script = _worker_script(tmp_path, "sys.exit(7)")
         rc = run_elastic([sys.executable, script],
