@@ -103,10 +103,10 @@ def main() -> None:
                                            (inputs, targets))
         return params, opt_state, loss
 
-    from horovod_tpu.utils.mfu import aot_compile_with_flops, peak_tflops
+    from horovod_tpu.utils.mfu import aot_compile_with_flops, peak_tflops_info
 
     run_chunk, chunk_flops = aot_compile_with_flops(chunk, params, opt_state)
-    peak = peak_tflops(jax.devices()[0])
+    peak, peak_source = peak_tflops_info(jax.devices()[0])
 
     for _ in range(args.warmup):
         params, opt_state, loss = run_chunk(params, opt_state)
@@ -138,6 +138,7 @@ def main() -> None:
         if peak:
             out["mfu_pct"] = round(
                 100.0 * per_chip_flops_s / (peak * 1e12), 2)
+            out["peak_tflops_source"] = peak_source
     print(json.dumps(out))
     sys.stdout.flush()
 

@@ -78,6 +78,14 @@ def check_build_str() -> str:
         "    [X] chunked-vocab LM cross-entropy (no [B,T,V] logits "
         "materialization)",
         "",
+        "Runtime features:",
+        "    [X] online autotune (HOROVOD_AUTOTUNE=1: GP-tuned fusion "
+        "threshold, applied at re-jit boundaries)",
+        "    [X] uneven-data join (negotiated input pipeline: "
+        "JoinedBatchIterator + global_masked_mean)",
+        "    [X] timeline (HOROVOD_TIMELINE Chrome trace) + stall "
+        "inspector (single- and cross-process)",
+        "",
         "Parallelism:",
         "    [X] data parallel (+Adasum any world size, elastic, "
         "process sets, hierarchical allreduce)",
