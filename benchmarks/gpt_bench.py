@@ -138,7 +138,9 @@ def main() -> None:
         if peak:
             out["mfu_pct"] = round(
                 100.0 * per_chip_flops_s / (peak * 1e12), 2)
-            out["peak_tflops_source"] = peak_source
+        # Unconditional: the provenance of mfu_pct — or of its absence
+        # (unknown device kind) — must be explicit in the artifact.
+        out["peak_tflops_source"] = peak_source
     print(json.dumps(out))
     sys.stdout.flush()
 
