@@ -1,6 +1,6 @@
-"""Hierarchical allreduce and compressed-ZeRO wires across REAL
-controllers (round-4 matrix deepening — verdict weak #4: these tiers
-had only in-process witnesses).
+"""Hierarchical allreduce, compressed-ZeRO wires, and autotune
+synchronization across REAL controllers (round-4 matrix deepening —
+verdict weak #4: these tiers had only in-process witnesses).
 
 Reference CI analogue: test/parallel/test_torch.py hierarchical cases
 under -np, SURVEY.md §4 (mount empty, unverified).
@@ -95,4 +95,56 @@ class TestCompressedZeroMP:
                                    rtol=0.05, atol=5e-3)
         np.testing.assert_allclose(results['int8'][0], w_exact,
                                    rtol=0.2, atol=2e-2)
+        """, timeout=420.0)
+
+
+class TestAutotuneMP:
+    def test_rank0_decision_syncs_across_controllers(self, world):
+        """HOROVOD_AUTOTUNE=1 across 2 real controllers: every window
+        decision comes from rank 0's GP via broadcast, so both ranks
+        apply the SAME thresholds in the same order and freeze at the
+        same point — divergent re-jits would hang the wire."""
+        world(2, """
+        import jax.numpy as jnp
+        import optax
+        from jax.sharding import PartitionSpec as P
+
+        hvd.shutdown()
+        os.environ['HOROVOD_AUTOTUNE'] = '1'
+        os.environ['HOROVOD_AUTOTUNE_WARMUP_SAMPLES'] = '1'
+        os.environ['HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE'] = '2'
+        os.environ['HVD_TPU_AUTOTUNE_MAX_SAMPLES'] = '3'
+        hvd.init()
+        try:
+            from horovod_tpu.optim.autotune import AutotunedTrainStep
+            from horovod_tpu.parallel.train import shard_batch
+
+            pm = hvd.parameter_manager()
+            assert pm is not None
+
+            rng = np.random.RandomState(0)  # same data on both ranks
+            X = rng.randn(8, 4).astype(np.float32)
+            Y = (X @ rng.randn(4, 1)).astype(np.float32)
+
+            tx = hvd.DistributedOptimizer(optax.sgd(0.05))
+            step = hvd.make_train_step(
+                lambda p, b: jnp.mean((b[0] @ p['w'] - b[1]) ** 2), tx,
+                donate=False)
+            assert isinstance(step, AutotunedTrainStep)
+            params = {'w': jnp.zeros((4, 1))}
+            opt = tx.init(params)
+            gm = hvd.global_mesh()
+            batch = shard_batch((X, Y), gm.mesh, P(gm.axis_name))
+            for _ in range(16):
+                params, opt, loss = step(params, opt, batch)
+            assert pm.frozen, 'tuner did not freeze'
+            # Every rank applied the identical threshold sequence and
+            # agrees on the frozen choice (rank 0 decided, peers
+            # mirrored).
+            seqs = hvd.allgather_object(
+                (step.applied, hvd.config().fusion_threshold))
+            assert all(s == seqs[0] for s in seqs), seqs
+            assert jnp.isfinite(loss)
+        finally:
+            hvd.shutdown()
         """, timeout=420.0)
