@@ -97,10 +97,11 @@ def main() -> None:
     gm = hvd.global_mesh()
     n_chips = hvd.size()
 
-    if args.batch_size is not None and args.batch_size % n_chips:
-        sys.exit(f"--batch-size {args.batch_size} must be a multiple of "
-                 f"the chip count ({n_chips}): each chip takes an equal "
-                 "shard")
+    if args.batch_size is not None and (
+            args.batch_size <= 0 or args.batch_size % n_chips):
+        sys.exit(f"--batch-size {args.batch_size} must be a positive "
+                 f"multiple of the chip count ({n_chips}): each chip "
+                 "takes an equal shard")
     if args.preset == "tiny":
         model = ResNet18(num_classes=100, width=16)
         default_per_chip = (args.batch_size or 8 * n_chips) // n_chips
@@ -339,7 +340,11 @@ def main() -> None:
         profile_dir=args.profile_dir)
 
     baseline_per_chip = 2500.0  # see module docstring
-    prev_best = 2576.9          # BENCH_r02.json — own trend anchor
+    # BENCH_r02.json — own trend anchor, with the config it was measured
+    # at so the trend ratio is interpretable when auto-batch moves the
+    # config (advisor r4: ratio alone conflates tuning with framework).
+    prev_best = 2576.9
+    prev_best_config = {"per_chip_batch": 256, "steps_per_call": 10}
     is_headline = args.preset == "full" and args.model == "resnet50"
     out = {
         "metric": metric_name,
@@ -354,6 +359,7 @@ def main() -> None:
         # Self-trend: regression vs the best prior round is
         # machine-checkable without consulting old artifacts.
         out["prev_best"] = prev_best
+        out["prev_best_config"] = prev_best_config
         out["vs_prev_best"] = round(per_chip / prev_best, 4)
     if args.preset == "full":
         out["peak_tflops_source"] = peak_source

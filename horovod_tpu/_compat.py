@@ -15,3 +15,38 @@ except ImportError:  # older jax: experimental module with check_rep
     def shard_map(f, *, mesh, in_specs, out_specs, check: bool = False):
         return _shard_map(f, mesh=mesh, in_specs=in_specs,
                           out_specs=out_specs, check_rep=check)
+
+
+def _resolve_tracer():
+    """jax.core.Tracer's home keeps moving (jax.core is deprecated as a
+    public namespace); resolve it once, falling back through the known
+    locations so a jax upgrade can't break isinstance checks at call
+    time."""
+    import jax
+
+    for path in ("core", "_src.core"):
+        obj = jax
+        try:
+            for part in path.split("."):
+                obj = getattr(obj, part)
+            return obj.Tracer
+        except AttributeError:
+            continue
+    return None
+
+
+Tracer = _resolve_tracer()
+
+
+def is_tracer(x) -> bool:
+    """True when ``x`` is a JAX tracer (i.e. we are inside a trace).
+
+    The fallback must POSITIVELY identify tracers: tracers are
+    registered ``jax.Array`` instances, so "is it a concrete type?"
+    misclassifies every tracer as concrete — exactly the failure the
+    check exists to prevent.  Tracers (and only tracers) carry the
+    ``_trace`` link to their owning trace; concrete ``ArrayImpl`` does
+    not."""
+    if Tracer is not None:
+        return isinstance(x, Tracer)
+    return hasattr(x, "_trace") and hasattr(x, "aval")

@@ -167,7 +167,8 @@ class ElasticDriver:
             self._thread = None
 
     def wait_for_available_slots(self, min_slots: int,
-                                 timeout_s: float = None) -> Dict[str, int]:
+                                 timeout_s: Optional[float] = None,
+                                 ) -> Dict[str, int]:
         """Block until discovery reports at least ``min_slots`` (reference:
         driver startup barrier with HOROVOD_ELASTIC_TIMEOUT).  Default
         timeout = ``config().elastic_timeout_seconds`` (that env knob),

@@ -32,6 +32,7 @@ from typing import Callable
 
 import jax
 
+from .._compat import is_tracer
 from ..utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -74,7 +75,7 @@ class AutotunedTrainStep:
     def __call__(self, params, opt_state, batch, *rest):
         if self._pm.frozen:
             return self._step(params, opt_state, batch, *rest)
-        if any(isinstance(leaf, jax.core.Tracer)
+        if any(is_tracer(leaf)
                for leaf in jax.tree.leaves((params, opt_state, batch))):
             # Consumed inside an enclosing jit/scan: __call__ runs once
             # at trace time, so wall-clock timing and window counting

@@ -1,37 +1,12 @@
 #!/usr/bin/env bash
-# One-command TPU evidence capture (round-4 verdict items 1+3).
+# One-command TPU evidence capture — thin wrapper over the watchdog's
+# capture suite (scripts/evidence_watchdog.py owns the step list and
+# the success criteria; keeping them in one place was a round-5 review
+# finding).  --once = single probe + capture, exit nonzero unless every
+# artifact was produced with a real (non-outage) value.
 #
-# Runs, on the real chip:
-#   1. bench.py (headline ResNet-50) with a jax.profiler trace
-#   2. benchmarks/allreduce_bench.py --out BUSBW_r04_tpu.json
-#   3. bench.py --fp16-allreduce (the reference's flag)
-#
-# Every entrypoint already carries the outage defense (bounded probes,
-# watchdog, structured failure line) — see utils/backend_probe.py.
-# Artifacts land in the repo root / profiles/.  Exits nonzero if ANY
-# step failed, naming the failures (a zero exit with missing artifacts
-# was the round-3 failure mode).
+# For retry-through-outage capture across a whole round, run the
+# watchdog directly:  python scripts/evidence_watchdog.py
 set -uo pipefail
 cd "$(dirname "$0")/.."
-
-STAMP=$(date +%Y%m%d_%H%M%S)
-mkdir -p profiles
-FAILED=()
-
-echo "=== [1/3] headline bench + profile trace ==="
-python bench.py --profile-dir "profiles/resnet50_${STAMP}" \
-    | tee "BENCH_tpu_${STAMP}.json" || FAILED+=("bench")
-
-echo "=== [2/3] allreduce busbw sweep ==="
-python benchmarks/allreduce_bench.py --out BUSBW_r04_tpu.json \
-    | tail -3 || FAILED+=("busbw")
-
-echo "=== [3/3] fp16-allreduce variant ==="
-python bench.py --fp16-allreduce | tee -a "BENCH_tpu_${STAMP}.json" \
-    || FAILED+=("bench-fp16")
-
-if [ ${#FAILED[@]} -gt 0 ]; then
-    echo "=== CAPTURE INCOMPLETE: failed steps: ${FAILED[*]} ==="
-    exit 1
-fi
-echo "=== done: $(ls -d profiles/resnet50_${STAMP} 2>/dev/null) BUSBW_r04_tpu.json BENCH_tpu_${STAMP}.json ==="
+exec python scripts/evidence_watchdog.py --once "$@"

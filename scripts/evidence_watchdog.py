@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Retry-through-outage TPU evidence capture (round-5 verdict item 1).
+
+The round-3/4 failure mode was a tunnel outage at the single capture
+moment.  This watchdog inverts that: it probes the backend on a timer
+for the WHOLE round, logs every attempt (timestamped, append-only, so a
+full-round outage is provable), and the moment a probe succeeds runs the
+complete evidence suite:
+
+  1. ``bench.py`` (headline ResNet-50) with a jax.profiler trace
+  2. ``benchmarks/allreduce_bench.py`` -> BUSBW_r05_tpu.json
+  3. ``bench.py --fp16-allreduce``
+
+Artifacts: ``BENCH_tpu_<stamp>.json``, ``BUSBW_r05_tpu.json``,
+``profiles/resnet50_<stamp>/``, and ``EVIDENCE_ATTEMPTS.jsonl`` (the
+attempt log).  Exits 0 after a successful capture, 2 when the attempt
+budget is exhausted with the backend still down.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+LOG = os.path.join(ROOT, "EVIDENCE_ATTEMPTS.jsonl")
+
+
+def log_attempt(kind: str, **fields) -> None:
+    row = {"ts": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+           "kind": kind, **fields}
+    with open(LOG, "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+def run_capture(stamp: str) -> bool:
+    """Run the three-step suite; returns True when every step passed.
+    Each entrypoint carries its own guarded_init defense (now rc=0 on a
+    measured outage), so step success means parsed value > 0."""
+    env = {**os.environ,
+           # One probe per step: the watchdog already established health.
+           "HVD_TPU_PROBE_ATTEMPTS": "2",
+           "HVD_TPU_PROBE_BACKOFF_S": "30"}
+    ok = True
+
+    def step(name, cmd, out_path=None, append=False, timeout=2400):
+        nonlocal ok
+        t0 = time.monotonic()
+        try:
+            proc = subprocess.run(cmd, cwd=ROOT, env=env, text=True,
+                                  capture_output=True, timeout=timeout)
+        except subprocess.TimeoutExpired:
+            log_attempt("capture_step", step=name, ok=False,
+                        error=f"timeout after {timeout}s")
+            ok = False
+            return
+        line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+        parsed = None
+        try:
+            parsed = json.loads(line)
+        except ValueError:
+            pass
+        good = (proc.returncode == 0 and parsed is not None
+                and not parsed.get("error")
+                and parsed.get("value") != 0.0)
+        if out_path and parsed is not None:
+            with open(os.path.join(ROOT, out_path), "a" if append else "w") as f:
+                f.write(line + "\n")
+        log_attempt("capture_step", step=name, ok=good, rc=proc.returncode,
+                    elapsed_s=round(time.monotonic() - t0, 1),
+                    value=(parsed or {}).get("value"),
+                    mfu_pct=(parsed or {}).get("mfu_pct"),
+                    tail=(proc.stderr or proc.stdout)[-300:] if not good else "")
+        ok = ok and good
+
+    prof = os.path.join("profiles", f"resnet50_{stamp}")
+    step("bench_headline",
+         [sys.executable, "bench.py", "--profile-dir", prof],
+         out_path=f"BENCH_tpu_{stamp}.json")
+    step("busbw_sweep",
+         [sys.executable, os.path.join("benchmarks", "allreduce_bench.py"),
+          "--out", "BUSBW_r05_tpu.json"])
+    step("bench_fp16",
+         [sys.executable, "bench.py", "--fp16-allreduce"],
+         out_path=f"BENCH_tpu_{stamp}.json", append=True)
+    return ok
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-attempts", type=int, default=70)
+    ap.add_argument("--sleep-s", type=float, default=480.0)
+    ap.add_argument("--probe-timeout-s", type=float, default=120.0)
+    ap.add_argument("--once", action="store_true",
+                    help="single probe + capture, no retry loop (the "
+                         "capture_tpu_evidence.sh entry)")
+    args = ap.parse_args()
+    if args.once:
+        args.max_attempts = 1
+
+    from horovod_tpu.utils.backend_probe import probe_once
+
+    for i in range(1, args.max_attempts + 1):
+        info = probe_once(timeout_s=args.probe_timeout_s)
+        log_attempt("probe", attempt=i, **info)
+        if info.get("ok"):
+            stamp = datetime.datetime.now().strftime("%Y%m%d_%H%M%S")
+            print(f"backend healthy ({info.get('device_kind')}); "
+                  f"capturing as {stamp}", flush=True)
+            if run_capture(stamp):
+                log_attempt("capture_done", stamp=stamp)
+                print("capture complete", flush=True)
+                sys.exit(0)
+            # A step failed mid-capture (tunnel flapped?) — keep looping;
+            # partial artifacts stay on disk, later success overwrites.
+            log_attempt("capture_incomplete", stamp=stamp)
+        if i < args.max_attempts:
+            time.sleep(args.sleep_s)
+    print("attempt budget exhausted; backend never became healthy",
+          flush=True)
+    sys.exit(2)
+
+
+if __name__ == "__main__":
+    main()
