@@ -28,10 +28,14 @@ from .safe_shell_exec import execute
 
 class RegisterTaskRequest:
     def __init__(self, index: int, addresses: List[Tuple[str, int]],
-                 hostname: str):
+                 hostname: str,
+                 coordinator_port: Optional[int] = None):
         self.index = index
         self.addresses = addresses
         self.hostname = hostname
+        # A free port the agent reserved on ITS host: if this task hosts
+        # global rank 0, the jax.distributed coordinator binds here.
+        self.coordinator_port = coordinator_port
 
 
 class AllTaskAddressesRequest:
@@ -70,6 +74,38 @@ class CommandExitCodeResponse:
     def __init__(self, done: bool, exit_code: Optional[int]):
         self.done = done
         self.exit_code = exit_code
+
+
+class RunDistributedCommandRequest:
+    """Exec the worker command once per local slot, each wired into the
+    shared ``jax.distributed`` world via the launcher env contract
+    (reference: gloo_run sends each host its per-slot commands with the
+    Gloo rendezvous env)."""
+
+    def __init__(self, command: List[str], env: Dict[str, str],
+                 ranks: List[int], world_size: int, coordinator: str):
+        self.command = command
+        self.env = env
+        self.ranks = ranks
+        self.world_size = world_size
+        self.coordinator = coordinator
+
+
+class DistributedExitCodesRequest:
+    pass
+
+
+class DistributedExitCodesResponse:
+    def __init__(self, codes: Dict[int, Optional[int]]):
+        self.codes = codes  # rank -> exit code (None while running)
+
+
+class AbortCommandRequest:
+    pass
+
+
+class AgentShutdownRequest:
+    pass
 
 
 class DriverService(BasicService):
@@ -112,6 +148,11 @@ class DriverService(BasicService):
         with self._cv:
             return {i: t.hostname for i, t in self._tasks.items()}
 
+    def task_coordinator_ports(self) -> Dict[int, Optional[int]]:
+        with self._cv:
+            return {i: getattr(t, "coordinator_port", None)
+                    for i, t in self._tasks.items()}
+
 
 class TaskService(BasicService):
     """Per-host agent: answers pings, probes peers on request, and execs
@@ -124,6 +165,14 @@ class TaskService(BasicService):
         self._cmd_thread: Optional[threading.Thread] = None
         self._exit_code: Optional[int] = None
         self._abort = threading.Event()
+        self._rank_threads: Dict[int, threading.Thread] = {}
+        self._rank_codes: Dict[int, Optional[int]] = {}
+        self.shutdown_requested = threading.Event()
+
+    @property
+    def command_started(self) -> bool:
+        """True once any (single or distributed) command was launched."""
+        return self._cmd_thread is not None or bool(self._rank_threads)
 
     def _handle(self, req: Any, client_address) -> Any:
         if isinstance(req, ProbePeerRequest):
@@ -142,7 +191,48 @@ class TaskService(BasicService):
                     and not self._cmd_thread.is_alive())
             return CommandExitCodeResponse(done,
                                            self._exit_code if done else None)
+        if isinstance(req, RunDistributedCommandRequest):
+            self._launch_distributed(req)
+            return AckResponse()
+        if isinstance(req, DistributedExitCodesRequest):
+            codes = {rank: (self._rank_codes[rank]
+                            if not t.is_alive() else None)
+                     for rank, t in self._rank_threads.items()}
+            return DistributedExitCodesResponse(codes)
+        if isinstance(req, AbortCommandRequest):
+            self._abort.set()
+            return AckResponse()
+        if isinstance(req, AgentShutdownRequest):
+            self.shutdown_requested.set()
+            return AckResponse()
         return super()._handle(req, client_address)
+
+    def _launch_distributed(self, req: RunDistributedCommandRequest) -> None:
+        if any(t.is_alive() for t in self._rank_threads.values()):
+            raise RuntimeError("a distributed command is already running")
+
+        import os
+
+        for rank in req.ranks:
+            # Like the local launcher's _spawn_world: workers inherit
+            # the agent's (remote-host) environment, with the driver's
+            # overrides and the rank contract layered on top.
+            env = dict(os.environ)
+            env.update(req.env)
+            env.update({
+                "HVD_TPU_COORDINATOR_ADDR": req.coordinator,
+                "HVD_TPU_NUM_PROCESSES": str(req.world_size),
+                "HVD_TPU_PROCESS_ID": str(rank),
+            })
+            self._rank_codes[rank] = None
+
+            def target(rank=rank, env=env):
+                self._rank_codes[rank] = execute(
+                    req.command, env=env, events=[self._abort])
+
+            t = threading.Thread(target=target, daemon=True)
+            self._rank_threads[rank] = t
+            t.start()
 
     def _launch(self, command: List[str], env: Dict[str, str]) -> None:
         if self._cmd_thread is not None and self._cmd_thread.is_alive():

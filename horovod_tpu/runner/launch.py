@@ -47,9 +47,11 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         help="number of worker processes (default: 1 "
                              "locally; the whole allocation under LSF)")
     parser.add_argument("-H", "--hosts", default=None,
-                        help="host:slots[,host:slots...] — informational on "
-                             "TPU pods (the platform places processes); "
-                             "local execution supports localhost only")
+                        help="host:slots[,host:slots...] — non-local hosts "
+                             "are launched via ssh-exec'd task agents over "
+                             "the driver/task RPC mesh (reference: "
+                             "gloo_run); on managed TPU pods prefer the "
+                             "platform's own placement")
     parser.add_argument("--check-build", action="store_true",
                         help="print the feature matrix and exit")
     parser.add_argument("--min-np", type=int, default=None,
@@ -290,11 +292,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                      if h.split(":")[0] not in ("localhost", "127.0.0.1",
                                                 socket.gethostname())]
         if non_local:
-            print("error: remote host execution is platform-managed on TPU "
-                  "(run this command on every host of the slice, or use GKE/"
-                  f"queued resources); non-local hosts given: {non_local}",
-                  file=sys.stderr)
-            return 2
+            # Remote launch over the driver/task RPC mesh (reference:
+            # gloo_run's ssh-exec'd task agents).  All hosts — local
+            # included — go through agents so the rank layout is uniform.
+            from .remote import parse_hosts, remote_run
+
+            try:
+                hosts = parse_hosts(args.hosts)
+            except ValueError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+            return remote_run(hosts, command, np_=args.num_proc,
+                              start_timeout=args.start_timeout,
+                              verbose=args.verbose)
     num_proc = args.num_proc if args.num_proc is not None else 1
     if args.min_np is not None and num_proc < args.min_np:
         print(f"error: -np {num_proc} < --min-np {args.min_np}",

@@ -1,0 +1,201 @@
+"""Remote multi-host launch over the driver/task RPC mesh.
+
+Reference: ``horovod/runner/gloo_run.py`` + ``driver_service.py`` flow
+(SURVEY.md §2.5, §3.4 step 3, mount empty, unverified): the launcher
+starts a driver service, ssh-execs a task agent on every target host,
+waits for registrations, probes full pairwise connectivity (the
+common-interface pass), then fans the worker command out per slot and
+supervises exit codes — first failure kills the job.
+
+TPU-native redesign: there is no per-rank Gloo rendezvous store to
+bootstrap.  The mesh's product is ONE address — the rank-0 host's
+reserved ``jax.distributed`` coordinator port — plus the standard
+``HVD_TPU_COORDINATOR_ADDR/NUM_PROCESSES/PROCESS_ID`` env contract; XLA
+collectives ride ICI once the world forms, the RPC mesh is pre-flight
+only.  Remote exec defaults to ssh (BatchMode, like the reference) but
+is injectable (``exec_fn``) so loopback tests drive the REAL protocol
+end-to-end without sshd — the repo's shim-over-real-processes pattern.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .common.network import BasicClient
+from .common.secret import make_secret_key
+from .common.service import (
+    AbortCommandRequest, AgentShutdownRequest, DistributedExitCodesRequest,
+    DriverService, RunDistributedCommandRequest, probe_full_mesh,
+)
+
+
+def parse_hosts(spec: str) -> List[Tuple[str, int]]:
+    """``"a:2,b:4"`` -> ``[("a", 2), ("b", 4)]`` (reference -H syntax;
+    a bare host means one slot)."""
+    out: List[Tuple[str, int]] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, slots = part.partition(":")
+        if not host:
+            raise ValueError(f"bad -H entry: {part!r}")
+        out.append((host, int(slots) if slots else 1))
+    return out
+
+
+def _agent_argv(index: int, driver_addrs: List[Tuple[str, int]],
+                timeout_s: float) -> List[str]:
+    spec = ",".join(f"{h}:{p}" for h, p in driver_addrs)
+    return [sys.executable, "-m", "horovod_tpu.runner.task_agent",
+            "--driver", spec, "--index", str(index),
+            "--timeout", str(timeout_s)]
+
+
+def ssh_exec(host: str, argv: List[str],
+             secret_hex: str) -> subprocess.Popen:
+    """Default remote exec: ssh in BatchMode (no password prompts —
+    reference gloo_run assumes passwordless ssh), secret over stdin."""
+    proc = subprocess.Popen(
+        ["ssh", "-o", "BatchMode=yes", "-o", "StrictHostKeyChecking=no",
+         "--", host] + argv,
+        stdin=subprocess.PIPE, text=True)
+    proc.stdin.write(secret_hex + "\n")
+    proc.stdin.flush()
+    proc.stdin.close()
+    return proc
+
+
+def local_exec(host: str, argv: List[str],
+               secret_hex: str) -> subprocess.Popen:
+    """Exec an agent as a local child (test path: loopback hosts
+    pretending to be remote — the full RPC protocol still runs)."""
+    proc = subprocess.Popen(argv, stdin=subprocess.PIPE, text=True,
+                            env=dict(os.environ))
+    proc.stdin.write(secret_hex + "\n")
+    proc.stdin.flush()
+    proc.stdin.close()
+    return proc
+
+
+def remote_run(hosts: List[Tuple[str, int]], command: List[str], *,
+               np_: Optional[int] = None,
+               env: Optional[Dict[str, str]] = None,
+               exec_fn: Optional[Callable[
+                   [str, List[str], str], subprocess.Popen]] = None,
+               start_timeout: float = 120.0,
+               poll_interval_s: float = 0.5,
+               verbose: bool = False) -> int:
+    """Launch ``command`` across ``hosts`` (``[(host, slots), ...]``)
+    through the driver/task RPC mesh; returns the first nonzero worker
+    exit code (0 when every rank succeeds).
+
+    ``np_`` caps the world at the first N slots in host order
+    (reference: ``horovodrun -np`` against a larger ``-H`` set).
+    """
+    if not command:
+        raise ValueError("No command given")
+    if not hosts:
+        raise ValueError("No hosts given")
+
+    # Rank layout: host i owns a contiguous rank block, host order.
+    total_slots = sum(s for _, s in hosts)
+    if np_ is not None and np_ > total_slots:
+        raise ValueError(
+            f"-np {np_} exceeds total slots {total_slots} in -H")
+    world_size = np_ or total_slots
+    rank_blocks: List[List[int]] = []
+    next_rank = 0
+    for _, slots in hosts:
+        take = max(0, min(slots, world_size - next_rank))
+        rank_blocks.append(list(range(next_rank, next_rank + take)))
+        next_rank += take
+
+    exec_fn = exec_fn or ssh_exec
+    key = make_secret_key()
+    driver = DriverService(len(hosts), key)
+    agents: List[subprocess.Popen] = []
+    clients: Dict[int, BasicClient] = {}
+    exit_code = 0
+    try:
+        driver_addrs = driver.addresses()
+        for i, (host, _) in enumerate(hosts):
+            if verbose:
+                print(f"[horovodtpurun] starting agent {i} on {host}",
+                      file=sys.stderr)
+            # timeout here is the agent's IDLE bound (registration ->
+            # first command); a running job is supervised by the
+            # agent's driver-liveness pings, not a wall clock.
+            agents.append(exec_fn(
+                host, _agent_argv(i, driver_addrs,
+                                  timeout_s=start_timeout + 300.0),
+                key.hex()))
+        driver.wait_for_initial_registration(timeout_s=start_timeout)
+        routes = probe_full_mesh(driver, key)
+        if verbose:
+            print(f"[horovodtpurun] mesh verified: {len(routes)} routes",
+                  file=sys.stderr)
+
+        addresses = driver.task_addresses()
+        clients = {i: BasicClient(f"task-{i}", addrs, key)
+                   for i, addrs in addresses.items()}
+
+        # Coordinator = rank-0 host's reserved port, at the address its
+        # PEERS proved they can route to (the driver's own route may
+        # differ on multi-NIC hosts); single-host worlds use the
+        # driver's route.
+        coord_port = driver.task_coordinator_ports()[0]
+        if len(hosts) > 1:
+            coord_host = routes[(1, 0)][0]
+        else:
+            coord_host = clients[0].address[0]
+        coordinator = f"{coord_host}:{coord_port}"
+        if verbose:
+            print(f"[horovodtpurun] coordinator {coordinator}, world "
+                  f"{world_size}", file=sys.stderr)
+
+        for i, ranks in enumerate(rank_blocks):
+            if not ranks:
+                continue
+            clients[i].request(RunDistributedCommandRequest(
+                command, env or {}, ranks, world_size, coordinator))
+
+        # Supervise: first nonzero exit kills the job (reference
+        # behavior); all-zero on every agent means success.
+        pending = {i for i, ranks in enumerate(rank_blocks) if ranks}
+        while pending:
+            for i in sorted(pending):
+                codes = clients[i].request(
+                    DistributedExitCodesRequest()).codes
+                finished = {r: c for r, c in codes.items() if c is not None}
+                bad = {r: c for r, c in finished.items() if c != 0}
+                if bad and exit_code == 0:
+                    rank, exit_code = sorted(bad.items())[0]
+                    print(f"[horovodtpurun] rank {rank} exited "
+                          f"{exit_code}; terminating job", file=sys.stderr)
+                    for j in sorted(pending):
+                        clients[j].request(AbortCommandRequest())
+                if len(finished) == len(codes):
+                    pending.discard(i)
+            if pending:
+                time.sleep(poll_interval_s)
+    except (TimeoutError, ConnectionError) as e:
+        print(f"[horovodtpurun] {e}", file=sys.stderr)
+        exit_code = 1
+    finally:
+        for client in clients.values():
+            try:
+                client.request(AgentShutdownRequest())
+            except OSError:
+                pass
+        for proc in agents:
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        driver.shutdown()
+    return exit_code

@@ -1,0 +1,93 @@
+"""Per-host task agent for remote multi-host launch.
+
+Reference: ``horovod/runner/task_fn.py`` — the module the launcher
+ssh-execs on every target host (SURVEY.md §2.5/§3.4, mount empty,
+unverified): it starts a :class:`TaskService`, registers with the
+driver, answers connectivity probes, execs the worker command on
+request, and reports exit codes.
+
+TPU-native redesign: the agent's extra job is reserving the
+``jax.distributed`` coordinator port on its host at registration time —
+the driver points every worker's ``HVD_TPU_COORDINATOR_ADDR`` at the
+rank-0 host's reserved port, so world formation needs no ssh-visible
+rendezvous files.
+
+Security: the launcher-minted HMAC secret arrives on **stdin** (one hex
+line), never on argv — command lines are world-readable via /proc.
+
+Usage (what the launcher execs over ssh)::
+
+    python -m horovod_tpu.runner.task_agent \
+        --driver ip:port[,ip:port...] --index N
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import List, Optional, Tuple
+
+from .common.network import BasicClient, free_port, resolvable_hostname
+from .common.service import RegisterTaskRequest, TaskService
+
+
+def parse_addresses(spec: str) -> List[Tuple[str, int]]:
+    out = []
+    for part in spec.split(","):
+        host, _, port = part.rpartition(":")
+        out.append((host, int(port)))
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="horovod_tpu.runner.task_agent")
+    ap.add_argument("--driver", required=True,
+                    help="driver service address(es), ip:port[,ip:port...]")
+    ap.add_argument("--index", type=int, required=True)
+    ap.add_argument("--timeout", type=float, default=3600.0,
+                    help="exit with an error if no command arrives "
+                         "within this many seconds (idle bound only — "
+                         "a RUNNING job is supervised by driver-"
+                         "liveness pings, never a wall clock)")
+    args = ap.parse_args(argv)
+
+    key = bytes.fromhex(sys.stdin.readline().strip())
+    service = TaskService(args.index, key)
+    try:
+        driver = BasicClient("driver", parse_addresses(args.driver), key)
+        driver.request(RegisterTaskRequest(
+            args.index, service.addresses(), resolvable_hostname(),
+            coordinator_port=free_port()))
+        # Serve (probes / run-command / exit-code polls happen on the
+        # service threads) until the driver says we're done.  Two exit
+        # hatches so a dead driver can't leak agents or workers:
+        #  * idle timeout — registered but no command ever arrived;
+        #  * liveness — once a command ran, a driver that stops
+        #    answering pings means the launcher died: abort workers.
+        idle_deadline = time.monotonic() + args.timeout
+        missed_pings = 0
+        while not service.shutdown_requested.wait(timeout=15.0):
+            if not service.command_started:
+                if time.monotonic() > idle_deadline:
+                    print(f"task-{args.index}: no command within "
+                          f"{args.timeout:.0f}s", file=sys.stderr)
+                    return 1
+                continue
+            try:
+                driver.ping()
+                missed_pings = 0
+            except OSError:
+                missed_pings += 1
+                if missed_pings >= 4:
+                    print(f"task-{args.index}: driver unreachable; "
+                          "aborting workers", file=sys.stderr)
+                    service.abort_command()
+                    return 1
+        return 0
+    finally:
+        service.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

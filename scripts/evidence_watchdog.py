@@ -117,8 +117,12 @@ def main() -> None:
                 log_attempt("capture_done", stamp=stamp)
                 print("capture complete", flush=True)
                 sys.exit(0)
-            # A step failed mid-capture (tunnel flapped?) — keep looping;
-            # partial artifacts stay on disk, later success overwrites.
+            # A step failed mid-capture (tunnel flapped?) — drop this
+            # stamp's partial artifact so a stale outage file can't be
+            # mistaken for the round's evidence, then keep looping.
+            partial = os.path.join(ROOT, f"BENCH_tpu_{stamp}.json")
+            if os.path.exists(partial):
+                os.remove(partial)
             log_attempt("capture_incomplete", stamp=stamp)
         if i < args.max_attempts:
             time.sleep(args.sleep_s)
