@@ -208,12 +208,16 @@ def _maybe_build_parameter_manager(cfg):
     (reference: ``ParameterManager`` in the background thread,
     ``parameter_manager.cc`` per SURVEY.md §2.1, mount empty).
 
-    The TPU tunable surface is the fusion threshold — the bucket size
-    that trades collective latency hiding against pipelining inside the
-    compiled step.  ``make_train_step`` feeds windowed samples/sec and
-    re-jits when the manager proposes a new value (the re-jit boundary
-    replaces the reference's next-cycle knob application); see
-    ``optim/autotune.py``."""
+    The reference tunes (fusion threshold, cycle time) JOINTLY via
+    Bayesian optimization.  The TPU surface has no cycle time, but it
+    has a second trace-time wire knob with the same shape: the
+    hierarchical-allreduce inner width (ICI-block size of the two-level
+    reduction).  With ``HOROVOD_HIERARCHICAL_ALLREDUCE=1`` in a world
+    of >= 4 slots the GP therefore searches 2-D
+    (fusion_threshold x hierarchical_inner_size); otherwise it tunes
+    the threshold alone.  Both knobs are applied at the re-jit
+    boundary (the next-cycle application point of the reference); see
+    ``optim/autotune.py`` and ``_apply_autotuned_knobs``."""
     if not cfg.autotune:
         return None
     import dataclasses
@@ -221,6 +225,24 @@ def _maybe_build_parameter_manager(cfg):
     from .optim.parameter_manager import ParameterManager
 
     lo, hi = 1 << 20, 1 << 28
+    knobs = {"fusion_threshold": (lo, hi)}
+    initial = {}
+    size = _state.mesh.size if _state.mesh is not None else 1
+    joint = cfg.hierarchical_allreduce and size >= 4
+    if joint:
+        # log2 search over [1, size]; proposals snap to the nearest
+        # divisor of the slot count (1 and size both mean "flat"
+        # — turning hierarchy OFF is a legitimate point to discover).
+        knobs["hierarchical_inner_size"] = (1, size)
+        live_inner = cfg.hierarchical_inner_size
+        if not 1 <= live_inner <= size:
+            live_inner = max(1, size // 2)
+        # Snap BEFORE seeding: scores are attributed to the manager's
+        # start point, so it must be the width the job actually runs
+        # (a non-divisor like INNER=3 on 8 slots would otherwise seed
+        # the GP at a point that never executes).
+        initial["hierarchical_inner_size"] = _nearest_divisor(
+            live_inner, size)
     # Scores are attributed to the manager's current point — seed it
     # with the threshold the first windows will actually run.  A live
     # value outside the search space (e.g. HOROVOD_FUSION_THRESHOLD=0,
@@ -228,30 +250,50 @@ def _maybe_build_parameter_manager(cfg):
     # start point becomes the live value instead — autotune overriding
     # a manual threshold is its purpose.
     seedable = lo <= cfg.fusion_threshold <= hi
+    if seedable:
+        initial["fusion_threshold"] = cfg.fusion_threshold
     pm = ParameterManager(
-        knobs={"fusion_threshold": (lo, hi)},
+        knobs=knobs,
         warmup_samples=cfg.autotune_warmup_samples,
         steps_per_sample=cfg.autotune_steps_per_sample,
         max_samples=cfg.autotune_max_samples,
         log_path=cfg.autotune_log,
-        initial=({"fusion_threshold": cfg.fusion_threshold}
-                 if seedable else None),
+        initial=initial or None,
     )
+    start_vals = pm.current_values()
     if not seedable:
-        start = int(pm.current_values()["fusion_threshold"])
+        start = int(start_vals["fusion_threshold"])
         logger.warning(
             "HOROVOD_AUTOTUNE=1 overrides fusion_threshold=%d (outside "
             "the tunable range [%d, %d]): starting from %d",
             cfg.fusion_threshold, lo, hi, start)
         _state.config = dataclasses.replace(_state.config,
                                             fusion_threshold=start)
+    if joint:
+        # The manager's start point must equal the live config (scores
+        # are attributed to it): snap and store.
+        start_inner = _nearest_divisor(
+            int(round(start_vals["hierarchical_inner_size"])), size)
+        _state.config = dataclasses.replace(
+            _state.config, hierarchical_inner_size=start_inner)
     logger.info(
-        "autotune enabled: tuning fusion_threshold over [1MiB, 256MiB], "
-        "%d warmup + %d scored windows of %d steps%s",
+        "autotune enabled: tuning %s, %d warmup + %d scored windows "
+        "of %d steps%s",
+        " x ".join(pm.knob_names),
         cfg.autotune_warmup_samples, cfg.autotune_max_samples,
         cfg.autotune_steps_per_sample,
         f", log={cfg.autotune_log}" if cfg.autotune_log else "")
     return pm
+
+
+def _nearest_divisor(value: int, size: int) -> int:
+    """The divisor of ``size`` nearest ``value`` in log space (the
+    hierarchical inner width must tile the slot axis exactly)."""
+    import math
+
+    divisors = [d for d in range(1, size + 1) if size % d == 0]
+    return min(divisors,
+               key=lambda d: abs(math.log2(d) - math.log2(max(1, value))))
 
 
 def parameter_manager():
@@ -260,15 +302,29 @@ def parameter_manager():
 
 
 def _apply_autotuned_fusion_threshold(value: float) -> None:
+    """Single-knob form of :func:`_apply_autotuned_knobs` (kept for
+    compatibility with external callers/tests)."""
+    _apply_autotuned_knobs({"fusion_threshold": value})
+
+
+def _apply_autotuned_knobs(values) -> dict:
     """Apply an autotune proposal: swap the frozen Config for one with
-    the new fusion threshold.  Callers must rebuild (re-jit) their train
-    step afterwards — trace-time reads of ``config().fusion_threshold``
-    pick the new value up on the next trace."""
+    the new knob values.  Callers must rebuild (re-jit) their train
+    step afterwards — trace-time reads of ``config()`` pick the new
+    values up on the next trace.  Returns the values as actually
+    applied (the hierarchical inner width snaps to the nearest divisor
+    of the slot count)."""
     import dataclasses
 
     st = _require_init()
-    st.config = dataclasses.replace(st.config,
-                                    fusion_threshold=int(value))
+    updates = {}
+    if "fusion_threshold" in values:
+        updates["fusion_threshold"] = int(values["fusion_threshold"])
+    if "hierarchical_inner_size" in values:
+        updates["hierarchical_inner_size"] = _nearest_divisor(
+            int(round(values["hierarchical_inner_size"])), st.mesh.size)
+    st.config = dataclasses.replace(st.config, **updates)
+    return updates
 
 
 def _maybe_start_cross_monitor(cfg):

@@ -67,6 +67,7 @@ class AutotunedTrainStep:
         self._burn_in = True
         self._warned_traced = False
         self.applied: list = []
+        self.applied_knobs: list = []
 
     @property
     def frozen(self) -> bool:
@@ -135,12 +136,14 @@ class AutotunedTrainStep:
     def _apply(self, suggestion) -> None:
         from .. import basics
 
-        threshold = int(suggestion["fusion_threshold"])
-        basics._apply_autotuned_fusion_threshold(threshold)
+        applied = basics._apply_autotuned_knobs(suggestion)
         self._step = self._rebuild()
         self._burn_in = True   # next call compiles; keep it unscored
-        self.applied.append(threshold)
+        # ``applied`` keeps its historical shape (threshold ints) for
+        # existing consumers; the joint search is in applied_knobs.
+        self.applied.append(applied.get("fusion_threshold"))
+        self.applied_knobs.append(applied)
         logger.info(
-            "autotune %s fusion_threshold=%d (%d applied so far)",
-            "froze at" if self._pm.frozen else "trying", threshold,
+            "autotune %s %s (%d applied so far)",
+            "froze at" if self._pm.frozen else "trying", applied,
             len(self.applied))

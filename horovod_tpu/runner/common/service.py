@@ -168,11 +168,28 @@ class TaskService(BasicService):
         self._rank_threads: Dict[int, threading.Thread] = {}
         self._rank_codes: Dict[int, Optional[int]] = {}
         self.shutdown_requested = threading.Event()
+        self._coord_sock = None
 
     @property
     def command_started(self) -> bool:
         """True once any (single or distributed) command was launched."""
         return self._cmd_thread is not None or bool(self._rank_threads)
+
+    def reserve_coordinator_port(self) -> int:
+        """Bind (and HOLD) a listening socket for the jax.distributed
+        coordinator; released in :meth:`_launch_distributed` just
+        before the workers spawn.  Holding the bind shrinks the
+        port-stealing window from launch-sequence minutes to the
+        milliseconds between release and the rank-0 worker's own bind
+        (a true handoff would need SO_REUSEPORT cooperation from XLA)."""
+        import socket
+
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("0.0.0.0", 0))
+        s.listen(1)
+        self._coord_sock = s
+        return s.getsockname()[1]
 
     def _handle(self, req: Any, client_address) -> Any:
         if isinstance(req, ProbePeerRequest):
@@ -210,6 +227,11 @@ class TaskService(BasicService):
     def _launch_distributed(self, req: RunDistributedCommandRequest) -> None:
         if any(t.is_alive() for t in self._rank_threads.values()):
             raise RuntimeError("a distributed command is already running")
+        if self._coord_sock is not None:
+            # Release the held coordinator port now — rank 0 (possibly
+            # among this agent's workers) binds it during hvd.init.
+            self._coord_sock.close()
+            self._coord_sock = None
 
         import os
 
@@ -227,8 +249,18 @@ class TaskService(BasicService):
             self._rank_codes[rank] = None
 
             def target(rank=rank, env=env):
-                self._rank_codes[rank] = execute(
-                    req.command, env=env, events=[self._abort])
+                try:
+                    self._rank_codes[rank] = execute(
+                        req.command, env=env, events=[self._abort])
+                except Exception as e:
+                    # Spawn failure (missing executable etc.) must
+                    # surface as a rank exit code, or the launcher's
+                    # exit-code poll waits forever on a dead thread.
+                    import sys
+
+                    print(f"rank {rank} failed to spawn: {e}",
+                          file=sys.stderr)
+                    self._rank_codes[rank] = 127
 
             t = threading.Thread(target=target, daemon=True)
             self._rank_threads[rank] = t
@@ -258,6 +290,9 @@ class TaskService(BasicService):
 
     def shutdown(self) -> None:
         self._abort.set()
+        if self._coord_sock is not None:
+            self._coord_sock.close()
+            self._coord_sock = None
         super().shutdown()
 
 

@@ -299,12 +299,18 @@ def main(argv: Optional[List[str]] = None) -> int:
 
             try:
                 hosts = parse_hosts(args.hosts)
+                # Forward the launcher's environment like the local
+                # path does (_spawn_world inherits os.environ): a
+                # HOROVOD_* knob set at the CLI must mean the same
+                # thing on every host.  Agent-host values lose to the
+                # launcher's on conflict.
+                return remote_run(hosts, command, np_=args.num_proc,
+                                  env=dict(os.environ),
+                                  start_timeout=args.start_timeout,
+                                  verbose=args.verbose)
             except ValueError as e:
                 print(f"error: {e}", file=sys.stderr)
                 return 2
-            return remote_run(hosts, command, np_=args.num_proc,
-                              start_timeout=args.start_timeout,
-                              verbose=args.verbose)
     num_proc = args.num_proc if args.num_proc is not None else 1
     if args.min_np is not None and num_proc < args.min_np:
         print(f"error: -np {num_proc} < --min-np {args.min_np}",

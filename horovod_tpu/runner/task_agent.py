@@ -28,7 +28,7 @@ import sys
 import time
 from typing import List, Optional, Tuple
 
-from .common.network import BasicClient, free_port, resolvable_hostname
+from .common.network import BasicClient, resolvable_hostname
 from .common.service import RegisterTaskRequest, TaskService
 
 
@@ -58,7 +58,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         driver = BasicClient("driver", parse_addresses(args.driver), key)
         driver.request(RegisterTaskRequest(
             args.index, service.addresses(), resolvable_hostname(),
-            coordinator_port=free_port()))
+            coordinator_port=service.reserve_coordinator_port()))
         # Serve (probes / run-command / exit-code polls happen on the
         # service threads) until the driver says we're done.  Two exit
         # hatches so a dead driver can't leak agents or workers:

@@ -49,13 +49,25 @@ def run_capture(stamp: str) -> bool:
            "HVD_TPU_PROBE_BACKOFF_S": "30"}
     ok = True
 
-    def step(name, cmd, out_path=None, append=False, timeout=2400):
+    def step(name, cmd, out_path=None, append=False, timeout=2400,
+             side_artifact=None):
+        """``side_artifact``: a fixed-name file the COMMAND writes
+        itself; deleted when this step fails so a stale partial can't
+        masquerade as the round's evidence."""
         nonlocal ok
+
+        def drop_side():
+            if side_artifact:
+                path = os.path.join(ROOT, side_artifact)
+                if os.path.exists(path):
+                    os.remove(path)
+
         t0 = time.monotonic()
         try:
             proc = subprocess.run(cmd, cwd=ROOT, env=env, text=True,
                                   capture_output=True, timeout=timeout)
         except subprocess.TimeoutExpired:
+            drop_side()
             log_attempt("capture_step", step=name, ok=False,
                         error=f"timeout after {timeout}s")
             ok = False
@@ -69,6 +81,8 @@ def run_capture(stamp: str) -> bool:
         good = (proc.returncode == 0 and parsed is not None
                 and not parsed.get("error")
                 and parsed.get("value") != 0.0)
+        if not good:
+            drop_side()
         if out_path and parsed is not None:
             with open(os.path.join(ROOT, out_path), "a" if append else "w") as f:
                 f.write(line + "\n")
@@ -85,7 +99,8 @@ def run_capture(stamp: str) -> bool:
          out_path=f"BENCH_tpu_{stamp}.json")
     step("busbw_sweep",
          [sys.executable, os.path.join("benchmarks", "allreduce_bench.py"),
-          "--out", "BUSBW_r05_tpu.json"])
+          "--out", "BUSBW_r05_tpu.json"],
+         side_artifact="BUSBW_r05_tpu.json")
     step("bench_fp16",
          [sys.executable, "bench.py", "--fp16-allreduce"],
          out_path=f"BENCH_tpu_{stamp}.json", append=True)

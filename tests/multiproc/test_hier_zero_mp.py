@@ -148,3 +148,62 @@ class TestAutotuneMP:
         finally:
             hvd.shutdown()
         """, timeout=420.0)
+
+    def test_joint_2d_autotune_syncs_across_controllers(self, world):
+        """Joint (fusion_threshold x hierarchical_inner_size) GP across
+        4 real controllers (reference tunes fusion+cycle jointly): rank
+        0's 2-D decisions broadcast; every rank applies the identical
+        knob-dict sequence, every applied inner width divides the slot
+        count, and the frozen config matches the last applied point."""
+        world(4, """
+        import jax.numpy as jnp
+        import optax
+        from jax.sharding import PartitionSpec as P
+
+        hvd.shutdown()
+        os.environ['HOROVOD_AUTOTUNE'] = '1'
+        os.environ['HOROVOD_HIERARCHICAL_ALLREDUCE'] = '1'
+        os.environ['HOROVOD_AUTOTUNE_WARMUP_SAMPLES'] = '1'
+        os.environ['HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE'] = '2'
+        os.environ['HVD_TPU_AUTOTUNE_MAX_SAMPLES'] = '3'
+        hvd.init()
+        try:
+            from horovod_tpu.optim.autotune import AutotunedTrainStep
+            from horovod_tpu.parallel.train import shard_batch
+
+            pm = hvd.parameter_manager()
+            assert pm is not None
+            assert pm.knob_names == ['fusion_threshold',
+                                     'hierarchical_inner_size'], pm.knob_names
+
+            rng = np.random.RandomState(0)  # same data on all ranks
+            X = rng.randn(8, 4).astype(np.float32)
+            Y = (X @ rng.randn(4, 1)).astype(np.float32)
+
+            tx = hvd.DistributedOptimizer(optax.sgd(0.05))
+            step = hvd.make_train_step(
+                lambda p, b: jnp.mean((b[0] @ p['w'] - b[1]) ** 2), tx,
+                donate=False)
+            assert isinstance(step, AutotunedTrainStep)
+            params = {'w': jnp.zeros((4, 1))}
+            opt = tx.init(params)
+            gm = hvd.global_mesh()
+            batch = shard_batch((X, Y), gm.mesh, P(gm.axis_name))
+            for _ in range(16):
+                params, opt, loss = step(params, opt, batch)
+            assert pm.frozen, 'tuner did not freeze'
+            assert step.applied_knobs, 'no joint proposal applied'
+            for knobs in step.applied_knobs:
+                assert set(knobs) == {'fusion_threshold',
+                                      'hierarchical_inner_size'}, knobs
+                assert 4 % knobs['hierarchical_inner_size'] == 0, knobs
+            assert (hvd.config().hierarchical_inner_size
+                    == step.applied_knobs[-1]['hierarchical_inner_size'])
+            seqs = hvd.allgather_object(
+                (step.applied_knobs, hvd.config().fusion_threshold,
+                 hvd.config().hierarchical_inner_size))
+            assert all(s == seqs[0] for s in seqs), seqs
+            assert jnp.isfinite(loss)
+        finally:
+            hvd.shutdown()
+        """, timeout=420.0)

@@ -88,6 +88,53 @@ class TestParameterManager:
         lines = log.read_text().strip().splitlines()
         assert len(lines) >= 2  # samples + frozen marker
 
+    def test_joint_2d_search_converges_and_freezes(self, tmp_path):
+        """The GP searches BOTH axes (reference: fusion threshold and
+        cycle time jointly): with a separable objective peaked inside
+        the box, the frozen point is the best sampled 2-D point and the
+        log records both knobs per sample."""
+        log = tmp_path / "joint.jsonl"
+        pm = ParameterManager(
+            {"fusion_threshold": (2 ** 20, 2 ** 28),
+             "hierarchical_inner_size": (1, 16)},
+            warmup_samples=1, steps_per_sample=1,
+            max_samples=12, log_path=str(log))
+
+        import math
+
+        def objective(vals):
+            x = math.log2(vals["fusion_threshold"])
+            y = math.log2(vals["hierarchical_inner_size"])
+            return 100.0 - (x - 24.0) ** 2 - (y - 2.0) ** 2
+
+        self._drive(pm, objective)
+        assert pm.frozen
+        final = pm.current_values()
+        assert set(final) == {"fusion_threshold",
+                              "hierarchical_inner_size"}
+        assert 2 ** 20 <= final["fusion_threshold"] <= 2 ** 28
+        assert 1 <= final["hierarchical_inner_size"] <= 16
+        lines = [json.loads(l) for l in
+                 log.read_text().strip().splitlines()]
+        assert all(set(l["knobs"]) == {"fusion_threshold",
+                                       "hierarchical_inner_size"}
+                   for l in lines)
+        # Frozen at the best SAMPLED point: its recorded score is the
+        # max of all scored samples.
+        scores = [l["score"] for l in lines if l["note"] != "frozen"]
+        assert lines[-1]["note"] == "frozen"
+        assert lines[-1]["score"] == max(scores)
+
+    def test_nearest_divisor_snaps_inner_width(self):
+        from horovod_tpu.basics import _nearest_divisor
+
+        assert _nearest_divisor(3, 8) in (2, 4)
+        assert _nearest_divisor(4, 8) == 4
+        assert _nearest_divisor(100, 8) == 8
+        assert _nearest_divisor(0, 8) == 1
+        assert _nearest_divisor(5, 12) == 6  # log-nearest divisor of 12
+        assert all(12 % _nearest_divisor(v, 12) == 0 for v in range(1, 20))
+
     def test_record_before_enough_steps_returns_none(self):
         pm = ParameterManager({"k": (1, 1024)}, steps_per_sample=5)
         for _ in range(4):
@@ -195,6 +242,53 @@ class TestAutotuneEndToEnd:
                      log.read_text().strip().splitlines()]
             assert len(lines) >= 3
             assert lines[-1]["note"] == "frozen"
+        finally:
+            hvd.shutdown()
+            hvd.init()
+
+    def test_joint_knobs_on_hierarchical_mesh(self):
+        """HOROVOD_AUTOTUNE + HOROVOD_HIERARCHICAL_ALLREDUCE on the
+        8-slot mesh → the 2-D search drives the live config: every
+        applied inner width divides the slot count and the frozen
+        config matches the last applied point."""
+        import jax.numpy as jnp
+        import optax
+
+        from horovod_tpu.optim.autotune import AutotunedTrainStep
+
+        hvd.shutdown()
+        try:
+            hvd.init(Config(autotune=True, hierarchical_allreduce=True,
+                            autotune_warmup_samples=1,
+                            autotune_steps_per_sample=2,
+                            autotune_max_samples=3))
+            pm = hvd.parameter_manager()
+            assert pm.knob_names == ["fusion_threshold",
+                                     "hierarchical_inner_size"]
+            # Seeded start already snapped onto the divisor lattice.
+            assert hvd.size() % hvd.config().hierarchical_inner_size == 0
+
+            rng = np.random.RandomState(0)
+            x = jnp.asarray(rng.randn(64, 16).astype(np.float32))
+            y = jnp.asarray(x @ rng.randn(16, 1).astype(np.float32))
+
+            tx = hvd.DistributedOptimizer(optax.sgd(0.05))
+            step = hvd.make_train_step(
+                lambda p, b: jnp.mean((b[0] @ p["w"] - b[1]) ** 2), tx)
+            assert isinstance(step, AutotunedTrainStep)
+            params = {"w": jnp.zeros((16, 1))}
+            opt_state = tx.init(params)
+            for _ in range(16):
+                params, opt_state, loss = step(params, opt_state, (x, y))
+            assert pm.frozen
+            assert step.applied_knobs
+            for knobs in step.applied_knobs:
+                assert hvd.size() % knobs["hierarchical_inner_size"] == 0
+            assert (hvd.config().hierarchical_inner_size
+                    == step.applied_knobs[-1]["hierarchical_inner_size"])
+            assert (hvd.config().fusion_threshold
+                    == step.applied_knobs[-1]["fusion_threshold"])
+            assert jnp.isfinite(loss)
         finally:
             hvd.shutdown()
             hvd.init()
