@@ -25,10 +25,12 @@ SO_PATH = os.path.join(_HERE, "libhvdtpu_native.so")
 
 
 def sources() -> List[str]:
-    # ffi_ops.cc is the XLA FFI library: different toolchain contract
-    # (C++17 + jaxlib headers), built separately by native/ffi.py.
+    # ffi_ops.cc is the XLA FFI library (C++17 + jaxlib headers) and
+    # tf_xla_ops.cc is the TF-XLA adapter (TF headers + libtensorflow);
+    # both have their own toolchain contracts and builders
+    # (native/ffi.py, tensorflow/xla_ops.py).
     return sorted(p for p in glob.glob(os.path.join(SRC_DIR, "*.cc"))
-                  if not p.endswith("ffi_ops.cc"))
+                  if not p.endswith(("ffi_ops.cc", "tf_xla_ops.cc")))
 
 
 def needs_build() -> bool:

@@ -137,6 +137,11 @@ class AutotunedTrainStep:
         from .. import basics
 
         applied = basics._apply_autotuned_knobs(suggestion)
+        # Re-point the manager at the AS-APPLIED values (divisor
+        # snapping, int truncation): window scores are attributed to
+        # _current, which must be what the job actually runs —
+        # deterministic on every rank, so the broadcast stays in sync.
+        self._pm.mirror(applied, frozen=self._pm.frozen)
         self._step = self._rebuild()
         self._burn_in = True   # next call compiles; keep it unscored
         # ``applied`` keeps its historical shape (threshold ints) for

@@ -5,6 +5,15 @@
 from __future__ import annotations
 
 
+def _tf_xla_ok() -> bool:
+    try:
+        from ..tensorflow import xla_ops
+
+        return xla_ops.available()
+    except ImportError:
+        return False
+
+
 def check_build_str() -> str:
     from ..version import __version__
 
@@ -75,6 +84,8 @@ def check_build_str() -> str:
         "flash engine)",
         "    [X] wire compression (fp16, bf16, int8 "
         "transport-quantized allreduce)",
+        f"    [{'X' if _tf_xla_ok() else ' '}] TF-XLA adapter "
+        "(collectives inside tf.function(jit_compile=True))",
         "    [X] chunked-vocab LM cross-entropy (no [B,T,V] logits "
         "materialization)",
         "",

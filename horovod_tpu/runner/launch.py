@@ -299,13 +299,19 @@ def main(argv: Optional[List[str]] = None) -> int:
 
             try:
                 hosts = parse_hosts(args.hosts)
-                # Forward the launcher's environment like the local
-                # path does (_spawn_world inherits os.environ): a
-                # HOROVOD_* knob set at the CLI must mean the same
-                # thing on every host.  Agent-host values lose to the
-                # launcher's on conflict.
+                # Forward framework/runtime knobs so a HOROVOD_* var set
+                # at the CLI means the same thing on every host — but
+                # NOT the whole environment: the launcher's
+                # PATH/HOME/VIRTUAL_ENV would clobber host-critical
+                # values on remote machines (workers inherit the agent
+                # host's env underneath these overrides).
+                fwd_prefixes = ("HOROVOD_", "HVD_TPU_", "JAX_", "XLA_",
+                                "TF_", "LIBTPU_", "TPU_", "PYTHONPATH",
+                                "PYTHONUNBUFFERED")
+                env = {k: v for k, v in os.environ.items()
+                       if k.startswith(fwd_prefixes)}
                 return remote_run(hosts, command, np_=args.num_proc,
-                                  env=dict(os.environ),
+                                  env=env,
                                   start_timeout=args.start_timeout,
                                   verbose=args.verbose)
             except ValueError as e:

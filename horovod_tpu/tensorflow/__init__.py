@@ -68,6 +68,11 @@ from .mpi_ops import (  # noqa: F401
     size_op, rank_op, local_rank_op, local_size_op, process_set_included_op,
 )
 from . import keras  # noqa: F401  (horovod.tensorflow.keras parity)
+from . import xla_ops as _xla_ops
+
+# TF finalizes its XLA kernel registry at the first jit_compile trace;
+# the adapter op must be registered before then (see xla_ops.preload).
+_xla_ops.preload()
 
 
 def _to_dense(grad):

@@ -23,12 +23,16 @@ playing the background thread (proved multi-controller by
 Collective *order* must match across workers; grouped ops make a whole
 gradient set one ordered call (the reference's tensor-fusion guarantee).
 
-Known limit: ``tf.function(jit_compile=True)`` — an XLA-compiled TF
-graph — cannot host the bridge (XLA runs no py_function, the same
-constraint as user custom calls on XLA:TPU; see the FFI notes in
-README).  The reference's ``xla_mpi_ops.cc`` had the same job and the
-same boundary on TPU.  Train TF under plain ``tf.function`` graphs, or
-use the pure-JAX tier for fully-compiled steps.
+``tf.function(jit_compile=True)`` rides the native TF-XLA adapter
+(:mod:`horovod_tpu.tensorflow.xla_ops`, the reference's
+``xla_mpi_ops.cc`` equivalent): dense allreduce and grouped allreduce
+(dtype-bucketed concat — the fusion buffer, in-graph) lower to a host
+CustomCall in TF's own XLA runtime running the SAME closure the
+py_function bridge runs.  Remaining jit_compile limits, matching the
+reference adapter's allreduce-only scope: Adasum grouped reduction
+(per-tensor projections don't commute with concat) and sparse
+IndexedSlices gradients (use ``sparse_as_dense=True``) fall back to
+py_function and fail under jit with the pinned error.
 """
 
 from __future__ import annotations
@@ -97,15 +101,43 @@ def _np_bridge(fn, inputs: Sequence, out_dtypes: Sequence,
 
 # --- allreduce ---------------------------------------------------------------
 
+def _native_bridge(fn, tensor, name):
+    """Emit the native ``HvdTpuAllreduce`` op running ``fn`` on the host
+    tensor inside graphs (plain or ``jit_compile=True``), chained like
+    the py_function path so collective order == trace order.  Eager
+    calls run ``fn`` directly — the op's closure table is trace-time
+    state; keying every eager step would grow it unboundedly."""
+    from . import xla_ops
+
+    if tf.executing_eagerly():
+        return tf.convert_to_tensor(np.asarray(fn(_to_numpy(tensor))))
+    graph = tf.compat.v1.get_default_graph()
+    prev = getattr(graph, _CHAIN_ATTR, None)
+    with tf.control_dependencies([prev] if prev is not None else []):
+        out = xla_ops.allreduce(tensor, fn, name)
+    setattr(graph, _CHAIN_ATTR, out)
+    return out
+
+
+def _use_native(dtype) -> bool:
+    from . import xla_ops
+
+    return xla_ops.available() and xla_ops.supported_dtype(dtype)
+
+
 def _allreduce_dense(tensor, op, process_set, prescale_factor,
                      postscale_factor, name):
-    def run(value):
-        return [H.allreduce_async(
+    def run_np(value):
+        return np.asarray(H.allreduce_async(
             value, op=op, process_set=process_set,
             prescale_factor=prescale_factor,
-            postscale_factor=postscale_factor, name=name).wait()]
+            postscale_factor=postscale_factor, name=name).wait())
 
-    out = _np_bridge(run, [tensor], [tensor.dtype], name)[0]
+    if _use_native(tensor.dtype):
+        out = _native_bridge(run_np, tensor, name)
+    else:
+        out = _np_bridge(lambda v: [run_np(v)], [tensor],
+                         [tensor.dtype], name)[0]
     out.set_shape(tensor.shape)
     return out
 
@@ -162,13 +194,25 @@ def grouped_allreduce(tensors: Sequence, *, op: str = Average,
         wires.append(w)
         ctxs.append(c)
 
-    def run(*values):
-        return H.grouped_allreduce_async(
-            list(values), op=op, process_set=process_set,
-            prescale_factor=float(prescale_factor),
-            postscale_factor=float(postscale_factor), name=name).wait()
+    if (op != Adasum
+            and all(_use_native(w.dtype) for w in wires)
+            and all(w.shape.is_fully_defined() for w in wires)):
+        # jit_compile-capable path: concat each dtype bucket in-graph
+        # (XLA-compilable, and literally the fusion buffer — one
+        # transport call per dtype) and allreduce it through the native
+        # op.  Elementwise reduce ops commute with concat; Adasum's
+        # per-tensor projections do NOT, hence the guard.
+        outs = _grouped_native(wires, op, process_set,
+                               float(prescale_factor),
+                               float(postscale_factor), name)
+    else:
+        def run(*values):
+            return H.grouped_allreduce_async(
+                list(values), op=op, process_set=process_set,
+                prescale_factor=float(prescale_factor),
+                postscale_factor=float(postscale_factor), name=name).wait()
 
-    outs = _np_bridge(run, wires, [w.dtype for w in wires], name)
+        outs = _np_bridge(run, wires, [w.dtype for w in wires], name)
     results = []
     for o, w, t, c in zip(outs, wires, tensors, ctxs):
         o.set_shape(w.shape)
@@ -176,6 +220,30 @@ def grouped_allreduce(tensors: Sequence, *, op: str = Average,
             o = compression.decompress(o, c)
         results.append(tf.cast(o, t.dtype))
     return results
+
+
+def _grouped_native(wires, op, process_set, prescale, postscale,
+                    name) -> List:
+    """Grouped allreduce as one native allreduce per dtype bucket."""
+    buckets: dict = {}
+    for i, w in enumerate(wires):
+        buckets.setdefault(w.dtype, []).append(i)
+    outs: List = [None] * len(wires)
+    for dtype, idxs in buckets.items():
+        flats = [tf.reshape(wires[i], [-1]) for i in idxs]
+        sizes = [int(wires[i].shape.num_elements()) for i in idxs]
+        fused = tf.concat(flats, axis=0)
+
+        def run_np(value, _n=f"{name}.{dtype.name}"):
+            return np.asarray(H.allreduce_async(
+                value, op=op, process_set=process_set,
+                prescale_factor=prescale, postscale_factor=postscale,
+                name=_n).wait())
+
+        reduced = _native_bridge(run_np, fused, f"{name}.{dtype.name}")
+        for i, part in zip(idxs, tf.split(reduced, sizes)):
+            outs[i] = tf.reshape(part, tf.shape(wires[i]))
+    return outs
 
 
 # --- allgather ---------------------------------------------------------------

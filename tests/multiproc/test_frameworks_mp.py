@@ -113,6 +113,35 @@ class TestTensorFlowGraphModeMP:
         assert np.allclose(v.numpy(), want), (v.numpy(), want)
         """)
 
+    def test_allreduce_under_jit_compile_cross_process(self, world):
+        """tf.function(jit_compile=True) across 2 REAL controllers: the
+        native TF-XLA adapter's CustomCall re-enters the collective
+        core from inside the compiled program, and both workers get the
+        cross-process reduction (the retired round-4 waiver, proved
+        multi-controller)."""
+        world(2, """
+        import tensorflow as tf
+        import horovod_tpu.tensorflow as hvt
+        from horovod_tpu.tensorflow import xla_ops
+
+        assert xla_ops.available(), xla_ops.load_error()
+
+        @tf.function(jit_compile=True)
+        def step(x):
+            s = hvt.allreduce(x, op=hvt.Sum, name='jit_sum')
+            g = hvt.grouped_allreduce(
+                [x * 2.0, tf.cast(x, tf.int32) * 3],
+                op=hvt.Sum, name='jit_group')
+            return s + 1.0, g
+
+        x = tf.fill([2, 2], float(rank + 1))
+        for _ in range(3):  # re-execution, compiled once
+            s, (ga, gb) = step(x)
+        assert np.allclose(s.numpy(), 4.0), s.numpy()      # 1+2 +1
+        assert np.allclose(ga.numpy(), 6.0), ga.numpy()    # 2+4
+        assert np.all(gb.numpy() == 9), gb.numpy()         # 3+6
+        """)
+
 
 class TestCrossProcessMonitorMP:
     def test_stall_attribution_and_clean_cycles(self, world):

@@ -385,29 +385,94 @@ class TestOpConstants:
         assert float(out) == 3.0
 
 
-class TestJitCompileWaiver:
-    """tf.function(jit_compile=True) WAIVER (pinned, not silent).
+class TestJitCompile:
+    """tf.function(jit_compile=True) — the round-4 waiver is RETIRED.
 
-    An XLA-compiled TF graph cannot host the py_function bridge — XLA
-    runs no host callbacks, the same boundary the reference's custom op
-    hits on XLA:TPU (its ``xla_mpi_ops.cc`` covered XLA:GPU only; see
-    README "TensorFlow under jit_compile").  This test pins the failure
-    so the capability edge is explicit and any TF release that lifts
-    the constraint flips this test and retires the waiver.
+    The native TF-XLA adapter (``tensorflow/xla_ops.py`` +
+    ``native/src/tf_xla_ops.cc``) is the reference's ``xla_mpi_ops.cc``
+    equivalent: collectives inside XLA-compiled TF graphs lower to a
+    host CustomCall registered in TF's own XLA runtime.  These tests
+    pin the capability; the Adasum-grouped case pins the REMAINING
+    boundary (per-tensor projections don't commute with the concat
+    fusion buffer).
     """
 
-    def test_allreduce_under_jit_compile_fails_loudly(self):
+    def test_allreduce_under_jit_compile(self):
+        import tensorflow as tf
+
+        import horovod_tpu.tensorflow as hvt
+        from horovod_tpu.tensorflow import xla_ops
+
+        assert xla_ops.available(), xla_ops.load_error()
+
+        @tf.function(jit_compile=True)
+        def f(x):
+            return hvt.allreduce(x, op=hvt.Sum) * 2.0
+
+        out = f(tf.constant([1.0, 2.0]))
+        # Single controller: sum over one process is identity; the op
+        # executed INSIDE the compiled program (x2 fused around it).
+        assert np.allclose(out.numpy(), [2.0, 4.0]), out
+
+    def test_grouped_allreduce_and_tape_under_jit_compile(self):
+        import tensorflow as tf
+
+        import horovod_tpu.tensorflow as hvt
+
+        v = tf.Variable([[1.0, 2.0], [3.0, 4.0]])
+        w = tf.Variable([5.0, 6.0])
+
+        @tf.function(jit_compile=True)
+        def step():
+            with tf.GradientTape() as tape:
+                loss = tf.reduce_sum(v * v) + tf.reduce_sum(w)
+            tape = hvt.DistributedGradientTape(tape)
+            gv, gw = tape.gradient(loss, [v, w])
+            return gv, gw
+
+        gv, gw = step()
+        assert np.allclose(gv.numpy(), 2 * v.numpy())
+        assert np.allclose(gw.numpy(), [1.0, 1.0])
+
+    def test_mixed_dtype_grouped_under_jit_compile(self):
+        import tensorflow as tf
+
+        import horovod_tpu.tensorflow as hvt
+
+        @tf.function(jit_compile=True)
+        def f(a, b):
+            return hvt.grouped_allreduce([a, b], op=hvt.Sum)
+
+        a, b = f(tf.ones((3,)), tf.ones((2,), tf.int32) * 2)
+        assert np.allclose(a.numpy(), 1.0) and a.dtype == tf.float32
+        assert np.all(b.numpy() == 2) and b.dtype == tf.int32
+
+    def test_fp16_compression_under_jit_compile(self):
         import tensorflow as tf
 
         import horovod_tpu.tensorflow as hvt
 
         @tf.function(jit_compile=True)
         def f(x):
-            return hvt.allreduce(x, op=hvt.Sum)
+            return hvt.allreduce(x, op=hvt.Average,
+                                 compression=hvt.Compression.fp16)
+
+        out = f(tf.fill((8,), 1.5))
+        assert out.dtype == tf.float32
+        assert np.allclose(out.numpy(), 1.5, atol=1e-3)
+
+    def test_adasum_grouped_remains_pinned_boundary(self):
+        import tensorflow as tf
+
+        import horovod_tpu.tensorflow as hvt
+
+        @tf.function(jit_compile=True)
+        def f(x, y):
+            return hvt.grouped_allreduce([x, y], op=hvt.Adasum)
 
         with pytest.raises(tf.errors.InvalidArgumentError,
                            match="EagerPyFunc"):
-            f(tf.ones((4,)))
+            f(tf.ones((2,)), tf.ones((3,)))
 
     def test_plain_tf_function_is_the_supported_path(self):
         import tensorflow as tf
