@@ -28,11 +28,13 @@ gradient set one ordered call (the reference's tensor-fusion guarantee).
 ``xla_mpi_ops.cc`` equivalent): dense allreduce and grouped allreduce
 (dtype-bucketed concat — the fusion buffer, in-graph) lower to a host
 CustomCall in TF's own XLA runtime running the SAME closure the
-py_function bridge runs.  Remaining jit_compile limits, matching the
-reference adapter's allreduce-only scope: Adasum grouped reduction
-(per-tensor projections don't commute with concat) and sparse
-IndexedSlices gradients (use ``sparse_as_dense=True``) fall back to
-py_function and fail under jit with the pinned error.
+py_function bridge runs.  Adasum groups lower to one
+CustomCall per tensor (projections are per-tensor).  Remaining
+jit_compile limit: every non-allreduce collective (broadcast,
+allgather, alltoall, reducescatter, sparse IndexedSlices — use
+``sparse_as_dense=True``) still rides py_function and fails under jit
+with the pinned error, matching the reference adapter's
+allreduce-only scope.
 """
 
 from __future__ import annotations
@@ -194,14 +196,14 @@ def grouped_allreduce(tensors: Sequence, *, op: str = Average,
         wires.append(w)
         ctxs.append(c)
 
-    if (op != Adasum
-            and all(_use_native(w.dtype) for w in wires)
+    if (all(_use_native(w.dtype) for w in wires)
             and all(w.shape.is_fully_defined() for w in wires)):
         # jit_compile-capable path: concat each dtype bucket in-graph
         # (XLA-compilable, and literally the fusion buffer — one
         # transport call per dtype) and allreduce it through the native
         # op.  Elementwise reduce ops commute with concat; Adasum's
-        # per-tensor projections do NOT, hence the guard.
+        # per-tensor projections do NOT, so Adasum groups emit one
+        # native call per tensor instead (order still chained).
         outs = _grouped_native(wires, op, process_set,
                                float(prescale_factor),
                                float(postscale_factor), name)
@@ -224,7 +226,13 @@ def grouped_allreduce(tensors: Sequence, *, op: str = Average,
 
 def _grouped_native(wires, op, process_set, prescale, postscale,
                     name) -> List:
-    """Grouped allreduce as one native allreduce per dtype bucket."""
+    """Grouped allreduce as one native allreduce per dtype bucket
+    (elementwise ops), or per tensor (Adasum — its projection norms
+    are per-tensor and do not commute with concatenation)."""
+    if op == Adasum:
+        return [_allreduce_dense(w, op, process_set, prescale, postscale,
+                                 f"{name}[{i}]")
+                for i, w in enumerate(wires)]
     buckets: dict = {}
     for i, w in enumerate(wires):
         buckets.setdefault(w.dtype, []).append(i)

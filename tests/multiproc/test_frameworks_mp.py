@@ -140,6 +140,24 @@ class TestTensorFlowGraphModeMP:
         assert np.allclose(s.numpy(), 4.0), s.numpy()      # 1+2 +1
         assert np.allclose(ga.numpy(), 6.0), ga.numpy()    # 2+4
         assert np.all(gb.numpy() == 9), gb.numpy()         # 3+6
+
+        # Adasum group under jit must match the EAGER per-tensor Adasum
+        # exactly — this discriminates the per-tensor lowering from a
+        # (wrong) concat lowering: with rank-dependent tensors of
+        # different norms, fused projections would change the result.
+        a0 = tf.fill([3], float(rank + 1))
+        b0 = tf.fill([2], float(10 * (1 - rank) + 1))
+        want = [t.numpy() for t in hvt.grouped_allreduce(
+            [a0, b0], op=hvt.Adasum, name='ada_eager')]
+
+        @tf.function(jit_compile=True)
+        def ada(x, y):
+            return hvt.grouped_allreduce([x, y], op=hvt.Adasum,
+                                         name='ada_jit')
+
+        ja, jb = ada(a0, b0)
+        assert np.allclose(ja.numpy(), want[0], atol=1e-6), (ja, want)
+        assert np.allclose(jb.numpy(), want[1], atol=1e-6), (jb, want)
         """)
 
 

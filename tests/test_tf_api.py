@@ -461,7 +461,9 @@ class TestJitCompile:
         assert out.dtype == tf.float32
         assert np.allclose(out.numpy(), 1.5, atol=1e-3)
 
-    def test_adasum_grouped_remains_pinned_boundary(self):
+    def test_adasum_grouped_under_jit_compile(self):
+        """Adasum groups emit one native call per tensor (projections
+        are per-tensor; concat would corrupt them) — compiled fine."""
         import tensorflow as tf
 
         import horovod_tpu.tensorflow as hvt
@@ -470,9 +472,26 @@ class TestJitCompile:
         def f(x, y):
             return hvt.grouped_allreduce([x, y], op=hvt.Adasum)
 
+        a, b = f(tf.fill((2,), 3.0), tf.fill((3,), 5.0))
+        # Single controller: Adasum over one rank is the identity.
+        assert np.allclose(a.numpy(), 3.0) and np.allclose(b.numpy(), 5.0)
+
+    def test_sparse_allgather_remains_pinned_boundary(self):
+        """The remaining jit_compile boundary: non-allreduce
+        collectives (broadcast/allgather/alltoall/reducescatter,
+        IndexedSlices) still ride py_function — matching the reference
+        adapter's allreduce-only scope; use sparse_as_dense=True."""
+        import tensorflow as tf
+
+        import horovod_tpu.tensorflow as hvt
+
+        @tf.function(jit_compile=True)
+        def f(x):
+            return hvt.allgather(x)
+
         with pytest.raises(tf.errors.InvalidArgumentError,
                            match="EagerPyFunc"):
-            f(tf.ones((2,)), tf.ones((3,)))
+            f(tf.ones((2, 2)))
 
     def test_plain_tf_function_is_the_supported_path(self):
         import tensorflow as tf
