@@ -1,15 +1,23 @@
-"""Allreduce bus-bandwidth sweep — the reference's second headline metric.
+"""Collective bus-bandwidth sweeps — the reference's second headline
+metric family.
 
 Reference vehicle (SURVEY.md §6; mount empty, unverified): the
 BASELINE.json "allreduce bus BW (GB/s) @ 64M floats" config, measured
-the nccl-tests way: ``busbw = algbw * 2 * (n - 1) / n`` where
-``algbw = payload_bytes / time`` — the standard ring-allreduce wire
-cost model, so numbers are comparable across backends (NCCL ring on the
+the nccl-tests way: ``busbw = algbw * factor`` with the standard
+per-collective wire-cost factors
+
+    allreduce      2(n-1)/n   (ring reduce + broadcast phases)
+    allgather      (n-1)/n    (algbw over the gathered output bytes)
+    reducescatter  (n-1)/n    (algbw over the reduced input bytes)
+    alltoall       (n-1)/n    (algbw over the exchanged bytes)
+
+so numbers are comparable across backends (NCCL ring on the
 reference's 8xA100 vs XLA collectives over ICI here).
 
 Usage::
 
     python benchmarks/allreduce_bench.py                 # sweep to 64M floats
+    python benchmarks/allreduce_bench.py --collective allgather
     python benchmarks/allreduce_bench.py --max-elems 1048576 --cpu-mesh
 
 Prints one JSON line per size and a trailing summary line.
@@ -36,6 +44,11 @@ def main() -> None:
     parser.add_argument("--warmup", type=int, default=3)
     parser.add_argument("--dtype", default="float32",
                         choices=["float32", "bfloat16"])
+    parser.add_argument("--collective", default="allreduce",
+                        choices=["allreduce", "allgather",
+                                 "reducescatter", "alltoall"],
+                        help="which collective to sweep (nccl-tests "
+                             "busbw factors; see module docstring)")
     parser.add_argument("--cpu-mesh", action="store_true",
                         help="force the 8-device virtual CPU mesh "
                              "(functional check, not a perf number)")
@@ -58,32 +71,56 @@ def main() -> None:
 
     # Outage-proof acquisition (round-3 postmortem — see
     # horovod_tpu/utils/backend_probe.py).
-    guarded_init("allreduce_busbw_peak", "GB/s", skip=args.cpu_mesh)
+    guarded_init(f"{args.collective}_busbw_peak", "GB/s",
+                 skip=args.cpu_mesh)
     n = hvd.size()
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     bytes_per = 2 if args.dtype == "bfloat16" else 4
 
+    # (run_fn(stack), payload_bytes(elems), busbw factor) per collective
+    # — nccl-tests conventions; `elems` is one slot's contribution.
+    def _mk_stack(elems):
+        if args.collective in ("reducescatter", "alltoall"):
+            # Slot rows carry n chunks (the scatter/exchange layout);
+            # round elems up to a multiple of n.
+            elems = ((elems + n - 1) // n) * n
+        return jnp.ones((n, elems), dtype), elems
+
+    # Public dispatchers (NOT the slot-tier cores): they pick the right
+    # tier in multi-controller worlds, where a host-built full stack
+    # must route through hostops instead of a global device_put.
+    run = {
+        "allreduce": lambda s: C.allreduce(s, op=hvd.Sum),
+        "allgather": lambda s: C.allgather(s),
+        "reducescatter": lambda s: C.reducescatter(s, op=hvd.Sum),
+        "alltoall": lambda s: C.alltoall(s),
+    }[args.collective]
+    factor = ((2 * (n - 1) / n) if args.collective == "allreduce"
+              else (n - 1) / n) if n > 1 else 1.0
+
     results = []
     elems = args.min_elems
     while elems <= args.max_elems:
-        # Per-slot stack: every slot contributes `elems` elements; the
-        # reduced payload (the "message size" in nccl-tests terms) is
-        # one slot's worth.
-        stack = jnp.ones((n, elems), dtype)
-        out = C.allreduce(stack, op=hvd.Sum)
+        stack, real_elems = _mk_stack(elems)
+        out = run(stack)
         jax.block_until_ready(out)  # compile + warm cache
         for _ in range(args.warmup):
-            jax.block_until_ready(C.allreduce(stack, op=hvd.Sum))
+            jax.block_until_ready(run(stack))
         t0 = time.perf_counter()
         for _ in range(args.iters):
-            out = C.allreduce(stack, op=hvd.Sum)
-        jax.block_until_ready(out)
+            # Fence EVERY iteration, for every collective: identical
+            # timing semantics across the family (and no pileup of
+            # un-materialized replicated outputs — an allgather output
+            # is n x the input; `iters` pending ones would OOM HBM).
+            jax.block_until_ready(run(stack))
         dt = (time.perf_counter() - t0) / args.iters
 
-        payload = elems * bytes_per
+        payload = real_elems * bytes_per
+        if args.collective == "allgather":
+            payload *= n   # algbw over the gathered output bytes
         algbw = payload / dt / 1e9
-        busbw = algbw * (2 * (n - 1) / n) if n > 1 else algbw
-        row = {"elems": elems, "bytes": payload, "time_us": dt * 1e6,
+        busbw = algbw * factor
+        row = {"elems": real_elems, "bytes": payload, "time_us": dt * 1e6,
                "algbw_GBps": round(algbw, 3), "busbw_GBps": round(busbw, 3),
                "n_slots": n}
         results.append(row)
@@ -91,8 +128,9 @@ def main() -> None:
         elems *= 4
 
     peak = max(r["busbw_GBps"] for r in results)
-    summary = {"metric": "allreduce_busbw_peak", "value": peak,
+    summary = {"metric": f"{args.collective}_busbw_peak", "value": peak,
                "unit": "GB/s", "sizes_swept": len(results),
+               "collective": args.collective,
                "max_elems": results[-1]["elems"],
                "dtype": args.dtype, "n_slots": results[-1]["n_slots"]}
     print(json.dumps(summary))
