@@ -94,16 +94,22 @@ def run_capture(stamp: str) -> bool:
         ok = ok and good
 
     prof = os.path.join("profiles", f"resnet50_{stamp}")
+    # The auto-batch sweep compiles several chunk variants through the
+    # tunnel; give the headline run a full hour before calling it hung.
     step("bench_headline",
          [sys.executable, "bench.py", "--profile-dir", prof],
-         out_path=f"BENCH_tpu_{stamp}.json")
+         out_path=f"BENCH_tpu_{stamp}.json", timeout=3600)
     step("busbw_sweep",
          [sys.executable, os.path.join("benchmarks", "allreduce_bench.py"),
           "--out", "BUSBW_r05_tpu.json"],
          side_artifact="BUSBW_r05_tpu.json")
+    # The fp16 variant pins the default batch (--no-auto-batch): the
+    # sweep already ran in the headline step, and re-running it here
+    # would double the capture's compile budget for no new information.
     step("bench_fp16",
-         [sys.executable, "bench.py", "--fp16-allreduce"],
-         out_path=f"BENCH_tpu_{stamp}.json", append=True)
+         [sys.executable, "bench.py", "--fp16-allreduce",
+          "--no-auto-batch"],
+         out_path=f"BENCH_tpu_{stamp}.json", append=True, timeout=3600)
     return ok
 
 

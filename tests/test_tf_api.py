@@ -476,6 +476,29 @@ class TestJitCompile:
         # Single controller: Adasum over one rank is the identity.
         assert np.allclose(a.numpy(), 3.0) and np.allclose(b.numpy(), 5.0)
 
+    def test_keras_fit_with_jit_compile(self):
+        """The reference's HOROVOD_ENABLE_XLA_OPS demo scenario:
+        ``model.compile(..., jit_compile=True)`` with the distributed
+        optimizer — the whole Keras train step XLA-compiles with the
+        gradient allreduce inside."""
+        import tensorflow as tf
+
+        import horovod_tpu.tensorflow.keras as hvk
+
+        model = tf.keras.Sequential([
+            tf.keras.Input(shape=(4,)),
+            tf.keras.layers.Dense(8, activation="relu"),
+            tf.keras.layers.Dense(1),
+        ])
+        opt = hvk.DistributedOptimizer(tf.keras.optimizers.SGD(0.05))
+        model.compile(optimizer=opt, loss="mse", jit_compile=True)
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 4).astype(np.float32)
+        y = (x @ np.array([[1.], [2.], [-1.], [.5]],
+                          np.float32)).astype(np.float32)
+        h = model.fit(x, y, epochs=3, batch_size=16, verbose=0)
+        assert h.history["loss"][-1] < h.history["loss"][0], h.history
+
     def test_sparse_allgather_remains_pinned_boundary(self):
         """The remaining jit_compile boundary: non-allreduce
         collectives (broadcast/allgather/alltoall/reducescatter,
