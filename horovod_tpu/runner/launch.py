@@ -37,6 +37,35 @@ def _free_port() -> int:
     return free_port("127.0.0.1")
 
 
+def parse_hostfile(path: str) -> str:
+    """Read a hostfile into the ``-H`` spec string.  Accepts the
+    reference horovodrun format (``host slots=N`` per line, # comments)
+    and the compact ``host:N`` form; a bare hostname means one slot.
+    Every line is validated — a malformed entry names its line number
+    instead of becoming a bogus hostname that fails at ssh time."""
+    import re
+
+    entries = []
+    with open(path) as f:
+        for lineno, raw in enumerate(f, 1):
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            m = re.fullmatch(r"(\S+)\s+slots\s*=\s*(\d+)", line)
+            if m:
+                entries.append(f"{m.group(1)}:{int(m.group(2))}")
+                continue
+            m = re.fullmatch(r"([A-Za-z0-9._-]+)(?::(\d+))?", line)
+            if m:
+                entries.append(f"{m.group(1)}:{int(m.group(2) or 1)}")
+                continue
+            raise ValueError(f"line {lineno}: bad entry {raw.rstrip()!r} "
+                             "(expected 'host slots=N' or 'host[:N]')")
+    if not entries:
+        raise ValueError("no host entries found")
+    return ",".join(entries)
+
+
 def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     parser = argparse.ArgumentParser(
         prog="horovodtpurun",
@@ -52,6 +81,11 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                              "the driver/task RPC mesh (reference: "
                              "gloo_run); on managed TPU pods prefer the "
                              "platform's own placement")
+    parser.add_argument("--hostfile", default=None,
+                        help="file with one host per line, either "
+                             "'host slots=N' (reference horovodrun "
+                             "format) or 'host:N'; mutually exclusive "
+                             "with -H")
     parser.add_argument("--check-build", action="store_true",
                         help="print the feature matrix and exit")
     parser.add_argument("--min-np", type=int, default=None,
@@ -287,6 +321,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("error: no command to run (usage: horovodtpurun -np 4 "
               "python train.py)", file=sys.stderr)
         return 2
+    if args.hostfile:
+        if args.hosts:
+            print("error: -H and --hostfile are mutually exclusive",
+                  file=sys.stderr)
+            return 2
+        try:
+            args.hosts = parse_hostfile(args.hostfile)
+        except (OSError, ValueError) as e:
+            print(f"error: --hostfile: {e}", file=sys.stderr)
+            return 2
     if args.hosts:
         non_local = [h for h in args.hosts.split(",")
                      if h.split(":")[0] not in ("localhost", "127.0.0.1",
@@ -318,6 +362,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(f"error: {e}", file=sys.stderr)
                 return 2
     num_proc = args.num_proc if args.num_proc is not None else 1
+    if args.hosts:
+        # Local-only -H/--hostfile: the slot counts ARE the world size
+        # (reference: `horovodrun -H localhost:8` runs 8 workers).  An
+        # explicit -np must fit the declared slots.
+        from .remote import parse_hosts
+
+        try:
+            total_slots = sum(s for _, s in parse_hosts(args.hosts))
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        if args.num_proc is None:
+            num_proc = total_slots
+        elif num_proc > total_slots:
+            print(f"error: -np {num_proc} exceeds the {total_slots} "
+                  f"slot(s) declared in -H/--hostfile", file=sys.stderr)
+            return 2
     if args.min_np is not None and num_proc < args.min_np:
         print(f"error: -np {num_proc} < --min-np {args.min_np}",
               file=sys.stderr)

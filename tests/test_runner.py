@@ -109,6 +109,60 @@ class TestLocalRun:
 
         assert main(["-H", ":3", "x"]) == 2
 
+    def test_hostfile_parses_both_formats(self, tmp_path, monkeypatch):
+        """Reference horovodrun hostfile ('host slots=N') and the
+        compact 'host:N' form both route into the same -H path."""
+        import horovod_tpu.runner.launch as launch
+
+        hf = tmp_path / "hosts"
+        hf.write_text("# cluster A\n"
+                      "nodeA slots=4\n"
+                      "nodeB:2\n"
+                      "nodeC\n")
+        seen = {}
+
+        def fake_remote_run(hosts, command, **kw):
+            seen["hosts"] = hosts
+            return 0
+
+        monkeypatch.setattr("horovod_tpu.runner.remote.remote_run",
+                            fake_remote_run)
+        assert launch.main(["--hostfile", str(hf), "x"]) == 0
+        assert seen["hosts"] == [("nodeA", 4), ("nodeB", 2), ("nodeC", 1)]
+
+    def test_hostfile_errors(self, tmp_path):
+        from horovod_tpu.runner.launch import main
+
+        assert main(["--hostfile", "/nonexistent", "x"]) == 2
+        for bad in ("nodeA slots=xyz", "nodeA 4", "localhost:abc"):
+            hf = tmp_path / "bad"
+            hf.write_text(bad + "\n")
+            assert main(["--hostfile", str(hf), "x"]) == 2, bad
+        assert main(["-H", "a:1", "--hostfile", str(hf), "x"]) == 2
+
+    def test_local_hosts_slots_set_world_size(self, tmp_path, monkeypatch):
+        """`-H localhost:N` / a local hostfile sizes the world from the
+        declared slots (reference horovodrun semantics) — previously the
+        slot counts were silently ignored on the local path."""
+        import horovod_tpu.runner.launch as launch
+
+        seen = {}
+
+        def fake_run(np_, command, **kw):
+            seen["np"] = np_
+            return 0
+
+        monkeypatch.setattr(launch, "run", fake_run)
+        hf = tmp_path / "hosts"
+        hf.write_text("localhost slots=8\n")
+        assert launch.main(["--hostfile", str(hf), "x"]) == 0
+        assert seen["np"] == 8
+        assert launch.main(["-H", "localhost:4", "x"]) == 0
+        assert seen["np"] == 4
+        assert launch.main(["-np", "2", "-H", "localhost:4", "x"]) == 0
+        assert seen["np"] == 2   # explicit -np within slots is honored
+        assert launch.main(["-np", "9", "-H", "localhost:4", "x"]) == 2
+
 
 @pytest.mark.slow
 class TestMultiProcessIntegration:
