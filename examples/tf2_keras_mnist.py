@@ -45,11 +45,17 @@ def main():
     # callback ramps into it.
     opt = hvd.DistributedOptimizer(
         tf.keras.optimizers.SGD(0.01 * hvd.size(), momentum=0.9))
+    # With the native TF-XLA adapter available the whole train step
+    # XLA-compiles WITH the gradient allreduce inside (reference:
+    # HOROVOD_ENABLE_XLA_OPS); on a degraded install (adapter build
+    # failed) the example still runs via the py_function bridge.
+    from horovod_tpu.tensorflow import xla_ops
+
     model.compile(
         optimizer=opt,
         loss=tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True),
         metrics=["accuracy"],
-        jit_compile=False,  # collectives bridge via py_function
+        jit_compile=xla_ops.available(),
     )
 
     callbacks = [
