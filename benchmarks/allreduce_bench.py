@@ -49,6 +49,18 @@ def main() -> None:
                                  "reducescatter", "alltoall"],
                         help="which collective to sweep (nccl-tests "
                              "busbw factors; see module docstring)")
+    parser.add_argument("--compression", default="none",
+                        choices=["none", "exact", "fp16", "bf16", "int8"],
+                        help="time the fused SPMD gradient wire "
+                             "(compressor.spmd_allreduce inside "
+                             "shard_map — the DistributedOptimizer hot "
+                             "path, where int8's quantized transport "
+                             "actually lives) with this tier; 'exact' "
+                             "= same vehicle, no compression (the "
+                             "apples-to-apples baseline); algbw/busbw "
+                             "stay defined over the LOGICAL payload so "
+                             "the payoff reads as higher effective "
+                             "bandwidth")
     parser.add_argument("--cpu-mesh", action="store_true",
                         help="force the 8-device virtual CPU mesh "
                              "(functional check, not a perf number)")
@@ -56,6 +68,14 @@ def main() -> None:
                         help="also write the full sweep as a JSON artifact "
                              "(BUSBW_r*.json trend line for the judge)")
     args = parser.parse_args()
+    # Pure usage errors exit HERE — before guarded_init spends its probe
+    # budget and mislabels a bad invocation as a measured outage.
+    if args.compression != "none" and args.collective != "allreduce":
+        parser.error("--compression applies to the allreduce sweep only")
+    # Metric identity carries the vehicle: a compressed-wire sweep must
+    # never overwrite the BASELINE allreduce row in trend tooling.
+    metric = (f"{args.collective}_busbw_peak" if args.compression == "none"
+              else f"allreduce_{args.compression}_wire_busbw_peak")
 
     if args.cpu_mesh:
         from horovod_tpu.utils.platform import force_cpu_mesh
@@ -71,8 +91,7 @@ def main() -> None:
 
     # Outage-proof acquisition (round-3 postmortem — see
     # horovod_tpu/utils/backend_probe.py).
-    guarded_init(f"{args.collective}_busbw_peak", "GB/s",
-                 skip=args.cpu_mesh)
+    guarded_init(metric, "GB/s", skip=args.cpu_mesh)
     n = hvd.size()
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     bytes_per = 2 if args.dtype == "bfloat16" else 4
@@ -80,9 +99,11 @@ def main() -> None:
     # (run_fn(stack), payload_bytes(elems), busbw factor) per collective
     # — nccl-tests conventions; `elems` is one slot's contribution.
     def _mk_stack(elems):
-        if args.collective in ("reducescatter", "alltoall"):
-            # Slot rows carry n chunks (the scatter/exchange layout);
-            # round elems up to a multiple of n.
+        if (args.collective in ("reducescatter", "alltoall")
+                or args.compression != "none"):
+            # Slot rows carry n chunks (scatter/exchange layout), and
+            # the int8 wire's internal reduce-scatter shards the flat
+            # vector n ways; round elems up to a multiple of n.
             elems = ((elems + n - 1) // n) * n
         return jnp.ones((n, elems), dtype), elems
 
@@ -95,6 +116,36 @@ def main() -> None:
         "reducescatter": lambda s: C.reducescatter(s, op=hvd.Sum),
         "alltoall": lambda s: C.alltoall(s),
     }[args.collective]
+    if args.compression != "none":
+        # Wire-compression vehicle: the fused SPMD gradient path
+        # (compressor.spmd_allreduce inside shard_map) — the tier where
+        # int8's quantized alltoall+allgather transport actually lives;
+        # the stack-tier Int8Compressor.compress is a numerics
+        # SIMULATION with an unchanged wire (compression.py docstring)
+        # and must not be sold as a bandwidth measurement.
+        import jax
+
+        from horovod_tpu._compat import shard_map
+        from jax.sharding import PartitionSpec as P
+        from horovod_tpu.ops.compression import Compression as Comp
+
+        comp_cls = {"exact": Comp.none, "fp16": Comp.fp16,
+                    "bf16": Comp.bf16, "int8": Comp.int8}[args.compression]
+        gm = hvd.global_mesh()
+
+        def per_slot(xb):  # [1, elems] — this slot's gradient shard
+            red = comp_cls.spmd_allreduce(xb[0], op="sum",
+                                          axis=gm.axis_name)
+            return red[None]
+
+        @jax.jit
+        def spmd_wire(stack):
+            return shard_map(per_slot, mesh=gm.mesh,
+                             in_specs=P(gm.axis_name),
+                             out_specs=P(gm.axis_name))(stack)
+
+        def run(s):  # noqa: F811 — compressed vehicle replaces the map
+            return spmd_wire(s)
     factor = ((2 * (n - 1) / n) if args.collective == "allreduce"
               else (n - 1) / n) if n > 1 else 1.0
 
@@ -128,11 +179,14 @@ def main() -> None:
         elems *= 4
 
     peak = max(r["busbw_GBps"] for r in results)
-    summary = {"metric": f"{args.collective}_busbw_peak", "value": peak,
+    summary = {"metric": metric, "value": peak,
                "unit": "GB/s", "sizes_swept": len(results),
                "collective": args.collective,
                "max_elems": results[-1]["elems"],
                "dtype": args.dtype, "n_slots": results[-1]["n_slots"]}
+    if args.compression != "none":
+        summary["compression"] = args.compression
+        summary["vehicle"] = "spmd_gradient_wire"
     print(json.dumps(summary))
     if args.out:
         with open(args.out, "w") as f:
