@@ -105,6 +105,8 @@ def main() -> None:
             # the int8 wire's internal reduce-scatter shards the flat
             # vector n ways; round elems up to a multiple of n.
             elems = ((elems + n - 1) // n) * n
+        if args.compression != "none":
+            return _global_stack((n, elems), dtype), elems
         return jnp.ones((n, elems), dtype), elems
 
     # Public dispatchers (NOT the slot-tier cores): they pick the right
@@ -123,15 +125,15 @@ def main() -> None:
         # the stack-tier Int8Compressor.compress is a numerics
         # SIMULATION with an unchanged wire (compression.py docstring)
         # and must not be sold as a bandwidth measurement.
-        import jax
-
+        import numpy as np
         from horovod_tpu._compat import shard_map
-        from jax.sharding import PartitionSpec as P
+        from jax.sharding import NamedSharding, PartitionSpec as P
         from horovod_tpu.ops.compression import Compression as Comp
 
         comp_cls = {"exact": Comp.none, "fp16": Comp.fp16,
                     "bf16": Comp.bf16, "int8": Comp.int8}[args.compression]
         gm = hvd.global_mesh()
+        stack_sharding = NamedSharding(gm.mesh, P(gm.axis_name))
 
         def per_slot(xb):  # [1, elems] — this slot's gradient shard
             red = comp_cls.spmd_allreduce(xb[0], op="sum",
@@ -143,6 +145,16 @@ def main() -> None:
             return shard_map(per_slot, mesh=gm.mesh,
                              in_specs=P(gm.axis_name),
                              out_specs=P(gm.axis_name))(stack)
+
+        def _global_stack(shape, dt):
+            # Multi-controller safe: each process materializes only its
+            # addressable shards (a host-local jnp.ones cannot be
+            # device_put onto a multi-process mesh).
+            return jax.make_array_from_callback(
+                shape, stack_sharding,
+                lambda idx: np.ones(
+                    tuple(len(range(*s.indices(dim)))
+                          for s, dim in zip(idx, shape)), dt))
 
         def run(s):  # noqa: F811 — compressed vehicle replaces the map
             return spmd_wire(s)

@@ -52,6 +52,7 @@ from . import elastic  # noqa: F401
 from . import data  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .version import __version__  # noqa: F401
+from .runner.run_func import launch as run  # noqa: F401  (hvd.run parity)
 
 # The optimizer layer depends on optax; keep it a lazy attribute (PEP 562)
 # so collectives-only usage works in optax-less environments.

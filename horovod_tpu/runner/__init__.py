@@ -7,3 +7,4 @@ SURVEY.md §2.5).  Entry points:
 
 from .launch import main, run, run_elastic, parse_args  # noqa: F401
 from .check_build import check_build_str  # noqa: F401
+from .run_func import launch as run_function  # noqa: F401

@@ -332,9 +332,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"error: --hostfile: {e}", file=sys.stderr)
             return 2
     if args.hosts:
+        from .remote import is_local_host
+
         non_local = [h for h in args.hosts.split(",")
-                     if h.split(":")[0] not in ("localhost", "127.0.0.1",
-                                                socket.gethostname())]
+                     if not is_local_host(h.split(":")[0])]
         if non_local:
             # Remote launch over the driver/task RPC mesh (reference:
             # gloo_run's ssh-exec'd task agents).  All hosts — local

@@ -33,6 +33,15 @@ from .common.service import (
 )
 
 
+def is_local_host(host: str) -> bool:
+    """One definition of "this machine" for every launcher path (CLI
+    and ``hvd.run``) — drift here would route the same spec down
+    different launch mechanisms."""
+    import socket
+
+    return host in ("localhost", "127.0.0.1", socket.gethostname())
+
+
 def parse_hosts(spec: str) -> List[Tuple[str, int]]:
     """``"a:2,b:4"`` -> ``[("a", 2), ("b", 4)]`` (reference -H syntax;
     a bare host means one slot)."""

@@ -1,0 +1,145 @@
+"""Programmatic function launcher — reference parity with
+``horovod.run``.
+
+Reference (``horovod/runner/__init__.py`` ``run()`` — SURVEY.md §2.5
+CLI row, mount empty, unverified): ``horovod.run(func, args=...,
+np=N, hosts=...)`` executes a Python FUNCTION across a freshly
+launched worker world (cloudpickled to the workers, one result per
+rank returned in rank order) — the in-script alternative to the
+``horovodrun`` CLI, and the same shape ``horovod_tpu.spark.run``
+exposes inside Spark.
+
+TPU-native redesign: the world is the same one the CLI builds (local
+spawn via :func:`horovod_tpu.runner.run`, or the ssh-exec'd agent mesh
+via :func:`horovod_tpu.runner.remote.remote_run` when ``hosts`` has
+non-local entries); the payload travels as a cloudpickle file on the
+launcher's filesystem for local runs — remote hosts need a shared
+filesystem for the payload/result exchange, which is the reference's
+assumption for its checkpoint paths too (documented limitation).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import sys
+import tempfile
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def _serializer():
+    try:
+        import cloudpickle
+
+        return cloudpickle
+    except ImportError:  # stdlib fallback: module-level functions only
+        return pickle
+
+
+def launch(func, args: Tuple = (), kwargs: Optional[Dict] = None, *,
+           np: int = 1, hosts: Optional[str] = None,
+           env: Optional[Dict[str, str]] = None,
+           workdir: Optional[str] = None,
+           start_timeout: float = 120.0,
+           verbose: bool = False) -> List[Any]:
+    """Run ``func(*args, **kwargs)`` on every rank of a fresh ``np``-
+    process world; returns the per-rank results in rank order
+    (reference: ``horovod.run``).  Like the reference's examples,
+    ``func`` calls ``hvd.init()`` itself (so it can configure the
+    platform first).  ``hosts`` takes the ``-H`` syntax; non-local
+    hosts launch through the ssh agent mesh and the payload/result
+    exchange must live on a SHARED filesystem — pass ``workdir=`` (a
+    default tempdir is node-local /tmp, which remote workers cannot
+    see).  A launcher-created tempdir is removed on return; an
+    explicit ``workdir`` is left in place."""
+    from . import run as run_cmd
+    from .remote import is_local_host, parse_hosts, remote_run
+
+    own_workdir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="hvd_tpu_run_")
+    try:
+        payload = os.path.join(workdir, "payload.pkl")
+        with open(payload, "wb") as f:
+            _serializer().dump((func, tuple(args), dict(kwargs or {})), f)
+
+        command = [sys.executable, "-m", "horovod_tpu.runner.run_func",
+                   payload, workdir]
+        base_env = dict(env or {})
+        # Workers must resolve horovod_tpu (and the user's modules) the
+        # way the launcher does.
+        base_env.setdefault(
+            "PYTHONPATH",
+            os.pathsep.join(p for p in ([os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))]
+                + sys.path[:1] + [os.environ.get("PYTHONPATH", "")]) if p))
+
+        host_list = parse_hosts(hosts) if hosts else None
+        if host_list and any(not is_local_host(h) for h, _ in host_list):
+            if own_workdir:
+                from ..utils.logging import get_logger
+
+                get_logger(__name__).warning(
+                    "hvd.run with remote hosts but no workdir=: the "
+                    "default tempdir is node-local; remote workers "
+                    "need a shared-filesystem workdir")
+            rc = remote_run(host_list, command, np_=np, env=base_env,
+                            start_timeout=start_timeout, verbose=verbose)
+        else:
+            if host_list:
+                total = sum(s for _, s in host_list)
+                if np > total:
+                    raise ValueError(
+                        f"np={np} exceeds the {total} declared slot(s)")
+            rc = run_cmd(np, command, env=base_env,
+                         start_timeout=start_timeout, verbose=verbose)
+        if rc != 0:
+            raise RuntimeError(f"worker world exited with rc={rc}")
+
+        results: List[Any] = []
+        for rank in range(np):
+            path = os.path.join(workdir, f"result_{rank}.pkl")
+            if not os.path.exists(path):
+                raise RuntimeError(
+                    f"rank {rank} produced no result file (crashed "
+                    "after its collective work? remote hosts need a "
+                    "shared-filesystem workdir=)")
+            with open(path, "rb") as f:
+                results.append(pickle.load(f))
+        return results
+    finally:
+        if own_workdir:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+def _worker_main(payload_path: str, workdir: str) -> int:
+    """Per-rank bootstrap (what the launcher's command execs).
+
+    ``func`` owns initialization — reference examples call
+    ``hvd.init()`` themselves, and initializing here would also bind
+    the backend before the function can configure the platform (e.g.
+    the CPU-mesh pin).  The rank for the result file therefore comes
+    from the launcher's env contract, valid before init."""
+    with open(payload_path, "rb") as f:
+        func, args, kwargs = _serializer().load(f)
+
+    rank = int(os.environ.get("HVD_TPU_PROCESS_ID", "0"))
+    result = func(*args, **kwargs)
+    tmp = os.path.join(workdir, f".result_{rank}.tmp")
+    with open(tmp, "wb") as f:
+        _serializer().dump(result, f)
+    os.replace(tmp, os.path.join(workdir, f"result_{rank}.pkl"))
+
+    import horovod_tpu as hvd
+
+    if hvd.is_initialized():
+        # Results are durable on every rank before any rank exits (a
+        # fast rank exiting early would otherwise strand peers still
+        # inside collectives when the world tears down).
+        hvd.barrier()
+        hvd.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(_worker_main(sys.argv[1], sys.argv[2]))
