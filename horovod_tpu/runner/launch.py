@@ -100,6 +100,13 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     parser.add_argument("--blacklist-after", type=int, default=0,
                         help="elastic: blacklist a host after this many "
                              "failures (0 = never)")
+    parser.add_argument("--output-filename", default=None,
+                        help="redirect each worker's output to "
+                             "<dir>/rank.<N>.{stdout,stderr} instead of "
+                             "the launcher's terminal (reference "
+                             "horovodrun flag; local spawn only — "
+                             "remote workers stream through their "
+                             "agents)")
     parser.add_argument("--coordinator", default=None,
                         help="coordinator address (default: 127.0.0.1:random)")
     parser.add_argument("--start-timeout", type=float, default=120.0)
@@ -111,11 +118,16 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
 
 def _spawn_world(np_: int, command: List[str], coordinator: str,
                  env: Optional[Dict[str, str]],
-                 verbose: bool) -> List[subprocess.Popen]:
+                 verbose: bool,
+                 output_dir: Optional[str] = None,
+                 output_append: bool = False
+                 ) -> List[subprocess.Popen]:
     procs: List[subprocess.Popen] = []
     base_env = dict(os.environ)
     if env:
         base_env.update(env)
+    if output_dir:
+        os.makedirs(output_dir, exist_ok=True)
     for rank in range(np_):
         worker_env = dict(base_env)
         worker_env.update({
@@ -126,7 +138,22 @@ def _spawn_world(np_: int, command: List[str], coordinator: str,
         if verbose:
             print(f"[horovodtpurun] spawning rank {rank}: {' '.join(command)}",
                   file=sys.stderr)
-        procs.append(subprocess.Popen(command, env=worker_env))
+        if output_dir:
+            # Reference horovodrun --output-filename: one file pair per
+            # rank; file handles are inherited by the child and closed
+            # here (the child keeps them open).
+            # "wb": one launcher invocation owns the file pair —
+            # append would silently interleave output from earlier
+            # runs.  (Elastic RESTARTS within one invocation do append:
+            # the pre-restart world's output is part of this launch.)
+            mode = "ab" if output_append else "wb"
+            out = open(os.path.join(output_dir, f"rank.{rank}.stdout"), mode)
+            err = open(os.path.join(output_dir, f"rank.{rank}.stderr"), mode)
+            with out, err:
+                procs.append(subprocess.Popen(command, env=worker_env,
+                                              stdout=out, stderr=err))
+        else:
+            procs.append(subprocess.Popen(command, env=worker_env))
     return procs
 
 
@@ -143,7 +170,8 @@ def _terminate_all(procs: List[subprocess.Popen]) -> None:
 
 def run(np_: int, command: List[str], *, coordinator: Optional[str] = None,
         env: Optional[Dict[str, str]] = None,
-        start_timeout: float = 120.0, verbose: bool = False) -> int:
+        start_timeout: float = 120.0, verbose: bool = False,
+        output_dir: Optional[str] = None) -> int:
     """Spawn ``np_`` local worker processes wired into one
     ``jax.distributed`` world; returns the first nonzero exit code (0 on
     success).  Workers that outlive a failed peer are terminated —
@@ -151,7 +179,8 @@ def run(np_: int, command: List[str], *, coordinator: Optional[str] = None,
     if not command:
         raise ValueError("No command given")
     coordinator = coordinator or f"127.0.0.1:{_free_port()}"
-    procs = _spawn_world(np_, command, coordinator, env, verbose)
+    procs = _spawn_world(np_, command, coordinator, env, verbose,
+                         output_dir=output_dir)
 
     exit_code = 0
     deadline = time.monotonic() + start_timeout
@@ -199,7 +228,8 @@ def run_elastic(command: List[str], *, min_np: int = 1,
                 poll_interval_s: float = 1.0,
                 reset_limit: int = 0,
                 blacklist_after: int = 0,
-                verbose: bool = False) -> int:
+                verbose: bool = False,
+                output_dir: Optional[str] = None) -> int:
     """Elastic local supervision (reference: ``horovodrun
     --host-discovery-script`` driving the ElasticDriver, §3.5 of
     SURVEY.md): poll discovery, run a world sized to the available
@@ -254,7 +284,9 @@ def run_elastic(command: List[str], *, min_np: int = 1,
         if verbose:
             print(f"[horovodtpurun] elastic world of {np_} starting",
                   file=sys.stderr)
-        procs = _spawn_world(np_, command, coordinator, env, verbose)
+        procs = _spawn_world(np_, command, coordinator, env, verbose,
+                             output_dir=output_dir,
+                             output_append=resets > 0)
         hosts_this_world = sorted(driver.hosts)
         failed = False
         try:
@@ -388,6 +420,11 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.hosts is None and not args.host_discovery_script \
             and _lsf.in_lsf():
+        if args.output_filename:
+            print("[horovodtpurun] --output-filename is ignored under "
+                  "LSF/jsrun (the scheduler owns task placement and "
+                  "output; use jsrun's own redirection)",
+                  file=sys.stderr)
         # LSF allocation: place tasks via jsrun (reference: horovodrun's
         # lsf detection + js_run path); -np unset means "use the whole
         # allocation", an explicit -np (including 1) is honored exactly.
@@ -404,9 +441,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             start_timeout=args.start_timeout,
             reset_limit=args.reset_limit,
             blacklist_after=args.blacklist_after,
-            verbose=args.verbose)
+            verbose=args.verbose,
+            output_dir=args.output_filename)
     return run(num_proc, command, coordinator=args.coordinator,
-               start_timeout=args.start_timeout, verbose=args.verbose)
+               start_timeout=args.start_timeout, verbose=args.verbose,
+               output_dir=args.output_filename)
 
 
 if __name__ == "__main__":

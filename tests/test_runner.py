@@ -140,6 +140,27 @@ class TestLocalRun:
             assert main(["--hostfile", str(hf), "x"]) == 2, bad
         assert main(["-H", "a:1", "--hostfile", str(hf), "x"]) == 2
 
+    def test_output_filename_writes_per_rank_files(self, tmp_path):
+        """Reference horovodrun --output-filename: each rank's output
+        lands in its own file pair instead of the launcher's tty."""
+        from horovod_tpu.runner.launch import main
+
+        outdir = tmp_path / "logs"
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import os, sys\n"
+            "print('out-rank', os.environ['HVD_TPU_PROCESS_ID'])\n"
+            "print('err-rank', os.environ['HVD_TPU_PROCESS_ID'],"
+            " file=sys.stderr)\n")
+        rc = main(["-np", "2", "--output-filename", str(outdir), "--",
+                   sys.executable, str(script)])
+        assert rc == 0
+        for rank in (0, 1):
+            assert (outdir / f"rank.{rank}.stdout").read_text() \
+                == f"out-rank {rank}\n"
+            assert (outdir / f"rank.{rank}.stderr").read_text() \
+                == f"err-rank {rank}\n"
+
     def test_local_hosts_slots_set_world_size(self, tmp_path, monkeypatch):
         """`-H localhost:N` / a local hostfile sizes the world from the
         declared slots (reference horovodrun semantics) — previously the
