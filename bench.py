@@ -274,7 +274,8 @@ def main() -> None:
             and not args.no_auto_batch):
         candidates = sorted({default_per_chip,
                              default_per_chip * 5 // 4,
-                             default_per_chip * 3 // 2})
+                             default_per_chip * 3 // 2,
+                             default_per_chip * 2})
         sweep_log = []
         best_rate = -1.0
         for cand in candidates:

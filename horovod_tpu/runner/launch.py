@@ -305,7 +305,17 @@ def run_elastic(command: List[str], *, min_np: int = 1,
                     _terminate_all(procs)
                     failed = True
                     break
-                if driver.poll_once():
+                try:
+                    changed = driver.poll_once()
+                except Exception as e:
+                    # Discovery scripts may be transiently flaky
+                    # (reference tolerates this in the driver's own
+                    # poll loop); a blip must not crash the supervisor
+                    # and orphan the live world.
+                    print(f"[horovodtpurun] discovery poll failed "
+                          f"({e}); retrying", file=sys.stderr)
+                    changed = False
+                if changed:
                     if verbose:
                         print("[horovodtpurun] membership changed; "
                               "restarting world", file=sys.stderr)
@@ -316,6 +326,9 @@ def run_elastic(command: List[str], *, min_np: int = 1,
         except KeyboardInterrupt:
             _terminate_all(procs)
             return 130
+        except Exception:
+            _terminate_all(procs)   # never leak a live world
+            raise
         if failed:
             resets += 1
             if reset_limit and resets > reset_limit:
