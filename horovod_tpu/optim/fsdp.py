@@ -55,6 +55,7 @@ def make_fsdp_train_step(
     *,
     mesh=None,
     axis_name: Optional[str] = None,
+    dp_axis: Optional[str] = None,
     has_aux: bool = False,
     donate: bool = True,
 ):
@@ -67,11 +68,30 @@ def make_fsdp_train_step(
     with everything still sharded; ``batch`` shards along its leading
     axis.  Gradient averaging over the data axis is implicit in GSPMD
     (the batch is sharded, so the partitioner emits the reduce-scatter).
+
+    ``dp_axis`` selects **hybrid sharding (HSDP)** for multi-slice
+    topologies: parameters/grads/state shard over ``axis_name`` (the
+    ICI-connected slice) and stay REPLICATED across ``dp_axis`` (the
+    DCN slice axis), while the batch shards over both — the partitioner
+    then emits per-layer all-gather + grad reduce-scatter on ICI and
+    one gradient all-reduce across DCN, the standard multi-slice
+    recipe (FSDP traffic stays on the fast wire; only reduced grads
+    cross slices).
     """
     from .distributed_optimizer import resolve_mesh_axis
 
     mesh_obj, axis = resolve_mesh_axis(mesh, axis_name)
     n = mesh_obj.shape[axis]
+    if dp_axis is not None:
+        if dp_axis not in mesh_obj.shape:
+            raise ValueError(
+                f"dp_axis {dp_axis!r} is not an axis of the mesh "
+                f"{tuple(mesh_obj.shape)}")
+        if dp_axis == axis:
+            raise ValueError(
+                f"dp_axis must differ from the FSDP shard axis "
+                f"({axis!r}): hybrid sharding replicates across "
+                "dp_axis and shards over axis_name")
 
     def _sharding(leaf):
         return NamedSharding(mesh_obj, fsdp_spec(leaf, n, axis))
@@ -86,7 +106,8 @@ def make_fsdp_train_step(
         )(params)
         return params, opt_state
 
-    batch_sharding = NamedSharding(mesh_obj, P(axis))
+    batch_sharding = NamedSharding(
+        mesh_obj, P((dp_axis, axis) if dp_axis is not None else axis))
 
     def step_fn(params, opt_state, batch):
         # Pin the parameter layout so the partitioner gathers per-use
