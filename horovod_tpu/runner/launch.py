@@ -110,6 +110,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     parser.add_argument("--coordinator", default=None,
                         help="coordinator address (default: 127.0.0.1:random)")
     parser.add_argument("--start-timeout", type=float, default=120.0)
+    parser.add_argument("--log-level", default=None, type=str.lower,
+                        choices=["trace", "debug", "info", "warning",
+                                 "error", "fatal"],
+                        help="sets HOROVOD_LOG_LEVEL for every worker "
+                             "(reference horovodrun flag; "
+                             "case-insensitive like the env var)")
     parser.add_argument("--verbose", action="store_true")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="program and args (e.g. python train.py)")
@@ -366,6 +372,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("error: no command to run (usage: horovodtpurun -np 4 "
               "python train.py)", file=sys.stderr)
         return 2
+    # Threaded through env= (never os.environ: a rejected invocation
+    # must not mutate a programmatic caller's process).
+    extra_env = ({"HOROVOD_LOG_LEVEL": args.log_level}
+                 if args.log_level else {})
     if args.hostfile:
         if args.hosts:
             print("error: -H and --hostfile are mutually exclusive",
@@ -400,6 +410,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                                 "PYTHONUNBUFFERED")
                 env = {k: v for k, v in os.environ.items()
                        if k.startswith(fwd_prefixes)}
+                env.update(extra_env)
                 return remote_run(hosts, command, np_=args.num_proc,
                                   env=env,
                                   start_timeout=args.start_timeout,
@@ -438,6 +449,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "LSF/jsrun (the scheduler owns task placement and "
                   "output; use jsrun's own redirection)",
                   file=sys.stderr)
+        # jsrun tasks inherit the launcher env; this is the one path
+        # where the variable must be set in-process (the allocation's
+        # task placement is the scheduler's, not ours).
+        os.environ.update(extra_env)
         # LSF allocation: place tasks via jsrun (reference: horovodrun's
         # lsf detection + js_run path); -np unset means "use the whole
         # allocation", an explicit -np (including 1) is honored exactly.
@@ -455,8 +470,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             reset_limit=args.reset_limit,
             blacklist_after=args.blacklist_after,
             verbose=args.verbose,
+            env=extra_env,
             output_dir=args.output_filename)
     return run(num_proc, command, coordinator=args.coordinator,
+               env=extra_env,
                start_timeout=args.start_timeout, verbose=args.verbose,
                output_dir=args.output_filename)
 

@@ -140,6 +140,21 @@ class TestLocalRun:
             assert main(["--hostfile", str(hf), "x"]) == 2, bad
         assert main(["-H", "a:1", "--hostfile", str(hf), "x"]) == 2
 
+    def test_log_level_flag_reaches_workers(self, tmp_path, monkeypatch):
+        from horovod_tpu.runner.launch import main
+
+        monkeypatch.delenv("HOROVOD_LOG_LEVEL", raising=False)
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import os, sys\n"
+            "sys.exit(0 if os.environ.get('HOROVOD_LOG_LEVEL') == 'debug'"
+            " else 5)\n")
+        # case-insensitive like the env var itself
+        assert main(["-np", "1", "--log-level", "DEBUG", "--",
+                     sys.executable, str(script)]) == 0
+        # the launcher's own process env is never mutated
+        assert "HOROVOD_LOG_LEVEL" not in __import__("os").environ
+
     def test_output_filename_writes_per_rank_files(self, tmp_path):
         """Reference horovodrun --output-filename: each rank's output
         lands in its own file pair instead of the launcher's tty."""
