@@ -114,9 +114,11 @@ class BasicService:
     """Threaded TCP request/response service (reference:
     ``network.BasicService``).  Subclasses override ``_handle``."""
 
-    def __init__(self, name: str, key: bytes, host: str = "0.0.0.0"):
+    def __init__(self, name: str, key: bytes, host: str = "0.0.0.0",
+                 nics: Optional[List[str]] = None):
         self.name = name
         self._key = key
+        self._nics = list(nics) if nics else None
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
@@ -143,7 +145,23 @@ class BasicService:
         return self._server.server_address[1]
 
     def addresses(self) -> List[Tuple[str, int]]:
-        """Every (ip, port) a client could try, all interfaces."""
+        """Every (ip, port) a client could try.  With ``nics`` set at
+        construction (reference: ``horovodrun --network-interfaces``),
+        advertisement restricts to those interfaces plus loopback
+        (single-host runs keep working); an interface name matching
+        nothing raises immediately — a typo'd NIC must fail loudly,
+        not as a registration timeout minutes later."""
+        if self._nics:
+            per_nic = local_addresses()
+            unknown = [n for n in self._nics if n not in per_nic]
+            if unknown:
+                raise ValueError(
+                    f"--network-interfaces names unknown interface(s) "
+                    f"{unknown}; available: {sorted(per_nic)}")
+            ips = [ip for nic in self._nics for ip in per_nic[nic]]
+            ips += [ip for addrs in per_nic.values() for ip in addrs
+                    if ip.startswith("127.") and ip not in ips]
+            return [(ip, self.port) for ip in ips]
         return [(ip, self.port) for ip in routable_addresses()]
 
     def _handle(self, req: Any, client_address) -> Any:

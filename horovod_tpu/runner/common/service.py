@@ -112,8 +112,9 @@ class DriverService(BasicService):
     """Collects task registrations and answers the full address table
     (reference: ``HorovodRunDriverService``)."""
 
-    def __init__(self, num_tasks: int, key: bytes, name: str = "driver"):
-        super().__init__(name, key)
+    def __init__(self, num_tasks: int, key: bytes, name: str = "driver",
+                 nics=None):
+        super().__init__(name, key, nics=nics)
         self._num_tasks = num_tasks
         self._tasks: Dict[int, RegisterTaskRequest] = {}
         self._cv = threading.Condition()
@@ -158,8 +159,9 @@ class TaskService(BasicService):
     """Per-host agent: answers pings, probes peers on request, and execs
     the worker command (reference: ``HorovodRunTaskService``)."""
 
-    def __init__(self, index: int, key: bytes, name: Optional[str] = None):
-        super().__init__(name or f"task-{index}", key)
+    def __init__(self, index: int, key: bytes, name: Optional[str] = None,
+                 nics=None):
+        super().__init__(name or f"task-{index}", key, nics=nics)
         self.index = index
         self._key_bytes = key
         self._cmd_thread: Optional[threading.Thread] = None

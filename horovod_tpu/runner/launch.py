@@ -107,6 +107,16 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                              "horovodrun flag; local spawn only — "
                              "remote workers stream through their "
                              "agents)")
+    parser.add_argument("--ssh-port", type=int, default=None,
+                        help="ssh port for remote agent launch "
+                             "(reference horovodrun flag)")
+    parser.add_argument("--ssh-identity-file", default=None,
+                        help="ssh identity file for remote agent launch "
+                             "(reference horovodrun flag)")
+    parser.add_argument("--network-interfaces", default=None,
+                        help="comma-separated NICs the RPC services "
+                             "advertise (reference horovodrun "
+                             "--network-interfaces); default: all")
     parser.add_argument("--coordinator", default=None,
                         help="coordinator address (default: 127.0.0.1:random)")
     parser.add_argument("--start-timeout", type=float, default=120.0)
@@ -376,6 +386,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     # must not mutate a programmatic caller's process).
     extra_env = ({"HOROVOD_LOG_LEVEL": args.log_level}
                  if args.log_level else {})
+    nics = ([n.strip() for n in args.network_interfaces.split(",")
+             if n.strip()] if args.network_interfaces else None)
     if args.hostfile:
         if args.hosts:
             print("error: -H and --hostfile are mutually exclusive",
@@ -412,7 +424,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                        if k.startswith(fwd_prefixes)}
                 env.update(extra_env)
                 return remote_run(hosts, command, np_=args.num_proc,
-                                  env=env,
+                                  env=env, nics=nics,
+                                  ssh_port=args.ssh_port,
+                                  ssh_identity_file=args.ssh_identity_file,
                                   start_timeout=args.start_timeout,
                                   verbose=args.verbose)
             except ValueError as e:
@@ -450,7 +464,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "output; use jsrun's own redirection)",
                   file=sys.stderr)
         # jsrun tasks inherit the launcher env; this is the one path
-        # where the variable must be set in-process (the allocation's
+        # where the variables must be set in-process (the allocation's
         # task placement is the scheduler's, not ours).
         os.environ.update(extra_env)
         # LSF allocation: place tasks via jsrun (reference: horovodrun's

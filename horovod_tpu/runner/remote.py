@@ -58,21 +58,31 @@ def parse_hosts(spec: str) -> List[Tuple[str, int]]:
 
 
 def _agent_argv(index: int, driver_addrs: List[Tuple[str, int]],
-                timeout_s: float) -> List[str]:
+                timeout_s: float,
+                nics: Optional[List[str]] = None) -> List[str]:
     spec = ",".join(f"{h}:{p}" for h, p in driver_addrs)
-    return [sys.executable, "-m", "horovod_tpu.runner.task_agent",
+    argv = [sys.executable, "-m", "horovod_tpu.runner.task_agent",
             "--driver", spec, "--index", str(index),
             "--timeout", str(timeout_s)]
+    if nics:
+        argv += ["--nics", ",".join(nics)]
+    return argv
 
 
-def ssh_exec(host: str, argv: List[str],
-             secret_hex: str) -> subprocess.Popen:
+def ssh_exec(host: str, argv: List[str], secret_hex: str, *,
+             ssh_port: Optional[int] = None,
+             ssh_identity_file: Optional[str] = None) -> subprocess.Popen:
     """Default remote exec: ssh in BatchMode (no password prompts —
-    reference gloo_run assumes passwordless ssh), secret over stdin."""
-    proc = subprocess.Popen(
-        ["ssh", "-o", "BatchMode=yes", "-o", "StrictHostKeyChecking=no",
-         "--", host] + argv,
-        stdin=subprocess.PIPE, text=True)
+    reference gloo_run assumes passwordless ssh), secret over stdin.
+    ``ssh_port`` / ``ssh_identity_file`` mirror the reference's
+    ``--ssh-port`` / ``--ssh-identity-file`` flags."""
+    ssh = ["ssh", "-o", "BatchMode=yes", "-o", "StrictHostKeyChecking=no"]
+    if ssh_port:
+        ssh += ["-p", str(ssh_port)]
+    if ssh_identity_file:
+        ssh += ["-i", ssh_identity_file]
+    proc = subprocess.Popen(ssh + ["--", host] + argv,
+                            stdin=subprocess.PIPE, text=True)
     proc.stdin.write(secret_hex + "\n")
     proc.stdin.flush()
     proc.stdin.close()
@@ -80,9 +90,11 @@ def ssh_exec(host: str, argv: List[str],
 
 
 def local_exec(host: str, argv: List[str],
-               secret_hex: str) -> subprocess.Popen:
+               secret_hex: str, **_ssh_opts) -> subprocess.Popen:
     """Exec an agent as a local child (test path: loopback hosts
-    pretending to be remote — the full RPC protocol still runs)."""
+    pretending to be remote — the full RPC protocol still runs).
+    Accepts and ignores ssh keyword options so it can stand in for
+    :func:`ssh_exec` verbatim."""
     proc = subprocess.Popen(argv, stdin=subprocess.PIPE, text=True,
                             env=dict(os.environ))
     proc.stdin.write(secret_hex + "\n")
@@ -96,6 +108,9 @@ def remote_run(hosts: List[Tuple[str, int]], command: List[str], *,
                env: Optional[Dict[str, str]] = None,
                exec_fn: Optional[Callable[
                    [str, List[str], str], subprocess.Popen]] = None,
+               nics: Optional[List[str]] = None,
+               ssh_port: Optional[int] = None,
+               ssh_identity_file: Optional[str] = None,
                start_timeout: float = 120.0,
                poll_interval_s: float = 0.5,
                verbose: bool = False) -> int:
@@ -124,9 +139,12 @@ def remote_run(hosts: List[Tuple[str, int]], command: List[str], *,
         rank_blocks.append(list(range(next_rank, next_rank + take)))
         next_rank += take
 
-    exec_fn = exec_fn or ssh_exec
+    if exec_fn is None:
+        def exec_fn(host, argv, secret_hex):
+            return ssh_exec(host, argv, secret_hex, ssh_port=ssh_port,
+                            ssh_identity_file=ssh_identity_file)
     key = make_secret_key()
-    driver = DriverService(len(hosts), key)
+    driver = DriverService(len(hosts), key, nics=nics)
     agents: List[subprocess.Popen] = []
     clients: Dict[int, BasicClient] = {}
     exit_code = 0
@@ -141,7 +159,8 @@ def remote_run(hosts: List[Tuple[str, int]], command: List[str], *,
             # agent's driver-liveness pings, not a wall clock.
             agents.append(exec_fn(
                 host, _agent_argv(i, driver_addrs,
-                                  timeout_s=start_timeout + 300.0),
+                                  timeout_s=start_timeout + 300.0,
+                                  nics=nics),
                 key.hex()))
         driver.wait_for_initial_registration(timeout_s=start_timeout)
         routes = probe_full_mesh(driver, key)

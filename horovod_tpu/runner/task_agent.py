@@ -45,6 +45,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--driver", required=True,
                     help="driver service address(es), ip:port[,ip:port...]")
     ap.add_argument("--index", type=int, required=True)
+    ap.add_argument("--nics", default=None,
+                    help="comma-separated interfaces to advertise "
+                         "(reference horovodrun --network-interfaces)")
     ap.add_argument("--timeout", type=float, default=3600.0,
                     help="exit with an error if no command arrives "
                          "within this many seconds (idle bound only — "
@@ -53,7 +56,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
 
     key = bytes.fromhex(sys.stdin.readline().strip())
-    service = TaskService(args.index, key)
+    nics = ([n.strip() for n in args.nics.split(",") if n.strip()]
+            if args.nics else None)
+    service = TaskService(args.index, key, nics=nics)
     try:
         driver = BasicClient("driver", parse_addresses(args.driver), key)
         driver.request(RegisterTaskRequest(

@@ -140,6 +140,82 @@ class TestLocalRun:
             assert main(["--hostfile", str(hf), "x"]) == 2, bad
         assert main(["-H", "a:1", "--hostfile", str(hf), "x"]) == 2
 
+    def test_ssh_and_nics_flags_reach_remote_run(self, monkeypatch):
+        """--ssh-port/--ssh-identity-file/--network-interfaces thread
+        into remote_run as explicit parameters (reference horovodrun
+        flags) — no environment side channels."""
+        import horovod_tpu.runner.launch as launch
+        from horovod_tpu.runner.remote import ssh_exec
+
+        seen = {}
+
+        def fake_remote_run(hosts, command, **kw):
+            seen.update(kw)
+            return 0
+
+        monkeypatch.setattr("horovod_tpu.runner.remote.remote_run",
+                            fake_remote_run)
+        assert launch.main(["-H", "otherhost:1", "--ssh-port", "2222",
+                            "--ssh-identity-file", "/id_rsa",
+                            "--network-interfaces", "eth1,eth2",
+                            "x"]) == 0
+        assert seen["ssh_port"] == 2222
+        assert seen["ssh_identity_file"] == "/id_rsa"
+        assert seen["nics"] == ["eth1", "eth2"]
+
+        # and ssh_exec turns the params into the ssh command line
+        built = {}
+
+        class FakeStdin:
+            write = staticmethod(lambda _ : None)
+            flush = staticmethod(lambda: None)
+            close = staticmethod(lambda: None)
+
+        class FakeProc:
+            stdin = FakeStdin()
+
+        import horovod_tpu.runner.remote as remote
+
+        monkeypatch.setattr(
+            remote.subprocess, "Popen",
+            lambda cmd, **kw: built.update(cmd=cmd) or FakeProc())
+        ssh_exec("otherhost", ["agent"], "aa", ssh_port=2222,
+                 ssh_identity_file="/id_rsa")
+        cmd = built["cmd"]
+        assert "-p" in cmd and "2222" in cmd
+        assert "-i" in cmd and "/id_rsa" in cmd
+
+    def test_network_interfaces_filters_advertised_addresses(
+            self, monkeypatch):
+        """Services constructed with nics= advertise only those NICs
+        (plus loopback); unknown names fail loudly."""
+        import pytest
+
+        from horovod_tpu.runner.common import network
+
+        monkeypatch.setattr(
+            network, "local_addresses",
+            lambda: {"eth0": ["10.0.0.5"], "eth1": ["192.168.1.9"],
+                     "lo": ["127.0.0.1"]})
+        svc = network.BasicService("t", b"k" * 32, nics=["eth1"])
+        try:
+            ips = [ip for ip, _ in svc.addresses()]
+            assert "192.168.1.9" in ips and "127.0.0.1" in ips
+            assert "10.0.0.5" not in ips
+        finally:
+            svc.shutdown()
+        bad = network.BasicService("t2", b"k" * 32, nics=["eth9"])
+        try:
+            with pytest.raises(ValueError, match="eth9"):
+                bad.addresses()
+        finally:
+            bad.shutdown()
+        svc3 = network.BasicService("t3", b"k" * 32)
+        try:
+            assert "10.0.0.5" in [ip for ip, _ in svc3.addresses()]
+        finally:
+            svc3.shutdown()
+
     def test_log_level_flag_reaches_workers(self, tmp_path, monkeypatch):
         from horovod_tpu.runner.launch import main
 
