@@ -91,6 +91,31 @@ class TestRunFunction:
         assert [r["rank"] for r in results] == [0, 1]
         assert all(abs(r["sum"] - 3.0) < 1e-5 for r in results)
 
+    def test_explicit_workdir_kept_default_cleaned(self, tmp_path):
+        """workdir= (the shared-filesystem hook for remote hosts) is
+        left in place with its artifacts; the default tempdir is
+        removed on return."""
+        import glob
+        import tempfile
+
+        import horovod_tpu as hvd
+
+        wd = tmp_path / "exchange"
+        wd.mkdir()
+        out = hvd.run(_train_fn, args=(1,), np=2, env=_env(),
+                      workdir=str(wd), start_timeout=120.0)
+        assert [r["rank"] for r in out] == [0, 1]
+        kept = sorted(p.name for p in wd.iterdir())
+        assert "payload.pkl" in kept and "result_0.pkl" in kept
+
+        before = set(glob.glob(os.path.join(tempfile.gettempdir(),
+                                            "hvd_tpu_run_*")))
+        hvd.run(_train_fn, args=(1,), np=2, env=_env(),
+                start_timeout=120.0)
+        after = set(glob.glob(os.path.join(tempfile.gettempdir(),
+                                           "hvd_tpu_run_*")))
+        assert after == before  # launcher-created dir was removed
+
     def test_worker_failure_raises(self):
         import horovod_tpu as hvd
 
