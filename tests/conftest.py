@@ -16,6 +16,13 @@ import os
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
+# Tests that reach guarded_init() must not point the session-global
+# persistent compilation cache at a real directory (order-dependent
+# reads + stray writes); both prefix spellings are forced off because
+# _env() resolves HOROVOD_ first.  Individual tests opt back in via
+# monkeypatch.
+os.environ["HOROVOD_COMPILE_CACHE"] = "off"
+os.environ["HVD_TPU_COMPILE_CACHE"] = "off"
 
 import jax  # noqa: E402
 

@@ -148,6 +148,69 @@ def test_exec_attempt_counter(monkeypatch):
     assert bp.retry_via_exec(max_execs=2, backoff_s=0.0) is None
 
 
+class TestCompilationCache:
+    """enable_compilation_cache: the cross-process compile reuse that
+    shrinks the capture window (a cold ResNet compile through the
+    tunnel costs minutes; the cache makes re-runs start in seconds)."""
+
+    @pytest.fixture(autouse=True)
+    def _restore_jax_config(self, monkeypatch):
+        # The HOROVOD_ prefix wins in _env resolution; keep it out of
+        # the way so each test controls the HVD_TPU_ spelling alone.
+        monkeypatch.delenv("HOROVOD_COMPILE_CACHE", raising=False)
+        import jax
+
+        before = jax.config.jax_compilation_cache_dir
+        yield
+        jax.config.update("jax_compilation_cache_dir", before)
+
+    @pytest.mark.parametrize("off", ["0", "off", "none", "", "false", "no"])
+    def test_env_kill_switch(self, monkeypatch, off):
+        monkeypatch.setenv("HVD_TPU_COMPILE_CACHE", off)
+        assert bp.enable_compilation_cache() is None
+
+    def test_env_path_wins_and_is_created(self, monkeypatch, tmp_path):
+        target = tmp_path / "cache" / "nested"
+        monkeypatch.setenv("HVD_TPU_COMPILE_CACHE", str(target))
+        import jax
+
+        assert bp.enable_compilation_cache() == str(target)
+        assert target.is_dir()
+        assert jax.config.jax_compilation_cache_dir == str(target)
+
+    def test_default_dir_parameter(self, monkeypatch, tmp_path):
+        monkeypatch.delenv("HVD_TPU_COMPILE_CACHE", raising=False)
+        path = bp.enable_compilation_cache(default_dir=str(tmp_path / "c"))
+        assert path == str(tmp_path / "c")
+        assert os.path.isdir(path)
+
+    def test_unwritable_repo_falls_back_to_user_cache(self, monkeypatch,
+                                                      tmp_path):
+        # pip-install layout: the repo-relative candidate is unwritable;
+        # the user cache dir must be used instead of losing the cache.
+        monkeypatch.delenv("HVD_TPU_COMPILE_CACHE", raising=False)
+        real_makedirs = os.makedirs
+
+        def picky(p, **kw):
+            if p.endswith(".jax_cache"):
+                raise OSError(13, "Permission denied")
+            real_makedirs(p, **kw)
+
+        monkeypatch.setenv("HOME", str(tmp_path))
+        monkeypatch.setattr(bp.os, "makedirs", picky)
+        path = bp.enable_compilation_cache()
+        assert path == str(tmp_path / ".cache" / "horovod_tpu" / "jax")
+        assert os.path.isdir(path)
+
+    def test_unwritable_path_degrades_to_none(self, monkeypatch, tmp_path):
+        def deny(*a, **k):
+            raise OSError(13, "Permission denied")
+
+        monkeypatch.setenv("HVD_TPU_COMPILE_CACHE", str(tmp_path / "c"))
+        monkeypatch.setattr(bp.os, "makedirs", deny)
+        assert bp.enable_compilation_cache() is None
+
+
 def test_is_backend_unavailable_error():
     assert bp.is_backend_unavailable_error(
         RuntimeError("UNAVAILABLE: TPU backend setup/compile error"))
