@@ -276,10 +276,14 @@ def guarded_init(metric: str, unit: str, skip: bool = False,
     """
     import horovod_tpu as hvd
 
-    enable_compilation_cache()
     if skip:
+        # CPU smoke presets skip the cache too: XLA:CPU AOT reload
+        # warns about host-feature mismatches (potential SIGILL) and
+        # CPU compiles are cheap — the cache's value is the tunneled
+        # TPU path.
         hvd.init()
         return
+    enable_compilation_cache()
     def _env(name, default, cast):
         # Malformed/empty values must not crash before the structured
         # failure line exists (the whole point of this module).
