@@ -124,6 +124,20 @@ def _maybe_init_distributed() -> None:
     )
 
 
+def _per_process_path(path: Optional[str]) -> Optional[str]:
+    """One observability writer per file: every controller process opens
+    its configured path with mode "w", so a shared path in a
+    multi-process world would truncate/interleave.  Suffixing here — in
+    the library, not in any launcher — covers every launch path (local
+    spawn, remote agents, LSF, a plain exported env var).  Process 0
+    keeps the exact path: the reference's one-file contract, and in
+    SPMD every controller dispatches the same programs, so process 0 is
+    representative."""
+    if path and jax.process_index() > 0:
+        return f"{path}.rank{jax.process_index()}"
+    return path
+
+
 def init(config: Optional[Config] = None) -> None:
     """Initialize the framework (reference: ``hvd.init()``).
 
@@ -150,7 +164,8 @@ def init(config: Optional[Config] = None) -> None:
         _state.config = cfg
         _state.mesh = GlobalMesh.build(axis_name=cfg.mesh_axis_name)
         _state.process_sets = _ps.ProcessSetTable(_state.mesh)
-        _state.timeline = Timeline(cfg.timeline, mark_cycles=cfg.timeline_mark_cycles)
+        _state.timeline = Timeline(_per_process_path(cfg.timeline),
+                                   mark_cycles=cfg.timeline_mark_cycles)
         _state.stall_inspector = StallInspector(
             enabled=not cfg.stall_check_disable,
             warn_after_s=cfg.stall_check_time_seconds,
@@ -257,7 +272,10 @@ def _maybe_build_parameter_manager(cfg):
         warmup_samples=cfg.autotune_warmup_samples,
         steps_per_sample=cfg.autotune_steps_per_sample,
         max_samples=cfg.autotune_max_samples,
-        log_path=cfg.autotune_log,
+        # Only the decision rank writes samples (proposals are rank-0
+        # broadcast); a non-zero rank opening the shared path with
+        # mode "w" would truncate the real log.
+        log_path=cfg.autotune_log if jax.process_index() == 0 else None,
         initial=initial or None,
     )
     start_vals = pm.current_values()
@@ -574,7 +592,7 @@ def start_timeline(path: str, mark_cycles: bool = False) -> None:
     st = _require_init()
     if st.timeline is not None:
         st.timeline.close()
-    st.timeline = Timeline(path, mark_cycles=mark_cycles)
+    st.timeline = Timeline(_per_process_path(path), mark_cycles=mark_cycles)
 
 
 def stop_timeline() -> None:

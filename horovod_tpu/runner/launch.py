@@ -126,6 +126,24 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         help="sets HOROVOD_LOG_LEVEL for every worker "
                              "(reference horovodrun flag; "
                              "case-insensitive like the env var)")
+    parser.add_argument("--timeline-filename", default=None,
+                        help="write a Chrome-trace timeline of collective "
+                             "lifecycles (reference horovodrun flag; sets "
+                             "HOROVOD_TIMELINE). Process 0 writes exactly "
+                             "this path; other processes write "
+                             "<path>.rank<N> — enforced at hvd.init(), so "
+                             "it holds on every launch path")
+    parser.add_argument("--timeline-mark-cycles", action="store_true",
+                        help="mark scheduling cycles in the timeline "
+                             "(reference horovodrun flag; sets "
+                             "HOROVOD_TIMELINE_MARK_CYCLES)")
+    parser.add_argument("--autotune", action="store_true",
+                        help="enable online Bayesian autotuning in every "
+                             "worker (reference horovodrun flag; sets "
+                             "HOROVOD_AUTOTUNE=1)")
+    parser.add_argument("--autotune-log-file", default=None,
+                        help="JSONL log of autotune samples (reference "
+                             "horovodrun flag; sets HOROVOD_AUTOTUNE_LOG)")
     parser.add_argument("--verbose", action="store_true")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="program and args (e.g. python train.py)")
@@ -384,8 +402,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     # Threaded through env= (never os.environ: a rejected invocation
     # must not mutate a programmatic caller's process).
-    extra_env = ({"HOROVOD_LOG_LEVEL": args.log_level}
-                 if args.log_level else {})
+    extra_env = {}
+    if args.log_level:
+        extra_env["HOROVOD_LOG_LEVEL"] = args.log_level
+    if args.timeline_filename:
+        extra_env["HOROVOD_TIMELINE"] = args.timeline_filename
+    if args.timeline_mark_cycles:
+        extra_env["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"
+    if args.autotune:
+        extra_env["HOROVOD_AUTOTUNE"] = "1"
+    if args.autotune_log_file:
+        extra_env["HOROVOD_AUTOTUNE_LOG"] = args.autotune_log_file
     nics = ([n.strip() for n in args.network_interfaces.split(",")
              if n.strip()] if args.network_interfaces else None)
     if args.hostfile:

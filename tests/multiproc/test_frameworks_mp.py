@@ -31,13 +31,17 @@ class TestTimelineMP:
         world(2, f"""
         import json
         hvd.shutdown()
-        path = r'{tmp_path}' + f'/timeline_{{rank}}.json'
+        path = r'{tmp_path}' + '/timeline.json'
         os.environ['HOROVOD_TIMELINE'] = path
         hvd.init()
         np.asarray(hvd.allreduce(np.ones((1, 4), np.float32), op=hvd.Sum,
                                  name='traced_op'))
         hvd.shutdown()
-        events = json.load(open(path))
+        # One writer per file: process 0 owns the exact path, the rest
+        # are suffixed at hvd.init (tests/multiproc/test_observability_mp.py
+        # pins the suffix contract itself).
+        events = json.load(open(path if rank == 0
+                                else path + f'.rank{{rank}}'))
         assert isinstance(events, list) and events, 'no timeline events'
         tensors = {{e.get('args', {{}}).get('tensor') for e in events}}
         assert 'traced_op' in tensors, tensors

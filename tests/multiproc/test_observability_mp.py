@@ -1,0 +1,66 @@
+"""Observability file contracts under a real multi-controller world.
+
+One writer per file: every controller process opens its configured
+observability paths at ``hvd.init()``, so shared paths must be
+de-conflicted by the LIBRARY (covering every launch path — local spawn,
+remote agents, LSF, plain env vars), not by any single launcher.
+Reference: ``HOROVOD_TIMELINE`` is written once by the coordinator
+(``timeline.cc``, SURVEY.md §5 — mount empty, unverified);
+``HOROVOD_AUTOTUNE_LOG`` likewise records the coordinator's decisions.
+"""
+
+import json
+import os
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+class TestTimelineMP:
+    def test_per_process_timeline_suffix(self, world, tmp_path):
+        """Process 0 writes exactly the configured path; process 1
+        writes ``<path>.rank1``; both files are valid event streams."""
+        tl = tmp_path / "t.json"
+        world(2, f"""
+        import dataclasses, time
+        import horovod_tpu.basics as basics
+        hvd.shutdown()
+        cfg = dataclasses.replace(
+            basics.Config.from_env(), timeline={str(tl)!r})
+        hvd.init(cfg)
+        x = np.full((1, 4), float(rank + 1), np.float32)
+        np.asarray(hvd.allreduce(x))
+        hvd.shutdown()  # closes/flushes the timeline
+        want = {str(tl)!r} + ('' if rank == 0 else '.rank1')
+        assert os.path.exists(want), want
+        """)
+        # Back in the launcher process: both files exist and parse.
+        for path in (tl, tmp_path / "t.json.rank1"):
+            text = path.read_text()
+            assert text.strip(), path
+            events = json.loads(text if text.rstrip().endswith("]")
+                                else text + "]")
+            assert any(e.get("ph") == "X" for e in events), path
+
+
+class TestAutotuneLogMP:
+    def test_only_rank0_opens_the_log(self, world, tmp_path):
+        """A non-zero rank must not hold a truncating handle on the
+        shared autotune log (decisions are rank-0 broadcast, so rank
+        0's log IS the log)."""
+        log = tmp_path / "a.jsonl"
+        world(2, f"""
+        import dataclasses
+        import horovod_tpu.basics as basics
+        hvd.shutdown()
+        cfg = dataclasses.replace(
+            basics.Config.from_env(), autotune=True,
+            autotune_log={str(log)!r})
+        hvd.init(cfg)
+        pm = basics._state.parameter_manager
+        assert pm is not None
+        assert (pm._log is not None) == (rank == 0), rank
+        hvd.shutdown()
+        """)
+        assert log.exists()  # rank 0 created it

@@ -231,6 +231,35 @@ class TestLocalRun:
         # the launcher's own process env is never mutated
         assert "HOROVOD_LOG_LEVEL" not in __import__("os").environ
 
+    def test_timeline_and_autotune_flags_reach_workers(self, tmp_path,
+                                                       monkeypatch):
+        """Reference horovodrun flags --timeline-filename /
+        --timeline-mark-cycles / --autotune / --autotune-log-file map to
+        their env vars, identically on every rank — per-rank path
+        de-confliction is the library's job at ``hvd.init()`` (covering
+        remote/LSF launches too; proven in
+        tests/multiproc/test_observability_mp.py)."""
+        from horovod_tpu.runner.launch import main
+
+        for var in ("HOROVOD_TIMELINE", "HOROVOD_TIMELINE_MARK_CYCLES",
+                    "HOROVOD_AUTOTUNE", "HOROVOD_AUTOTUNE_LOG"):
+            monkeypatch.delenv(var, raising=False)
+        tl = tmp_path / "t.json"
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import os, sys\n"
+            "ok = (os.environ.get('HOROVOD_TIMELINE') == %r\n"
+            "      and os.environ.get('HOROVOD_TIMELINE_MARK_CYCLES') == '1'\n"
+            "      and os.environ.get('HOROVOD_AUTOTUNE') == '1'\n"
+            "      and os.environ.get('HOROVOD_AUTOTUNE_LOG') == 'a.jsonl')\n"
+            "sys.exit(0 if ok else 5)\n" % str(tl))
+        assert main(["-np", "2", "--timeline-filename", str(tl),
+                     "--timeline-mark-cycles", "--autotune",
+                     "--autotune-log-file", "a.jsonl", "--",
+                     sys.executable, str(script)]) == 0
+        # the launcher's own process env is never mutated
+        assert "HOROVOD_TIMELINE" not in __import__("os").environ
+
     def test_output_filename_writes_per_rank_files(self, tmp_path):
         """Reference horovodrun --output-filename: each rank's output
         lands in its own file pair instead of the launcher's tty."""
