@@ -144,10 +144,114 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     parser.add_argument("--autotune-log-file", default=None,
                         help="JSONL log of autotune samples (reference "
                              "horovodrun flag; sets HOROVOD_AUTOTUNE_LOG)")
+    parser.add_argument("--fusion-threshold-mb", type=int, default=None,
+                        help="fusion bucket size in MB for every worker "
+                             "(reference horovodrun flag; sets "
+                             "HOROVOD_FUSION_THRESHOLD in bytes)")
+    parser.add_argument("--cycle-time-ms", type=float, default=None,
+                        help="reference horovodrun flag; forwarded as "
+                             "HOROVOD_CYCLE_TIME — a documented no-op "
+                             "here (XLA's async dispatch has no cycle "
+                             "loop), workers warn when it is set")
+    parser.add_argument("--cache-capacity", type=int, default=None,
+                        help="compiled-collective dispatch cache capacity "
+                             "(reference horovodrun flag; sets "
+                             "HOROVOD_CACHE_CAPACITY)")
+    parser.add_argument("--hierarchical-allreduce", action="store_true",
+                        help="two-level allreduce in every worker "
+                             "(reference horovodrun flag; sets "
+                             "HOROVOD_HIERARCHICAL_ALLREDUCE=1)")
+    parser.add_argument("--hierarchical-allgather", action="store_true",
+                        help="reference horovodrun flag; forwarded as "
+                             "HOROVOD_HIERARCHICAL_ALLGATHER — a "
+                             "documented no-op (XLA lowers AllGather "
+                             "over the topology natively)")
+    parser.add_argument("--no-stall-check", action="store_true",
+                        help="disable the stall inspector (reference "
+                             "horovodrun flag; sets "
+                             "HOROVOD_STALL_CHECK_DISABLE=1)")
+    parser.add_argument("--stall-check-warning-time-seconds", type=float,
+                        default=None,
+                        help="reference horovodrun flag; sets "
+                             "HOROVOD_STALL_CHECK_TIME_SECONDS")
+    parser.add_argument("--stall-check-shutdown-time-seconds", type=float,
+                        default=None,
+                        help="reference horovodrun flag; sets "
+                             "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS")
+    parser.add_argument("--config-file", default=None,
+                        help="YAML file of launcher parameters (reference "
+                             "horovodrun --config-file analogue): a flat "
+                             "mapping of long option names (with or "
+                             "without leading dashes, '-' or '_' "
+                             "separated) to values; explicit CLI flags "
+                             "win over file values")
     parser.add_argument("--verbose", action="store_true")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="program and args (e.g. python train.py)")
-    return parser.parse_args(argv)
+    args = parser.parse_args(argv)
+    if args.config_file:
+        _apply_config_file(parser, args, argv)
+    return args
+
+
+_BOOL_WORDS = {"1": True, "true": True, "yes": True, "on": True,
+               "0": False, "false": False, "no": False, "off": False,
+               "": False}
+
+
+def _apply_config_file(parser: argparse.ArgumentParser,
+                       args: argparse.Namespace,
+                       argv: Optional[List[str]]) -> None:
+    """Fill parameters from ``--config-file`` (YAML flat mapping of long
+    option names).  Explicit CLI flags win — "explicit" is determined by
+    scanning the launcher's own argv tokens (the command remainder is
+    excluded, so a worker command's flags can't shadow launcher ones).
+    File values go through the same type/choices validation the CLI
+    applies."""
+    import yaml
+
+    with open(args.config_file) as f:
+        data = yaml.safe_load(f) or {}
+    if not isinstance(data, dict):
+        raise SystemExit(f"--config-file {args.config_file}: expected a "
+                         "flat YAML mapping, got "
+                         f"{type(data).__name__}")
+    tokens = sys.argv[1:] if argv is None else list(argv)
+    tokens = tokens[:len(tokens) - len(args.command)]  # REMAINDER is the tail
+    given = set()
+    for act in parser._actions:
+        for opt in act.option_strings:
+            if opt in tokens or any(t.startswith(opt + "=") for t in tokens):
+                given.add(act.dest)
+    actions = {a.dest: a for a in parser._actions
+               if a.default is not argparse.SUPPRESS}  # excludes -h/--help
+    for key, value in data.items():
+        dest = str(key).lstrip("-").replace("-", "_")
+        if dest in ("config_file", "command") or dest not in actions:
+            raise SystemExit(f"--config-file {args.config_file}: unknown "
+                             f"parameter {key!r}")
+        act = actions[dest]
+        if isinstance(act, argparse._StoreTrueAction):
+            if not isinstance(value, bool):
+                try:
+                    value = _BOOL_WORDS[str(value).strip().lower()]
+                except KeyError:
+                    raise SystemExit(
+                        f"--config-file {args.config_file}: bad value "
+                        f"{value!r} for boolean {key!r}")
+        elif act.type is not None and value is not None:
+            try:
+                value = act.type(value)
+            except (TypeError, ValueError):
+                raise SystemExit(
+                    f"--config-file {args.config_file}: bad value "
+                    f"{value!r} for {key!r}")
+        if act.choices is not None and value not in act.choices:
+            raise SystemExit(
+                f"--config-file {args.config_file}: {key!r} must be one "
+                f"of {sorted(act.choices)}, got {value!r}")
+        if dest not in given:  # CLI wins
+            setattr(args, dest, value)
 
 
 def _spawn_world(np_: int, command: List[str], coordinator: str,
@@ -413,6 +517,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         extra_env["HOROVOD_AUTOTUNE"] = "1"
     if args.autotune_log_file:
         extra_env["HOROVOD_AUTOTUNE_LOG"] = args.autotune_log_file
+    if args.fusion_threshold_mb is not None:
+        extra_env["HOROVOD_FUSION_THRESHOLD"] = str(
+            args.fusion_threshold_mb * 1024 * 1024)
+    if args.cycle_time_ms is not None:
+        extra_env["HOROVOD_CYCLE_TIME"] = str(args.cycle_time_ms)
+    if args.cache_capacity is not None:
+        extra_env["HOROVOD_CACHE_CAPACITY"] = str(args.cache_capacity)
+    if args.hierarchical_allreduce:
+        extra_env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+    if args.hierarchical_allgather:
+        extra_env["HOROVOD_HIERARCHICAL_ALLGATHER"] = "1"
+    if args.no_stall_check:
+        extra_env["HOROVOD_STALL_CHECK_DISABLE"] = "1"
+    if args.stall_check_warning_time_seconds is not None:
+        extra_env["HOROVOD_STALL_CHECK_TIME_SECONDS"] = str(
+            args.stall_check_warning_time_seconds)
+    if args.stall_check_shutdown_time_seconds is not None:
+        extra_env["HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"] = str(
+            args.stall_check_shutdown_time_seconds)
     nics = ([n.strip() for n in args.network_interfaces.split(",")
              if n.strip()] if args.network_interfaces else None)
     if args.hostfile:

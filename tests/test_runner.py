@@ -260,6 +260,102 @@ class TestLocalRun:
         # the launcher's own process env is never mutated
         assert "HOROVOD_TIMELINE" not in __import__("os").environ
 
+    def test_knob_flags_reach_workers(self, tmp_path, monkeypatch):
+        """Reference horovodrun tunable-parameter flags map to their
+        env vars (fusion threshold converted MB -> bytes)."""
+        from horovod_tpu.runner.launch import main
+
+        for var in ("HOROVOD_FUSION_THRESHOLD", "HOROVOD_CACHE_CAPACITY",
+                    "HOROVOD_HIERARCHICAL_ALLREDUCE",
+                    "HOROVOD_STALL_CHECK_DISABLE",
+                    "HOROVOD_STALL_CHECK_TIME_SECONDS"):
+            monkeypatch.delenv(var, raising=False)
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import os, sys\n"
+            "e = os.environ\n"
+            "ok = (e.get('HOROVOD_FUSION_THRESHOLD') == str(32 << 20)\n"
+            "      and e.get('HOROVOD_CACHE_CAPACITY') == '128'\n"
+            "      and e.get('HOROVOD_HIERARCHICAL_ALLREDUCE') == '1'\n"
+            "      and e.get('HOROVOD_STALL_CHECK_DISABLE') == '1'\n"
+            "      and e.get('HOROVOD_STALL_CHECK_TIME_SECONDS') == '30.0')\n"
+            "sys.exit(0 if ok else 5)\n")
+        assert main(["-np", "1", "--fusion-threshold-mb", "32",
+                     "--cache-capacity", "128", "--hierarchical-allreduce",
+                     "--no-stall-check",
+                     "--stall-check-warning-time-seconds", "30",
+                     "--", sys.executable, str(script)]) == 0
+
+    def test_config_file_fills_params_cli_wins(self, tmp_path, monkeypatch):
+        """--config-file (reference horovodrun analogue): flat YAML of
+        long option names; explicit CLI flags beat file values; unknown
+        keys and bad values are rejected loudly."""
+        from horovod_tpu.runner.launch import main, parse_args
+
+        monkeypatch.delenv("HOROVOD_FUSION_THRESHOLD", raising=False)
+        cfg = tmp_path / "h.yaml"
+        cfg.write_text("fusion-threshold-mb: 16\n"
+                       "hierarchical-allreduce: true\n"
+                       "log_level: debug\n")
+        args = parse_args(["--config-file", str(cfg),
+                           "--fusion-threshold-mb", "64", "--", "true"])
+        assert args.fusion_threshold_mb == 64  # CLI wins
+        assert args.hierarchical_allreduce is True
+        assert args.log_level == "debug"
+
+        bad = tmp_path / "bad.yaml"
+        bad.write_text("no-such-flag: 1\n")
+        with pytest.raises(SystemExit, match="unknown parameter"):
+            parse_args(["--config-file", str(bad), "--", "true"])
+
+        badval = tmp_path / "badval.yaml"
+        badval.write_text("fusion-threshold-mb: not-a-number\n")
+        with pytest.raises(SystemExit, match="bad value"):
+            parse_args(["--config-file", str(badval), "--", "true"])
+
+        # A CLI flag explicitly set to its DEFAULT value still wins
+        # (presence in argv decides, not value-vs-default).
+        resetcfg = tmp_path / "r.yaml"
+        resetcfg.write_text("reset-limit: 5\n")
+        args = parse_args(["--reset-limit", "0",
+                           "--config-file", str(resetcfg), "--", "true"])
+        assert args.reset_limit == 0
+        # ...and the worker command's own flags never count as launcher
+        # flags (REMAINDER excluded from the scan).
+        args = parse_args(["--config-file", str(resetcfg), "--",
+                           "prog", "--reset-limit", "9"])
+        assert args.reset_limit == 5
+
+        # choices are validated like the CLI validates them
+        typo = tmp_path / "typo.yaml"
+        typo.write_text("log-level: deubg\n")
+        with pytest.raises(SystemExit, match="must be one of"):
+            parse_args(["--config-file", str(typo), "--", "true"])
+
+        # quoted booleans parse strictly; garbage is loud
+        quoted = tmp_path / "q.yaml"
+        quoted.write_text("hierarchical-allreduce: 'false'\n")
+        assert parse_args(["--config-file", str(quoted), "--", "true"]
+                          ).hierarchical_allreduce is False
+        garbage = tmp_path / "g.yaml"
+        garbage.write_text("hierarchical-allreduce: maybe\n")
+        with pytest.raises(SystemExit, match="bad value.*boolean"):
+            parse_args(["--config-file", str(garbage), "--", "true"])
+
+        # 'help' is not an injectable parameter
+        helpcfg = tmp_path / "h2.yaml"
+        helpcfg.write_text("help: true\n")
+        with pytest.raises(SystemExit, match="unknown parameter"):
+            parse_args(["--config-file", str(helpcfg), "--", "true"])
+
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import os, sys\n"
+            "sys.exit(0 if os.environ.get('HOROVOD_FUSION_THRESHOLD')"
+            " == str(16 << 20) else 5)\n")
+        assert main(["--config-file", str(cfg), "--",
+                     sys.executable, str(script)]) == 0
+
     def test_output_filename_writes_per_rank_files(self, tmp_path):
         """Reference horovodrun --output-filename: each rank's output
         lands in its own file pair instead of the launcher's tty."""
