@@ -186,6 +186,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                              "separated) to values; explicit CLI flags "
                              "win over file values")
     parser.add_argument("--verbose", action="store_true")
+    from ..version import __version__
+
+    parser.add_argument("--version", action="version",
+                        version=f"horovod-tpu {__version__}",
+                        help="print the framework version and exit "
+                             "(reference horovodrun flag)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="program and args (e.g. python train.py)")
     args = parser.parse_args(argv)

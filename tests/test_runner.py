@@ -20,6 +20,14 @@ class TestParseArgs:
     def test_check_build_flag(self):
         assert parse_args(["--check-build"]).check_build
 
+    def test_version_flag(self, capsys):
+        from horovod_tpu.version import __version__
+
+        with pytest.raises(SystemExit) as exc:
+            parse_args(["--version"])
+        assert exc.value.code == 0
+        assert __version__ in capsys.readouterr().out
+
     def test_elastic_args(self):
         args = parse_args(["-np", "2", "--min-np", "1", "--max-np", "4",
                            "--host-discovery-script", "./d.sh", "x"])
