@@ -52,10 +52,11 @@ def run_capture(stamp: str) -> bool:
     ok = True
 
     def step(name, cmd, out_path=None, append=False, timeout=2400,
-             side_artifact=None):
+             side_artifact=None, bonus=False):
         """``side_artifact``: a fixed-name file the COMMAND writes
         itself; deleted when this step fails so a stale partial can't
-        masquerade as the round's evidence."""
+        masquerade as the round's evidence.  ``bonus`` steps add
+        evidence but never gate capture completion."""
         nonlocal ok
 
         def drop_side():
@@ -93,7 +94,8 @@ def run_capture(stamp: str) -> bool:
                     value=(parsed or {}).get("value"),
                     mfu_pct=(parsed or {}).get("mfu_pct"),
                     tail=(proc.stderr or proc.stdout)[-300:] if not good else "")
-        ok = ok and good
+        if not bonus:
+            ok = ok and good
 
     prof = os.path.join("profiles", f"resnet50_{stamp}")
     # Step order is risk-ordered (measured 2026-07-31: the first healthy
@@ -126,6 +128,20 @@ def run_capture(stamp: str) -> bool:
          [sys.executable, "bench.py", "--fp16-allreduce",
           "--no-auto-batch"],
          out_path=f"BENCH_tpu_{stamp}.json", append=True, timeout=3600)
+    # Bonus evidence (never gates completion): the remaining
+    # BASELINE.json config vehicles — BERT-Large + fp16 fusion, Adasum
+    # ResNet-50 — and the flagship GPT MFU vehicle.
+    step("bench_bert",
+         [sys.executable, os.path.join("benchmarks",
+                                       "bert_finetune_bench.py")],
+         out_path=f"BENCH_tpu_{stamp}.json", append=True, bonus=True)
+    step("bench_adasum",
+         [sys.executable, os.path.join("benchmarks",
+                                       "adasum_resnet_bench.py")],
+         out_path=f"BENCH_tpu_{stamp}.json", append=True, bonus=True)
+    step("bench_gpt",
+         [sys.executable, os.path.join("benchmarks", "gpt_bench.py")],
+         out_path=f"BENCH_tpu_{stamp}.json", append=True, bonus=True)
     return ok
 
 
