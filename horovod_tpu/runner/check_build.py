@@ -112,9 +112,13 @@ def check_build_str() -> str:
         "",
         "Launchers:",
         "    [X] local multi-process (-np N)",
+        "    [X] remote multi-host (-H host:slots / --hostfile: ssh task "
+        "agents, RPC mesh, fail-fast supervision)",
         "    [X] elastic (--host-discovery-script, min/max-np)",
         "    [X] LSF/jsrun (allocation auto-detect, PMIX rank pickup)",
         "    [X] TPU pod passthrough (platform-set coordination env)",
+        "    [X] programmatic hvd.run(fn, np=N) (cloudpickled function, "
+        "per-rank results)",
         "",
         "Integration test waiver: Spark/Ray/MXNet integrations are",
         "exercised against faithful in-repo API shims driving REAL",
