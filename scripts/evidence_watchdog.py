@@ -41,7 +41,7 @@ def log_attempt(kind: str, **fields) -> None:
         f.write(json.dumps(row) + "\n")
 
 
-def run_capture(stamp: str) -> bool:
+def run_capture(stamp: str, hard_deadline: float = float("inf")) -> bool:
     """Run the four-step suite; returns True when every step passed.
     Each entrypoint carries its own guarded_init defense (now rc=0 on a
     measured outage), so step success means parsed value > 0."""
@@ -56,8 +56,22 @@ def run_capture(stamp: str) -> bool:
         """``side_artifact``: a fixed-name file the COMMAND writes
         itself; deleted when this step fails so a stale partial can't
         masquerade as the round's evidence.  ``bonus`` steps add
-        evidence but never gate capture completion."""
+        evidence but never gate capture completion.
+
+        A step only STARTS when its full timeout fits before
+        ``hard_deadline`` (monotonic seconds): the deadline exists so
+        the watchdog provably releases the chip before the driver's
+        own end-of-round bench run — two processes competing for the
+        single TPU would turn the official artifact into a false
+        outage."""
         nonlocal ok
+        if time.monotonic() + timeout > hard_deadline:
+            log_attempt("capture_step", step=name, ok=False,
+                        error="skipped: step timeout would cross the "
+                              "hard deadline")
+            if not bonus:
+                ok = False
+            return
 
         def drop_side():
             if side_artifact:
@@ -170,21 +184,33 @@ def main() -> None:
     ap.add_argument("--once", action="store_true",
                     help="single probe + capture, no retry loop (the "
                          "capture_tpu_evidence.sh entry)")
+    ap.add_argument("--stop-after-s", type=float, default=None,
+                    help="hard wall-clock budget: no probe or capture "
+                         "step may run past now+THIS many seconds (the "
+                         "watchdog must release the chip before the "
+                         "driver's own end-of-round bench)")
     args = ap.parse_args()
     if args.once:
         args.max_attempts = 1
+    hard_deadline = (time.monotonic() + args.stop_after_s
+                     if args.stop_after_s else float("inf"))
 
     from horovod_tpu.utils.backend_probe import probe_once
 
     kept_stamps = []
     for i in range(1, args.max_attempts + 1):
+        if time.monotonic() + args.probe_timeout_s > hard_deadline:
+            log_attempt("deadline_reached", kept=kept_stamps)
+            print("hard deadline reached; releasing the chip",
+                  flush=True)
+            sys.exit(0 if kept_stamps else 3)
         info = probe_once(timeout_s=args.probe_timeout_s)
         log_attempt("probe", attempt=i, **info)
         if info.get("ok"):
             stamp = datetime.datetime.now().strftime("%Y%m%d_%H%M%S")
             print(f"backend healthy ({info.get('device_kind')}); "
                   f"capturing as {stamp}", flush=True)
-            if run_capture(stamp):
+            if run_capture(stamp, hard_deadline):
                 log_attempt("capture_done", stamp=stamp)
                 print("capture complete", flush=True)
                 sys.exit(0)
