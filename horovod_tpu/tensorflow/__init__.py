@@ -94,21 +94,29 @@ def _to_dense(grad):
 
 def _allreduce_grads(grads: Sequence, *, op: str, compression,
                      process_set, sparse_as_dense: bool,
-                     name: str) -> List:
+                     name: str, num_groups: int = 0) -> List:
     """Reduce a gradient set as ONE ordered logical op: dense grads ride
     a fused grouped_allreduce (the reference's tensor-fusion guarantee),
-    sparse/None entries are handled per reference semantics."""
+    sparse/None entries are handled per reference semantics.
+    ``num_groups`` (reference arg) splits the dense set into that many
+    fused ops instead of one; 0 keeps the single fully-fused group."""
+    if num_groups < 0:
+        raise ValueError("num_groups must be >= 0")
     if sparse_as_dense:
         grads = [_to_dense(g) for g in grads]
     dense_idx = [i for i, g in enumerate(grads)
                  if g is not None and not isinstance(g, tf.IndexedSlices)]
     out = list(grads)
     if dense_idx:
-        reduced = grouped_allreduce(
-            [grads[i] for i in dense_idx], op=op, compression=compression,
-            process_set=process_set, name=name)
-        for i, r in zip(dense_idx, reduced):
-            out[i] = r
+        n = min(num_groups, len(dense_idx)) if num_groups > 0 else 1
+        for g in range(n):
+            chunk = dense_idx[g::n]
+            reduced = grouped_allreduce(
+                [grads[i] for i in chunk], op=op, compression=compression,
+                process_set=process_set,
+                name=name if n == 1 else f"{name}.g{g}")
+            for i, r in zip(chunk, reduced):
+                out[i] = r
     for i, g in enumerate(grads):
         if isinstance(g, tf.IndexedSlices):
             out[i] = allreduce(g, op=op, process_set=process_set,
@@ -178,12 +186,13 @@ class _DistributedOptimizerMixin:
     _hvd_tpu_distributed = True
 
     def _hvd_setup(self, *, op, compression, process_set, sparse_as_dense,
-                   backward_passes_per_step, reduce_name):
+                   backward_passes_per_step, reduce_name, num_groups=0):
         self._hvd_op = op
         self._hvd_compression = compression
         self._hvd_process_set = process_set
         self._hvd_sparse_as_dense = sparse_as_dense
         self._hvd_reduce_name = reduce_name
+        self._hvd_num_groups = num_groups
         self._hvd_agg = (
             LocalGradientAggregationHelper(
                 backward_passes_per_step, self._hvd_allreduce)
@@ -194,7 +203,8 @@ class _DistributedOptimizerMixin:
             grads, op=self._hvd_op, compression=self._hvd_compression,
             process_set=self._hvd_process_set,
             sparse_as_dense=self._hvd_sparse_as_dense,
-            name=self._hvd_reduce_name)
+            name=self._hvd_reduce_name,
+            num_groups=self._hvd_num_groups)
 
     def apply(self, grads, trainable_variables=None, **kwargs):
         sup = super()
@@ -211,6 +221,7 @@ def DistributedOptimizer(optimizer, *, op: str = Average,
                          compression=Compression.none,
                          backward_passes_per_step: int = 1,
                          process_set=None, sparse_as_dense: bool = False,
+                         num_groups: int = 0,
                          name: Optional[str] = None):
     """Reference: ``hvd.DistributedOptimizer(opt)`` — returns an
     optimizer of a dynamically-created subclass of ``type(opt)`` whose
@@ -228,7 +239,8 @@ def DistributedOptimizer(optimizer, *, op: str = Average,
         op=op, compression=compression, process_set=process_set,
         sparse_as_dense=sparse_as_dense,
         backward_passes_per_step=backward_passes_per_step,
-        reduce_name=name or "DistributedOptimizer.grads")
+        reduce_name=name or "DistributedOptimizer.grads",
+        num_groups=num_groups)
     return dist
 
 
@@ -237,12 +249,13 @@ class _DistributedGradientTape:
     whose ``gradient()`` returns allreduced gradients."""
 
     def __init__(self, tape: "tf.GradientTape", *, op, compression,
-                 process_set, sparse_as_dense):
+                 process_set, sparse_as_dense, num_groups=0):
         self._tape = tape
         self._op = op
         self._compression = compression
         self._process_set = process_set
         self._sparse_as_dense = sparse_as_dense
+        self._num_groups = num_groups
 
     def __enter__(self):
         self._tape.__enter__()
@@ -261,7 +274,8 @@ class _DistributedGradientTape:
             flat, op=self._op, compression=self._compression,
             process_set=self._process_set,
             sparse_as_dense=self._sparse_as_dense,
-            name="DistributedGradientTape.grads")
+            name="DistributedGradientTape.grads",
+            num_groups=self._num_groups)
         return tf.nest.pack_sequence_as(grads, reduced)
 
 
@@ -269,8 +283,9 @@ def DistributedGradientTape(gradtape: "tf.GradientTape", *,
                             op: str = Average,
                             compression=Compression.none,
                             process_set=None,
-                            sparse_as_dense: bool = False):
+                            sparse_as_dense: bool = False,
+                            num_groups: int = 0):
     """Reference: ``hvd.DistributedGradientTape(tape)``."""
     return _DistributedGradientTape(
         gradtape, op=op, compression=compression, process_set=process_set,
-        sparse_as_dense=sparse_as_dense)
+        sparse_as_dense=sparse_as_dense, num_groups=num_groups)

@@ -19,7 +19,7 @@ background NCCL thread.
 from __future__ import annotations
 
 import contextlib
-from typing import Dict, Iterable, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple
 
 import torch
 
@@ -36,12 +36,15 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                  op: str = mpi_ops.Average,
                  gradient_predivide_factor: float = 1.0,
                  process_set=None,
-                 sparse_as_dense: bool = False):
+                 sparse_as_dense: bool = False,
+                 num_groups: int = 0):
         super(self.__class__, self).__init__(params)
 
         if gradient_predivide_factor != 1.0 and op != mpi_ops.Average:
             raise ValueError(
                 "gradient_predivide_factor is only supported with op=Average")
+        if num_groups < 0:
+            raise ValueError("num_groups must be >= 0")
 
         if named_parameters is not None:
             named_parameters = list(named_parameters)
@@ -58,6 +61,28 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         self._sparse_as_dense = bool(sparse_as_dense)
         self._process_set = process_set
         self._predivide = float(gradient_predivide_factor)
+        # Reference num_groups semantics: dense gradients are reduced as
+        # this many fused grouped ops instead of one per parameter (0 =
+        # per-parameter async, the reference default).  Group membership
+        # is fixed at construction in stable parameter order, and a
+        # group dispatches AS SOON AS every member is ready — retaining
+        # the backward/collective overlap the per-parameter path has
+        # (the reference's group_table behaves the same way).  Members
+        # whose hook never fires are swept into a partial-group dispatch
+        # at synchronize().
+        self._num_groups = int(num_groups)
+        self._param_group: Dict[torch.Tensor, int] = {}
+        if self._num_groups > 0:
+            grouped = [p for p in self._all_params() if p.requires_grad]
+            n = min(self._num_groups, len(grouped))
+            for g in range(n):
+                for p in grouped[g::n]:
+                    self._param_group[p] = g
+        self._group_size = {
+            g: sum(1 for v in self._param_group.values() if v == g)
+            for g in set(self._param_group.values())
+        }
+        self._group_ready: Dict[int, List[torch.Tensor]] = {}
         self.backward_passes_per_step = int(backward_passes_per_step)
 
         self._handles: Dict[torch.Tensor, Tuple] = {}
@@ -113,9 +138,11 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     def _enqueue_allreduce(self, p: torch.Tensor) -> None:
         name = self._param_names.get(p, f"param.{id(p)}")
         if p.grad.is_sparse:
-            # Reference sparse path: densify when asked, else the
-            # allgather-based sparse allreduce (duplicate indices sum by
-            # coalescing) whose result replaces p.grad at synchronize.
+            # Reference sparse path: densify when asked (a densified
+            # grad then joins its fused group like any dense one), else
+            # the allgather-based sparse allreduce (duplicate indices
+            # sum by coalescing) whose result replaces p.grad at
+            # synchronize.
             if self._sparse_as_dense:
                 p.grad = p.grad.to_dense()
             else:
@@ -129,9 +156,33 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                     name=f"sparse_allreduce.{name}")
                 self._handles[p] = ("sparse", handle)
                 return
+        g = self._param_group.get(p)
+        if g is not None:
+            ready = self._group_ready.setdefault(g, [])
+            ready.append(p)
+            self._handles[p] = ("pending_group", g)
+            if len(ready) == self._group_size[g]:
+                self._dispatch_group(g)
+            return
         handle = mpi_ops.allreduce_async_(
             p.grad, name=f"allreduce.{name}", **self._allreduce_kwargs())
         self._handles[p] = handle
+
+    def _dispatch_group(self, g: int) -> None:
+        """One fused op over the group's ready members (all of them in
+        the overlap path; the subset that got gradients when swept at
+        synchronize).  Stable parameter order keeps every rank's fused
+        wire layout identical."""
+        ready = self._group_ready.pop(g, [])
+        if not ready:
+            return
+        order = {p: i for i, p in enumerate(self._all_params())}
+        ready.sort(key=lambda p: order[p])
+        handle = mpi_ops.grouped_allreduce_async_(
+            [p.grad for p in ready], name=f"grouped_allreduce.g{g}",
+            **self._allreduce_kwargs())
+        for p in ready:
+            self._handles[p] = ("group", handle)
 
     # -- reference API -------------------------------------------------------
 
@@ -148,9 +199,21 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         for p in self._all_params():
             if p.requires_grad and p.grad is not None and p not in self._handles:
                 self._enqueue_allreduce(p)
+        # Partial groups: members whose hook never fired get no grad
+        # this step, so their group never hit full strength — dispatch
+        # whatever subset is ready (every rank sees the same subset in
+        # a lockstep model, the same assumption the per-param path
+        # makes).
+        for g in list(self._group_ready):
+            self._dispatch_group(g)
+        waited = set()
         for p, handle in self._handles.items():
             if isinstance(handle, tuple) and handle[0] == "sparse":
                 p.grad = handle[1].wait()
+            elif isinstance(handle, tuple) and handle[0] == "group":
+                if id(handle[1]) not in waited:
+                    waited.add(id(handle[1]))
+                    mpi_ops.synchronize(handle[1])
             else:
                 mpi_ops.synchronize(handle)
         self._handles.clear()
@@ -190,7 +253,8 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          op: str = mpi_ops.Average,
                          gradient_predivide_factor: float = 1.0,
                          process_set=None,
-                         sparse_as_dense: bool = False) -> torch.optim.Optimizer:
+                         sparse_as_dense: bool = False,
+                         num_groups: int = 0) -> torch.optim.Optimizer:
     """Reference: ``hvd.DistributedOptimizer`` — wraps any torch optimizer
     so ``step()`` applies gradients averaged across all workers.
 
@@ -204,4 +268,4 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                dict(_DistributedOptimizer.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression,
                backward_passes_per_step, op, gradient_predivide_factor,
-               process_set, sparse_as_dense)
+               process_set, sparse_as_dense, num_groups)

@@ -156,6 +156,46 @@ class TestDistributedOptimizer:
             m.trainable_variables[0].numpy(), w0 - 0.1 * np.ones((3, 2)),
             atol=1e-6)
 
+    def test_num_groups_splits_fused_groups(self):
+        """Reference arg num_groups: the dense grad set rides N fused
+        grouped ops instead of one — applied update identical."""
+        m = tf.keras.Sequential([
+            tf.keras.layers.Dense(2, use_bias=True,
+                                  kernel_initializer="ones"),
+            tf.keras.layers.Dense(1, use_bias=True,
+                                  kernel_initializer="ones"),
+        ])
+        m.build((None, 3))
+        opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(0.1),
+                                       num_groups=3)
+        w0 = [v.numpy().copy() for v in m.trainable_variables]
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_sum(m(tf.ones((1, 3))))
+        grads = tape.gradient(loss, m.trainable_variables)
+        opt.apply_gradients(zip(grads, m.trainable_variables))
+        for v, w, g in zip(m.trainable_variables, w0, grads):
+            np.testing.assert_allclose(v.numpy(), w - 0.1 * g.numpy(),
+                                       atol=1e-6)
+
+    def test_num_groups_negative_rejected(self):
+        m = self._model()
+        opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(0.1),
+                                       num_groups=-1)
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_sum(m(tf.ones((1, 3))))
+        grads = tape.gradient(loss, m.trainable_variables)
+        with pytest.raises(ValueError, match="num_groups"):
+            opt.apply_gradients(zip(grads, m.trainable_variables))
+
+    def test_gradient_tape_num_groups(self):
+        m = self._model()
+        tape = hvd.DistributedGradientTape(tf.GradientTape(), num_groups=2)
+        with tape:
+            loss = tf.reduce_sum(m(tf.ones((1, 3))))
+        grads = tape.gradient(loss, m.trainable_variables)
+        np.testing.assert_allclose(grads[0].numpy(), np.ones((3, 2)),
+                                   atol=1e-6)
+
     def test_double_wrap_rejected(self):
         opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(0.1))
         with pytest.raises(ValueError, match="already distributed"):

@@ -171,6 +171,76 @@ class TestDistributedOptimizer:
         for p, q in zip(model.parameters(), ref.parameters()):
             assert torch.allclose(p, q, atol=1e-6)
 
+    def test_num_groups_matches_per_param_path(self):
+        """Reference arg num_groups: dense grads ride num_groups fused
+        grouped ops instead of one per parameter — numerics identical."""
+        model, ref = self._models()
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9),
+            named_parameters=model.named_parameters(), num_groups=2)
+        ropt = hvd.DistributedOptimizer(
+            torch.optim.SGD(ref.parameters(), lr=0.1, momentum=0.9),
+            named_parameters=ref.named_parameters())
+        x = torch.randn(8, 4)
+        for _ in range(2):
+            opt.zero_grad()
+            model(x).pow(2).sum().backward()
+            opt.step()
+            ropt.zero_grad()
+            ref(x).pow(2).sum().backward()
+            ropt.step()
+        for p, q in zip(model.parameters(), ref.parameters()):
+            assert torch.allclose(p, q, atol=1e-6)
+
+    def test_num_groups_dispatches_group_when_full(self):
+        """Overlap path: a group's fused op is issued as soon as every
+        member's hook fired — before synchronize()/step()."""
+        model, _ = self._models()
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(), num_groups=1)
+        model(torch.randn(2, 4)).sum().backward()
+        # All params got grads, so the single group must already be
+        # in-flight ("group" handles), not parked as pending.
+        kinds = {h[0] for h in opt._handles.values()
+                 if isinstance(h, tuple)}
+        assert kinds == {"group"}, kinds
+        opt.step()
+
+    def test_num_groups_with_sparse_as_dense(self):
+        """Densified sparse grads join their fused group (parity with
+        the TF binding's sparse_as_dense + num_groups behavior)."""
+        torch.manual_seed(0)
+        emb = torch.nn.EmbeddingBag(10, 4, sparse=True, mode="sum")
+        ref = torch.nn.EmbeddingBag(10, 4, sparse=True, mode="sum")
+        ref.load_state_dict(emb.state_dict())
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(emb.parameters(), lr=0.1),
+            named_parameters=emb.named_parameters(),
+            sparse_as_dense=True, num_groups=1)
+        ropt = torch.optim.SGD(ref.parameters(), lr=0.1)
+        idx = torch.tensor([1, 2, 4, 1])
+        off = torch.tensor([0, 2])
+        opt.zero_grad()
+        emb(idx, off).sum().backward()
+        opt.step()
+        ref(idx, off).sum().backward()
+        ref.weight.grad = ref.weight.grad.to_dense()
+        ropt.step()
+        assert torch.allclose(emb.weight, ref.weight, atol=1e-6)
+
+    def test_num_groups_caps_and_validates(self):
+        model, _ = self._models()
+        # More groups than params: capped, still correct.
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(), num_groups=99)
+        model(torch.randn(2, 4)).sum().backward()
+        opt.step()
+        with pytest.raises(ValueError, match="num_groups"):
+            hvd.DistributedOptimizer(
+                torch.optim.SGD(model.parameters(), lr=0.1), num_groups=-1)
+
     def test_backward_passes_per_step(self):
         model, ref = self._models()
         opt = hvd.DistributedOptimizer(
