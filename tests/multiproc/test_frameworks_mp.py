@@ -77,6 +77,43 @@ class TestTorchSparseMP:
         """)
 
 
+class TestTorchNumGroupsMP:
+    def test_grouped_fused_grads_average_across_controllers(self, world):
+        """num_groups fused dispatch across real controllers: the fused
+        wire layout must agree on both ranks and the result equal the
+        per-parameter average path."""
+        world(2, """
+        import torch
+        import horovod_tpu.torch as hvt
+
+        torch.manual_seed(0)
+        model = torch.nn.Sequential(torch.nn.Linear(4, 6),
+                                    torch.nn.Tanh(),
+                                    torch.nn.Linear(6, 2))
+        opt = hvt.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(), num_groups=2)
+        # Different data per rank: the update must reflect the mean.
+        x = torch.full((3, 4), float(rank + 1))
+        model(x).sum().backward()
+        opt.synchronize()
+        ref = torch.nn.Sequential(torch.nn.Linear(4, 6),
+                                  torch.nn.Tanh(),
+                                  torch.nn.Linear(6, 2))
+        ref.load_state_dict({k: v for k, v in model.state_dict().items()})
+        for r in (1.0, 2.0):
+            ref.zero_grad()
+            (ref(torch.full((3, 4), r)).sum() / 2).backward()
+            if r == 1.0:
+                saved = [p.grad.clone() for p in ref.parameters()]
+            else:
+                for p, s in zip(ref.parameters(), saved):
+                    p.grad += s
+        for p, q in zip(model.parameters(), ref.parameters()):
+            assert torch.allclose(p.grad, q.grad, atol=1e-5), (p.grad, q.grad)
+        """)
+
+
 class TestTensorFlowGraphModeMP:
     def test_allreduce_inside_tf_function(self, world):
         """The reference's custom op works inside tf.function graphs;
