@@ -15,6 +15,9 @@ exception surfacing from a collective (XLA halts propagate as errors)
 or a driver notification.
 """
 
-from .state import State, ObjectState, TpuState, HorovodInternalError, run  # noqa: F401
+from .state import (  # noqa: F401
+    State, ObjectState, TpuState, HorovodInternalError,
+    HostsUpdatedInterrupt, run,
+)
 from .sampler import ElasticSampler  # noqa: F401
 from .driver import ElasticDriver, HostDiscovery, ScriptDiscovery  # noqa: F401
