@@ -1,0 +1,43 @@
+"""Examples smoke tier: the runnable examples are user-facing API
+documentation (reference CI runs its examples the same way, SURVEY.md
+§4 — mount empty, unverified); a rotted example is a broken doc.
+
+Two representatives run as real subprocesses on the CPU mesh: the
+minimal DP slice (mnist_mlp) and the uneven-data join path
+(uneven_data_join) — between them they exercise init, shard_batch,
+DistributedOptimizer, broadcast_parameters, the negotiated input
+pipeline, and hvd.join.  The remaining examples share the same API
+surface and are exercised by the functional suites.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(example: str, timeout: float = 420.0):
+    env = {**os.environ}
+    env.pop("JAX_PLATFORMS", None)  # examples force the CPU mesh themselves
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "examples", example)],
+        capture_output=True, text=True, timeout=timeout, env=env)
+
+
+class TestExamplesSmoke:
+    def test_mnist_mlp(self):
+        proc = _run("mnist_mlp.py")
+        assert proc.returncode == 0, proc.stderr[-800:]
+        assert "done" in proc.stdout
+        assert "loss=" in proc.stdout
+
+    def test_uneven_data_join(self):
+        proc = _run("uneven_data_join.py")
+        assert proc.returncode == 0, proc.stderr[-800:]
+        assert "join" in proc.stdout
+        assert "final" in proc.stdout
