@@ -64,7 +64,7 @@ from .functions import (  # noqa: F401
 from .mpi_ops import (  # noqa: F401
     Adasum, Average, Max, Min, Product, Sum,
     allgather, allreduce, alltoall, barrier, broadcast, grouped_allgather,
-    grouped_allreduce, join, reducescatter,
+    grouped_allreduce, grouped_reducescatter, join, reducescatter,
     size_op, rank_op, local_rank_op, local_size_op, process_set_included_op,
 )
 from . import keras  # noqa: F401  (horovod.tensorflow.keras parity)

@@ -345,6 +345,15 @@ def reducescatter(tensor, *, op: str = Sum, process_set=None,
     return out
 
 
+def grouped_reducescatter(tensors: Sequence, *, op: str = Sum,
+                          process_set=None,
+                          name: str = "grouped_reducescatter") -> List:
+    """Reference: ``hvd.grouped_reducescatter`` (late vintages)."""
+    return [reducescatter(t, op=op, process_set=process_set,
+                          name=f"{name}[{i}]")
+            for i, t in enumerate(tensors)]
+
+
 # --- barrier / join ----------------------------------------------------------
 
 def barrier(process_set=None, name: str = "barrier") -> None:

@@ -38,7 +38,8 @@ from .mpi_ops import (  # noqa: F401
     grouped_allreduce_async_,
     allgather, allgather_async, grouped_allgather,
     broadcast, broadcast_, broadcast_async, broadcast_async_,
-    alltoall, reducescatter, sparse_allreduce_async,
+    alltoall, reducescatter, grouped_reducescatter,
+    sparse_allreduce_async,
     barrier, join, synchronize, poll, Handle,
 )
 from .compression import Compression  # noqa: F401

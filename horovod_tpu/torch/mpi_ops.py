@@ -360,6 +360,16 @@ def reducescatter(tensor: "torch.Tensor", *, op: str = Sum,
     return _to_torch(shard, tensor.dtype)
 
 
+def grouped_reducescatter(tensors: Sequence["torch.Tensor"], *,
+                          op: str = Sum, process_set=None,
+                          name: str = "grouped_reducescatter"
+                          ) -> List["torch.Tensor"]:
+    """Reference: ``hvd.grouped_reducescatter`` (late vintages)."""
+    return [reducescatter(t, op=op, process_set=process_set,
+                          name=f"{name}[{i}]")
+            for i, t in enumerate(tensors)]
+
+
 # --- barrier / join ----------------------------------------------------------
 
 def barrier(process_set=None, name: str = "barrier") -> None:

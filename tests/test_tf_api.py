@@ -93,6 +93,12 @@ class TestSingleWorkerOps:
         out = hvd.reducescatter(t, op=hvd.Sum)
         np.testing.assert_allclose(out.numpy(), np.arange(4))
 
+    def test_grouped_reducescatter(self):
+        ts = [tf.range(4, dtype=tf.float32), tf.ones((2, 3))]
+        outs = hvd.grouped_reducescatter(ts, op=hvd.Sum)
+        np.testing.assert_allclose(outs[0].numpy(), np.arange(4))
+        np.testing.assert_allclose(outs[1].numpy(), np.ones((2, 3)))
+
     def test_allreduce_indexed_slices(self):
         g = tf.IndexedSlices(values=tf.ones((2, 3)),
                              indices=tf.constant([0, 2]),

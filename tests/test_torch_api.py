@@ -113,6 +113,13 @@ class TestSingleWorkerOps:
         out = hvd.reducescatter(t)
         assert torch.equal(out, t)
 
+    def test_grouped_reducescatter(self):
+        ts = [torch.arange(4, dtype=torch.float32),
+              torch.ones(2, 3)]
+        outs = hvd.grouped_reducescatter(ts)
+        assert torch.equal(outs[0], ts[0])
+        assert torch.equal(outs[1], ts[1])
+
     def test_barrier_and_join(self):
         hvd.barrier()
         assert hvd.join() >= 0
