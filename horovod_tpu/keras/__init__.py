@@ -8,7 +8,7 @@ and callback implementations, so this package re-exports them.
 
 from ..tensorflow.keras import (  # noqa: F401
     Compression, DistributedOptimizer, broadcast_model, broadcast_variables,
-    callbacks,
+    callbacks, elastic,
     init, shutdown, is_initialized,
     rank, size, local_rank, local_size, cross_rank, cross_size,
 )

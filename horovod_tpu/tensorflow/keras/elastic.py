@@ -63,3 +63,8 @@ class UpdateEpochStateCallback(tf.keras.callbacks.Callback):
 
     def on_epoch_end(self, epoch, logs=None):
         self.state.epoch = epoch + 1
+
+
+# Reference: ``horovod.keras.elastic.KerasState`` is the standalone-
+# keras name for the same state object.
+from ..elastic import TensorFlowKerasState as KerasState  # noqa: E402,F401
