@@ -61,6 +61,27 @@ def main() -> None:
                              "stay defined over the LOGICAL payload so "
                              "the payoff reads as higher effective "
                              "bandwidth")
+    parser.add_argument("--two-phase", action="store_true",
+                        help="sweep the two-phase (reduce-scatter + "
+                             "all-gather) bucket-pipelined fused wire "
+                             "AND the single-phase fused wire at every "
+                             "size, reporting busbw for both paths "
+                             "(rows carry path=single_phase/two_phase); "
+                             "allreduce only")
+    parser.add_argument("--pipeline-depth", type=int, default=2,
+                        help="buckets in flight for --two-phase "
+                             "(HVD_TPU_PIPELINE_DEPTH)")
+    parser.add_argument("--bench-buckets", type=int, default=4,
+                        help="split the --two-phase payload into this "
+                             "many equal leaves so the pipeline has "
+                             "buckets to interleave")
+    parser.add_argument("--cost-alpha-us", type=float, default=None,
+                        help="override HVD_TPU_COST_ALPHA_US for the "
+                             "two-phase cost model (unset: every "
+                             "bucket decomposes in the --two-phase "
+                             "sweep so the comparison is direct)")
+    parser.add_argument("--cost-beta-gbps", type=float, default=None,
+                        help="override HVD_TPU_COST_BETA_GBPS")
     parser.add_argument("--cpu-mesh", action="store_true",
                         help="force the 8-device virtual CPU mesh "
                              "(functional check, not a perf number)")
@@ -72,10 +93,17 @@ def main() -> None:
     # budget and mislabels a bad invocation as a measured outage.
     if args.compression != "none" and args.collective != "allreduce":
         parser.error("--compression applies to the allreduce sweep only")
+    if args.two_phase and args.collective != "allreduce":
+        parser.error("--two-phase applies to the allreduce sweep only")
+    if args.two_phase and args.compression != "none":
+        parser.error("--two-phase and --compression are separate "
+                     "vehicles; run them as separate sweeps")
     # Metric identity carries the vehicle: a compressed-wire sweep must
     # never overwrite the BASELINE allreduce row in trend tooling.
     metric = (f"{args.collective}_busbw_peak" if args.compression == "none"
               else f"allreduce_{args.compression}_wire_busbw_peak")
+    if args.two_phase:
+        metric = "allreduce_two_phase_busbw_peak"
 
     if args.cpu_mesh:
         from horovod_tpu.utils.platform import force_cpu_mesh
@@ -100,7 +128,7 @@ def main() -> None:
     # — nccl-tests conventions; `elems` is one slot's contribution.
     def _mk_stack(elems):
         if (args.collective in ("reducescatter", "alltoall")
-                or args.compression != "none"):
+                or args.compression != "none" or args.two_phase):
             # Slot rows carry n chunks (scatter/exchange layout), and
             # the int8 wire's internal reduce-scatter shards the flat
             # vector n ways; round elems up to a multiple of n.
@@ -158,6 +186,64 @@ def main() -> None:
 
         def run(s):  # noqa: F811 — compressed vehicle replaces the map
             return spmd_wire(s)
+
+    runs = {"": run}
+    if args.two_phase:
+        # Two-phase vehicle: the fused SPMD gradient wire
+        # (fused_allreduce_pytree inside shard_map — the
+        # DistributedOptimizer hot path), payload split into
+        # --bench-buckets leaves so the pipelined schedule has
+        # consecutive buckets whose RS/AG phases can overlap.  Cost
+        # knobs default to "always decompose" so every size compares
+        # two-phase against single-phase directly; pass --cost-alpha-us/
+        # --cost-beta-gbps to watch the α–β gate hand latency-bound
+        # sizes back to the monolithic allreduce.
+        import dataclasses
+
+        import numpy as np
+        from horovod_tpu import basics
+        from horovod_tpu._compat import shard_map
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from horovod_tpu.ops.fusion import fused_allreduce_pytree
+
+        basics._state.config = dataclasses.replace(
+            basics.config(),
+            cost_alpha_us=(args.cost_alpha_us if args.cost_alpha_us
+                           is not None else 1e-9),
+            cost_beta_gbps=(args.cost_beta_gbps if args.cost_beta_gbps
+                            is not None else 1.0))
+        gm = hvd.global_mesh()
+        stack_sharding = NamedSharding(gm.mesh, P(gm.axis_name))
+        nbuckets = max(1, args.bench_buckets)
+
+        def _global_stack(shape, dt):
+            return jax.make_array_from_callback(
+                shape, stack_sharding,
+                lambda idx: np.ones(
+                    tuple(len(range(*s.indices(dim)))
+                          for s, dim in zip(idx, shape)), dt))
+
+        def _mk_stack(elems):  # noqa: F811 — bucket-splittable payload
+            elems = ((elems + n * nbuckets - 1) // (n * nbuckets)) \
+                * n * nbuckets
+            return _global_stack((n, elems), dtype), elems
+
+        def _wire(two_phase):
+            def per_slot(xb):  # [1, elems] — this slot's gradient
+                leaves = list(jnp.split(xb[0], nbuckets))
+                red = fused_allreduce_pytree(
+                    leaves, axis=gm.axis_name, op="sum",
+                    threshold=1,   # one bucket per leaf
+                    two_phase=two_phase,
+                    pipeline_depth=args.pipeline_depth)
+                return jnp.concatenate(red)[None]
+
+            return jax.jit(shard_map(per_slot, mesh=gm.mesh,
+                                     in_specs=P(gm.axis_name),
+                                     out_specs=P(gm.axis_name)))
+
+        runs = {"single_phase": _wire(False), "two_phase": _wire(True)}
+
     factor = ((2 * (n - 1) / n) if args.collective == "allreduce"
               else (n - 1) / n) if n > 1 else 1.0
 
@@ -165,40 +251,57 @@ def main() -> None:
     elems = args.min_elems
     while elems <= args.max_elems:
         stack, real_elems = _mk_stack(elems)
-        out = run(stack)
-        jax.block_until_ready(out)  # compile + warm cache
-        for _ in range(args.warmup):
-            jax.block_until_ready(run(stack))
-        t0 = time.perf_counter()
-        for _ in range(args.iters):
-            # Fence EVERY iteration, for every collective: identical
-            # timing semantics across the family (and no pileup of
-            # un-materialized replicated outputs — an allgather output
-            # is n x the input; `iters` pending ones would OOM HBM).
-            jax.block_until_ready(run(stack))
-        dt = (time.perf_counter() - t0) / args.iters
+        for path, run_fn in runs.items():
+            out = run_fn(stack)
+            jax.block_until_ready(out)  # compile + warm cache
+            for _ in range(args.warmup):
+                jax.block_until_ready(run_fn(stack))
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                # Fence EVERY iteration, for every collective: identical
+                # timing semantics across the family (and no pileup of
+                # un-materialized replicated outputs — an allgather output
+                # is n x the input; `iters` pending ones would OOM HBM).
+                jax.block_until_ready(run_fn(stack))
+            dt = (time.perf_counter() - t0) / args.iters
 
-        payload = real_elems * bytes_per
-        if args.collective == "allgather":
-            payload *= n   # algbw over the gathered output bytes
-        algbw = payload / dt / 1e9
-        busbw = algbw * factor
-        row = {"elems": real_elems, "bytes": payload, "time_us": dt * 1e6,
-               "algbw_GBps": round(algbw, 3), "busbw_GBps": round(busbw, 3),
-               "n_slots": n}
-        results.append(row)
-        print(json.dumps(row), flush=True)
+            payload = real_elems * bytes_per
+            if args.collective == "allgather":
+                payload *= n   # algbw over the gathered output bytes
+            algbw = payload / dt / 1e9
+            busbw = algbw * factor
+            row = {"elems": real_elems, "bytes": payload,
+                   "time_us": dt * 1e6,
+                   "algbw_GBps": round(algbw, 3),
+                   "busbw_GBps": round(busbw, 3), "n_slots": n}
+            if path:
+                row["path"] = path
+            results.append(row)
+            print(json.dumps(row), flush=True)
         elems *= 4
 
-    peak = max(r["busbw_GBps"] for r in results)
+    two_rows = [r for r in results if r.get("path") == "two_phase"]
+    peak_rows = two_rows if args.two_phase else results
+    peak = max(r["busbw_GBps"] for r in peak_rows)
     summary = {"metric": metric, "value": peak,
-               "unit": "GB/s", "sizes_swept": len(results),
+               "unit": "GB/s", "sizes_swept": len(peak_rows),
                "collective": args.collective,
                "max_elems": results[-1]["elems"],
                "dtype": args.dtype, "n_slots": results[-1]["n_slots"]}
     if args.compression != "none":
         summary["compression"] = args.compression
         summary["vehicle"] = "spmd_gradient_wire"
+    if args.two_phase:
+        single_peak = max(r["busbw_GBps"] for r in results
+                          if r.get("path") == "single_phase")
+        summary.update({
+            "vehicle": "spmd_gradient_wire",
+            "pipeline_depth": args.pipeline_depth,
+            "bench_buckets": nbuckets,
+            "single_phase_busbw_peak": single_peak,
+            "two_phase_vs_single": round(peak / single_peak, 3)
+            if single_peak else None,
+        })
     print(json.dumps(summary))
     if args.out:
         with open(args.out, "w") as f:

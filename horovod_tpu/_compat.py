@@ -17,6 +17,41 @@ except ImportError:  # older jax: experimental module with check_rep
                           out_specs=out_specs, check_rep=check)
 
 
+def enable_x64(new_val: bool = True):
+    """64-bit-mode context manager: ``jax.enable_x64`` on jax versions
+    that export it, else ``jax.experimental.enable_x64`` (same
+    semantics)."""
+    import jax
+
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(new_val)
+    from jax.experimental import enable_x64 as _enable_x64
+
+    return _enable_x64(new_val)
+
+
+def axis_size(axis: str) -> int:
+    """Static width of a named mesh axis inside an SPMD region.
+
+    ``jax.lax.axis_size`` only exists on newer jax; older versions
+    resolve the width from the abstract mesh (shard_map regions) or, as
+    a last resort, the constant-psum folding trick (``psum(1, axis)``
+    is evaluated statically)."""
+    import jax
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+        shape = getattr(mesh, "shape", None) or {}
+        if axis in shape:
+            return int(shape[axis])
+    except Exception:
+        pass
+    return lax.psum(1, axis)
+
+
 def _resolve_tracer():
     """jax.core.Tracer's home keeps moving (jax.core is deprecated as a
     public namespace); resolve it once, falling back through the known

@@ -205,7 +205,8 @@ def _apply_cache_capacity(capacity: Optional[int]) -> None:
             capacity)
         capacity = None
     for name in ("_allreduce_fn", "_grouped_allreduce_fn", "_allgather_fn",
-                 "_broadcast_fn", "_alltoall_fn", "_reducescatter_fn"):
+                 "_broadcast_fn", "_alltoall_fn", "_reducescatter_fn",
+                 "_grouped_reducescatter_fn"):
         fn = getattr(_c, name)
         wrapped = getattr(fn, "__wrapped__", None)
         if wrapped is None:
@@ -230,9 +231,14 @@ def _maybe_build_parameter_manager(cfg):
     reduction).  With ``HOROVOD_HIERARCHICAL_ALLREDUCE=1`` in a world
     of >= 4 slots the GP therefore searches 2-D
     (fusion_threshold x hierarchical_inner_size); otherwise it tunes
-    the threshold alone.  Both knobs are applied at the re-jit
-    boundary (the next-cycle application point of the reference); see
-    ``optim/autotune.py`` and ``_apply_autotuned_knobs``."""
+    the threshold alone.  With ``HVD_TPU_TWO_PHASE_ALLREDUCE=1`` the
+    search additionally spans the two-phase wire knobs: ``two_phase``
+    (a 1/2-valued on/off axis — the GP is free to discover that the
+    monolithic allreduce wins) and ``pipeline_depth`` (buckets in
+    flight, snapped to an integer in [1, 8]).  All knobs are applied at
+    the re-jit boundary (the next-cycle application point of the
+    reference); see ``optim/autotune.py`` and
+    ``_apply_autotuned_knobs``."""
     if not cfg.autotune:
         return None
     import dataclasses
@@ -244,6 +250,16 @@ def _maybe_build_parameter_manager(cfg):
     initial = {}
     size = _state.mesh.size if _state.mesh is not None else 1
     joint = cfg.hierarchical_allreduce and size >= 4
+    joint_two_phase = cfg.two_phase_allreduce and size > 1
+    if joint_two_phase:
+        # On/off rides the same log2 machinery as every other knob:
+        # points round to 1 (off) or 2 (on); proposals snap at the
+        # apply boundary like the hierarchical inner width does.
+        knobs["two_phase"] = (1, 2)
+        initial["two_phase"] = 2
+        knobs["pipeline_depth"] = (1, _MAX_PIPELINE_DEPTH)
+        initial["pipeline_depth"] = min(max(1, cfg.pipeline_depth),
+                                        _MAX_PIPELINE_DEPTH)
     if joint:
         # log2 search over [1, size]; proposals snap to the nearest
         # divisor of the slot count (1 and size both mean "flat"
@@ -294,6 +310,12 @@ def _maybe_build_parameter_manager(cfg):
             int(round(start_vals["hierarchical_inner_size"])), size)
         _state.config = dataclasses.replace(
             _state.config, hierarchical_inner_size=start_inner)
+    if joint_two_phase:
+        # Same invariant for the two-phase knobs: the live config must
+        # equal the clamped start point the first windows run.
+        _state.config = dataclasses.replace(
+            _state.config,
+            pipeline_depth=int(round(start_vals["pipeline_depth"])))
     logger.info(
         "autotune enabled: tuning %s, %d warmup + %d scored windows "
         "of %d steps%s",
@@ -302,6 +324,11 @@ def _maybe_build_parameter_manager(cfg):
         cfg.autotune_steps_per_sample,
         f", log={cfg.autotune_log}" if cfg.autotune_log else "")
     return pm
+
+
+# Pipeline-depth search ceiling: past ~8 buckets in flight the transient
+# shard buffers outweigh any remaining overlap.
+_MAX_PIPELINE_DEPTH = 8
 
 
 def _nearest_divisor(value: int, size: int) -> int:
@@ -330,19 +357,35 @@ def _apply_autotuned_knobs(values) -> dict:
     the new knob values.  Callers must rebuild (re-jit) their train
     step afterwards — trace-time reads of ``config()`` pick the new
     values up on the next trace.  Returns the values as actually
-    applied (the hierarchical inner width snaps to the nearest divisor
-    of the slot count)."""
+    applied, keyed by KNOB name (the hierarchical inner width snaps to
+    the nearest divisor of the slot count; ``pipeline_depth`` snaps to
+    an int in [1, 8]; ``two_phase`` snaps to its 1=off / 2=on lattice) —
+    the caller re-points the manager at these, so keys must match
+    ``pm.knob_names`` even where the Config field is spelled
+    differently (``two_phase`` → ``two_phase_allreduce``)."""
     import dataclasses
 
     st = _require_init()
-    updates = {}
+    updates = {}   # Config field names
+    applied = {}   # knob names (ParameterManager space)
     if "fusion_threshold" in values:
-        updates["fusion_threshold"] = int(values["fusion_threshold"])
+        v = int(values["fusion_threshold"])
+        updates["fusion_threshold"] = applied["fusion_threshold"] = v
     if "hierarchical_inner_size" in values:
-        updates["hierarchical_inner_size"] = _nearest_divisor(
+        v = _nearest_divisor(
             int(round(values["hierarchical_inner_size"])), st.mesh.size)
+        updates["hierarchical_inner_size"] = v
+        applied["hierarchical_inner_size"] = v
+    if "two_phase" in values:
+        snapped = 2 if values["two_phase"] >= 1.5 else 1
+        updates["two_phase_allreduce"] = snapped == 2
+        applied["two_phase"] = snapped
+    if "pipeline_depth" in values:
+        v = min(max(1, int(round(values["pipeline_depth"]))),
+                _MAX_PIPELINE_DEPTH)
+        updates["pipeline_depth"] = applied["pipeline_depth"] = v
     st.config = dataclasses.replace(st.config, **updates)
-    return updates
+    return applied
 
 
 def _maybe_start_cross_monitor(cfg):
@@ -431,7 +474,8 @@ def shutdown() -> None:
         from .ops import collectives as _c
 
         for fn in (_c._allreduce_fn, _c._grouped_allreduce_fn, _c._allgather_fn,
-                   _c._broadcast_fn, _c._alltoall_fn, _c._reducescatter_fn):
+                   _c._broadcast_fn, _c._alltoall_fn, _c._reducescatter_fn,
+                   _c._grouped_reducescatter_fn):
             fn.cache_clear()
         if _state.parameter_manager is not None:
             _state.parameter_manager.close()

@@ -21,6 +21,12 @@ from typing import Optional
 _TRUE = {"1", "true", "yes", "on"}
 _FALSE = {"0", "false", "no", "off", ""}
 
+# α–β cost-model defaults, shared with the planner's pre-init fallbacks
+# (ops/fusion.py) so a retune here cannot diverge the phase decisions
+# between initialized and uninitialized entry points.
+DEFAULT_COST_ALPHA_US = 10.0
+DEFAULT_COST_BETA_GBPS = 100.0
+
 
 def _env(name: str, default: Optional[str] = None) -> Optional[str]:
     """Look up ``HOROVOD_<name>`` then ``HVD_TPU_<name>``."""
@@ -83,6 +89,14 @@ class Config:
     fusion_threshold: int = 64 * 1024 * 1024  # bytes; HOROVOD_FUSION_THRESHOLD
     cycle_time_ms: float = 1.0                # HOROVOD_CYCLE_TIME (latency knob)
 
+    # --- two-phase bucket-pipelined allreduce (no reference analogue;
+    #     the phase-decomposed, schedule-aware collectives of the
+    #     "Collective Communication for 100k+ GPUs" line) ---
+    two_phase_allreduce: bool = False         # HVD_TPU_TWO_PHASE_ALLREDUCE
+    pipeline_depth: int = 2                   # HVD_TPU_PIPELINE_DEPTH (buckets in flight)
+    cost_alpha_us: float = DEFAULT_COST_ALPHA_US    # HVD_TPU_COST_ALPHA_US (per-collective launch latency)
+    cost_beta_gbps: float = DEFAULT_COST_BETA_GBPS  # HVD_TPU_COST_BETA_GBPS (per-hop wire bandwidth)
+
     # --- collectives ---
     hierarchical_allreduce: bool = False      # HOROVOD_HIERARCHICAL_ALLREDUCE
     hierarchical_allgather: bool = False      # HOROVOD_HIERARCHICAL_ALLGATHER (no-op: warns)
@@ -127,6 +141,11 @@ class Config:
         return Config(
             fusion_threshold=_env_int("FUSION_THRESHOLD", 64 * 1024 * 1024),
             cycle_time_ms=_env_float("CYCLE_TIME", 1.0),
+            two_phase_allreduce=_env_bool("TWO_PHASE_ALLREDUCE", False),
+            pipeline_depth=_env_int("PIPELINE_DEPTH", 2),
+            cost_alpha_us=_env_float("COST_ALPHA_US", DEFAULT_COST_ALPHA_US),
+            cost_beta_gbps=_env_float("COST_BETA_GBPS",
+                                      DEFAULT_COST_BETA_GBPS),
             hierarchical_allreduce=_env_bool("HIERARCHICAL_ALLREDUCE", False),
             hierarchical_allgather=_env_bool("HIERARCHICAL_ALLGATHER", False),
             batch_d2d_memcopies=_env_bool("BATCH_D2D_MEMCOPIES", True),

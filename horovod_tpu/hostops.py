@@ -49,10 +49,10 @@ def x64_if(*dtypes):
     """64-bit transport context: JAX downcasts f64/i64 to 32 bits unless
     x64 mode is on (the reference's MPI/NCCL path is exact for these, so
     match it).  No-op for 32-bit-or-narrower wires."""
-    import jax
-
     if any(np.dtype(d).itemsize == 8 for d in dtypes):
-        return jax.enable_x64(True)
+        from ._compat import enable_x64
+
+        return enable_x64(True)
     return contextlib.nullcontext()
 
 
@@ -479,6 +479,54 @@ def reducescatter(value: np.ndarray, *, op: str = Sum, process_set=None,
     # so the core's op handling is already process-correct here.  Output
     # rows are indexed by global slot: read this process's own head slot.
     return row_from_sharded(raw, heads[rank_])
+
+
+def grouped_reducescatter_async(values: Sequence[np.ndarray], *,
+                                op: str = Sum, process_set=None,
+                                name: str = "grouped_reducescatter"
+                                ) -> HostHandle:
+    """Fused process-level reducescatter of several host arrays — ONE
+    slot-tier dispatch for the whole set (grouped_reducescatter_slots:
+    one compiled program, one reduction per dtype bucket) instead of the
+    per-tensor loop the tf/torch shims used to run; resolves to the
+    list of this process's shards."""
+    P_, rank_, L = world()
+    ranks = member_ranks(process_set)
+    members = ranks if ranks is not None else list(range(P_))
+    n = len(members)
+    heads = head_slots()
+    ps = slot_set([heads[r] for r in members])
+    for i, value in enumerate(values):
+        if value.shape[0] % n != 0:
+            raise ValueError(f"{name}[{i}]: dim 0 ({value.shape[0]}) not "
+                             f"divisible by worker count {n}")
+    blocks = []
+    for value in values:
+        block = np.zeros((L,) + value.shape, dtype=value.dtype)
+        block[0] = value
+        blocks.append(lift_local(block))
+    with x64_if(*[b.dtype for b in blocks]):
+        raws = C.grouped_reducescatter_slots(blocks, op=op, process_set=ps,
+                                             name=name)
+    require_member(ranks, name)
+
+    def finish():
+        # Output rows are indexed by global slot: read this process's
+        # own head slot (see the alltoall note on heads[me] vs
+        # heads[rank_]).
+        return [row_from_sharded(raw, heads[rank_]) for raw in raws]
+
+    return HostHandle(raws, finish, name)
+
+
+def grouped_reducescatter(values: Sequence[np.ndarray], *, op: str = Sum,
+                          process_set=None,
+                          name: str = "grouped_reducescatter"
+                          ) -> List[np.ndarray]:
+    """Synchronous form of :func:`grouped_reducescatter_async`."""
+    return grouped_reducescatter_async(values, op=op,
+                                       process_set=process_set,
+                                       name=name).wait()
 
 
 # --- barrier / join ----------------------------------------------------------

@@ -32,6 +32,8 @@ _SIGNATURES = {
     "hvd_tpu_native_abi_version": (c_i64, []),
     "hvd_tpu_plan_buckets": (c_i64, [ctypes.POINTER(c_i64), c_i64, c_i64,
                                      ctypes.POINTER(c_i32)]),
+    "hvd_tpu_plan_two_phase": (c_i64, [ctypes.POINTER(c_i64), c_i64, c_i64,
+                                       c_dbl, c_dbl, ctypes.POINTER(c_i8)]),
     # controller
     "hvd_ctrl_create": (c_void, [c_i32, c_i64, c_i64]),
     "hvd_ctrl_destroy": (None, [c_void]),

@@ -36,3 +36,25 @@ def plan_buckets(sizes_bytes: Sequence[int], threshold: int) -> List[List[int]]:
     for i in range(n):
         buckets[out[i]].append(i)
     return buckets
+
+
+def plan_two_phase_flags(bucket_bytes: Sequence[int], world_size: int,
+                         alpha_us: float, beta_gbps: float) -> List[bool]:
+    """Native α–β phase decision per bucket (same contract as
+    ``ops.fusion.plan_two_phase_flags``; equivalence is property-tested
+    in tests/test_fusion.py)."""
+    lib = bindings.load()
+    if lib is None:
+        from ..ops.fusion import plan_two_phase_flags as _py
+
+        return _py(bucket_bytes, world_size, alpha_us, beta_gbps)
+    n = len(bucket_bytes)
+    sizes_arr = (ctypes.c_int64 * n)(*[int(b) for b in bucket_bytes])
+    flags = (ctypes.c_int8 * n)()
+    rc = lib.hvd_tpu_plan_two_phase(sizes_arr, n, int(world_size),
+                                    float(alpha_us), float(beta_gbps), flags)
+    if rc < 0:
+        raise ValueError(
+            f"Invalid schedule planner input (n={n}, world={world_size}, "
+            f"alpha_us={alpha_us}, beta_gbps={beta_gbps})")
+    return [bool(flags[i]) for i in range(n)]

@@ -21,6 +21,44 @@
 
 extern "C" {
 
+// Two-phase decision per bucket from the alpha-beta cost model (mirrors
+// ops/fusion.py:plan_two_phase_flags exactly; equivalence tested in
+// tests/test_fusion.py): a bucket decomposes into reduce-scatter +
+// all-gather when its payload clears the crossover
+// alpha_us * beta_gbps * 1e3 * world_size bytes — i.e. the per-hop
+// shard transfer time bytes/(n*beta) is at least the extra phase launch
+// latency alpha.  Writes flags[i] in {0, 1}; returns the number of
+// decomposed buckets, or -1 on invalid input.
+int64_t hvd_tpu_plan_two_phase(const int64_t* bucket_bytes,
+                               int64_t n_buckets, int64_t world_size,
+                               double alpha_us, double beta_gbps,
+                               int8_t* flags) {
+  if (n_buckets < 0 || (n_buckets > 0 && (!bucket_bytes || !flags)) ||
+      alpha_us < 0 || beta_gbps <= 0) {
+    return -1;
+  }
+  int64_t decomposed = 0;
+  if (world_size <= 1) {
+    for (int64_t i = 0; i < n_buckets; ++i) flags[i] = 0;
+    return 0;
+  }
+  const double crossover_d =
+      alpha_us * beta_gbps * 1e3 * static_cast<double>(world_size);
+  // Truncate exactly like the Python planner's int() — ranks that fell
+  // back to Python (native build failure) must still compute identical
+  // flags at the crossover boundary.  Past int64 range nothing can
+  // clear the bar.
+  const bool unreachable = crossover_d >= 9.2e18;
+  const int64_t crossover =
+      unreachable ? 0 : static_cast<int64_t>(crossover_d);
+  for (int64_t i = 0; i < n_buckets; ++i) {
+    if (bucket_bytes[i] < 0) return -1;
+    flags[i] = (!unreachable && bucket_bytes[i] >= crossover) ? 1 : 0;
+    decomposed += flags[i];
+  }
+  return decomposed;
+}
+
 // Writes bucket_ids[i] = bucket index of tensor i (buckets are
 // consecutive, starting at 0). Returns the number of buckets, or -1 on
 // invalid input.

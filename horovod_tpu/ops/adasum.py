@@ -41,6 +41,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from .._compat import axis_size as _axis_size
+
 
 def _combine(a: jax.Array, b: jax.Array) -> jax.Array:
     """The symmetric Adasum pairwise rule, numerically guarded."""
@@ -78,7 +80,7 @@ def adasum_allreduce(x: jax.Array, axis: str = "hvd",
             raise ValueError("Adasum process-set groups must be equal-sized")
         n = sizes.pop()
     else:
-        n = lax.axis_size(axis)
+        n = _axis_size(axis)
     if n <= 1:
         return x
     p = 1 << (n.bit_length() - 1)  # largest power of two <= n
@@ -104,7 +106,7 @@ def adasum_allreduce(x: jax.Array, axis: str = "hvd",
     if r:
         # Post-scatter: partner e returns the converged result to the
         # extra member p+e, which overwrites (not combines) its value.
-        axis_n = lax.axis_size(axis)
+        axis_n = _axis_size(axis)
         extra = np.zeros(axis_n, dtype=bool)
         if groups is None:
             post = [(e, p + e) for e in range(r)]

@@ -81,7 +81,9 @@ def x64_transport(*tensors):
         dt = np.dtype(dt)
         if (dt.kind in "fiu" and dt.itemsize == 8) or (
                 dt.kind == "c" and dt.itemsize == 16):
-            return jax.enable_x64(True)
+            from .._compat import enable_x64
+
+            return enable_x64(True)
     return contextlib.nullcontext()
 
 
@@ -378,14 +380,44 @@ def allreduce_slots(tensor, *, op: str = Average, process_set=None,
 
 
 
+def _scatter_gather_tail(r: jax.Array, gm) -> jax.Array:
+    """Force the replicated reduction result through a slot-sharded
+    intermediate: under the auto partitioner the sharding constraint
+    makes XLA lower the reduction as **reduce-scatter** (each slot owns
+    one shard) and the replicated output as **all-gather** — the
+    two-phase decomposition, compiler-scheduled so consecutive buckets'
+    phases can overlap."""
+    size = gm.size
+    flat = r.reshape(-1)
+    pad = (-flat.size) % size
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    shards = flat.reshape(size, -1)
+    shards = jax.lax.with_sharding_constraint(shards, gm.shard_leading())
+    full = shards.reshape(-1)
+    if pad:
+        full = full[: r.size]
+    return full.reshape(r.shape)
+
+
 @functools.lru_cache(maxsize=512)
 def _grouped_allreduce_fn(op: str, members: Optional[Tuple[int, ...]],
                           prescale: float, postscale: float, compression,
-                          threshold: int, nleaves: int):
+                          threshold: int, nleaves: int,
+                          two_phase: bool = False,
+                          crossover_bytes: int = 0):
     def fn(xs):
+        gm = _st().mesh
+
         def collective(stack):  # [size, N] fused bucket -> [N]
-            return _reduce_stack(stack, op, members, prescale, postscale,
-                                 compression)
+            r = _reduce_stack(stack, op, members, prescale, postscale,
+                              compression)
+            # α–β cost gate: only bandwidth-bound buckets pay the extra
+            # phase; latency-bound stragglers stay single-launch.
+            payload = r.size * np.dtype(r.dtype).itemsize
+            if two_phase and r.size >= gm.size and payload >= crossover_bytes:
+                r = _scatter_gather_tail(r, gm)
+            return r
 
         # Fuse along the feature axis, keeping the slot axis (lead_ndim=1):
         # each leaf [size, *S_i] flattens to [size, n_i]; one reduction per
@@ -420,11 +452,18 @@ def grouped_allreduce_slots(tensors: Sequence[Any], *, op: str = Average,
                                     prescale_factor=prescale_factor,
                                     postscale_factor=postscale_factor,
                                     name=f"{name}[{i}]") for i, x in enumerate(xs)]
+        # Two-phase decision rides the compiled-program cache key: a
+        # config flip (autotune re-proposal) dispatches a different
+        # cached executable instead of retracing in place.
+        crossover = fusion_mod.two_phase_crossover_bytes(
+            st.mesh.size, st.config.cost_alpha_us, st.config.cost_beta_gbps)
         fn = _grouped_allreduce_fn(op, _members_key(process_set),
                                    float(prescale_factor),
                                    float(postscale_factor),
                                    compression, st.config.fusion_threshold,
-                                   len(xs))
+                                   len(xs),
+                                   bool(st.config.two_phase_allreduce),
+                                   crossover)
         with st.timeline.activity(name, "EXECUTE",
                                   {"op": op, "ntensors": len(xs)}):
             return list(fn(xs))
@@ -588,6 +627,92 @@ def reducescatter_slots(tensor, *, op: str = Sum, process_set=None,
             return fn(x)
 
 
+
+
+@functools.lru_cache(maxsize=128)
+def _grouped_reducescatter_fn(op: str, members: Optional[Tuple[int, ...]],
+                              size: int, threshold: int, nleaves: int):
+    """Fused grouped reducescatter: one reduction per dtype bucket
+    instead of one dispatch per tensor (the tentpole's RS decomposition
+    applied to the host tier — fixes the per-tensor Python loop the
+    tf/torch shims had).  Leaves normalize to ``[size, n, cols_i]`` so a
+    bucket's concat along the last axis keeps every leaf's n-chunk
+    scatter structure intact."""
+    idx = np.array(members) if members is not None else None
+    n = len(idx) if idx is not None else size
+
+    def fn(xs):  # tuple of [size, n*k_i, *T_i] -> tuple of [size, k_i, *T_i]
+        out = [None] * len(xs)
+        by_dtype: dict = {}
+        for i, x in enumerate(xs):
+            by_dtype.setdefault(jnp.asarray(x).dtype, []).append(i)
+        for dtype, idxs in by_dtype.items():
+            # Per-slot wire bytes of each leaf (the fusion-threshold
+            # discipline of ops/fusion.py).
+            sizes = [int(np.prod(xs[i].shape[1:])) * dtype.itemsize
+                     for i in idxs]
+            for bucket in fusion_mod.plan_buckets(sizes, threshold):
+                bmembers = [idxs[j] for j in bucket]
+                cols = [int(np.prod(xs[i].shape[1:])) // n for i in bmembers]
+                flats = [xs[i].reshape(size, n, -1) for i in bmembers]
+                fused = (jnp.concatenate(flats, axis=2) if len(flats) > 1
+                         else flats[0])
+                if idx is None:
+                    r = jnp.sum(fused, axis=0)
+                    if op == Average:
+                        r = r / size
+                else:
+                    r = jnp.sum(fused[idx], axis=0)
+                    if op == Average:
+                        r = r / n
+                offset = 0
+                for i, ncols in zip(bmembers, cols):
+                    piece = jax.lax.dynamic_slice_in_dim(r, offset, ncols,
+                                                         axis=1)
+                    shard_shape = (n, xs[i].shape[1] // n) + xs[i].shape[2:]
+                    piece = piece.reshape(shard_shape)
+                    if idx is None:
+                        out[i] = piece
+                    else:
+                        out_shape = (size,) + shard_shape[1:]
+                        # piece.dtype (not x.dtype): integer Average
+                        # promotes to float, matching _reducescatter_fn.
+                        out[i] = jnp.zeros(out_shape,
+                                           dtype=piece.dtype).at[idx].set(piece)
+                    offset += ncols
+        return tuple(out)
+
+    gm = _st().mesh
+    return jax.jit(fn, out_shardings=(gm.shard_leading(),) * nleaves)
+
+
+def grouped_reducescatter_slots(tensors: Sequence[Any], *, op: str = Sum,
+                                process_set=None,
+                                name: str = "grouped_reducescatter"
+                                ) -> List[Any]:
+    """Slot-tier core: fused reducescatter of a list of tensors as one
+    logical operation (reference: ``hvd.grouped_reducescatter``) — one
+    compiled program, one reduction per dtype bucket, instead of the
+    per-tensor dispatch loop."""
+    if op not in (Sum, Average):
+        raise ValueError(f"reducescatter supports Sum/Average, got {op!r}")
+    st = _st()
+    _heartbeat(name)
+    with x64_transport(*tensors):
+        xs = tuple(_lift(t, f"{name}[{i}]") for i, t in enumerate(tensors))
+        members = _members_key(process_set)
+        n = len(members) if members else st.mesh.size
+        for i, x in enumerate(xs):
+            if x.ndim < 2 or x.shape[1] % n != 0:
+                raise ValueError(
+                    f"{name}[{i}]: per-slot contributions must have dim-0 "
+                    f"divisible by group size {n}; got per-slot shape "
+                    f"{tuple(x.shape[1:])}")
+        fn = _grouped_reducescatter_fn(op, members, st.mesh.size,
+                                       st.config.fusion_threshold, len(xs))
+        with st.timeline.activity(name, "EXECUTE",
+                                  {"op": op, "ntensors": len(xs)}):
+            return list(fn(xs))
 
 
 def barrier(process_set=None, name: str = "barrier") -> None:
@@ -815,17 +940,24 @@ def reducescatter_async(tensor, **kwargs) -> Handle:
 
 def grouped_reducescatter(tensors, *, op: str = Sum, process_set=None,
                           name: str = "grouped_reducescatter"):
-    return [reducescatter(t, op=op, process_set=process_set,
-                          name=f"{name}[{i}]") for i, t in enumerate(tensors)]
+    """Reference: ``hvd.grouped_reducescatter`` — one fused dispatch for
+    the whole tensor set (single compiled program with one reduction per
+    dtype bucket), not a per-tensor loop."""
+    return grouped_reducescatter_async(tensors, op=op,
+                                       process_set=process_set,
+                                       name=name).result()
 
 
 def grouped_reducescatter_async(tensors, *, op: str = Sum, process_set=None,
                                 name: str = "grouped_reducescatter") -> Handle:
     """Reference: ``hvd.grouped_reducescatter_async``."""
-    return _GroupHandle(
-        [reducescatter_async(t, op=op, process_set=process_set,
-                             name=f"{name}[{i}]")
-         for i, t in enumerate(tensors)], name)
+    if all(_multicontroller_value(t) for t in tensors) \
+            and jax.process_count() > 1:
+        return _host().grouped_reducescatter_async(
+            [np.asarray(t) for t in tensors], op=op,
+            process_set=process_set, name=name)
+    return Handle(grouped_reducescatter_slots(
+        tensors, op=op, process_set=process_set, name=name), name)
 
 
 def join() -> int:

@@ -51,6 +51,17 @@ class Compressor:
         red = spmd.reducescatter(wire, op=op, axis=axis, groups=groups)
         return cls.decompress(red, ctx)
 
+    @classmethod
+    def spmd_allgather(cls, x, *, axis, groups=None):
+        """All-gather phase of the two-phase (RS→AG) allreduce wire:
+        compress the shard, gather everyone's on the narrow wire,
+        decompress once (int8 overrides with its quantized transport)."""
+        from . import spmd
+
+        wire, ctx = cls.compress(x)
+        full = spmd.allgather(wire, axis=axis, groups=groups, tiled=True)
+        return cls.decompress(full, ctx)
+
 
 class NoneCompressor(Compressor):
     """Reference: ``Compression.none``."""
@@ -159,6 +170,20 @@ class Int8Compressor(Compressor):
             return int8_reducescatter(x, op=op, axis=axis, groups=groups)
         return super().spmd_reducescatter(x, op=op, axis=axis,
                                           groups=groups)
+
+    @classmethod
+    def spmd_allgather(cls, x, *, axis, groups=None):
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            from .quantization import int8_allgather
+
+            # Real quantized AG transport (phase 3 of the int8 wire);
+            # the stack-tier compress() simulation must NOT feed the
+            # base path here — it would inject noise without shrinking
+            # any wire.
+            return int8_allgather(x, axis=axis, groups=groups)
+        from . import spmd
+
+        return spmd.allgather(x, axis=axis, groups=groups, tiled=True)
 
 
 class Compression:

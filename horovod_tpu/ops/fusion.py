@@ -15,15 +15,33 @@ into one flat vector, applies one collective per bucket, and splits back.
 XLA fuses the concat/split into the collective's pre/post memcpys — the
 same batched-memcpy trick as the reference's fusion-buffer kernels, but
 compiler-generated, with no persistent scratch buffer to manage.
+
+Two-phase bucket pipelining (beyond the reference; the phase-decomposed,
+schedule-aware collectives of "Collective Communication for 100k+ GPUs",
+PAPERS.md): a bandwidth-bound bucket's single allreduce decomposes into
+**reduce-scatter → all-gather**, and consecutive buckets' phases are
+emitted software-pipelined — bucket *i*'s all-gather interleaved with
+bucket *i+pipeline_depth-1*'s reduce-scatter inside one traced program —
+so XLA's async collective scheduler can keep both phases on the wire at
+once.  Which buckets decompose is decided by an **α–β cost model**
+(per-collective launch latency α, per-hop bandwidth β): a bucket whose
+per-hop wire time ``bytes/(n·β)`` clears the extra phase launch α is
+bandwidth-bound and splits; latency-bound stragglers stay single-phase.
+``plan_bucket_schedule`` emits the whole plan (bucket membership +
+per-bucket phase decision + interleaved emission order) deterministically
+from static sizes, so every rank agrees without negotiation.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, List, Sequence, Tuple
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..config import DEFAULT_COST_ALPHA_US, DEFAULT_COST_BETA_GBPS
 
 
 def plan_buckets(sizes_bytes: Sequence[int], threshold: int) -> List[List[int]]:
@@ -64,6 +82,156 @@ def plan_buckets_py(sizes_bytes: Sequence[int], threshold: int) -> List[List[int
     if current:
         buckets.append(current)
     return buckets
+
+
+# --- α–β cost model + schedule planning --------------------------------------
+
+def phase_cost_us(nbytes: int, n: int, alpha_us: float,
+                  beta_gbps: float) -> float:
+    """Modeled wall time of ONE phase (reduce-scatter or all-gather) of a
+    ring collective over ``n`` participants: ``(n-1)`` hops of launch
+    latency α plus shard transfer at bandwidth β."""
+    if n <= 1:
+        return 0.0
+    beta_bytes_per_us = beta_gbps * 1e3  # GB/s == 10^9 B/s == 10^3 B/µs
+    return (n - 1) * (alpha_us + (nbytes / n) / beta_bytes_per_us)
+
+
+def allreduce_cost_us(nbytes: int, n: int, alpha_us: float,
+                      beta_gbps: float) -> float:
+    """Modeled wall time of a monolithic ring allreduce (the RS+AG wire
+    cost fused into one launch): ``2(n-1)`` hops."""
+    return 2.0 * phase_cost_us(nbytes, n, alpha_us, beta_gbps)
+
+
+def two_phase_crossover_bytes(n: int, alpha_us: float,
+                              beta_gbps: float) -> int:
+    """Bucket payload above which phase decomposition pays: splitting
+    costs one extra launch (α per hop), which the pipeline earns back
+    only when the per-hop shard transfer time ``bytes/(n·β)`` is at
+    least α — i.e. the bucket is bandwidth-bound."""
+    if n <= 1:
+        return 1 << 62  # nothing to decompose in a world of one
+    return int(alpha_us * beta_gbps * 1e3 * n)
+
+
+def plan_two_phase_flags(bucket_bytes: Sequence[int], n: int,
+                         alpha_us: float, beta_gbps: float) -> List[bool]:
+    """Per-bucket phase decision from the α–β model (True = decompose
+    into reduce-scatter + all-gather)."""
+    crossover = two_phase_crossover_bytes(n, alpha_us, beta_gbps)
+    return [b >= crossover for b in bucket_bytes]
+
+
+def _dispatch_two_phase_flags(payloads: Sequence[int], world_size: int,
+                              alpha_us: float,
+                              beta_gbps: float) -> List[bool]:
+    """Same contract as :func:`plan_two_phase_flags`; delegates to the
+    native planner when built and not disabled (mirroring
+    :func:`plan_buckets`' dispatch)."""
+    use_native = True
+    from .. import basics
+
+    if basics.is_initialized():
+        use_native = basics.config().use_native_planner
+    if use_native:
+        try:
+            from ..native import planner as _native
+
+            if _native.available():
+                return _native.plan_two_phase_flags(
+                    list(payloads), world_size, alpha_us, beta_gbps)
+        except ImportError:
+            pass
+    return plan_two_phase_flags(payloads, world_size, alpha_us, beta_gbps)
+
+
+def plan_pipeline_order(two_phase_flags: Sequence[bool],
+                        pipeline_depth: int) -> List[Tuple[str, int]]:
+    """Software-pipelined emission order over buckets: ``("rs", i)`` /
+    ``("ag", i)`` for decomposed buckets, ``("ar", i)`` for single-phase
+    ones.  At most ``pipeline_depth`` reduce-scatters are in flight
+    before the oldest bucket's all-gather is emitted; depth 1 degenerates
+    to strictly sequential rs/ag pairs.  Deterministic in its inputs —
+    every rank traces the identical collective order (the SPMD
+    dispatch-order contract)."""
+    depth = max(1, int(pipeline_depth))
+    order: List[Tuple[str, int]] = []
+    inflight: List[int] = []
+    for i, tp in enumerate(two_phase_flags):
+        if tp:
+            order.append(("rs", i))
+            inflight.append(i)
+            if len(inflight) >= depth:
+                order.append(("ag", inflight.pop(0)))
+        else:
+            order.append(("ar", i))
+    while inflight:
+        order.append(("ag", inflight.pop(0)))
+    return order
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketSchedule:
+    """A complete fusion plan: bucket membership, per-bucket phase
+    decision, interleaved emission order, and the modeled makespan."""
+
+    buckets: Tuple[Tuple[int, ...], ...]
+    two_phase: Tuple[bool, ...]
+    order: Tuple[Tuple[str, int], ...]
+    est_cost_us: float
+
+
+def estimate_schedule_cost_us(bucket_bytes: Sequence[int],
+                              two_phase_flags: Sequence[bool], n: int,
+                              alpha_us: float, beta_gbps: float) -> float:
+    """Modeled makespan of a pipelined schedule: single-phase buckets
+    serialize; decomposed buckets overlap bucket *i*'s all-gather with
+    bucket *i+1*'s reduce-scatter (steady state runs at the slower of
+    the two phases per stage)."""
+    total = 0.0
+    prev_ag = 0.0
+    for nbytes, tp in zip(bucket_bytes, two_phase_flags):
+        if not tp:
+            total += prev_ag + allreduce_cost_us(nbytes, n, alpha_us,
+                                                 beta_gbps)
+            prev_ag = 0.0
+            continue
+        rs = phase_cost_us(nbytes, n, alpha_us, beta_gbps)
+        total += max(rs, prev_ag)   # this RS hides behind the prior AG
+        prev_ag = rs                # AG cost == RS cost in the α–β model
+    return total + prev_ag
+
+
+def plan_bucket_schedule(sizes_bytes: Sequence[int], threshold: int, *,
+                         world_size: int,
+                         alpha_us: float = DEFAULT_COST_ALPHA_US,
+                         beta_gbps: float = DEFAULT_COST_BETA_GBPS,
+                         two_phase: bool = True,
+                         pipeline_depth: int = 2) -> BucketSchedule:
+    """Full schedule-aware plan for one dtype class: greedy byte-bounded
+    buckets (``plan_buckets`` — native-capable), α–β phase decisions and
+    the pipelined emission order.  Pure bookkeeping on static sizes, so
+    every rank computes the identical schedule.  Delegates the
+    flag computation to the native planner when built (same contract;
+    equivalence property-tested in tests/test_native.py style in
+    tests/test_fusion.py)."""
+    buckets = plan_buckets(sizes_bytes, threshold)
+    payloads = [sum(sizes_bytes[i] for i in b) for b in buckets]
+    if two_phase and world_size > 1:
+        flags = _dispatch_two_phase_flags(payloads, world_size, alpha_us,
+                                          beta_gbps)
+    else:
+        flags = [False] * len(buckets)
+    order = plan_pipeline_order(flags, pipeline_depth)
+    cost = estimate_schedule_cost_us(payloads, flags, world_size, alpha_us,
+                                     beta_gbps)
+    return BucketSchedule(
+        buckets=tuple(tuple(b) for b in buckets),
+        two_phase=tuple(flags),
+        order=tuple(order),
+        est_cost_us=cost,
+    )
 
 
 def _native_ffi_ok() -> bool:
@@ -155,6 +323,108 @@ def fused_apply(
     return out
 
 
+def _uniform_group_width(axis: str, groups) -> Optional[int]:
+    """Participant count per reduction group, or None when the groups
+    are ragged (XLA's ReduceScatter/AllGather need uniform replica
+    groups — e.g. a process set's ``[members, complement]`` partition
+    with unequal halves must stay single-phase)."""
+    from .._compat import axis_size
+
+    if not groups:
+        return axis_size(axis)
+    widths = {len(g) for g in groups}
+    if len(widths) != 1:
+        return None
+    return len(groups[0])
+
+
+def fused_two_phase_apply(
+    leaves: Sequence[jax.Array],
+    *,
+    axis: str,
+    op: str,
+    groups,
+    compression,
+    threshold: int,
+    pipeline_depth: int,
+    alpha_us: float,
+    beta_gbps: float,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+) -> List[jax.Array]:
+    """Schedule-aware fused allreduce: buckets whose payload clears the
+    α–β crossover decompose into reduce-scatter → all-gather, emitted in
+    the pipelined order of :func:`plan_pipeline_order` so bucket *i*'s
+    all-gather interleaves with bucket *i+1*'s reduce-scatter in the
+    traced program (XLA's async collective scheduler overlaps them on
+    the wire).  Latency-bound buckets stay single-launch allreduces.
+    Must run inside an SPMD region over ``axis``; numerically equivalent
+    to the single-phase path (same reduction, same compression wire).
+    """
+    n = _uniform_group_width(axis, groups)
+
+    out: List[jax.Array] = [None] * len(leaves)  # type: ignore[list-item]
+    by_dtype: dict = {}
+    for i, leaf in enumerate(leaves):
+        by_dtype.setdefault(jnp.asarray(leaf).dtype, []).append(i)
+
+    # One global bucket list across dtype classes: pipelining is about
+    # wire occupancy, which doesn't care about element type.
+    packed: List[dict] = []
+    for dtype, idxs in by_dtype.items():
+        sizes = [int(np.prod(leaves[i].shape)) * dtype.itemsize
+                 for i in idxs]
+        for bucket in plan_buckets(sizes, threshold):
+            members = [idxs[j] for j in bucket]
+            flats = [leaves[i].reshape(-1) for i in members]
+            fused = (jnp.concatenate(flats) if len(flats) > 1 else flats[0])
+            if prescale_factor != 1.0:
+                fused = fused * prescale_factor
+            packed.append({
+                "members": members,
+                "fused": fused,
+                "cols": [int(np.prod(leaves[i].shape)) for i in members],
+                "bytes": sum(sizes[j] for j in bucket),
+            })
+
+    if n is None or n <= 1:
+        flags = [False] * len(packed)
+    else:
+        flags = _dispatch_two_phase_flags([b["bytes"] for b in packed], n,
+                                          alpha_us, beta_gbps)
+    order = plan_pipeline_order(flags, pipeline_depth)
+
+    shards: dict = {}
+    reduced: dict = {}
+    for kind, bi in order:
+        b = packed[bi]
+        if kind == "ar":
+            reduced[bi] = compression.spmd_allreduce(
+                b["fused"], op=op, axis=axis, groups=groups)
+        elif kind == "rs":
+            x = b["fused"]
+            pad = (-x.size) % n
+            if pad:
+                x = jnp.concatenate([x, jnp.zeros((pad,), x.dtype)])
+            shards[bi] = compression.spmd_reducescatter(
+                x, op=op, axis=axis, groups=groups)
+        else:  # "ag"
+            full = compression.spmd_allgather(shards.pop(bi), axis=axis,
+                                              groups=groups)
+            reduced[bi] = full[: b["fused"].size]
+
+    for bi, b in enumerate(packed):
+        r = reduced[bi]
+        if postscale_factor != 1.0:
+            r = r * postscale_factor
+        offset = 0
+        for i, ncols in zip(b["members"], b["cols"]):
+            piece = jax.lax.dynamic_slice_in_dim(r, offset, ncols, axis=0)
+            out[i] = piece.reshape(leaves[i].shape)
+            offset += ncols
+    return out
+
+
 def fused_allreduce_pytree(
     tree: Any,
     *,
@@ -165,17 +435,46 @@ def fused_allreduce_pytree(
     compression=None,
     prescale_factor: float = 1.0,
     postscale_factor: float = 1.0,
+    two_phase: Optional[bool] = None,
+    pipeline_depth: Optional[int] = None,
 ) -> Any:
     """Fused allreduce of every leaf of a pytree — the gradient hot path
     (reference: fused ``ncclAllReduce`` over the fusion buffer).
 
     Must run inside an SPMD region (``shard_map``) over ``axis``.
+
+    ``two_phase``/``pipeline_depth`` default to the live config
+    (``HVD_TPU_TWO_PHASE_ALLREDUCE`` / ``HVD_TPU_PIPELINE_DEPTH``) at
+    trace time, so the autotuner can flip them at a re-jit boundary.
+    When on, bandwidth-bound buckets ride the pipelined reduce-scatter +
+    all-gather schedule of :func:`fused_two_phase_apply`.
     """
-    from . import spmd
     from .compression import Compression
 
     compression = compression or Compression.none
     leaves, treedef = jax.tree.flatten(tree)
+
+    alpha_us, beta_gbps = DEFAULT_COST_ALPHA_US, DEFAULT_COST_BETA_GBPS
+    from .. import basics
+
+    if basics.is_initialized():
+        cfg = basics.config()
+        if two_phase is None:
+            two_phase = cfg.two_phase_allreduce
+        if pipeline_depth is None:
+            pipeline_depth = cfg.pipeline_depth
+        alpha_us, beta_gbps = cfg.cost_alpha_us, cfg.cost_beta_gbps
+    two_phase = bool(two_phase) if two_phase is not None else False
+    pipeline_depth = int(pipeline_depth) if pipeline_depth else 2
+
+    if two_phase:
+        reduced = fused_two_phase_apply(
+            leaves, axis=axis, op=op, groups=groups,
+            compression=compression, threshold=threshold,
+            pipeline_depth=pipeline_depth, alpha_us=alpha_us,
+            beta_gbps=beta_gbps, prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor)
+        return jax.tree.unflatten(treedef, reduced)
 
     def collective(flat: jax.Array) -> jax.Array:
         x = flat

@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .._compat import axis_size as _axis_size
+
 from . import spmd
 
 _EPS = 1e-30
@@ -55,7 +57,7 @@ def _group_size(axis, groups):
     this into data movement, so heterogeneous group sizes would corrupt
     every group but the first — reject them (ADVICE r3)."""
     if not groups:
-        return lax.axis_size(axis)
+        return _axis_size(axis)
     sizes = {len(g) for g in groups}
     if len(sizes) > 1:
         raise ValueError(

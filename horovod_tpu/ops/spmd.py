@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .._compat import axis_size as _axis_size
+
 Groups = Optional[List[List[int]]]
 
 
@@ -31,7 +33,7 @@ def rank(axis: str = "hvd"):
 
 def size(axis: str = "hvd") -> int:
     """Width of the mesh axis (reference: ``hvd.size()``)."""
-    return lax.axis_size(axis)
+    return _axis_size(axis)
 
 
 def allreduce(x, op: str = "sum", axis: str = "hvd", groups: Groups = None):
@@ -43,7 +45,7 @@ def allreduce(x, op: str = "sum", axis: str = "hvd", groups: Groups = None):
     if op == "sum":
         return lax.psum(x, axis, axis_index_groups=groups)
     if op == "average":
-        n = len(groups[0]) if groups else lax.axis_size(axis)
+        n = len(groups[0]) if groups else _axis_size(axis)
         return lax.psum(x, axis, axis_index_groups=groups) / n
     if op == "min":
         return lax.pmin(x, axis, axis_index_groups=groups)
@@ -85,7 +87,7 @@ def alltoall(x, axis: str = "hvd", groups: Groups = None):
     reference's uniform-splits case.  (Ragged ``splits`` are handled at
     the host tier by padding; see ``collectives.alltoall``.)
     """
-    n = len(groups[0]) if groups else lax.axis_size(axis)
+    n = len(groups[0]) if groups else _axis_size(axis)
     chunks = x.reshape((n, x.shape[0] // n) + x.shape[1:])
     out = lax.all_to_all(chunks, axis, split_axis=0, concat_axis=0,
                          axis_index_groups=groups, tiled=False)
@@ -100,7 +102,7 @@ def reducescatter(x, op: str = "sum", axis: str = "hvd", groups: Groups = None):
         raise ValueError(f"reducescatter supports sum/average, got {op!r}")
     out = lax.psum_scatter(x, axis, axis_index_groups=groups, tiled=True)
     if op == "average":
-        n = len(groups[0]) if groups else lax.axis_size(axis)
+        n = len(groups[0]) if groups else _axis_size(axis)
         out = out / n
     return out
 
@@ -109,6 +111,6 @@ def ppermute_ring(x, axis: str = "hvd", shift: int = 1):
     """Rotate values around the mesh axis ring — the building block for
     ring attention and hand-written ring collectives (no reference
     analogue; NCCL hides its rings)."""
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)

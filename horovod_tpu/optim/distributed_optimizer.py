@@ -64,13 +64,15 @@ def _check_reduce_args(op: str, compression) -> None:
             "compression argument or use op=Average/Sum")
 
 
-def _allreduce_grads(grads, *, op, axis, groups, compression, threshold):
+def _allreduce_grads(grads, *, op, axis, groups, compression, threshold,
+                     two_phase=None, pipeline_depth=None):
     if op == C.Adasum:
         return adasum_pytree(grads, axis=axis, groups=groups)
     spmd_op = "average" if op == C.Average else "sum"
     return fused_allreduce_pytree(
         grads, axis=axis, op=spmd_op, threshold=threshold, groups=groups,
-        compression=compression,
+        compression=compression, two_phase=two_phase,
+        pipeline_depth=pipeline_depth,
     )
 
 
@@ -84,6 +86,8 @@ def DistributedOptimizer(
     process_set=None,
     axis_name: Optional[str] = None,
     fusion_threshold: Optional[int] = None,
+    two_phase: Optional[bool] = None,
+    pipeline_depth: Optional[int] = None,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer with distributed gradient aggregation
     (reference: ``hvd.DistributedOptimizer``).
@@ -96,6 +100,12 @@ def DistributedOptimizer(
     ``backward_passes_per_step`` (aggregate locally for k calls, allreduce
     + apply on the k-th; in between, parameters receive zero updates),
     ``average_aggregated_gradients`` (divide the accumulated sum by k).
+
+    ``two_phase``/``pipeline_depth`` opt the gradient allreduce into the
+    bucket-pipelined reduce-scatter + all-gather schedule
+    (``ops.fusion.fused_two_phase_apply``); None defers to the live
+    config (``HVD_TPU_TWO_PHASE_ALLREDUCE`` / ``HVD_TPU_PIPELINE_DEPTH``)
+    at trace time, so autotune proposals land at re-jit boundaries.
     """
     _check_reduce_args(op, compression)
     if backward_passes_per_step < 1:
@@ -145,6 +155,8 @@ def DistributedOptimizer(
             groups=member_groups if op == C.Adasum else groups,
             compression=compression,
             threshold=_threshold(),
+            two_phase=two_phase,
+            pipeline_depth=pipeline_depth,
         )
         updates, inner_state = optimizer.update(g, state.inner_state, params)
         return updates, inner_state
@@ -205,10 +217,14 @@ def make_train_step(
     op: str = C.Average,
     compression=Compression.none,
     process_set=None,
+    two_phase: Optional[bool] = None,
+    pipeline_depth: Optional[int] = None,
 ):
     """Build the jit'ed SPMD training step — the hot loop the reference
     assembles from hooks + background thread + NCCL (§3.2 of SURVEY.md),
-    here a single compiled program.
+    here a single compiled program.  ``two_phase``/``pipeline_depth``
+    select the bucket-pipelined RS+AG gradient wire (None = live config
+    at trace time — the autotune application point).
 
     ``loss_fn(params, batch) -> loss`` (or ``(loss, aux)`` with
     ``has_aux``).  The returned ``step(params, opt_state, batch)`` shards
@@ -264,6 +280,7 @@ def make_train_step(
                 grads, op=op, axis=axis,
                 groups=member_groups if op == C.Adasum else groups,
                 compression=compression, threshold=_threshold(),
+                two_phase=two_phase, pipeline_depth=pipeline_depth,
             )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)

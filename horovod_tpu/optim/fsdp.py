@@ -58,6 +58,8 @@ def make_fsdp_train_step(
     dp_axis: Optional[str] = None,
     has_aux: bool = False,
     donate: bool = True,
+    two_phase: Optional[bool] = None,
+    pipeline_depth: Optional[int] = None,
 ):
     """Build ``(shard, step)`` for FSDP training over the framework mesh.
 
@@ -77,8 +79,27 @@ def make_fsdp_train_step(
     one gradient all-reduce across DCN, the standard multi-slice
     recipe (FSDP traffic stays on the fast wire; only reduced grads
     cross slices).
+
+    ``two_phase``/``pipeline_depth`` exist for API uniformity with the
+    other training entry points (``make_train_step``/``make_zero_
+    train_step``): FSDP's communication is emitted by the GSPMD
+    partitioner and is **inherently phase-decomposed** (per-layer
+    all-gather + gradient reduce-scatter, scheduled by the compiler), so
+    there is nothing to switch — passing ``two_phase=False`` warns that
+    the decomposition cannot be disabled here.
     """
     from .distributed_optimizer import resolve_mesh_axis
+
+    if two_phase is False:
+        from ..utils.logging import get_logger
+
+        get_logger(__name__).warning(
+            "make_fsdp_train_step(two_phase=False): FSDP communication "
+            "is emitted by the GSPMD partitioner and is inherently "
+            "reduce-scatter + all-gather; the flag only affects the "
+            "explicit-collective entry points (make_train_step / "
+            "make_zero_train_step)")
+    del pipeline_depth  # partitioner-scheduled; accepted for uniformity
 
     mesh_obj, axis = resolve_mesh_axis(mesh, axis_name)
     n = mesh_obj.shape[axis]

@@ -92,6 +92,14 @@ class ParameterManager:
     ``warmup_samples`` discarded).  When the candidate pool is
     exhausted or scores converge, tuning freezes at the best point
     (reference behavior).
+
+    Discrete/boolean knobs ride the same continuous machinery with a
+    **snap at the apply boundary**: the caller quantizes each proposal
+    onto its lattice (``hierarchical_inner_size`` → nearest divisor of
+    the slot count, ``pipeline_depth`` → int in [1, 8], ``two_phase`` →
+    the 1=off / 2=on pair) and mirrors the as-applied point back via
+    :meth:`mirror`, so scores are always attributed to values the job
+    actually ran — see ``basics._apply_autotuned_knobs``.
     """
 
     def __init__(self, knobs: Dict[str, Tuple[float, float]],

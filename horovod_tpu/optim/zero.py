@@ -123,10 +123,20 @@ def make_zero_train_step(
     def _plan_buckets(leaves, bucket_bytes):
         """Static (trace-time) bucket plan: leaf indices grouped by
         dtype (no promotion — mixed-precision trees keep each dtype's
-        wire width) and chunked so one bucket's transient concat buffer
-        stays under ``bucket_bytes`` (the fusion-threshold discipline of
-        ops/fusion.py — caps peak HBM instead of materializing one
-        full-gradient-size buffer).  Zero-size leaves join no bucket."""
+        wire width), then chunked by the shared fusion planner
+        (``ops.fusion.plan_buckets`` — native-capable, same greedy
+        order-preserving contract) so one bucket's transient concat
+        buffer stays under ``bucket_bytes`` — caps peak HBM instead of
+        materializing one full-gradient-size buffer.  Zero-size leaves
+        join no bucket.
+
+        ZeRO's wire IS the two-phase decomposition the fusion tier
+        gates by cost model (gradient reduce-scatter → sharded update →
+        parameter all-gather, with the optimizer as a full-tree barrier
+        between the phases), so the only schedule freedom here is
+        bucket granularity — governed by the same fusion_threshold."""
+        from ..ops import fusion as fusion_mod
+
         by_dtype: dict = {}
         for i, leaf in enumerate(leaves):
             if leaf.size == 0:
@@ -134,17 +144,10 @@ def make_zero_train_step(
             by_dtype.setdefault(jnp.dtype(leaf.dtype), []).append(i)
         buckets = []
         for dt, idxs in by_dtype.items():
-            cur, cur_bytes = [], 0
-            for i in idxs:
-                w = _flat_pad(leaves[i], n).size
-                nbytes = w * dt.itemsize
-                if cur and cur_bytes + nbytes > bucket_bytes:
-                    buckets.append(cur)
-                    cur, cur_bytes = [], 0
-                cur.append(i)
-                cur_bytes += nbytes
-            if cur:
-                buckets.append(cur)
+            sizes = [_flat_pad(leaves[i], n).size * dt.itemsize
+                     for i in idxs]
+            for b in fusion_mod.plan_buckets(sizes, bucket_bytes):
+                buckets.append([idxs[j] for j in b])
         return buckets
 
     def _bucket_bytes():

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .._compat import shard_map
+from .._compat import shard_map, axis_size as _axis_size
 
 
 def pipeline_apply(stage_fn: Callable, stage_params: Any, x, *,
@@ -59,7 +59,7 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x, *,
     def local(params_local, x_local):
         # params_local: [1, ...] stage slice; x_local: [B/dp, ...]
         params_me = jax.tree.map(lambda p: p[0], params_local)
-        n = lax.axis_size(pp_axis)
+        n = _axis_size(pp_axis)
         me = lax.axis_index(pp_axis)
         b = x_local.shape[0]
         if b % n_micro:

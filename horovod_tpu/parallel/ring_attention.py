@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .._compat import shard_map
+from .._compat import shard_map, axis_size as _axis_size
 
 _NEG_INF = -1e30
 
@@ -93,7 +93,7 @@ def ring_attention_local(q, k, v, *, axis: str, causal: bool = False,
         raise ValueError(f"unknown ring attention engine {engine!r}")
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     me = lax.axis_index(axis)
     b, t, h, d = q.shape
     qpos = me * t + jnp.arange(t)
@@ -138,7 +138,7 @@ def _ring_flash_local(q, k, v, *, axis: str, causal: bool,
     b, t, h, d = q.shape
     if scale is None:
         scale = d ** -0.5
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     me = lax.axis_index(axis)
 
     def diag_block(q, k, v):

@@ -18,6 +18,8 @@ from functools import partial
 from typing import Optional
 
 from jax import lax
+
+from .._compat import axis_size as _axis_size
 from jax.sharding import Mesh
 
 from .ring_attention import full_attention
@@ -30,7 +32,7 @@ def _ulysses_local(q, k, v, *, axis: str, causal: bool, scale):
     Local full attention.  AllToAll #2: inverse.
     Requires h % sp == 0.
     """
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     h = q.shape[2]
     if h % n != 0:
         raise ValueError(

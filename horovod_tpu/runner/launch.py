@@ -71,6 +71,11 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         prog="horovodtpurun",
         description="Launch a horovod_tpu training program "
                     "(reference CLI: horovodrun)",
+        # No prefix matching: an abbreviated flag (e.g. --auto) must be
+        # an error, not a silent match that a --config-file value could
+        # then be "overridden" by — the explicit-CLI-wins scan below
+        # matches argv tokens against FULL option strings only.
+        allow_abbrev=False,
     )
     parser.add_argument("-np", "--num-proc", type=int, default=None,
                         help="number of worker processes (default: 1 "
@@ -214,7 +219,13 @@ def _apply_config_file(parser: argparse.ArgumentParser,
     excluded, so a worker command's flags can't shadow launcher ones).
     File values go through the same type/choices validation the CLI
     applies."""
-    import yaml
+    try:
+        import yaml
+    except ImportError:
+        raise SystemExit(
+            "--config-file requires pyyaml, which is not installed; "
+            "install it with `pip install horovod-tpu[config]` (or "
+            "`pip install pyyaml`)")
 
     with open(args.config_file) as f:
         data = yaml.safe_load(f) or {}

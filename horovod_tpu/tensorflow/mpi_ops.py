@@ -348,10 +348,20 @@ def reducescatter(tensor, *, op: str = Sum, process_set=None,
 def grouped_reducescatter(tensors: Sequence, *, op: str = Sum,
                           process_set=None,
                           name: str = "grouped_reducescatter") -> List:
-    """Reference: ``hvd.grouped_reducescatter`` (late vintages)."""
-    return [reducescatter(t, op=op, process_set=process_set,
-                          name=f"{name}[{i}]")
-            for i, t in enumerate(tensors)]
+    """Reference: ``hvd.grouped_reducescatter`` (late vintages) — one
+    fused bridge call through the host-level grouped core (one compiled
+    program, one reduction per dtype bucket), not a per-tensor loop; in
+    graphs the whole group is a single ordered collective node."""
+    tensors = [tf.convert_to_tensor(t) for t in tensors]
+
+    def run(*values):
+        return H.grouped_reducescatter(list(values), op=op,
+                                       process_set=process_set, name=name)
+
+    outs = _np_bridge(run, tensors, [t.dtype for t in tensors], name)
+    for o, t in zip(outs, tensors):
+        o.set_shape(tf.TensorShape([None]).concatenate(t.shape[1:]))
+    return list(outs)
 
 
 # --- barrier / join ----------------------------------------------------------

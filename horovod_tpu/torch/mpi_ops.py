@@ -364,10 +364,13 @@ def grouped_reducescatter(tensors: Sequence["torch.Tensor"], *,
                           op: str = Sum, process_set=None,
                           name: str = "grouped_reducescatter"
                           ) -> List["torch.Tensor"]:
-    """Reference: ``hvd.grouped_reducescatter`` (late vintages)."""
-    return [reducescatter(t, op=op, process_set=process_set,
-                          name=f"{name}[{i}]")
-            for i, t in enumerate(tensors)]
+    """Reference: ``hvd.grouped_reducescatter`` (late vintages) — one
+    fused dispatch through the host-level grouped core (one compiled
+    program, one reduction per dtype bucket), not a per-tensor loop."""
+    shards = H.grouped_reducescatter([_to_numpy(t) for t in tensors],
+                                     op=op, process_set=process_set,
+                                     name=name)
+    return [_to_torch(s, t.dtype) for s, t in zip(shards, tensors)]
 
 
 # --- barrier / join ----------------------------------------------------------

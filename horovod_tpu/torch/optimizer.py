@@ -158,6 +158,22 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 return
         g = self._param_group.get(p)
         if g is not None:
+            existing = self._handles.get(p)
+            if existing is not None and isinstance(existing, tuple) \
+                    and existing[0] in ("pending_group", "group"):
+                # A second backward reached this parameter before
+                # step()/synchronize() consumed its group: enqueueing it
+                # again would double-count it inside the fused wire (or
+                # dispatch a short group) — silent gradient corruption.
+                # Mirror the reference's "gradient computed twice"
+                # assertion.
+                name = self._param_names.get(p, f"param.{id(p)}")
+                raise AssertionError(
+                    f"Gradient for {name} was computed twice in the "
+                    "grouped path before optimizer.step(); this usually "
+                    "means multiple backward passes without a step — "
+                    "use backward_passes_per_step > 1 (or call "
+                    "optimizer.synchronize() between passes)")
             ready = self._group_ready.setdefault(g, [])
             ready.append(p)
             self._handles[p] = ("pending_group", g)

@@ -63,6 +63,40 @@ class TestKnobBehavior:
         monkeypatch.setenv("HOROVOD_CACHE_CAPACITY", "1024")
         assert Config.from_env().cache_capacity == 1024
 
+    def test_two_phase_knobs_parse(self, monkeypatch):
+        for var in ("TWO_PHASE_ALLREDUCE", "PIPELINE_DEPTH",
+                    "COST_ALPHA_US", "COST_BETA_GBPS"):
+            monkeypatch.delenv(f"HOROVOD_{var}", raising=False)
+            monkeypatch.delenv(f"HVD_TPU_{var}", raising=False)
+        cfg = Config.from_env()
+        assert cfg.two_phase_allreduce is False
+        assert cfg.pipeline_depth == 2
+        assert cfg.cost_alpha_us == 10.0
+        assert cfg.cost_beta_gbps == 100.0
+        monkeypatch.setenv("HVD_TPU_TWO_PHASE_ALLREDUCE", "1")
+        monkeypatch.setenv("HVD_TPU_PIPELINE_DEPTH", "4")
+        monkeypatch.setenv("HVD_TPU_COST_ALPHA_US", "2.5")
+        monkeypatch.setenv("HVD_TPU_COST_BETA_GBPS", "450")
+        cfg = Config.from_env()
+        assert cfg.two_phase_allreduce is True
+        assert cfg.pipeline_depth == 4
+        assert cfg.cost_alpha_us == 2.5
+        assert cfg.cost_beta_gbps == 450.0
+
+    def test_two_phase_env_drives_fused_wire(self, restore_session_init):
+        """The knob is consumed, not just parsed: with it on (and a
+        tiny crossover) the grouped-allreduce dispatch compiles the
+        two-phase program and stays correct."""
+        import numpy as np
+
+        _reinit(Config(two_phase_allreduce=True, pipeline_depth=3,
+                       cost_alpha_us=1e-6, cost_beta_gbps=1.0))
+        assert hvd.config().two_phase_allreduce is True
+        assert hvd.config().pipeline_depth == 3
+        x = np.ones((hvd.size(), 257), np.float32)
+        out = hvd.grouped_allreduce([x], op=hvd.Sum)[0]
+        assert float(np.asarray(out)[0]) == hvd.size()
+
     def test_elastic_timeout_default_from_config(self,
                                                  restore_session_init):
         from horovod_tpu.elastic.driver import ElasticDriver, FixedDiscovery

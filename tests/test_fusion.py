@@ -1,9 +1,20 @@
 """Fusion planner tests (reference pattern: fusion edge cases in
-test/parallel/* — odd sizes, empty tensors; SURVEY.md §4)."""
+test/parallel/* — odd sizes, empty tensors; SURVEY.md §4), plus the
+two-phase bucket-pipelined schedule: α–β cost-model decisions, pipeline
+emission order, and numerical equivalence of the reduce-scatter +
+all-gather wire against the single-phase allreduce."""
 
+import jax
 import numpy as np
+import pytest
 
-from horovod_tpu.ops.fusion import plan_buckets_py, plan_buckets
+import horovod_tpu as hvd
+from horovod_tpu.ops.fusion import (
+    allreduce_cost_us, estimate_schedule_cost_us, fused_allreduce_pytree,
+    fused_two_phase_apply, phase_cost_us, plan_bucket_schedule, plan_buckets,
+    plan_buckets_py, plan_pipeline_order, plan_two_phase_flags,
+    two_phase_crossover_bytes,
+)
 
 
 class TestPlanner:
@@ -35,3 +46,230 @@ class TestPlanner:
     def test_dispatch_matches_python(self):
         sizes = list(np.random.RandomState(0).randint(1, 200, size=50))
         assert plan_buckets(sizes, 256) == plan_buckets_py(sizes, 256)
+
+
+class TestCostModel:
+    def test_crossover_is_alpha_beta_n(self):
+        # bytes/(n·β) >= α  ⇔  bytes >= α·β·1e3·n  (β in GB/s = 1e3 B/µs)
+        assert two_phase_crossover_bytes(8, 10.0, 100.0) == 8 * 10 * 100 * 1000
+        assert two_phase_crossover_bytes(1, 10.0, 100.0) > 1 << 60  # no-op world
+
+    def test_flags_gate_on_crossover(self):
+        cross = two_phase_crossover_bytes(8, 1.0, 1.0)
+        flags = plan_two_phase_flags([cross - 1, cross, cross + 1], 8, 1.0, 1.0)
+        assert flags == [False, True, True]
+
+    def test_world_of_one_never_decomposes(self):
+        assert plan_two_phase_flags([1 << 40], 1, 0.0, 1.0) == [False]
+
+    def test_phase_cost_halves_allreduce(self):
+        assert allreduce_cost_us(1 << 20, 8, 1.0, 1.0) == pytest.approx(
+            2 * phase_cost_us(1 << 20, 8, 1.0, 1.0))
+
+    def test_pipelined_schedule_beats_serial_for_large_buckets(self):
+        # Four bandwidth-bound buckets: the steady-state overlap should
+        # model strictly cheaper than four serial allreduces.
+        sizes = [64 << 20] * 4
+        serial = sum(allreduce_cost_us(s, 8, 10.0, 100.0) for s in sizes)
+        piped = estimate_schedule_cost_us(sizes, [True] * 4, 8, 10.0, 100.0)
+        assert piped < serial
+
+
+class TestPipelineOrder:
+    def test_depth_one_is_sequential(self):
+        assert plan_pipeline_order([True, True], 1) == [
+            ("rs", 0), ("ag", 0), ("rs", 1), ("ag", 1)]
+
+    def test_depth_two_interleaves(self):
+        assert plan_pipeline_order([True, True, True], 2) == [
+            ("rs", 0), ("rs", 1), ("ag", 0), ("rs", 2), ("ag", 1), ("ag", 2)]
+
+    def test_single_phase_buckets_stay_monolithic(self):
+        order = plan_pipeline_order([False, True, False, True], 2)
+        assert ("ar", 0) in order and ("ar", 2) in order
+        assert ("rs", 1) in order and ("ag", 3) in order
+
+    def test_every_bucket_completes_exactly_once(self):
+        flags = [True, False, True, True, False, True]
+        order = plan_pipeline_order(flags, 3)
+        done = [op for op in order if op[0] in ("ag", "ar")]
+        assert sorted(i for _, i in done) == list(range(len(flags)))
+        # each rs precedes its ag
+        for i, tp in enumerate(flags):
+            if tp:
+                assert order.index(("rs", i)) < order.index(("ag", i))
+
+    def test_inflight_bounded_by_depth(self):
+        order = plan_pipeline_order([True] * 8, 3)
+        inflight = 0
+        for kind, _ in order:
+            if kind == "rs":
+                inflight += 1
+            elif kind == "ag":
+                inflight -= 1
+            assert inflight <= 3
+
+
+class TestBucketSchedule:
+    def test_deterministic_across_calls(self):
+        sizes = list(np.random.RandomState(1).randint(1, 10 ** 7, size=40))
+        a = plan_bucket_schedule(sizes, 1 << 20, world_size=8)
+        b = plan_bucket_schedule(sizes, 1 << 20, world_size=8)
+        assert a == b  # every rank computes the identical schedule
+
+    def test_two_phase_off_is_all_allreduce(self):
+        s = plan_bucket_schedule([100, 200], 1 << 20, world_size=8,
+                                 two_phase=False)
+        assert s.two_phase == (False,)
+        assert all(k == "ar" for k, _ in s.order)
+
+    def test_buckets_match_plan_buckets(self):
+        sizes = [60, 60, 60, 10]
+        s = plan_bucket_schedule(sizes, 100, world_size=8)
+        assert [list(b) for b in s.buckets] == plan_buckets(sizes, 100)
+
+    def test_native_flags_match_python(self):
+        try:
+            from horovod_tpu.native import planner as native
+        except ImportError:
+            pytest.skip("native planner not importable")
+        if not native.available():
+            pytest.skip("native planner not built")
+        rng = np.random.RandomState(7)
+        payloads = [int(b) for b in rng.randint(0, 1 << 30, size=100)]
+        for n, alpha, beta in [(2, 10.0, 100.0), (8, 1.0, 1.0),
+                               (64, 0.5, 400.0)]:
+            assert native.plan_two_phase_flags(payloads, n, alpha, beta) \
+                == plan_two_phase_flags(payloads, n, alpha, beta)
+        # Fractional crossover at the exact boundary: both planners must
+        # truncate identically (a mixed native/Python fleet would
+        # otherwise trace divergent schedules).  0.33*1.0*1e3*3 =
+        # 990.0000000000002 -> int() == 990 on both sides.
+        boundary = [989, 990, 991]
+        assert native.plan_two_phase_flags(boundary, 3, 0.33, 1.0) \
+            == plan_two_phase_flags(boundary, 3, 0.33, 1.0) \
+            == [False, True, True]
+
+
+class TestTwoPhaseEquivalence:
+    """Acceptance criterion: the two-phase path is numerically
+    equivalent to single-phase across ops / compression / process sets /
+    uneven last buckets (allclose on the 8-slot CPU mesh)."""
+
+    def _tree(self, seed=0):
+        rng = np.random.RandomState(seed)
+        # Mixed sizes: a multi-leaf bucket, an uneven (non-divisible-
+        # by-8) leaf, a scalar, and a bucket-overflowing leaf.
+        return {
+            "w": rng.randn(37).astype(np.float32),
+            "b": rng.randn(1000).astype(np.float32),
+            "s": np.float32(rng.randn()),
+            "big": rng.randn(3, 5, 7).astype(np.float32),
+        }
+
+    def _reduce(self, tree, *, two_phase, op="sum", compression=None,
+                groups=None, depth=2, threshold=512):
+        from horovod_tpu._compat import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        gm = hvd.global_mesh()
+        stacked = jax.tree.map(
+            lambda l: np.broadcast_to(np.asarray(l)[None],
+                                      (gm.size,) + np.shape(l)).copy(), tree)
+
+        def per_slot(tb):
+            t0 = jax.tree.map(lambda l: l[0], tb)
+            if two_phase:
+                leaves, treedef = jax.tree.flatten(t0)
+                red = fused_two_phase_apply(
+                    leaves, axis=gm.axis_name, op=op, groups=groups,
+                    compression=compression or hvd.Compression.none,
+                    threshold=threshold, pipeline_depth=depth,
+                    alpha_us=1e-6, beta_gbps=1.0)  # force decomposition
+                red = jax.tree.unflatten(treedef, red)
+            else:
+                red = fused_allreduce_pytree(
+                    t0, axis=gm.axis_name, op=op, groups=groups,
+                    compression=compression, threshold=threshold,
+                    two_phase=False)
+            return jax.tree.map(lambda l: jax.numpy.asarray(l)[None], red)
+
+        f = shard_map(per_slot, mesh=gm.mesh, in_specs=P(gm.axis_name),
+                      out_specs=P(gm.axis_name))
+        return jax.jit(f)(stacked)
+
+    def _assert_equiv(self, **kw):
+        tree = self._tree()
+        two = self._reduce(tree, two_phase=True, **kw)
+        one = self._reduce(tree, two_phase=False, **kw)
+        tol = dict(rtol=1e-5, atol=1e-5)
+        if kw.get("compression") is not None:
+            tol = dict(rtol=5e-2, atol=5e-1)
+        for k in tree:
+            np.testing.assert_allclose(
+                np.asarray(two[k], np.float32)[0],
+                np.asarray(one[k], np.float32)[0], **tol)
+
+    @pytest.mark.parametrize("op", ["sum", "average"])
+    def test_sum_average(self, op):
+        self._assert_equiv(op=op)
+
+    @pytest.mark.parametrize("depth", [1, 2, 4])
+    def test_pipeline_depths(self, depth):
+        self._assert_equiv(depth=depth)
+
+    def test_uneven_last_bucket_tiny_threshold(self):
+        # threshold far below every leaf: one bucket per leaf, each with
+        # a padded (non-divisible) tail.
+        self._assert_equiv(threshold=4)
+
+    def test_process_set_uniform_groups(self):
+        self._assert_equiv(groups=[[0, 1, 2, 3], [4, 5, 6, 7]])
+
+    def test_ragged_groups_fall_back_single_phase(self):
+        # [members, complement] with unequal halves: XLA can't scatter
+        # over ragged replica groups — the planner must fall back, still
+        # numerically correct.
+        self._assert_equiv(groups=[[0, 1, 2], [3, 4, 5, 6, 7]])
+
+    @pytest.mark.parametrize("comp", ["fp16", "bf16", "int8"])
+    def test_compression_wires(self, comp):
+        self._assert_equiv(compression=getattr(hvd.Compression, comp))
+
+    def test_config_driven_path(self):
+        """HVD_TPU_TWO_PHASE_ALLREDUCE=1 routes fused_allreduce_pytree
+        through the scheduled path with config cost knobs."""
+        from horovod_tpu.config import Config
+
+        hvd.shutdown()
+        try:
+            hvd.init(Config(two_phase_allreduce=True, pipeline_depth=3,
+                            cost_alpha_us=1e-6, cost_beta_gbps=1.0))
+            tree = self._tree()
+            two = self._reduce(tree, two_phase=False)  # two_phase=None→config
+            # _reduce(two_phase=False) pins single-phase; rerun via config:
+            from horovod_tpu._compat import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            gm = hvd.global_mesh()
+            stacked = jax.tree.map(
+                lambda l: np.broadcast_to(
+                    np.asarray(l)[None], (gm.size,) + np.shape(l)).copy(),
+                tree)
+
+            def per_slot(tb):
+                t0 = jax.tree.map(lambda l: l[0], tb)
+                red = fused_allreduce_pytree(t0, axis=gm.axis_name, op="sum",
+                                             threshold=512)
+                return jax.tree.map(lambda l: jax.numpy.asarray(l)[None], red)
+
+            f = shard_map(per_slot, mesh=gm.mesh, in_specs=P(gm.axis_name),
+                          out_specs=P(gm.axis_name))
+            via_config = jax.jit(f)(stacked)
+            for k in tree:
+                np.testing.assert_allclose(
+                    np.asarray(via_config[k], np.float32)[0],
+                    np.asarray(two[k], np.float32)[0], rtol=1e-5, atol=1e-5)
+        finally:
+            hvd.shutdown()
+            hvd.init()

@@ -364,6 +364,32 @@ class TestLocalRun:
         assert main(["--config-file", str(cfg), "--",
                      sys.executable, str(script)]) == 0
 
+    def test_abbreviated_flags_rejected(self, capsys):
+        """allow_abbrev=False: a prefix like --fusion must error, not
+        silently match --fusion-threshold-mb — the config-file
+        explicit-CLI-wins scan compares argv against FULL option
+        strings, so an abbreviation would let a file value shadow what
+        the user typed."""
+        from horovod_tpu.runner.launch import parse_args
+
+        with pytest.raises(SystemExit):
+            parse_args(["--fusion", "32", "--", "true"])
+        capsys.readouterr()  # swallow argparse usage noise
+
+    def test_config_file_without_pyyaml_names_the_extra(self, tmp_path,
+                                                        monkeypatch):
+        """With pyyaml absent, --config-file must fail with an
+        actionable install hint, not a bare ImportError."""
+        import sys as _sys
+
+        from horovod_tpu.runner.launch import parse_args
+
+        cfg = tmp_path / "h.yaml"
+        cfg.write_text("verbose: true\n")
+        monkeypatch.setitem(_sys.modules, "yaml", None)  # import → ImportError
+        with pytest.raises(SystemExit, match="pyyaml"):
+            parse_args(["--config-file", str(cfg), "--", "true"])
+
     def test_output_filename_writes_per_rank_files(self, tmp_path):
         """Reference horovodrun --output-filename: each rank's output
         lands in its own file pair instead of the launcher's tty."""

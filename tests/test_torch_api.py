@@ -236,6 +236,20 @@ class TestDistributedOptimizer:
         ropt.step()
         assert torch.allclose(emb.weight, ref.weight, atol=1e-6)
 
+    def test_grouped_double_backward_without_step_raises(self):
+        """A parameter enqueued twice in the grouped path before step()
+        would double-count inside the fused wire (silent corruption);
+        mirror the reference's "gradient computed twice" assertion."""
+        model, _ = self._models()
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(), num_groups=1)
+        x = torch.randn(2, 4)
+        model(x).sum().backward()
+        with pytest.raises((AssertionError, RuntimeError),
+                           match="computed twice"):
+            model(x).sum().backward()
+
     def test_num_groups_caps_and_validates(self):
         model, _ = self._models()
         # More groups than params: capped, still correct.
