@@ -52,6 +52,39 @@ def axis_size(axis: str) -> int:
     return lax.psum(1, axis)
 
 
+def ffi_module():
+    """The jax typed-FFI namespace: ``jax.ffi`` on jax >= 0.5, its
+    previous home ``jax.extend.ffi`` on 0.4.x (same surface:
+    ``ffi_call``, ``register_ffi_target``, ``pycapsule``,
+    ``include_dir``).  ``register_ffi_target_as_batch_partitionable``
+    only exists in the new home — callers must getattr-guard it."""
+    try:
+        import jax.ffi as m
+
+        return m
+    except ImportError:
+        import jax.extend.ffi as m  # type: ignore
+
+        return m
+
+
+def sanitize_checkpoint_tree(tree):
+    """Normalize a pytree for orbax's ``StandardSave``: newer orbax
+    (0.7+) accepts only ``int``/``float``/``np.ndarray``/``jax.Array``
+    leaves, so numpy *scalars* (``np.int64(7)`` — the idiomatic step
+    counter) fail the type check.  Wrap them as 0-d ndarrays, which
+    round-trip with dtype intact; everything else passes through."""
+    import jax
+    import numpy as np
+
+    def fix(leaf):
+        if isinstance(leaf, np.generic):
+            return np.asarray(leaf)
+        return leaf
+
+    return jax.tree.map(fix, tree)
+
+
 def _resolve_tracer():
     """jax.core.Tracer's home keeps moving (jax.core is deprecated as a
     public namespace); resolve it once, falling back through the known

@@ -160,6 +160,15 @@ def init(config: Optional[Config] = None) -> None:
         from .utils.logging import set_level
 
         set_level(cfg.log_level)
+        if cfg.fault_spec:
+            from . import faults
+
+            # Arm the fault plan once per spec: an elastic re-init
+            # (shutdown+init mid-recovery) must NOT restart the armed
+            # plan's counters/history — the failure sequence spans the
+            # process, or a step fault could re-fire on every reset.
+            if faults.active_spec() != cfg.fault_spec:
+                faults.configure(cfg.fault_spec)
         _apply_cache_capacity(cfg.cache_capacity)
         _state.config = cfg
         _state.mesh = GlobalMesh.build(axis_name=cfg.mesh_axis_name)

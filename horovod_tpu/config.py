@@ -28,6 +28,118 @@ DEFAULT_COST_ALPHA_US = 10.0
 DEFAULT_COST_BETA_GBPS = 100.0
 
 
+# --- fault-injection spec grammar (HVD_TPU_FAULT_SPEC) ----------------------
+# ``site:key=val,key=val;site2:...`` — one clause per injection site.
+# Sites are the recovery-relevant layers (horovod_tpu/faults.py threads
+# them through collectives, fusion, elastic discovery, control-plane RPC
+# and the checkpointer).  Parsed here so a typo'd spec fails loudly at
+# init, exactly like every other malformed env knob.
+
+FAULT_SITES = ("collective", "fusion", "discovery", "rpc", "checkpoint")
+
+_FAULT_MODES = {
+    "collective": ("raise",),
+    "fusion": ("raise",),
+    "discovery": ("flap", "timeout", "error"),
+    "rpc": ("drop", "delay"),
+    "checkpoint": ("corrupt", "partial"),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultClause:
+    """One parsed clause of a fault spec: what fires at one site.
+
+    ``step`` fires on that site-event index (each check at the site
+    advances a counter; sites that know their own step — the
+    checkpointer — match the domain step instead).  ``p`` fires each
+    event with seeded probability.  ``times`` caps total firings
+    (default: 1 for step faults, unlimited for probability faults).
+    ``mode`` picks the site-specific action; ``delay_ms`` parameterizes
+    ``rpc:mode=delay``.
+    """
+
+    site: str
+    step: Optional[int] = None
+    p: float = 0.0
+    seed: int = 0
+    times: Optional[int] = None
+    mode: Optional[str] = None
+    delay_ms: float = 0.0
+
+
+def parse_fault_spec(spec: str) -> "dict[str, FaultClause]":
+    """Parse ``HVD_TPU_FAULT_SPEC`` (e.g.
+    ``collective:step=40;discovery:flap=0.2,seed=7``) into per-site
+    clauses.  Raises ``ValueError`` on unknown sites/keys/modes — a
+    fault plan that silently no-ops would invalidate a chaos run."""
+    clauses: dict = {}
+    for raw in spec.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        site, _, body = raw.partition(":")
+        site = site.strip()
+        if site not in FAULT_SITES:
+            raise ValueError(
+                f"fault spec: unknown site {site!r}; expected one of "
+                f"{FAULT_SITES}")
+        if site in clauses:
+            raise ValueError(f"fault spec: duplicate clause for {site!r}")
+        kw: dict = {"site": site}
+        for kv in body.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            if "=" not in kv:
+                raise ValueError(
+                    f"fault spec [{site}]: expected key=value, got {kv!r}")
+            key, _, val = kv.partition("=")
+            key, val = key.strip(), val.strip()
+            try:
+                if key == "step":
+                    kw["step"] = int(val)
+                elif key == "p":
+                    kw["p"] = float(val)
+                elif key == "flap":  # discovery shorthand: p + mode=flap
+                    kw["p"] = float(val)
+                    kw["mode"] = "flap"
+                elif key == "seed":
+                    kw["seed"] = int(val)
+                elif key == "times":
+                    kw["times"] = int(val)
+                elif key == "mode":
+                    kw["mode"] = val
+                elif key == "delay_ms":
+                    kw["delay_ms"] = float(val)
+                else:
+                    raise ValueError(
+                        f"fault spec [{site}]: unknown key {key!r}")
+            except ValueError as e:
+                if "unknown key" in str(e) or "fault spec" in str(e):
+                    raise
+                raise ValueError(
+                    f"fault spec [{site}]: bad value {val!r} for "
+                    f"{key!r}") from e
+        if key_err := _fault_clause_error(kw):
+            raise ValueError(f"fault spec [{site}]: {key_err}")
+        clauses[site] = FaultClause(**kw)
+    return clauses
+
+
+def _fault_clause_error(kw: dict) -> Optional[str]:
+    site = kw["site"]
+    mode = kw.get("mode")
+    if mode is not None and mode not in _FAULT_MODES[site]:
+        return (f"unknown mode {mode!r}; expected one of "
+                f"{_FAULT_MODES[site]}")
+    if kw.get("step") is None and kw.get("p", 0.0) <= 0.0:
+        return "clause needs a trigger: step=N or p=<prob> (flap=<prob>)"
+    if not 0.0 <= kw.get("p", 0.0) <= 1.0:
+        return f"probability must be in [0, 1], got {kw['p']}"
+    return None
+
+
 def _env(name: str, default: Optional[str] = None) -> Optional[str]:
     """Look up ``HOROVOD_<name>`` then ``HVD_TPU_<name>``."""
     for prefix in ("HOROVOD_", "HVD_TPU_"):
@@ -64,6 +176,15 @@ def _env_opt_int(name: str) -> Optional[int]:
     if _env(name) is None:
         return None
     return _env_int(name, 0)
+
+
+def _validated_fault_spec(spec: Optional[str]) -> Optional[str]:
+    """Empty/unset → None; anything else must parse (fail at init, not
+    silently no-op a chaos run)."""
+    if not spec or not spec.strip():
+        return None
+    parse_fault_spec(spec)  # raises ValueError on a malformed plan
+    return spec
 
 
 def _env_float(name: str, default: float) -> float:
@@ -123,6 +244,20 @@ class Config:
     # --- elastic (reference: runner/elastic/) ---
     elastic_timeout_seconds: float = 600.0    # HOROVOD_ELASTIC_TIMEOUT
     reset_limit: int = 0                      # HOROVOD_ELASTIC_RESET_LIMIT (0 = unlimited)
+    reset_backoff_seconds: float = 0.5        # HVD_TPU_RESET_BACKOFF (0 = hot loop, not recommended)
+    reset_backoff_max_seconds: float = 30.0   # HVD_TPU_RESET_BACKOFF_MAX
+    blacklist_decay_seconds: float = 300.0    # HVD_TPU_BLACKLIST_DECAY (0 = permanent)
+    discovery_failure_threshold: int = 3      # HVD_TPU_DISCOVERY_FAILURES (K consecutive ⇒ membership loss)
+
+    # --- control-plane RPC + checkpoint robustness ---
+    rpc_retries: int = 3                      # HVD_TPU_RPC_RETRIES (attempts per request)
+    rpc_backoff_seconds: float = 0.3          # HVD_TPU_RPC_BACKOFF (base, jittered exponential)
+    agent_ping_interval_seconds: float = 15.0  # HVD_TPU_AGENT_PING_INTERVAL
+    agent_max_missed_pings: int = 4           # HVD_TPU_AGENT_MAX_MISSED
+    checkpoint_digest: bool = True            # HVD_TPU_CHECKPOINT_DIGEST (integrity sidecar)
+
+    # --- fault injection (horovod_tpu/faults.py; no reference analogue) ---
+    fault_spec: Optional[str] = None          # HVD_TPU_FAULT_SPEC
 
     # --- cache (reference: response_cache.cc) ---
     # None = unset: each dispatch cache keeps its per-op tuned size.  An
@@ -163,6 +298,16 @@ class Config:
             autotune_max_samples=_env_int("AUTOTUNE_MAX_SAMPLES", 20),
             elastic_timeout_seconds=_env_float("ELASTIC_TIMEOUT", 600.0),
             reset_limit=_env_int("ELASTIC_RESET_LIMIT", 0),
+            reset_backoff_seconds=_env_float("RESET_BACKOFF", 0.5),
+            reset_backoff_max_seconds=_env_float("RESET_BACKOFF_MAX", 30.0),
+            blacklist_decay_seconds=_env_float("BLACKLIST_DECAY", 300.0),
+            discovery_failure_threshold=_env_int("DISCOVERY_FAILURES", 3),
+            rpc_retries=_env_int("RPC_RETRIES", 3),
+            rpc_backoff_seconds=_env_float("RPC_BACKOFF", 0.3),
+            agent_ping_interval_seconds=_env_float("AGENT_PING_INTERVAL", 15.0),
+            agent_max_missed_pings=_env_int("AGENT_MAX_MISSED", 4),
+            checkpoint_digest=_env_bool("CHECKPOINT_DIGEST", True),
+            fault_spec=_validated_fault_spec(_env("FAULT_SPEC")),
             cache_capacity=_env_opt_int("CACHE_CAPACITY"),
             mesh_axis_name=_env("MESH_AXIS_NAME", "hvd") or "hvd",
             use_native_planner=_env_bool("USE_NATIVE_PLANNER", True),

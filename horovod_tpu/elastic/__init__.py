@@ -18,6 +18,8 @@ or a driver notification.
 from .state import (  # noqa: F401
     State, ObjectState, TpuState, HorovodInternalError,
     HostsUpdatedInterrupt, run,
+    register_exception_translator, translate_exception,
+    default_exception_translator,
 )
 from .sampler import ElasticSampler  # noqa: F401
 from .driver import ElasticDriver, HostDiscovery, ScriptDiscovery  # noqa: F401

@@ -23,7 +23,9 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Set
 
+from .. import faults as faults_mod
 from ..utils.logging import get_logger
+from ..utils.retry import RetryPolicy, retry_call
 from .state import HostsUpdatedInterrupt
 
 logger = get_logger(__name__)
@@ -40,17 +42,39 @@ class HostDiscovery:
 class ScriptDiscovery(HostDiscovery):
     """Reference: ``HostDiscoveryScript`` — run a user script that prints
     ``hostname:slots`` per line (the ``--host-discovery-script``
-    contract)."""
+    contract).
 
-    def __init__(self, script: str, timeout_s: float = 30.0) -> None:
+    One script run is allowed to flake: invocations ride the shared
+    retry helper (jittered exponential backoff, ``retries`` attempts)
+    so a transient non-zero exit or timeout doesn't surface as a
+    membership event.  Persistent failure propagates — the driver's
+    consecutive-failure accounting decides when that means the
+    membership is gone.
+    """
+
+    def __init__(self, script: str, timeout_s: float = 30.0,
+                 retries: int = 3, backoff_s: float = 0.5) -> None:
         self.script = script
         self.timeout_s = timeout_s
+        self._policy = RetryPolicy(attempts=max(1, retries),
+                                   base_delay_s=backoff_s,
+                                   max_delay_s=max(backoff_s, 5.0))
 
-    def find_available_hosts_and_slots(self) -> Dict[str, int]:
-        out = subprocess.run(
+    def _run_script(self) -> str:
+        if faults_mod._active is not None:
+            faults_mod.on_discovery_script(self.script)
+        return subprocess.run(
             self.script, shell=True, capture_output=True, text=True,
             timeout=self.timeout_s, check=True,
         ).stdout
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        out = retry_call(
+            self._run_script,
+            policy=self._policy,
+            retry_on=(subprocess.SubprocessError, OSError),
+            describe=f"host discovery ({self.script})",
+        )
         hosts: Dict[str, int] = {}
         for line in out.splitlines():
             line = line.strip()
@@ -61,6 +85,8 @@ class ScriptDiscovery(HostDiscovery):
                 hosts[host] = int(slots)
             else:
                 hosts[line] = 1
+        if faults_mod._active is not None:
+            hosts = faults_mod.on_discovery_hosts(hosts)
         return hosts
 
 
@@ -79,18 +105,46 @@ class ElasticDriver:
 
     ``on_hosts_updated`` callbacks receive ``(added, removed)`` host
     sets.  Hosts that fail more than ``blacklist_after`` times are
-    excluded from future membership (reference: host blacklisting).
+    excluded from membership (reference: host blacklisting) — but not
+    forever: after ``blacklist_decay_s`` the host gets a half-open
+    probation (strikes drop to ``blacklist_after - 1``, so one more
+    failure re-blacklists immediately, one success via
+    :meth:`record_success` clears it).  Permanent blacklists turn every
+    transient rack drain into permanently-lost capacity at fleet scale.
+
+    Discovery itself is allowed to fail: ``poll_once`` counts
+    *consecutive* failures and treats membership as unknown-but-
+    unchanged until ``failure_threshold`` in a row, at which point the
+    host set is declared lost (``{}``) and callbacks fire — a dead
+    discovery endpoint is indistinguishable from a dead fleet, and
+    waiting forever on a stale host set is the worse failure mode.
     """
 
     def __init__(self, discovery: HostDiscovery, *,
                  poll_interval_s: float = 1.0,
-                 blacklist_after: int = 3) -> None:
+                 blacklist_after: int = 3,
+                 blacklist_decay_s: Optional[float] = None,
+                 failure_threshold: Optional[int] = None) -> None:
+        from .. import basics
+        from ..config import Config
+
+        # The resolved Config when this process init()ed; the same
+        # parser over the env in launcher/supervisor processes.
+        cfg = basics.config() if basics.is_initialized() \
+            else Config.from_env()
         self.discovery = discovery
         self.poll_interval_s = poll_interval_s
         self.blacklist_after = blacklist_after
+        self.blacklist_decay_s = (
+            blacklist_decay_s if blacklist_decay_s is not None
+            else cfg.blacklist_decay_seconds)
+        self.failure_threshold = (
+            failure_threshold if failure_threshold is not None
+            else cfg.discovery_failure_threshold)
         self._hosts: Dict[str, int] = {}
         self._failures: Dict[str, int] = {}
-        self._blacklist: Set[str] = set()
+        self._blacklist: Dict[str, float] = {}   # host -> blacklisted-at
+        self._poll_failures = 0                  # consecutive discovery errors
         self._callbacks: List[Callable[[Set[str], Set[str]], None]] = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -111,28 +165,74 @@ class ElasticDriver:
 
     def record_failure(self, host: str) -> None:
         """Reference: failed workers increment their host's strike count;
-        over the limit → blacklist."""
+        over the limit → blacklist (time-stamped, so decay can age it)."""
         with self._lock:
             self._failures[host] = self._failures.get(host, 0) + 1
             if self._failures[host] >= self.blacklist_after:
                 if host not in self._blacklist:
-                    logger.warning("Blacklisting host %s after %d failures",
-                                   host, self._failures[host])
-                self._blacklist.add(host)
+                    logger.warning("Blacklisting host %s after %d failures"
+                                   " (decay: %s)",
+                                   host, self._failures[host],
+                                   f"{self.blacklist_decay_s:.0f}s"
+                                   if self.blacklist_decay_s > 0
+                                   else "never")
+                self._blacklist[host] = time.monotonic()
+
+    def record_success(self, host: str) -> None:
+        """A host completed useful work: reset its strikes and lift any
+        blacklist — the half-open probation closes on the good side."""
+        with self._lock:
+            had = self._failures.pop(host, 0)
+            lifted = self._blacklist.pop(host, None) is not None
+        if lifted or had:
+            logger.info("Host %s recovered (strikes reset%s)", host,
+                        ", blacklist lifted" if lifted else "")
+
+    def _blacklisted_locked(self, host: str) -> bool:
+        """Caller holds the lock.  Applies decay as a side effect."""
+        at = self._blacklist.get(host)
+        if at is None:
+            return False
+        if self.blacklist_decay_s > 0 and \
+                time.monotonic() - at >= self.blacklist_decay_s:
+            # Half-open: eligible again, one strike short of the limit —
+            # a single new failure re-blacklists without a full cycle.
+            del self._blacklist[host]
+            self._failures[host] = max(0, self.blacklist_after - 1)
+            logger.info("Blacklist decayed for host %s (probation)", host)
+            return False
+        return True
 
     def blacklisted(self, host: str) -> bool:
         with self._lock:
-            return host in self._blacklist
+            return self._blacklisted_locked(host)
 
     # --- polling -----------------------------------------------------------
 
     def poll_once(self) -> bool:
         """One discovery round; fires callbacks on delta.  Returns True
-        if membership changed."""
-        found = self.discovery.find_available_hosts_and_slots()
+        if membership changed.  A discovery failure no longer escapes:
+        below ``failure_threshold`` consecutive errors membership is
+        held steady (a flaky script run is not a membership event);
+        at the threshold the host set is declared lost."""
+        try:
+            found = self.discovery.find_available_hosts_and_slots()
+            with self._lock:
+                self._poll_failures = 0
+        except Exception as e:
+            with self._lock:
+                self._poll_failures += 1
+                n = self._poll_failures
+            if n < self.failure_threshold:
+                logger.warning("Host discovery failed (%d/%d consecutive):"
+                               " %s", n, self.failure_threshold, e)
+                return False
+            logger.error("Host discovery failed %d times consecutively"
+                         " (%s); treating membership as lost", n, e)
+            found = {}
         with self._lock:
             found = {h: s for h, s in found.items()
-                     if h not in self._blacklist}
+                     if not self._blacklisted_locked(h)}
             old = set(self._hosts)
             new = set(found)
             changed = found != self._hosts

@@ -12,6 +12,7 @@ the reference; durable checkpoints belong to orbax.
 from __future__ import annotations
 
 import copy
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
@@ -169,6 +170,87 @@ def _reinitialize() -> None:
     basics.init()
 
 
+# --- exception translation ---------------------------------------------------
+# The reference's C++ core converts backend errors into one canonical
+# signal; here jax/XLA failures surface as backend-specific exception
+# types (XlaRuntimeError, grpc deadline errors, ...) that the retry loop
+# would otherwise not recognize.  Translators map an arbitrary exception
+# to a HorovodInternalError / HostsUpdatedInterrupt (handle it) or None
+# (not ours — propagate).  User-registered translators run before the
+# default, newest first.
+
+_translators: List[Callable[[BaseException], Optional[BaseException]]] = []
+
+# Substrings of jax/XLA/distributed-runtime errors that mean "the
+# collective/world broke", not "the training code is wrong".
+_XLA_FAILURE_MARKERS = (
+    "collective", "all-reduce", "allreduce", "all-gather",
+    "deadline_exceeded", "deadline exceeded",
+    "failed to connect", "connection reset", "socket closed",
+    "preempted", "preemption", "heartbeat", "coordination service",
+    "distributed runtime", "peer down", "unavailable",
+)
+
+
+def default_exception_translator(e: BaseException) -> Optional[BaseException]:
+    """Map jax/XLA collective & distributed-runtime failures to
+    ``HorovodInternalError`` (rollback + re-init is the right response);
+    anything else is not ours."""
+    if isinstance(e, (HorovodInternalError, HostsUpdatedInterrupt)):
+        return e
+    name = type(e).__name__
+    if name not in ("XlaRuntimeError", "JaxRuntimeError", "RpcError",
+                    "InternalError", "DistributedRuntimeError"):
+        return None
+    msg = str(e).lower()
+    if any(marker in msg for marker in _XLA_FAILURE_MARKERS):
+        return HorovodInternalError(f"translated from {name}: {e}")
+    return None
+
+
+def register_exception_translator(
+        fn: Callable[[BaseException], Optional[BaseException]]) -> None:
+    """Register a translator consulted by ``elastic.run`` before the
+    default one.  ``fn(exc)`` returns a ``HorovodInternalError`` /
+    ``HostsUpdatedInterrupt`` to recover from ``exc``, or None to pass
+    (deployment-specific error surfaces: a GKE preemption notice, a
+    custom data-plane health check, ...)."""
+    _translators.insert(0, fn)
+
+
+def translate_exception(e: BaseException) -> Optional[BaseException]:
+    for fn in (*_translators, default_exception_translator):
+        try:
+            out = fn(e)
+        except Exception:  # a broken translator must not mask the error
+            continue
+        if out is not None:
+            return out
+    return None
+
+
+# Failures further apart than this are separate incidents, not a streak
+# (comfortably above the 30s default backoff cap plus re-init time).
+_FAILURE_STREAK_WINDOW_S = 120.0
+
+
+def _reset_backoff_s(consecutive_failures: int) -> float:
+    """Jittered exponential backoff between failure-driven resets
+    (``HVD_TPU_RESET_BACKOFF``); a hot retry loop against a broken
+    fleet re-breaks it — and synchronized retries across hosts
+    re-create the stampede (utils/retry.py)."""
+    from .. import basics
+    from ..config import Config
+    from ..utils.retry import RetryPolicy
+
+    cfg = basics.config() if basics.is_initialized() else Config.from_env()
+    base, cap = cfg.reset_backoff_seconds, cfg.reset_backoff_max_seconds
+    if base <= 0:
+        return 0.0
+    return RetryPolicy(attempts=0, base_delay_s=base,
+                       max_delay_s=cap).delay_s(consecutive_failures)
+
+
 def run(func: Callable) -> Callable:
     """Decorator making a training function elastic (reference:
     ``@hvd.elastic.run``)::
@@ -180,9 +262,15 @@ def run(func: Callable) -> Callable:
                 state.commit()
 
     On ``HorovodInternalError``: rollback to the last commit, re-init,
-    sync from rank 0, retry.  On ``HostsUpdatedInterrupt``: re-init and
-    continue without rollback (graceful resize).  Retries are bounded by
-    ``HOROVOD_ELASTIC_RESET_LIMIT`` (0 = unlimited).
+    sync from rank 0, retry — after a jittered exponential backoff
+    (``HVD_TPU_RESET_BACKOFF``; each consecutive failure backs off
+    further, capped at ``HVD_TPU_RESET_BACKOFF_MAX``).  On
+    ``HostsUpdatedInterrupt``: re-init and continue without rollback
+    (graceful resize, no backoff).  Other exceptions are offered to the
+    translators (:func:`register_exception_translator`) so jax/XLA
+    collective errors recover like the reference's C++-raised signal.
+    Retries are bounded by ``HOROVOD_ELASTIC_RESET_LIMIT``
+    (0 = unlimited).
     """
 
     def wrapper(state: State, *args: Any, **kwargs: Any):
@@ -191,31 +279,51 @@ def run(func: Callable) -> Callable:
         reset_limit = (basics.config().reset_limit
                        if basics.is_initialized() else 0)
         resets = 0
+        consecutive_failures = 0
+        last_failure_t = 0.0
         while True:
             try:
                 return func(state, *args, **kwargs)
-            except HorovodInternalError as e:
+            except Exception as exc:
+                err = translate_exception(exc)
+                if err is None:
+                    raise
                 resets += 1
                 if reset_limit and resets > reset_limit:
                     raise RuntimeError(
                         f"Elastic reset limit ({reset_limit}) exceeded"
-                    ) from e
-                logger.warning("Collective failure (%s); rolling back to "
-                               "last commit and re-initializing", e)
-                _reinitialize()
-                state.restore()
-                state.on_reset()
-                state.sync()
-            except HostsUpdatedInterrupt:
-                resets += 1
-                if reset_limit and resets > reset_limit:
-                    raise RuntimeError(
-                        f"Elastic reset limit ({reset_limit}) exceeded")
-                logger.info("Membership changed; re-initializing without "
-                            "rollback")
-                _reinitialize()
-                state.on_reset()
-                state.sync()
+                    ) from exc
+                if isinstance(err, HorovodInternalError):
+                    # "Consecutive" means close in time: a failure long
+                    # after the last one is a fresh incident (training
+                    # ran in between — func() gives no progress signal,
+                    # so elapsed time stands in for it) and restarts
+                    # the escalation instead of paying the
+                    # accumulated-max backoff of incidents days apart.
+                    now = time.monotonic()
+                    if now - last_failure_t > _FAILURE_STREAK_WINDOW_S:
+                        consecutive_failures = 0
+                    last_failure_t = now
+                    consecutive_failures += 1
+                    delay = _reset_backoff_s(consecutive_failures)
+                    logger.warning(
+                        "Collective failure (%s); rolling back to last "
+                        "commit and re-initializing (reset %d%s, backoff "
+                        "%.2fs)", err, resets,
+                        f"/{reset_limit}" if reset_limit else "", delay)
+                    if delay > 0:
+                        time.sleep(delay)
+                    _reinitialize()
+                    state.restore()
+                    state.on_reset()
+                    state.sync()
+                else:  # HostsUpdatedInterrupt: graceful, no rollback/backoff
+                    consecutive_failures = 0
+                    logger.info("Membership changed; re-initializing "
+                                "without rollback")
+                    _reinitialize()
+                    state.on_reset()
+                    state.sync()
 
     wrapper.__name__ = getattr(func, "__name__", "elastic_run")
     return wrapper

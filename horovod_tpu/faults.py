@@ -1,0 +1,308 @@
+"""Deterministic, seedable fault injection for chaos-testing recovery.
+
+At production scale failures are the steady state — "Collective
+Communication for 100k+ GPUs" (PAPERS.md) reports that fault handling,
+not raw busbw, dominates fleet-level goodput.  This module makes every
+recovery path exercisable on demand: named injection *sites* are
+threaded through the recovery-relevant layers, and a **fault plan**
+declares what fires where:
+
+========== ===================================================== =====================
+site       threaded through                                      actions (``mode=``)
+========== ===================================================== =====================
+collective ``ops/collectives.py`` dispatch heartbeat             ``raise`` (HorovodInternalError)
+fusion     ``ops/fusion.py`` two-phase apply (trace time)        ``raise``
+discovery  ``elastic/driver.py`` ScriptDiscovery + poll          ``flap``/``timeout``/``error``
+rpc        ``runner/common/network.py`` BasicClient calls        ``drop``/``delay``
+checkpoint ``checkpoint.py`` Checkpointer.save                   ``corrupt``/``partial``
+========== ===================================================== =====================
+
+A plan comes from ``HVD_TPU_FAULT_SPEC`` (grammar parsed in
+:mod:`horovod_tpu.config`; e.g. ``collective:step=40;discovery:flap=0.2,
+seed=7``) or the :func:`inject` context manager.  Triggers are
+**deterministic**: ``step=N`` fires on the N-th event at the site (the
+checkpointer matches its own step number instead — the domain step is
+the reproducible coordinate there), ``p=x`` draws from a per-site
+``random.Random(seed)``, so the same spec over the same call sequence
+fires the identical failure sequence on every run — the property that
+makes a chaos failure debuggable.  :func:`history` records every firing
+for cross-run comparison.
+
+Hot-path contract: when no plan is active, ``_active is None`` and every
+instrumented call site guards on exactly that — zero work per dispatch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .config import FaultClause, parse_fault_spec
+from .utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "configure", "clear", "inject", "active_spec", "history",
+    "on_collective", "on_fusion", "on_discovery_script",
+    "on_discovery_hosts", "on_rpc", "on_checkpoint_save",
+]
+
+
+class _SiteState:
+    """Runtime state of one clause: event counter, firing count, and the
+    clause's private RNG (determinism: one RNG per site, never shared)."""
+
+    def __init__(self, clause: FaultClause) -> None:
+        self.clause = clause
+        self.rng = random.Random(clause.seed)
+        self.counter = 0   # events observed at this site
+        self.fired = 0
+
+    def _budget(self) -> int:
+        if self.clause.times is not None:
+            return self.clause.times
+        # A step fault is a one-shot by default (inject once, watch the
+        # recovery); a probability fault keeps flipping coins.
+        return 1 if self.clause.step is not None else (1 << 30)
+
+    def should_fire(self, domain_step: Optional[int] = None) -> bool:
+        idx = self.counter
+        self.counter += 1
+        if self.fired >= self._budget():
+            return False
+        if self.clause.step is not None:
+            at = domain_step if domain_step is not None else idx
+            if at == self.clause.step:
+                self.fired += 1
+                return True
+            if self.clause.p <= 0.0:
+                return False
+        if self.clause.p > 0.0 and self.rng.random() < self.clause.p:
+            self.fired += 1
+            return True
+        return False
+
+
+class FaultPlan:
+    """An armed fault plan: per-site state plus the firing history."""
+
+    def __init__(self, clauses: Dict[str, FaultClause], raw: str) -> None:
+        self.raw = raw
+        self._sites = {site: _SiteState(c) for site, c in clauses.items()}
+        self.history: List[Tuple[str, int, str]] = []
+        self._lock = threading.Lock()
+
+    def site(self, name: str) -> Optional[_SiteState]:
+        return self._sites.get(name)
+
+    def fire(self, site: str, mode: str, at: int, detail: str = "") -> None:
+        with self._lock:
+            self.history.append((site, at, mode + (f":{detail}" if detail
+                                                   else "")))
+        logger.warning("fault injected: site=%s mode=%s at=%d %s",
+                       site, mode, at, detail)
+
+
+_active: Optional[FaultPlan] = None
+_lock = threading.Lock()
+
+
+def configure(spec: Optional[str]) -> None:
+    """Arm (or disarm, with ``None``/empty) the process-wide fault plan.
+    Arming restarts counters/RNGs: a fresh, reproducible failure
+    sequence.  ``hvd.init`` arms only a *changed* spec, so the sequence
+    spans the whole process across elastic re-inits; call this (or
+    :func:`inject`) explicitly to restart it."""
+    global _active
+    with _lock:
+        if not spec:
+            _active = None
+            return
+        _active = FaultPlan(parse_fault_spec(spec), spec)
+        logger.warning("fault plan armed: %s", spec)
+
+
+def clear() -> None:
+    configure(None)
+
+
+def active_spec() -> Optional[str]:
+    return _active.raw if _active is not None else None
+
+
+def history() -> List[Tuple[str, int, str]]:
+    """Copy of the firing history ``[(site, at, action), ...]`` — the
+    cross-run reproducibility artifact."""
+    return list(_active.history) if _active is not None else []
+
+
+@contextlib.contextmanager
+def inject(spec: str):
+    """Context-manager fault plan (tests/chaos drivers)::
+
+        with faults.inject("collective:step=3"):
+            train(state)
+
+    Restores the previous plan (with its live counters) on exit."""
+    global _active
+    with _lock:
+        prev = _active
+        plan = FaultPlan(parse_fault_spec(spec), spec)
+        _active = plan
+    try:
+        yield plan
+    finally:
+        with _lock:
+            if _active is plan:
+                _active = prev
+
+
+# --- site hooks --------------------------------------------------------------
+# Call sites guard on ``faults._active is not None`` before calling these,
+# so an unset plan costs one module-attribute read per dispatch.
+
+def _internal_error(msg: str):
+    from .elastic.state import HorovodInternalError
+
+    return HorovodInternalError(msg)
+
+
+def on_collective(name: str = "") -> None:
+    """Site ``collective`` — raises ``HorovodInternalError`` when the
+    plan fires (the reference's a-collective-failed signal)."""
+    plan = _active
+    if plan is None:
+        return
+    st = plan.site("collective")
+    if st is None:
+        return
+    at = st.counter
+    if st.should_fire():
+        plan.fire("collective", "raise", at, name)
+        raise _internal_error(
+            f"injected collective fault at dispatch #{at} ({name})")
+
+
+def on_fusion(stage: str = "two_phase") -> None:
+    """Site ``fusion`` — fires inside the two-phase apply (trace time:
+    the failure surfaces while building the fused program)."""
+    plan = _active
+    if plan is None:
+        return
+    st = plan.site("fusion")
+    if st is None:
+        return
+    at = st.counter
+    if st.should_fire():
+        plan.fire("fusion", "raise", at, stage)
+        raise _internal_error(f"injected fusion fault at trace #{at} ({stage})")
+
+
+def on_discovery_script(script: str = "") -> None:
+    """Site ``discovery`` (modes ``timeout``/``error``) — fires before
+    the discovery script runs, as the script's failure would."""
+    import subprocess
+
+    plan = _active
+    if plan is None:
+        return
+    st = plan.site("discovery")
+    if st is None or st.clause.mode == "flap":
+        return
+    at = st.counter
+    if st.should_fire():
+        mode = st.clause.mode or "error"
+        plan.fire("discovery", mode, at, script)
+        if mode == "timeout":
+            raise subprocess.TimeoutExpired(script or "<discovery>",
+                                            timeout=0.0)
+        raise subprocess.CalledProcessError(1, script or "<discovery>",
+                                            stderr="injected discovery fault")
+
+
+def on_discovery_hosts(hosts: Dict[str, int]) -> Dict[str, int]:
+    """Site ``discovery`` (mode ``flap``) — drop each discovered host
+    independently with probability ``p`` (seeded): a flapping host set."""
+    plan = _active
+    if plan is None:
+        return hosts
+    st = plan.site("discovery")
+    if st is None or st.clause.mode != "flap":
+        return hosts
+    at = st.counter
+    st.counter += 1
+    if st.fired >= st._budget():  # times=N caps flapping polls too
+        return hosts
+    kept = {}
+    dropped = []
+    for host in sorted(hosts):  # sorted: draw order is reproducible
+        if st.rng.random() < st.clause.p:
+            dropped.append(host)
+        else:
+            kept[host] = hosts[host]
+    if dropped:
+        st.fired += 1
+        plan.fire("discovery", "flap", at, ",".join(dropped))
+    return kept
+
+
+def on_rpc(op: str = "") -> None:
+    """Site ``rpc`` — ``drop`` raises ``ConnectionError`` before the
+    request is written; ``delay`` sleeps ``delay_ms`` (a slow peer)."""
+    plan = _active
+    if plan is None:
+        return
+    st = plan.site("rpc")
+    if st is None:
+        return
+    at = st.counter
+    if st.should_fire():
+        mode = st.clause.mode or "drop"
+        plan.fire("rpc", mode, at, op)
+        if mode == "delay":
+            time.sleep(st.clause.delay_ms / 1000.0)
+            return
+        raise ConnectionError(f"injected rpc drop at call #{at} ({op})")
+
+
+def on_checkpoint_save(step: int) -> Optional[str]:
+    """Site ``checkpoint`` — returns ``"corrupt"``/``"partial"`` when the
+    plan fires for this checkpoint ``step`` (the domain step, so
+    ``checkpoint:step=2`` targets checkpoint 2 regardless of how many
+    saves preceded it), else None.  The checkpointer applies the damage."""
+    plan = _active
+    if plan is None:
+        return None
+    st = plan.site("checkpoint")
+    if st is None:
+        return None
+    if st.should_fire(domain_step=step):
+        mode = st.clause.mode or "corrupt"
+        plan.fire("checkpoint", mode, step)
+        return mode
+    return None
+
+
+# Arm from the environment at import time so pre-init layers (the
+# elastic driver, the runner's task agents) honor the spec too;
+# ``hvd.init`` arms changed/programmatic specs.  A malformed spec must
+# not break ``import horovod_tpu`` — it warns here and raises with the
+# full message at ``hvd.init`` (config validation).
+def _configure_from_env() -> None:
+    import os
+
+    spec = os.environ.get("HOROVOD_FAULT_SPEC") \
+        or os.environ.get("HVD_TPU_FAULT_SPEC")
+    if spec:
+        try:
+            configure(spec)
+        except ValueError as e:
+            logger.warning("ignoring malformed HVD_TPU_FAULT_SPEC at "
+                           "import (%s); hvd.init() will reject it", e)
+
+
+_configure_from_env()

@@ -21,8 +21,10 @@ custom call would force operand all-gathers; measured in
 HLO path in its manual-mode home — hlo 3334.5ms vs ffi 859.6ms, CPU
 controller tier).
 
-Registration uses ``jax.ffi.register_ffi_target`` with PyCapsules minted
-from ``dlsym`` addresses via ctypes — no pybind11 (not in this image).
+Registration uses ``jax.ffi.register_ffi_target`` (via the
+``_compat.ffi_module`` shim — ``jax.extend.ffi`` on jax 0.4.x) with
+PyCapsules minted from ``dlsym`` addresses via ctypes — no pybind11
+(not in this image).
 """
 
 from __future__ import annotations
@@ -55,10 +57,11 @@ def _needs_build() -> bool:
 
 def build(verbose: bool = False) -> Optional[str]:
     """Compile the FFI library against the jaxlib headers (mtime-cached)."""
-    import jax.ffi
+    from .._compat import ffi_module
 
+    jffi = ffi_module()
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-           f"-I{jax.ffi.include_dir()}", SRC, "-o", SO_PATH]
+           f"-I{jffi.include_dir()}", SRC, "-o", SO_PATH]
     try:
         proc = subprocess.run(cmd, check=True, capture_output=True,
                               timeout=300)
@@ -85,20 +88,29 @@ def ensure_registered() -> bool:
             _failed = True
             return False
         try:
-            import jax.ffi
+            from .._compat import ffi_module
 
+            jffi = ffi_module()
             lib = ctypes.cdll.LoadLibrary(SO_PATH)
             for name in _TARGETS:
                 fn = getattr(lib, name)
-                jax.ffi.register_ffi_target(
-                    name, jax.ffi.pycapsule(fn), platform="cpu")
+                jffi.register_ffi_target(
+                    name, jffi.pycapsule(fn), platform="cpu")
             # pack/unpack treat each leading-dim row independently, so the
             # SPMD partitioner may keep dim-0 (slot) sharding and run the
             # handler per-shard — without this, slot-sharded operands get
             # all-gathered before the custom call.  (adasum_combine is NOT
             # partitionable: its dot products are global.)
-            for name in ("hvd_bucket_pack", "hvd_bucket_unpack"):
-                jax.ffi.register_ffi_target_as_batch_partitionable(name)
+            # Only in the new (jax.ffi) home; on 0.4.x the partitioner
+            # falls back to gathering operands — correct, just slower,
+            # and _native_ffi_ok's manual-region gate keeps it off the
+            # auto-partitioned path anyway.
+            reg_bp = getattr(jffi,
+                             "register_ffi_target_as_batch_partitionable",
+                             None)
+            if reg_bp is not None:
+                for name in ("hvd_bucket_pack", "hvd_bucket_unpack"):
+                    reg_bp(name)
             _registered = True
             return True
         except Exception as e:  # registration must never break the core
@@ -123,20 +135,24 @@ def bucket_pack(leaves: Sequence) -> "jax.Array":
     import jax
     import jax.numpy as jnp
 
+    from .._compat import ffi_module
+
     leaves = [jnp.asarray(x) for x in leaves]
     rows = leaves[0].shape[0]
     total = sum(int(x.shape[1]) for x in leaves)
     out_t = jax.ShapeDtypeStruct((rows, total), leaves[0].dtype)
-    return jax.ffi.ffi_call("hvd_bucket_pack", out_t)(*leaves)
+    return ffi_module().ffi_call("hvd_bucket_pack", out_t)(*leaves)
 
 
 def bucket_unpack(flat, cols: Sequence[int]) -> List:
     """Split one ``[L, sum(cols)]`` buffer back into ``[L, c]`` pieces."""
     import jax
 
+    from .._compat import ffi_module
+
     rows = flat.shape[0]
     outs = [jax.ShapeDtypeStruct((rows, int(c)), flat.dtype) for c in cols]
-    res = jax.ffi.ffi_call("hvd_bucket_unpack", outs)(flat)
+    res = ffi_module().ffi_call("hvd_bucket_unpack", outs)(flat)
     return list(res)
 
 
@@ -145,5 +161,7 @@ def adasum_combine(a, b):
     scaled-add kernels fused into one pass); f32/f64."""
     import jax
 
+    from .._compat import ffi_module
+
     out_t = jax.ShapeDtypeStruct(a.shape, a.dtype)
-    return jax.ffi.ffi_call("hvd_adasum_combine", out_t)(a, b)
+    return ffi_module().ffi_call("hvd_adasum_combine", out_t)(a, b)

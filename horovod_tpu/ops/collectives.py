@@ -50,6 +50,7 @@ from jax.sharding import PartitionSpec as P
 from .compression import Compression
 from . import adasum as adasum_mod
 from . import fusion as fusion_mod
+from .. import faults as faults_mod
 from .._compat import shard_map
 
 # --- reduction-op constants (reference: hvd.Sum / hvd.Average / ...) --------
@@ -99,6 +100,11 @@ def _members_key(process_set) -> Optional[Tuple[int, ...]]:
 
 
 def _heartbeat(name: str) -> None:
+    # Fault site "collective": one counter tick per dispatch; raises
+    # HorovodInternalError when the armed plan fires.  The guard keeps
+    # the unset-plan hot path at a single attribute read.
+    if faults_mod._active is not None:
+        faults_mod.on_collective(name)
     st = _st()
     if st.stall_inspector is not None:
         st.stall_inspector.record_activity(name)

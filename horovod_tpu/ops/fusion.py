@@ -361,6 +361,13 @@ def fused_two_phase_apply(
     Must run inside an SPMD region over ``axis``; numerically equivalent
     to the single-phase path (same reduction, same compression wire).
     """
+    # Fault site "fusion": fires at trace time — the failure surfaces
+    # while the fused two-phase program is being built, the moment a
+    # planner/compile bug would.
+    from .. import faults as _faults
+
+    if _faults._active is not None:
+        _faults.on_fusion("two_phase_apply")
     n = _uniform_group_width(axis, groups)
 
     out: List[jax.Array] = [None] * len(leaves)  # type: ignore[list-item]

@@ -22,6 +22,8 @@ import struct
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+from ... import faults as faults_mod
+from ...utils.retry import RetryPolicy, retry_call
 from .secret import DIGEST_LEN
 
 _LEN = struct.Struct(">Q")
@@ -175,16 +177,42 @@ class BasicService:
         self._thread.join(timeout=5)
 
 
+def _default_rpc_policy() -> RetryPolicy:
+    """The unified control-plane retry policy: ``HVD_TPU_RPC_RETRIES``
+    attempts with ``HVD_TPU_RPC_BACKOFF`` jittered exponential backoff.
+    The resolved Config wins when this process ran ``hvd.init``;
+    launcher/agent processes (which never init) parse the env afresh —
+    same parser, same defaults, no drift."""
+    from ... import basics
+    from ...config import Config
+
+    cfg = basics.config() if basics.is_initialized() else Config.from_env()
+    return RetryPolicy(attempts=max(1, cfg.rpc_retries),
+                       base_delay_s=cfg.rpc_backoff_seconds,
+                       max_delay_s=5.0)
+
+
 class BasicClient:
     """Client side; tries each candidate address until one answers the
     ping (reference: the driver probing every task address to find a
-    routable interface)."""
+    routable interface).
+
+    Post-probe requests retry under the shared policy (jittered
+    exponential backoff): a dropped connection or slow peer is routine
+    at fleet scale, and a registration lost to one TCP RST otherwise
+    costs the whole launch.  The probe itself stays single-shot per
+    address (dead candidates are expected — that's what probing is),
+    and ``ping()`` stays single-shot because liveness accounting
+    (missed-ping counters) owns its own schedule.
+    """
 
     def __init__(self, name: str, addresses: List[Tuple[str, int]],
-                 key: bytes, probe_timeout: float = 5.0):
+                 key: bytes, probe_timeout: float = 5.0,
+                 retry_policy: Optional[RetryPolicy] = None):
         self.name = name
         self._key = key
         self._timeout = probe_timeout
+        self._retry_policy = retry_policy or _default_rpc_policy()
         self._address = self._probe(addresses)
 
     @property
@@ -204,13 +232,31 @@ class BasicClient:
             f"no address of service {self.name!r} answered: {errs}")
 
     def _call(self, req: Any, addr: Optional[Tuple[str, int]] = None) -> Any:
+        # Fault site "rpc": drop (ConnectionError before the write — the
+        # retry policy's job to absorb) or delay (a slow peer).
+        if faults_mod._active is not None:
+            faults_mod.on_rpc(type(req).__name__)
         addr = addr or self._address
         with socket.create_connection(addr, timeout=self._timeout) as sock:
             write_message(sock, req, self._key)
             return read_message(sock, self._key)
 
-    def request(self, req: Any) -> Any:
-        return self._call(req)
+    def request(self, req: Any, *, idempotent: bool = True) -> Any:
+        """One request/response exchange, retried under the unified
+        policy (OSError covers refused/reset/timed-out sockets).
+
+        ``idempotent=False`` disables the retry: re-sending a request
+        whose *response* was lost would re-execute its side effect
+        (e.g. a run-command landing twice) — for those, one attempt and
+        let the caller own the ambiguity."""
+        if not idempotent:
+            return self._call(req)
+        return retry_call(
+            lambda: self._call(req),
+            policy=self._retry_policy,
+            retry_on=(OSError,),
+            describe=f"rpc {type(req).__name__} -> {self.name}",
+        )
 
     def ping(self) -> PingResponse:
         return self._call(PingRequest())

@@ -450,6 +450,10 @@ def run_elastic(command: List[str], *, min_np: int = 1,
                 # not be "restarted" by a late membership delta.
                 rcs = [p.poll() for p in procs]
                 if all(rc == 0 for rc in rcs):
+                    # Strike reset: the hosts of a world that ran to
+                    # completion earned their blacklist strikes back.
+                    for host in hosts_this_world:
+                        driver.record_success(host)
                     return 0
                 if any(rc is not None and rc != 0 for rc in rcs):
                     # A local supervisor cannot attribute the failure to

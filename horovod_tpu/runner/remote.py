@@ -189,8 +189,11 @@ def remote_run(hosts: List[Tuple[str, int]], command: List[str], *,
         for i, ranks in enumerate(rank_blocks):
             if not ranks:
                 continue
+            # Non-idempotent: a retried launch whose first ACK was lost
+            # would hit "already running" on the agent.
             clients[i].request(RunDistributedCommandRequest(
-                command, env or {}, ranks, world_size, coordinator))
+                command, env or {}, ranks, world_size, coordinator),
+                idempotent=False)
 
         # Supervise: first nonzero exit kills the job (reference
         # behavior); all-zero on every agent means success.

@@ -24,10 +24,13 @@ Usage (what the launcher execs over ssh)::
 from __future__ import annotations
 
 import argparse
+import random
 import sys
 import time
 from typing import List, Optional, Tuple
 
+from ..config import Config
+from ..utils.retry import jittered
 from .common.network import BasicClient, resolvable_hostname
 from .common.service import RegisterTaskRequest, TaskService
 
@@ -70,9 +73,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         #  * idle timeout — registered but no command ever arrived;
         #  * liveness — once a command ran, a driver that stops
         #    answering pings means the launcher died: abort workers.
+        # The policy knobs are env-configurable (HVD_TPU_AGENT_PING_
+        # INTERVAL / _MAX_MISSED) — a 500-host fleet wants a laxer
+        # cadence than a 2-host bench — and the cadence is jittered so
+        # agents don't ping the driver in lockstep; after a missed ping
+        # the next probe comes sooner (jittered exponential ramp back
+        # up to the interval) to tell a blip from a dead driver fast.
+        from .. import basics
+
+        # Programmatic Config wins; the normal agent path (ssh-exec'd,
+        # never init()ed) parses the env with the same parser/defaults.
+        cfg = (basics.config() if basics.is_initialized()
+               else Config.from_env())
+        ping_interval = cfg.agent_ping_interval_seconds
+        max_missed = cfg.agent_max_missed_pings
+        rng = random.Random(args.index)  # per-agent deterministic spread
         idle_deadline = time.monotonic() + args.timeout
         missed_pings = 0
-        while not service.shutdown_requested.wait(timeout=15.0):
+        wait_s = jittered(ping_interval, 0.25, rng)
+        while not service.shutdown_requested.wait(timeout=wait_s):
+            wait_s = jittered(ping_interval, 0.25, rng)
             if not service.command_started:
                 if time.monotonic() > idle_deadline:
                     print(f"task-{args.index}: no command within "
@@ -84,11 +104,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                 missed_pings = 0
             except OSError:
                 missed_pings += 1
-                if missed_pings >= 4:
-                    print(f"task-{args.index}: driver unreachable; "
-                          "aborting workers", file=sys.stderr)
+                if missed_pings >= max_missed:
+                    print(f"task-{args.index}: driver unreachable "
+                          f"({missed_pings} missed pings; interval "
+                          f"{ping_interval:.0f}s); aborting workers",
+                          file=sys.stderr)
                     service.abort_command()
                     return 1
+                # Retry quickly (but jittered) while suspicion mounts.
+                wait_s = jittered(
+                    min(ping_interval, 1.0 * 2 ** (missed_pings - 1)),
+                    0.5, rng)
         return 0
     finally:
         service.shutdown()

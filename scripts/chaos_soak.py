@@ -1,0 +1,111 @@
+#!/usr/bin/env python3
+"""Chaos soak: loop the fault-injection recovery tests over randomized
+injection points and write a pass/fail summary JSON.
+
+Each iteration draws a fresh (fault step, RNG seed) pair, exports it via
+``HVD_TPU_CHAOS_STEP``/``HVD_TPU_CHAOS_SEED``, and runs the
+``chaos``-marked pytest suite in a subprocess.  The summary records
+every run's knobs, exit code and duration — soak evidence a later PR
+can cite ("N randomized chaos runs green at commit X").
+
+Default target is the single-controller chaos test (runs anywhere the
+tier-1 suite runs); ``--mp`` switches to the multi-process world test
+(needs a jax build whose CPU backend supports multiprocess computations,
+or real accelerators).
+
+Usage::
+
+    python scripts/chaos_soak.py --runs 20 --out chaos_soak.json
+    python scripts/chaos_soak.py --runs 5 --mp --master-seed 7
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SINGLE_TARGET = "tests/test_faults.py"
+MP_TARGET = "tests/multiproc/test_chaos_recovery_mp.py"
+
+
+def run_once(target: str, step: int, seed: int, timeout_s: float) -> dict:
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
+        "HVD_TPU_CHAOS_STEP": str(step),
+        "HVD_TPU_CHAOS_SEED": str(seed),
+    })
+    cmd = [sys.executable, "-m", "pytest", target, "-q", "-m", "chaos",
+           "-p", "no:cacheprovider"]
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                              text=True, timeout=timeout_s)
+        rc, tail = proc.returncode, proc.stdout[-2000:]
+    except subprocess.TimeoutExpired:
+        rc, tail = -1, f"timeout after {timeout_s}s"
+    return {
+        "step": step,
+        "seed": seed,
+        "rc": rc,
+        "passed": rc == 0,
+        "duration_s": round(time.monotonic() - t0, 2),
+        "tail": tail if rc != 0 else "",
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--runs", type=int, default=10,
+                    help="number of randomized iterations (default 10)")
+    ap.add_argument("--mp", action="store_true",
+                    help="soak the multi-process world test instead of "
+                         "the single-controller one")
+    ap.add_argument("--master-seed", type=int, default=None,
+                    help="seed for the (step, seed) draw itself — a "
+                         "seeded soak is replayable end to end")
+    ap.add_argument("--max-step", type=int, default=24,
+                    help="injection points are drawn from [0, max-step]")
+    ap.add_argument("--timeout", type=float, default=600.0,
+                    help="per-iteration pytest timeout in seconds")
+    ap.add_argument("--out", default="chaos_soak.json",
+                    help="summary JSON path (default chaos_soak.json)")
+    args = ap.parse_args(argv)
+
+    rng = random.Random(args.master_seed)
+    target = MP_TARGET if args.mp else SINGLE_TARGET
+    runs = []
+    for i in range(args.runs):
+        step = rng.randrange(0, args.max_step + 1)
+        seed = rng.randrange(0, 1 << 30)
+        print(f"[chaos_soak] run {i + 1}/{args.runs}: "
+              f"target={target} step={step} seed={seed}", flush=True)
+        result = run_once(target, step, seed, args.timeout)
+        print(f"[chaos_soak]   -> {'PASS' if result['passed'] else 'FAIL'} "
+              f"({result['duration_s']}s)", flush=True)
+        runs.append(result)
+
+    summary = {
+        "target": target,
+        "master_seed": args.master_seed,
+        "total": len(runs),
+        "passed": sum(r["passed"] for r in runs),
+        "failed": sum(not r["passed"] for r in runs),
+        "runs": runs,
+    }
+    with open(args.out, "w") as f:
+        json.dump(summary, f, indent=2)
+    print(f"[chaos_soak] {summary['passed']}/{summary['total']} passed; "
+          f"summary -> {args.out}")
+    return 0 if summary["failed"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

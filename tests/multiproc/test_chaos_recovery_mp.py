@@ -1,0 +1,92 @@
+"""End-to-end chaos recovery: an *injected* collective fault
+(horovod_tpu/faults.py) in a real 2-controller ``jax.distributed``
+world must drive the full elastic loop — rollback to the last commit,
+re-init, rank-0 sync — and training must converge with state intact.
+
+This is the harness's reason to exist (ISSUE 2 tentpole): the
+SIGKILL/grow tests (test_elastic_kill_mp / test_elastic_grow_mp) cover
+process death and resize; this one covers the reference's
+``HorovodInternalError`` path under a *deterministic, seeded* failure —
+every rank's plan fires at the same dispatch index, so the whole world
+fails the same step, exactly like a collective erroring on the wire.
+
+Seeded knobs (``HVD_TPU_CHAOS_STEP`` / ``HVD_TPU_CHAOS_SEED``) let
+``scripts/chaos_soak.py`` loop this test over randomized injection
+points."""
+
+import json
+import os
+
+import pytest
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos]
+
+BODY = """
+import json
+from horovod_tpu import faults
+from horovod_tpu.elastic import TpuState, run as elastic_run
+
+workdir = os.path.dirname(os.path.abspath(__file__))
+fault_step = int(os.environ.get('HVD_TPU_CHAOS_STEP', '5'))
+seed = int(os.environ.get('HVD_TPU_CHAOS_SEED', '0'))
+# Armed AFTER init on every rank: site counters start at zero, so the
+# plan fires at the same dispatch index world-wide (SPMD dispatch order
+# is the determinism contract).
+faults.configure(f"collective:step={fault_step},seed={seed}")
+
+TOTAL = 8
+state = TpuState(params={'w': jax.numpy.zeros((2,))}, step=0, accum=0.0)
+meta = {'tries': 0}
+
+@elastic_run
+def train(state):
+    meta['tries'] += 1
+    if meta['tries'] == 2:
+        # Retry entry: the rollback must have restored the committed
+        # accumulator exactly (sum of nproc*t for completed steps t).
+        expect = sum(nproc * t for t in range(int(state.step)))
+        assert abs(float(state.accum) - expect) < 1e-6, (state.accum, expect)
+        open(os.path.join(workdir, f'rolledback_{rank}'),
+             'w').write(str(int(state.step)))
+    while int(state.step) < TOTAL:
+        s = int(state.step)
+        x = np.full((1, 2), float(s), np.float32)
+        out = float(np.asarray(hvd.allreduce(x, op=hvd.Sum)).ravel()[0])
+        state.accum = float(state.accum) + out
+        state.params = jax.tree.map(lambda p: p + 1.0, state.params)
+        state.step = s + 1
+        state.commit()
+    return state
+
+train(state)
+
+fired = [h for h in faults.history() if h[0] == 'collective']
+assert len(fired) == 1, f'expected exactly one injected fault, got {fired}'
+assert meta['tries'] == 2, meta
+want = sum(nproc * t for t in range(TOTAL))
+assert abs(float(state.accum) - want) < 1e-6, (state.accum, want)
+assert float(np.asarray(state.params['w'])[0]) == float(TOTAL)
+if rank == 0:
+    json.dump({'accum': float(state.accum), 'fired': [list(h) for h in fired],
+               'nproc': nproc},
+              open(os.path.join(workdir, 'chaos_result.json'), 'w'))
+print(f'rank {rank}: recovered from injected fault, accum={state.accum}')
+"""
+
+
+class TestChaosRecovery:
+    def test_injected_collective_fault_rolls_back_and_converges(
+            self, world, tmp_path):
+        world(2, BODY, timeout=300.0)
+        result = json.load(open(tmp_path / "chaos_result.json"))
+        want = sum(2 * t for t in range(8))
+        assert result["accum"] == float(want), result
+        # Every rank rolled back (the fault fired world-wide), at the
+        # same committed step.
+        rolled = sorted(p.name for p in tmp_path.glob("rolledback_*"))
+        assert rolled == ["rolledback_0", "rolledback_1"], rolled
+        steps = {(tmp_path / m).read_text() for m in rolled}
+        assert len(steps) == 1, steps
+        # The injected fault is on the record, at the configured index.
+        step = int(os.environ.get("HVD_TPU_CHAOS_STEP", "5"))
+        assert result["fired"][0][:2] == ["collective", step], result

@@ -1,11 +1,17 @@
-"""Durable checkpoint tests (SURVEY.md §5 checkpoint/resume row)."""
+"""Durable checkpoint tests (SURVEY.md §5 checkpoint/resume row),
+including the integrity tier: digest sidecars, verified restore, and
+fallback to the newest intact step when the latest is corrupt."""
+
+import os
 
 import numpy as np
 import pytest
 import jax.numpy as jnp
 
+from horovod_tpu import faults
 from horovod_tpu.checkpoint import (
-    Checkpointer, latest_step, restore, save, should_save_on_this_host,
+    Checkpointer, CheckpointCorruptionError, latest_step, pytree_digest,
+    restore, save, should_save_on_this_host,
 )
 from horovod_tpu.elastic import TpuState
 
@@ -47,6 +53,149 @@ class TestCheckpointer:
 
     def test_should_save_on_this_host(self):
         assert should_save_on_this_host() is True  # single controller
+
+
+def _fill_steps(directory, steps=(1, 2, 3)):
+    with Checkpointer(directory, async_save=False, max_to_keep=10) as ckpt:
+        for s in steps:
+            ckpt.save(s, {"x": jnp.full((4,), float(s)), "epoch": s})
+
+
+def _corrupt_step(directory, step):
+    """Bit-flip the largest file of a step dir (what a torn write or a
+    flipped disk block looks like to the restore path)."""
+    from horovod_tpu.checkpoint import _damage_step_dir
+
+    _damage_step_dir(directory, step, "corrupt")
+
+
+class TestPytreeDigest:
+    def test_stable_and_content_sensitive(self):
+        a = {"w": jnp.ones((2, 2)), "n": 3}
+        assert pytree_digest(a) == pytree_digest(
+            {"w": jnp.ones((2, 2)), "n": 3})
+        assert pytree_digest(a) != pytree_digest(
+            {"w": jnp.ones((2, 2)), "n": 4})
+        assert pytree_digest(a) != pytree_digest(
+            {"v": jnp.ones((2, 2)), "n": 3})  # key path matters
+
+    def test_sidecar_written_next_to_save(self, tmp_path):
+        d = str(tmp_path / "ck")
+        _fill_steps(d, steps=(1,))
+        assert os.path.exists(os.path.join(d, "digests", "1.json"))
+
+    def test_container_normalization_invariant(self):
+        # A save/restore round trip turns namedtuples into dicts (and
+        # reorders leaves: field order vs sorted keys) — not a content
+        # change, so the digest must not change.
+        from collections import namedtuple
+
+        Opt = namedtuple("Opt", ["mu", "count"])  # non-alphabetical
+        as_nt = {"opt": Opt(mu={"w": jnp.ones((2,))},
+                            count=jnp.zeros((), jnp.int32))}
+        as_dict = {"opt": {"count": jnp.zeros((), jnp.int32),
+                           "mu": {"w": jnp.ones((2,))}}}
+        assert pytree_digest(as_nt) == pytree_digest(as_dict)
+        assert pytree_digest([jnp.ones(3), jnp.zeros(2)]) == \
+            pytree_digest((jnp.ones(3), jnp.zeros(2)))
+
+    def test_namedtuple_state_restores_verified(self, tmp_path):
+        # End to end: the optax-shaped tree must restore WITHOUT
+        # tripping digest verification (regression: GetAttrKey vs
+        # DictKey paths made every such checkpoint look corrupt).
+        from collections import namedtuple
+
+        Opt = namedtuple("Opt", ["mu", "count"])
+        tree = {"opt": Opt(mu={"w": jnp.full((2,), 5.0)},
+                           count=jnp.asarray(9, jnp.int32))}
+        d = str(tmp_path / "ck")
+        with Checkpointer(d, async_save=False) as ckpt:
+            ckpt.save(1, tree)
+        with Checkpointer(d, async_save=False) as ckpt:
+            got = ckpt.restore()  # latest path: would fall back/raise
+        assert int(got["opt"]["count"]) == 9
+        np.testing.assert_allclose(np.asarray(got["opt"]["mu"]["w"]),
+                                   [5.0, 5.0])
+
+
+class TestRestoreFallback:
+    def test_corrupted_latest_falls_back_to_newest_intact(self, tmp_path):
+        d = str(tmp_path / "ck")
+        _fill_steps(d)
+        _corrupt_step(d, 3)
+        with Checkpointer(d, async_save=False) as ckpt:
+            got = ckpt.restore()  # latest (3) is damaged -> step 2
+        np.testing.assert_allclose(np.asarray(got["x"]), [2.0] * 4)
+        assert int(got["epoch"]) == 2
+
+    def test_explicit_step_never_falls_back(self, tmp_path):
+        d = str(tmp_path / "ck")
+        _fill_steps(d)
+        _corrupt_step(d, 3)
+        with Checkpointer(d, async_save=False) as ckpt:
+            with pytest.raises(Exception):
+                ckpt.restore(3)
+            # ...while the intact explicit step still restores.
+            got = ckpt.restore(1)
+        assert int(got["epoch"]) == 1
+
+    def test_template_mismatch_propagates_not_corruption(self, tmp_path):
+        # A structurally-wrong template is a caller bug that would fail
+        # on every step: it must surface as the orbax ValueError, not as
+        # "no intact checkpoint" after silently grinding the fallback.
+        d = str(tmp_path / "ck")
+        _fill_steps(d)
+        bad_template = {"wrong_key": jnp.zeros((4,))}
+        with Checkpointer(d, async_save=False) as ckpt:
+            with pytest.raises(ValueError, match="key mismatch"):
+                ckpt.restore(template=bad_template)
+
+    def test_template_restore_skips_byte_digest(self, tmp_path):
+        # A template restore transforms content (here: a dtype cast) —
+        # that is not corruption, so digest verification must not fire.
+        d = str(tmp_path / "ck")
+        _fill_steps(d, steps=(1,))
+        template = {"x": jnp.zeros((4,), jnp.bfloat16), "epoch": 0}
+        with Checkpointer(d, async_save=False) as ckpt:
+            got = ckpt.restore(template=template)
+        assert got["x"].dtype == jnp.bfloat16
+
+    def test_all_steps_corrupt_raises_corruption_error(self, tmp_path):
+        d = str(tmp_path / "ck")
+        _fill_steps(d, steps=(1, 2))
+        _corrupt_step(d, 1)
+        _corrupt_step(d, 2)
+        with Checkpointer(d, async_save=False) as ckpt:
+            with pytest.raises(CheckpointCorruptionError):
+                ckpt.restore()
+
+    def test_injected_corrupt_save_triggers_fallback(self, tmp_path):
+        """The fault-site flow end to end: checkpoint:step=3,mode=corrupt
+        damages step 3 as it is written; restore degrades to step 2."""
+        d = str(tmp_path / "ck")
+        with faults.inject("checkpoint:step=3,mode=corrupt"):
+            _fill_steps(d)
+            assert [h[:2] for h in faults.history()] == [("checkpoint", 3)]
+        with Checkpointer(d, async_save=False) as ckpt:
+            got = ckpt.restore()
+        assert int(got["epoch"]) == 2
+
+    def test_injected_partial_save_triggers_fallback(self, tmp_path):
+        d = str(tmp_path / "ck")
+        with faults.inject("checkpoint:step=2,mode=partial"):
+            _fill_steps(d, steps=(1, 2))
+        with Checkpointer(d, async_save=False) as ckpt:
+            got = ckpt.restore()
+        assert int(got["epoch"]) == 1
+
+    def test_verify_off_skips_digests(self, tmp_path):
+        d = str(tmp_path / "ck")
+        with Checkpointer(d, async_save=False, verify=False) as ckpt:
+            ckpt.save(1, {"x": jnp.ones((2,))})
+        assert not os.path.exists(os.path.join(d, "digests"))
+        with Checkpointer(d, async_save=False, verify=False) as ckpt:
+            np.testing.assert_allclose(np.asarray(ckpt.restore()["x"]),
+                                       [1.0, 1.0])
 
 
 class TestElasticDurableTier:

@@ -159,6 +159,236 @@ class TestElasticDriver:
         check()  # flag cleared
 
 
+class _FlakyDiscovery(FixedDiscovery):
+    """Raises for the first ``fail_first`` polls, then serves hosts."""
+
+    def __init__(self, hosts, fail_first=0, forever=False):
+        super().__init__(hosts)
+        self.fail_first = fail_first
+        self.forever = forever
+        self.calls = 0
+
+    def find_available_hosts_and_slots(self):
+        self.calls += 1
+        if self.forever or self.calls <= self.fail_first:
+            raise RuntimeError(f"discovery outage #{self.calls}")
+        return super().find_available_hosts_and_slots()
+
+
+class TestBlacklistDecay:
+    def test_decay_gives_half_open_probation(self):
+        driver = ElasticDriver(FixedDiscovery({"a": 1, "b": 1}),
+                               blacklist_after=2, blacklist_decay_s=0.05)
+        driver.record_failure("b")
+        driver.record_failure("b")
+        assert driver.blacklisted("b")
+        import time
+
+        time.sleep(0.06)
+        assert not driver.blacklisted("b")       # decayed: eligible again
+        driver.poll_once()
+        assert driver.hosts == {"a": 1, "b": 1}  # back in membership
+        driver.record_failure("b")               # half-open: ONE strike...
+        assert driver.blacklisted("b")           # ...re-blacklists
+
+    def test_zero_decay_is_permanent(self):
+        driver = ElasticDriver(FixedDiscovery({"a": 1}),
+                               blacklist_after=1, blacklist_decay_s=0.0)
+        driver.record_failure("a")
+        import time
+
+        time.sleep(0.02)
+        assert driver.blacklisted("a")
+
+    def test_record_success_resets_strikes_and_blacklist(self):
+        driver = ElasticDriver(FixedDiscovery({"a": 1}),
+                               blacklist_after=2, blacklist_decay_s=600.0)
+        driver.record_failure("a")
+        driver.record_failure("a")
+        assert driver.blacklisted("a")
+        driver.record_success("a")
+        assert not driver.blacklisted("a")
+        driver.record_failure("a")               # full strike budget again
+        assert not driver.blacklisted("a")
+        driver.record_failure("a")
+        assert driver.blacklisted("a")
+
+
+class TestDiscoveryFailureAccounting:
+    def test_sub_threshold_failures_hold_membership(self):
+        disc = _FlakyDiscovery({"a": 2}, fail_first=0)
+        driver = ElasticDriver(disc, failure_threshold=3)
+        driver.poll_once()
+        assert driver.world_size() == 2
+        disc.forever = True
+        assert driver.poll_once() is False       # failure 1: held
+        assert driver.poll_once() is False       # failure 2: held
+        assert driver.hosts == {"a": 2}
+
+    def test_threshold_failures_mean_membership_loss(self):
+        events = []
+        disc = _FlakyDiscovery({"a": 2}, forever=False)
+        driver = ElasticDriver(disc, failure_threshold=3)
+        driver.register_hosts_updated_callback(
+            lambda added, removed: events.append((sorted(added),
+                                                  sorted(removed))))
+        driver.poll_once()
+        disc.forever = True
+        driver.poll_once()
+        driver.poll_once()
+        assert driver.poll_once() is True        # 3rd consecutive: lost
+        assert driver.hosts == {}
+        assert events[-1] == ([], ["a"])
+        # Recovery clears the streak and membership returns.
+        disc.forever = False
+        assert driver.poll_once() is True
+        assert driver.hosts == {"a": 2}
+
+    def test_wait_for_available_slots_survives_flaky_poll(self):
+        disc = _FlakyDiscovery({"a": 4}, fail_first=2)
+        driver = ElasticDriver(disc, poll_interval_s=0.01,
+                               failure_threshold=5)
+        hosts = driver.wait_for_available_slots(4, timeout_s=5.0)
+        assert hosts == {"a": 4}
+
+    def test_script_discovery_retries_flaky_script(self, tmp_path):
+        # The script fails on its first invocation (no state file), then
+        # succeeds — the retry helper must absorb that inside ONE
+        # find_available_hosts_and_slots call.
+        state = tmp_path / "ran_once"
+        script = tmp_path / "discover.sh"
+        script.write_text(textwrap.dedent(f"""\
+            #!/bin/sh
+            if [ ! -f {state} ]; then
+              touch {state}
+              exit 1
+            fi
+            echo host1:4
+        """))
+        script.chmod(script.stat().st_mode | stat.S_IEXEC)
+        disc = ScriptDiscovery(str(script), retries=3, backoff_s=0.01)
+        assert disc.find_available_hosts_and_slots() == {"host1": 4}
+
+
+class _FakeXlaRuntimeError(Exception):
+    pass
+
+
+# The default translator matches on the *type name* the jax runtime
+# uses, not the class identity (jaxlib's type isn't constructible here).
+_FakeXlaRuntimeError.__name__ = "XlaRuntimeError"
+
+
+class TestExceptionTranslation:
+    def test_default_translates_xla_collective_failure(self):
+        from horovod_tpu.elastic import translate_exception
+
+        err = translate_exception(
+            _FakeXlaRuntimeError("INTERNAL: all-reduce failed: peer down"))
+        assert isinstance(err, HorovodInternalError)
+
+    def test_default_passes_unrelated_errors(self):
+        from horovod_tpu.elastic import translate_exception
+
+        assert translate_exception(ValueError("bad shape")) is None
+        assert translate_exception(
+            _FakeXlaRuntimeError("INVALID_ARGUMENT: shape mismatch")) is None
+
+    def test_run_recovers_from_translated_error(self, monkeypatch):
+        from horovod_tpu.elastic import state as state_mod
+
+        monkeypatch.setattr(state_mod.time, "sleep", lambda s: None)
+        state = ObjectState(step=0)
+        calls = {"n": 0}
+
+        @run
+        def train(state):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise _FakeXlaRuntimeError(
+                    "DEADLINE_EXCEEDED: collective permute hung")
+            return "done"
+
+        assert train(state) == "done"
+        assert calls["n"] == 2
+
+    def test_untranslated_error_propagates(self):
+        state = ObjectState(step=0)
+
+        @run
+        def train(state):
+            raise KeyError("app bug")
+
+        with pytest.raises(KeyError):
+            train(state)
+
+    def test_registered_translator_wins(self, monkeypatch):
+        from horovod_tpu.elastic import (register_exception_translator,
+                                         state as state_mod)
+
+        monkeypatch.setattr(state_mod.time, "sleep", lambda s: None)
+
+        class PreemptionNotice(Exception):
+            pass
+
+        def my_translator(e):
+            if isinstance(e, PreemptionNotice):
+                return HorovodInternalError(f"preempted: {e}")
+            return None
+
+        register_exception_translator(my_translator)
+        try:
+            state = ObjectState(step=0)
+            calls = {"n": 0}
+
+            @run
+            def train(state):
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    raise PreemptionNotice("node reclaim in 30s")
+                return calls["n"]
+
+            assert train(state) == 2
+        finally:
+            state_mod._translators.remove(my_translator)
+
+
+class TestResetBackoff:
+    def test_backoff_grows_between_failed_resets(self, monkeypatch):
+        import horovod_tpu as hvd
+        from horovod_tpu.elastic import state as state_mod
+
+        sleeps = []
+        monkeypatch.setattr(state_mod.time, "sleep",
+                            lambda s: sleeps.append(s))
+        # Each reset re-inits and re-reads the env, so the knob must be
+        # patched BOTH on the live config and in the environment.
+        monkeypatch.setenv("HVD_TPU_RESET_BACKOFF", "1.0")
+        object.__setattr__(hvd.config(), "reset_backoff_seconds", 1.0)
+        try:
+            state = ObjectState(x=0)
+            calls = {"n": 0}
+
+            @run
+            def train(state):
+                calls["n"] += 1
+                if calls["n"] <= 3:
+                    raise HorovodInternalError("boom")
+                return True
+
+            assert train(state) is True
+        finally:
+            # The config object may have been replaced by the re-inits;
+            # restore the session default on whichever one is live.
+            object.__setattr__(hvd.config(), "reset_backoff_seconds", 0.5)
+        assert len(sleeps) == 3
+        # Jittered exponential: each window is [d*(1-j), d*(1+j)] around
+        # 1, 2, 4 — strictly increasing midpoints with j=0.5.
+        assert 0.5 <= sleeps[0] <= 1.5
+        assert 1.0 <= sleeps[1] <= 3.0
+        assert 2.0 <= sleeps[2] <= 6.0
+
+
 class TestElasticSampler:
     def test_shards_and_resharding(self):
         s = ElasticSampler(num_samples=100, batch_size=5, shuffle=False)

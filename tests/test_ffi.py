@@ -94,7 +94,9 @@ class TestAdasumCombine:
         b = rng.randn(512)
         dot, asq, bsq = a @ b, a @ a, b @ b
         want = (1.0 - dot / (2 * asq)) * a + (1.0 - dot / (2 * bsq)) * b
-        with jax.enable_x64(True):
+        from horovod_tpu._compat import enable_x64
+
+        with enable_x64(True):
             got = np.asarray(ffi.adasum_combine(jnp.asarray(a, jnp.float64),
                                                 jnp.asarray(b, jnp.float64)))
         np.testing.assert_allclose(got, want, rtol=1e-12)
