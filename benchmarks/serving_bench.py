@@ -1,0 +1,187 @@
+"""Serving-path benchmark: continuous-batching throughput + latency.
+
+The serving twin of ``allreduce_bench.py``: drives the
+``horovod_tpu.serve`` engine+batcher with a closed-loop synthetic
+workload (random prompt lengths, per-request sampling params) and
+emits the same JSON-lines contract — one row per finished request and
+ONE trailing summary line:
+
+    {"metric": "serving_tok_per_s", "value": ..., "unit": "tok/s",
+     "ttft_ms_p50": ..., "ttft_ms_p99": ...,
+     "tpot_ms_p50": ..., "tpot_ms_p99": ...,
+     "occupancy_mean": ..., ...}
+
+TTFT is measured from *submission* (queueing included — the number a
+user feels), TPOT as the post-first-token cadence.  Runnable on CPU
+(default tiny model; ``--cpu-mesh`` forces the virtual CPU mesh) —
+a functional datapoint there, a perf datapoint on TPU.
+
+Usage::
+
+    python benchmarks/serving_bench.py                     # tiny, CPU-safe
+    python benchmarks/serving_bench.py --requests 128 --slots 16
+    python benchmarks/serving_bench.py --out SERVING_r01.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+METRIC = "serving_tok_per_s"
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--requests", type=int, default=32,
+                        help="measured requests (closed loop)")
+    parser.add_argument("--warmup", type=int, default=2,
+                        help="warmup requests excluded from stats "
+                             "(compile noise otherwise owns ttft_p99)")
+    parser.add_argument("--max-new-tokens", type=int, default=16)
+    parser.add_argument("--prompt-min", type=int, default=4)
+    parser.add_argument("--prompt-max", type=int, default=48)
+    parser.add_argument("--slots", type=int, default=4,
+                        help="continuous-batching slots")
+    parser.add_argument("--queue-depth", type=int, default=64)
+    parser.add_argument("--buckets", default="16,64",
+                        help="prefill length buckets (comma-separated)")
+    parser.add_argument("--temperature", type=float, default=0.0)
+    parser.add_argument("--top-k", type=int, default=0)
+    parser.add_argument("--seed", type=int, default=0)
+    # Tiny-but-real decoder; flags let a TPU run scale it up.
+    parser.add_argument("--layers", type=int, default=2)
+    parser.add_argument("--d-model", type=int, default=64)
+    parser.add_argument("--heads", type=int, default=2)
+    parser.add_argument("--vocab", type=int, default=512)
+    parser.add_argument("--max-seq-len", type=int, default=128)
+    parser.add_argument("--cpu-mesh", action="store_true",
+                        help="force the virtual CPU mesh (functional "
+                             "check, not a perf number)")
+    parser.add_argument("--out", default=None,
+                        help="also write the full run as a JSON artifact")
+    args = parser.parse_args()
+    if args.prompt_min < 1 or args.prompt_max < args.prompt_min:
+        parser.error("--prompt-min/--prompt-max must satisfy "
+                     "1 <= min <= max")
+    if args.prompt_max + args.max_new_tokens >= args.max_seq_len:
+        parser.error("--prompt-max + --max-new-tokens must fit below "
+                     "--max-seq-len (the KV-cache length)")
+
+    if args.cpu_mesh:
+        from horovod_tpu.utils.platform import force_cpu_mesh
+
+        force_cpu_mesh()
+
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models.transformer import GPT, GPTConfig
+    from horovod_tpu.serve import (ContinuousBatcher, InferenceEngine,
+                                   QueueFullError, SamplingParams,
+                                   ServingStats)
+    from horovod_tpu.utils.backend_probe import guarded_init
+
+    guarded_init(METRIC, "tok/s", skip=args.cpu_mesh)
+
+    buckets = tuple(int(b) for b in args.buckets.split(",") if b.strip())
+    cfg = GPTConfig(
+        vocab_size=args.vocab, n_layer=args.layers, n_head=args.heads,
+        d_model=args.d_model, d_ff=4 * args.d_model,
+        max_seq_len=args.max_seq_len)
+    model = GPT(cfg)
+    rng = jax.random.PRNGKey(args.seed)
+    params = model.init(rng, jnp.zeros((1, 8), jnp.int32))["params"]
+    engine = InferenceEngine(model, params, max_slots=args.slots,
+                             prefill_buckets=buckets,
+                             max_seq_len=args.max_seq_len,
+                             seed=args.seed)
+    batcher = ContinuousBatcher(engine, max_queue=args.queue_depth,
+                                default_deadline_s=0)
+
+    py_rng = random.Random(args.seed)
+
+    def mk_prompt():
+        n = py_rng.randint(args.prompt_min,
+                           min(args.prompt_max, engine.prefill_buckets[-1]))
+        return [py_rng.randrange(args.vocab) for _ in range(n)]
+
+    sampling = SamplingParams(max_new_tokens=args.max_new_tokens,
+                              temperature=args.temperature,
+                              top_k=args.top_k)
+
+    def drive(prompts):
+        pending = collections.deque(prompts)
+        live = []
+        while pending or any(not r.done.is_set() for r in live):
+            while pending:
+                try:
+                    live.append(batcher.submit(pending[0], sampling))
+                    pending.popleft()
+                except QueueFullError:
+                    break
+            batcher.step()
+        return live
+
+    # Warmup compiles EVERY prefill bucket plus the decoder — a bucket
+    # first touched inside the measured window would bill its compile
+    # to some unlucky request's TTFT.
+    warm = [[1] * b for b in engine.prefill_buckets
+            if b < args.max_seq_len]
+    warm += [mk_prompt() for _ in range(max(0, args.warmup - len(warm)))]
+    drive(warm)
+    batcher.stats = ServingStats()  # measured window starts clean
+    t0 = time.perf_counter()
+    done = drive([mk_prompt() for _ in range(args.requests)])
+    elapsed = time.perf_counter() - t0
+
+    rows = []
+    for r in done:
+        row = {
+            "request": r.request_id, "prompt_len": len(r.prompt),
+            "tokens": len(r.tokens), "error": r.error,
+            "ttft_ms": (round((r.first_token_at - r.submitted_at) * 1e3, 3)
+                        if r.first_token_at else None),
+            "total_ms": (round((r.finished_at - r.submitted_at) * 1e3, 3)
+                         if r.finished_at else None),
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    snap = batcher.snapshot()
+    tokens_out = sum(len(r.tokens) for r in done if r.error is None)
+    summary = {
+        "metric": METRIC,
+        "value": round(tokens_out / elapsed, 3) if elapsed > 0 else 0.0,
+        "unit": "tok/s",
+        "requests": args.requests,
+        "failed": sum(1 for r in done if r.error is not None),
+        "slots": args.slots,
+        "prefill_buckets": list(engine.prefill_buckets),
+        "max_new_tokens": args.max_new_tokens,
+        "ttft_ms_p50": snap["ttft_ms_p50"],
+        "ttft_ms_p99": snap["ttft_ms_p99"],
+        "tpot_ms_p50": snap["tpot_ms_p50"],
+        "tpot_ms_p99": snap["tpot_ms_p99"],
+        "occupancy_mean": snap["occupancy_mean"],
+        "model": {"layers": args.layers, "d_model": args.d_model,
+                  "heads": args.heads, "vocab": args.vocab},
+    }
+    print(json.dumps(summary))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"platform": jax.default_backend(),
+                       "device_kind": jax.devices()[0].device_kind,
+                       "summary": summary, "stats": snap, "rows": rows},
+                      f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -61,14 +61,23 @@ _OPTIM_EXPORTS = ("DistributedOptimizer", "make_train_step",
                   "DistributedOptimizerState", "make_zero_train_step",
                   "make_fsdp_train_step")
 
+# The serving subsystem depends on flax (the model layer); same lazy
+# treatment — ``hvd.serve`` resolves on first touch.
+_LAZY_SUBMODULES = ("serve",)
+
 
 def __getattr__(name):
     if name in _OPTIM_EXPORTS:
         from . import optim
 
         return getattr(optim, name)
+    if name in _LAZY_SUBMODULES:
+        import importlib
+
+        return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def __dir__():
-    return sorted(list(globals()) + list(_OPTIM_EXPORTS))
+    return sorted(list(globals()) + list(_OPTIM_EXPORTS)
+                  + list(_LAZY_SUBMODULES))

@@ -35,7 +35,8 @@ DEFAULT_COST_BETA_GBPS = 100.0
 # and the checkpointer).  Parsed here so a typo'd spec fails loudly at
 # init, exactly like every other malformed env knob.
 
-FAULT_SITES = ("collective", "fusion", "discovery", "rpc", "checkpoint")
+FAULT_SITES = ("collective", "fusion", "discovery", "rpc", "checkpoint",
+               "serve")
 
 _FAULT_MODES = {
     "collective": ("raise",),
@@ -43,6 +44,10 @@ _FAULT_MODES = {
     "discovery": ("flap", "timeout", "error"),
     "rpc": ("drop", "delay"),
     "checkpoint": ("corrupt", "partial"),
+    # serve: drop/delay fire at the serving endpoint's request handler;
+    # kill fires at the continuous batcher's decode dispatch (replica
+    # death mid-decode — the router-failover drill).
+    "serve": ("drop", "delay", "kill"),
 }
 
 
@@ -187,6 +192,27 @@ def _validated_fault_spec(spec: Optional[str]) -> Optional[str]:
     return spec
 
 
+def _env_int_tuple(name: str, default: "tuple") -> "tuple":
+    """Comma-separated positive ints → sorted, deduplicated tuple
+    (``HVD_TPU_SERVE_PREFILL_BUCKETS``: the padded prompt shapes the
+    serving engine compiles — a malformed list must fail at init, not
+    as a recompile storm later)."""
+    val = _env(name)
+    if val is None:
+        return default
+    try:
+        items = tuple(sorted({int(v.strip()) for v in val.split(",")
+                              if v.strip()}))
+    except ValueError as e:
+        raise ValueError(
+            f"Env var {name!r} has unparseable value {val!r}; expected "
+            f"comma-separated ints") from e
+    if not items or any(v <= 0 for v in items):
+        raise ValueError(
+            f"Env var {name!r} needs at least one positive int, got {val!r}")
+    return items
+
+
 def _env_float(name: str, default: float) -> float:
     val = _env(name)
     if val is None:
@@ -256,6 +282,16 @@ class Config:
     agent_max_missed_pings: int = 4           # HVD_TPU_AGENT_MAX_MISSED
     checkpoint_digest: bool = True            # HVD_TPU_CHECKPOINT_DIGEST (integrity sidecar)
 
+    # --- inference serving (horovod_tpu/serve/; no reference analogue —
+    #     the reference is training-only) ---
+    serve_max_batch: int = 8                  # HVD_TPU_SERVE_MAX_BATCH (continuous-batching slots)
+    serve_queue_depth: int = 64               # HVD_TPU_SERVE_QUEUE_DEPTH (admission queue bound; full ⇒ reject)
+    serve_prefill_buckets: "tuple" = (64, 256, 1024)  # HVD_TPU_SERVE_PREFILL_BUCKETS (padded prompt shapes)
+    serve_max_new_tokens: int = 256           # HVD_TPU_SERVE_MAX_TOKENS (per-request generation cap)
+    serve_deadline_seconds: float = 30.0      # HVD_TPU_SERVE_DEADLINE_S (default per-request deadline; 0 = none)
+    serve_replica_strikes: int = 2            # HVD_TPU_SERVE_REPLICA_STRIKES (failures before a replica is benched)
+    serve_probation_seconds: float = 10.0     # HVD_TPU_SERVE_PROBATION_S (bench time before a half-open retry)
+
     # --- fault injection (horovod_tpu/faults.py; no reference analogue) ---
     fault_spec: Optional[str] = None          # HVD_TPU_FAULT_SPEC
 
@@ -307,6 +343,14 @@ class Config:
             agent_ping_interval_seconds=_env_float("AGENT_PING_INTERVAL", 15.0),
             agent_max_missed_pings=_env_int("AGENT_MAX_MISSED", 4),
             checkpoint_digest=_env_bool("CHECKPOINT_DIGEST", True),
+            serve_max_batch=_env_int("SERVE_MAX_BATCH", 8),
+            serve_queue_depth=_env_int("SERVE_QUEUE_DEPTH", 64),
+            serve_prefill_buckets=_env_int_tuple("SERVE_PREFILL_BUCKETS",
+                                                 (64, 256, 1024)),
+            serve_max_new_tokens=_env_int("SERVE_MAX_TOKENS", 256),
+            serve_deadline_seconds=_env_float("SERVE_DEADLINE_S", 30.0),
+            serve_replica_strikes=_env_int("SERVE_REPLICA_STRIKES", 2),
+            serve_probation_seconds=_env_float("SERVE_PROBATION_S", 10.0),
             fault_spec=_validated_fault_spec(_env("FAULT_SPEC")),
             cache_capacity=_env_opt_int("CACHE_CAPACITY"),
             mesh_axis_name=_env("MESH_AXIS_NAME", "hvd") or "hvd",

@@ -15,6 +15,8 @@ fusion     ``ops/fusion.py`` two-phase apply (trace time)        ``raise``
 discovery  ``elastic/driver.py`` ScriptDiscovery + poll          ``flap``/``timeout``/``error``
 rpc        ``runner/common/network.py`` BasicClient calls        ``drop``/``delay``
 checkpoint ``checkpoint.py`` Checkpointer.save                   ``corrupt``/``partial``
+serve      ``serve/server.py`` request handler (drop/delay);     ``drop``/``delay``/``kill``
+           ``serve/batcher.py`` decode dispatch (kill)
 ========== ===================================================== =====================
 
 A plan comes from ``HVD_TPU_FAULT_SPEC`` (grammar parsed in
@@ -49,6 +51,7 @@ __all__ = [
     "configure", "clear", "inject", "active_spec", "history",
     "on_collective", "on_fusion", "on_discovery_script",
     "on_discovery_hosts", "on_rpc", "on_checkpoint_save",
+    "on_serve_request", "on_serve_decode",
 ]
 
 
@@ -267,6 +270,51 @@ def on_rpc(op: str = "") -> None:
             time.sleep(st.clause.delay_ms / 1000.0)
             return
         raise ConnectionError(f"injected rpc drop at call #{at} ({op})")
+
+
+def on_serve_request(op: str = "") -> Optional[str]:
+    """Site ``serve`` (modes ``drop``/``delay``) — fires in the serving
+    endpoint's request handler.  ``delay`` sleeps ``delay_ms`` here (a
+    slow replica) and returns None; ``drop`` returns ``"drop"`` — the
+    server closes the connection without a response, so the router sees
+    a mid-frame peer death, exactly what a crashed replica looks like
+    on the wire.  ``kill`` clauses never fire here (their event
+    coordinate is the decode dispatch, :func:`on_serve_decode`)."""
+    plan = _active
+    if plan is None:
+        return None
+    st = plan.site("serve")
+    if st is None or st.clause.mode == "kill":
+        return None
+    at = st.counter
+    if st.should_fire():
+        mode = st.clause.mode or "drop"
+        plan.fire("serve", mode, at, op)
+        if mode == "delay":
+            time.sleep(st.clause.delay_ms / 1000.0)
+            return None
+        return "drop"
+    return None
+
+
+def on_serve_decode() -> bool:
+    """Site ``serve`` (mode ``kill``) — fires at the continuous
+    batcher's decode dispatch: each event is one real decode step, so
+    ``serve:step=N,mode=kill`` reproducibly kills whichever replica
+    executes the N-th decode in the process.  Returns True when the
+    replica must die mid-decode (the batcher raises ``ReplicaKilled``
+    and fails its in-flight requests — the router-failover drill)."""
+    plan = _active
+    if plan is None:
+        return False
+    st = plan.site("serve")
+    if st is None or st.clause.mode != "kill":
+        return False
+    at = st.counter
+    if st.should_fire():
+        plan.fire("serve", "kill", at)
+        return True
+    return False
 
 
 def on_checkpoint_save(step: int) -> Optional[str]:

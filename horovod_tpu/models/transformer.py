@@ -47,12 +47,28 @@ class GPTConfig:
     param_dtype: Any = jnp.float32
 
 
+def init_kv_cache(config: GPTConfig, batch_size: int, max_len: int):
+    """Preallocated per-layer KV cache for autoregressive decode
+    (serve/engine.py): one ``{"k", "v"}`` pair of ``[B, max_len, H, D]``
+    arrays per block.  Allocated once per serving slot-batch so the
+    decode hot path never reallocates; the engine's length buckets keep
+    the set of compiled shapes small."""
+    head_dim = config.d_model // config.n_head
+    shape = (batch_size, max_len, config.n_head, head_dim)
+    return [{"k": jnp.zeros(shape, config.dtype),
+             "v": jnp.zeros(shape, config.dtype)}
+            for _ in range(config.n_layer)]
+
+
+_NEG_INF = -1e30  # additive mask value (matches parallel/ring_attention)
+
+
 class Attention(nn.Module):
     config: GPTConfig
     mesh: Optional[Mesh] = None
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, cache=None, positions=None):
         cfg = self.config
         B, T, C = x.shape
         H = cfg.n_head
@@ -63,6 +79,28 @@ class Attention(nn.Module):
         q = q.reshape(B, T, H, D)
         k = k.reshape(B, T, H, D)
         v = v.reshape(B, T, H, D)
+        proj = nn.Dense(C, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype, name="out")
+        if cache is not None:
+            # KV-cache path (serving prefill chunks and single-token
+            # decode steps): write this chunk's K/V at its absolute
+            # ``positions`` (``[B, T]``, per-row offsets — continuous
+            # batching puts every slot at a different depth), then run
+            # exact masked attention over the padded cache.  Keys at
+            # indices beyond a row's position are stale/padding and the
+            # ``<= position`` mask excludes them — padding correctness
+            # needs no separate key mask.
+            row = jnp.arange(B)[:, None]
+            k_all = cache["k"].at[row, positions].set(k.astype(cache["k"].dtype))
+            v_all = cache["v"].at[row, positions].set(v.astype(cache["v"].dtype))
+            S = k_all.shape[1]
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_all)
+            scores = scores.astype(jnp.float32) * (D ** -0.5)
+            visible = jnp.arange(S)[None, None, :] <= positions[:, :, None]
+            scores = jnp.where(visible[:, None], scores, _NEG_INF)
+            probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_all)
+            return proj(out.reshape(B, T, C)), {"k": k_all, "v": v_all}
         if cfg.attention == "ring":
             if self.mesh is None:
                 raise ValueError("attention='ring' requires a mesh")
@@ -96,9 +134,7 @@ class Attention(nn.Module):
             out = full_attention(q, k, v, causal=cfg.causal)
         else:
             raise ValueError(f"Unknown attention {cfg.attention!r}")
-        out = out.reshape(B, T, C)
-        return nn.Dense(C, use_bias=False, dtype=cfg.dtype,
-                        param_dtype=cfg.param_dtype, name="out")(out)
+        return proj(out.reshape(B, T, C))
 
 
 class MlpBlock(nn.Module):
@@ -120,10 +156,16 @@ class Block(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, cache=None, positions=None):
         cfg = self.config
-        x = x + Attention(cfg, self.mesh, name="attn")(
-            nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x))
+        attn_in = nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x)
+        attn = Attention(cfg, self.mesh, name="attn")
+        new_cache = None
+        if cache is not None:
+            a, new_cache = attn(attn_in, cache=cache, positions=positions)
+        else:
+            a = attn(attn_in)
+        x = x + a
         if self.use_moe:
             from ..parallel.moe import MoEMlp
 
@@ -135,19 +177,38 @@ class Block(nn.Module):
         else:
             ffn = MlpBlock(cfg, name="mlp")
         x = x + ffn(nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x))
+        if cache is not None:
+            return x, new_cache
         return x
 
 
 class GPT(nn.Module):
-    """Decoder-only LM.  ``apply(params, tokens)`` → logits ``[B, T, V]``."""
+    """Decoder-only LM.  ``apply(params, tokens)`` → logits ``[B, T, V]``.
+
+    Serving mode: ``apply(params, tokens, kv_caches=caches,
+    positions=pos)`` (caches from :func:`init_kv_cache`, ``pos`` the
+    ``[B, T]`` absolute positions of the chunk) returns ``(logits,
+    new_caches)`` — the jitted prefill/decode primitive behind
+    ``horovod_tpu.serve.engine``."""
 
     config: GPTConfig
     mesh: Optional[Mesh] = None
 
     @nn.compact
-    def __call__(self, tokens, return_hidden: bool = False):
+    def __call__(self, tokens, return_hidden: bool = False,
+                 kv_caches=None, positions=None):
         cfg = self.config
         B, T = tokens.shape
+        if kv_caches is not None:
+            if cfg.attention in ("ring", "ulysses"):
+                # Sequence-sharded training layouts have no KV-cache
+                # analogue; decode is a per-replica workload.
+                raise ValueError(
+                    f"KV-cache decode requires attention='full' or "
+                    f"'flash', not {cfg.attention!r}")
+            if positions is None:
+                raise ValueError("kv_caches requires positions ([B, T] "
+                                 "absolute token positions)")
         tok_emb = nn.Embed(cfg.vocab_size, cfg.d_model,
                            param_dtype=cfg.param_dtype,
                            dtype=cfg.dtype, name="embed")(tokens)
@@ -155,12 +216,21 @@ class GPT(nn.Module):
             "pos_embed", nn.initializers.normal(0.02),
             (cfg.max_seq_len, cfg.d_model), cfg.param_dtype,
         )
-        x = tok_emb + pos_emb[None, :T].astype(cfg.dtype)
+        if kv_caches is not None:
+            x = tok_emb + pos_emb[positions].astype(cfg.dtype)
+        else:
+            x = tok_emb + pos_emb[None, :T].astype(cfg.dtype)
+        new_caches = []
         for i in range(cfg.n_layer):
             use_moe = (cfg.moe_experts > 0
                        and (i + 1) % max(1, cfg.moe_every) == 0)
-            x = Block(cfg, self.mesh, use_moe=use_moe,
-                      name=f"block_{i}")(x)
+            block = Block(cfg, self.mesh, use_moe=use_moe,
+                          name=f"block_{i}")
+            if kv_caches is not None:
+                x, c = block(x, cache=kv_caches[i], positions=positions)
+                new_caches.append(c)
+            else:
+                x = block(x)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         if return_hidden:
             # Pre-head activations for the chunked-vocab loss
@@ -169,6 +239,8 @@ class GPT(nn.Module):
             return x
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
                           param_dtype=cfg.param_dtype, name="lm_head")(x)
+        if kv_caches is not None:
+            return logits, new_caches
         return logits
 
 

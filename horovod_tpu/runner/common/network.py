@@ -43,6 +43,13 @@ class AckResponse:
     pass
 
 
+class DropConnection(Exception):
+    """Raised from a ``BasicService._handle`` override to close the
+    connection without writing a response — the wire signature of a
+    crashed peer (used by the serving endpoint's ``serve:mode=drop``
+    fault site; clients see a mid-frame ConnectionError and retry)."""
+
+
 def local_addresses() -> Dict[str, List[str]]:
     """{interface: [ipv4...]} for all non-loopback interfaces (plus
     loopback itself, which single-host runs rely on)."""
@@ -129,8 +136,14 @@ class BasicService:
                     req = read_message(self.request, outer._key)
                 except (PermissionError, ConnectionError, ValueError):
                     return  # unauthenticated/broken peer: drop silently
-                resp = outer._handle(req, self.client_address)
-                write_message(self.request, resp, outer._key)
+                try:
+                    resp = outer._handle(req, self.client_address)
+                except DropConnection:
+                    return  # handler chose to die on the wire: no reply
+                try:
+                    write_message(self.request, resp, outer._key)
+                except OSError:
+                    return  # peer gone before the reply: routine at scale
 
         class _Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -231,28 +244,37 @@ class BasicClient:
         raise ConnectionError(
             f"no address of service {self.name!r} answered: {errs}")
 
-    def _call(self, req: Any, addr: Optional[Tuple[str, int]] = None) -> Any:
+    def _call(self, req: Any, addr: Optional[Tuple[str, int]] = None,
+              timeout: Optional[float] = None) -> Any:
         # Fault site "rpc": drop (ConnectionError before the write — the
         # retry policy's job to absorb) or delay (a slow peer).
         if faults_mod._active is not None:
             faults_mod.on_rpc(type(req).__name__)
         addr = addr or self._address
         with socket.create_connection(addr, timeout=self._timeout) as sock:
+            if timeout is not None:
+                # Connect under the snappy probe timeout; wait for the
+                # *response* as long as the request legitimately takes
+                # (a serving generate runs for seconds — a 5s read
+                # timeout would misread every slow answer as a death).
+                sock.settimeout(timeout)
             write_message(sock, req, self._key)
             return read_message(sock, self._key)
 
-    def request(self, req: Any, *, idempotent: bool = True) -> Any:
+    def request(self, req: Any, *, idempotent: bool = True,
+                timeout: Optional[float] = None) -> Any:
         """One request/response exchange, retried under the unified
         policy (OSError covers refused/reset/timed-out sockets).
 
         ``idempotent=False`` disables the retry: re-sending a request
         whose *response* was lost would re-execute its side effect
         (e.g. a run-command landing twice) — for those, one attempt and
-        let the caller own the ambiguity."""
+        let the caller own the ambiguity.  ``timeout`` overrides the
+        per-response socket timeout (connect keeps the probe timeout)."""
         if not idempotent:
-            return self._call(req)
+            return self._call(req, timeout=timeout)
         return retry_call(
-            lambda: self._call(req),
+            lambda: self._call(req, timeout=timeout),
             policy=self._retry_policy,
             retry_on=(OSError,),
             describe=f"rpc {type(req).__name__} -> {self.name}",

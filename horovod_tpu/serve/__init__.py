@@ -1,0 +1,41 @@
+"""TPU-native inference serving: continuous batching + replica routing.
+
+The serving stack opens the inference workload the ROADMAP's north star
+implies ("serves heavy traffic from millions of users") on top of the
+training framework's existing layers:
+
+* :mod:`~horovod_tpu.serve.engine` — jitted, length-bucketed prefill +
+  slot-batched single-token decode over ``models.transformer.GPT``
+  (preallocated KV cache, greedy/temperature/top-k sampling,
+  Timeline phases ``SERVE_PREFILL``/``SERVE_DECODE``)
+* :mod:`~horovod_tpu.serve.batcher` — continuous-batching scheduler
+  (bounded admission queue, per-request deadlines, reject-when-full
+  backpressure)
+* :mod:`~horovod_tpu.serve.server` — replica endpoint on the runner's
+  HMAC-authenticated RPC stack
+* :mod:`~horovod_tpu.serve.router` — spreads requests across
+  data-parallel replica groups (``process_sets``), task-agent-style
+  strike/probation health, and drains a dead replica's in-flight
+  requests back through :class:`~horovod_tpu.utils.retry.RetryPolicy`
+* :mod:`~horovod_tpu.serve.metrics` — TTFT/TPOT/occupancy snapshots
+
+Chaos: the ``serve`` fault site (``HVD_TPU_FAULT_SPEC``) drops/delays
+requests at the endpoint and kills a replica mid-decode
+(docs/serving.md has recipes).
+"""
+
+from .batcher import (  # noqa: F401
+    ContinuousBatcher, QueueFullError, ReplicaKilledError, ServeRequest,
+)
+from .engine import (  # noqa: F401
+    InferenceEngine, PromptTooLongError, SamplingParams,
+)
+from .metrics import ServingStats, percentile  # noqa: F401
+from .router import (  # noqa: F401
+    NoHealthyReplicasError, ReplicaSpec, ReplicaUnavailableError, Router,
+    register_replica_process_sets, replica_slot_groups,
+)
+from .server import (  # noqa: F401
+    CancelRequest, GenerateRequest, GenerateResponse, InferenceServer,
+    StatsRequest, StatsResponse,
+)

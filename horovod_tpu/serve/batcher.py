@@ -1,0 +1,334 @@
+"""Continuous-batching scheduler: admission, deadlines, backpressure.
+
+The scheduling loop interleaves prefill and decode over the engine's
+slot batch: each :meth:`ContinuousBatcher.step` admits up to
+``max_prefill_per_step`` queued requests into free slots (one prefill
+each), then runs ONE decode for every active slot.  A long-running
+generation therefore never blocks admission, and a fresh request's
+TTFT is bounded by one decode's worth of head-of-line blocking — the
+continuous-batching property.
+
+Overload policy is **explicit backpressure**: the admission queue is
+bounded and a full queue rejects (:class:`QueueFullError`) instead of
+queueing unboundedly — at "millions of users" scale an unbounded queue
+converts overload into latency collapse and OOM; a reject converts it
+into a router-visible signal that shifts load to another replica.
+
+Fault site ``serve:mode=kill`` fires at the decode dispatch (each
+event = one real decode step): the batcher dies mid-decode exactly the
+way a preempted replica does, failing queued + in-flight requests so
+the router can re-run them on a survivor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from .. import faults as faults_mod
+from ..utils.logging import get_logger
+from .engine import (InferenceEngine, PromptTooLongError, SamplingParams,
+                     resolved_config)
+from .metrics import ServingStats
+
+logger = get_logger(__name__)
+
+_ids = itertools.count()
+
+
+class QueueFullError(RuntimeError):
+    """Admission queue at capacity — reject-when-full backpressure."""
+
+
+class ReplicaKilledError(RuntimeError):
+    """The ``serve:mode=kill`` fault fired mid-decode (or the batcher
+    was stopped with requests in flight)."""
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    """One in-flight generation; ``done`` fires exactly once, with
+    either ``tokens`` complete or ``error`` set."""
+
+    request_id: str
+    prompt: List[int]
+    sampling: SamplingParams
+    deadline: Optional[float] = None       # absolute time.monotonic()
+    submitted_at: float = 0.0
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    error: Optional[str] = None
+    done: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
+
+    def finish(self, error: Optional[str] = None) -> None:
+        if self.done.is_set():
+            return
+        self.error = error
+        self.finished_at = time.monotonic()
+        self.done.set()
+
+
+class ContinuousBatcher:
+    """Slot scheduler over one :class:`InferenceEngine`.
+
+    Drive it synchronously (:meth:`step`, deterministic — what the
+    tests and the bench do) or as a daemon thread (:meth:`start` /
+    :meth:`stop` — what the server does).
+    """
+
+    def __init__(self, engine: InferenceEngine, *,
+                 max_queue: Optional[int] = None,
+                 max_prefill_per_step: int = 1,
+                 default_deadline_s: Optional[float] = None):
+        cfg = resolved_config()
+        self.engine = engine
+        self.max_queue = int(max_queue if max_queue is not None
+                             else cfg.serve_queue_depth)
+        self.max_prefill_per_step = max(1, max_prefill_per_step)
+        self.default_deadline_s = (
+            default_deadline_s if default_deadline_s is not None
+            else cfg.serve_deadline_seconds)
+        self.max_new_tokens_cap = cfg.serve_max_new_tokens
+        self.stats = ServingStats()
+        self._lock = threading.Lock()
+        self._queue: List[ServeRequest] = []
+        self._slots: Dict[int, ServeRequest] = {}
+        self._killed: Optional[str] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+
+    # --- admission ----------------------------------------------------------
+
+    @property
+    def dead(self) -> bool:
+        return self._killed is not None
+
+    def submit(self, prompt: Sequence[int],
+               sampling: Optional[SamplingParams] = None,
+               request_id: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> ServeRequest:
+        """Enqueue one generation.  Raises :class:`QueueFullError` at
+        capacity and :class:`ReplicaKilledError` on a dead replica;
+        oversized prompts raise :class:`PromptTooLongError` up front
+        (admitting them would waste a slot to fail later)."""
+        sampling = sampling or SamplingParams()
+        if sampling.max_new_tokens > self.max_new_tokens_cap:
+            sampling = dataclasses.replace(
+                sampling, max_new_tokens=self.max_new_tokens_cap)
+        self.engine.check_prompt(len(prompt))   # PromptTooLongError early
+        limit = (deadline_s if deadline_s is not None
+                 else self.default_deadline_s)
+        req = ServeRequest(
+            request_id=request_id or f"req-{next(_ids)}",
+            prompt=list(prompt), sampling=sampling,
+            deadline=(time.monotonic() + limit) if limit and limit > 0
+            else None,
+            submitted_at=time.monotonic())
+        with self._lock:
+            if self._killed is not None:
+                raise ReplicaKilledError(self._killed)
+            if len(self._queue) >= self.max_queue:
+                self.stats.record_rejected()
+                raise QueueFullError(
+                    f"admission queue full ({self.max_queue} waiting)")
+            self._queue.append(req)
+        self._wake.set()
+        return req
+
+    def cancel(self, request_id: str) -> bool:
+        """Abandon a queued or in-flight request (router failover: the
+        caller re-ran it elsewhere, so finishing it here would only
+        burn a slot producing an answer nobody reads).  Returns True
+        when something was cancelled."""
+        target_slot = None
+        with self._lock:
+            req = next((r for r in self._queue
+                        if r.request_id == request_id), None)
+            if req is not None:
+                self._queue.remove(req)
+            else:
+                for slot, r in self._slots.items():
+                    if r.request_id == request_id:
+                        target_slot, req = slot, r
+                        break
+                if target_slot is not None:
+                    del self._slots[target_slot]
+        if req is None:
+            return False
+        if target_slot is not None:
+            self.engine.release(target_slot)
+        req.finish(error="cancelled")
+        return True
+
+    # --- scheduling ---------------------------------------------------------
+
+    def _expire(self, now: float) -> None:
+        with self._lock:
+            queued = [r for r in self._queue if r.deadline is not None
+                      and now > r.deadline]
+            for r in queued:
+                self._queue.remove(r)
+            running = [(s, r) for s, r in self._slots.items()
+                       if r.deadline is not None and now > r.deadline]
+            for s, r in running:
+                del self._slots[s]
+                self.engine.release(s)
+        for r in queued + [r for _, r in running]:
+            self.stats.record_expired()
+            r.finish(error="deadline_exceeded")
+
+    def _finish_slot(self, slot: int, req: ServeRequest) -> None:
+        with self._lock:
+            self._slots.pop(slot, None)
+        self.engine.release(slot)
+        req.finish()
+        self.stats.record_request(
+            ttft_s=(req.first_token_at or req.finished_at)
+            - req.submitted_at,
+            n_tokens=len(req.tokens),
+            total_s=req.finished_at - req.submitted_at)
+
+    def _emit(self, slot: int, req: ServeRequest, token: int,
+              now: float) -> None:
+        if req.done.is_set():
+            return   # cancelled/expired concurrently: drop the token
+        if req.first_token_at is None:
+            req.first_token_at = now
+        req.tokens.append(token)
+        stop = req.sampling.stop_token
+        if (len(req.tokens) >= req.sampling.max_new_tokens
+                or (stop is not None and token == stop)
+                or self.engine.slot_full(slot)):
+            self._finish_slot(slot, req)
+
+    def step(self) -> int:
+        """One scheduling iteration; returns the number of tokens
+        emitted (0 = idle)."""
+        if self._killed is not None:
+            raise ReplicaKilledError(self._killed)
+        now = time.monotonic()
+        self._expire(now)
+        emitted = 0
+        # Admit: bounded prefills per step keep decode cadence for the
+        # already-running requests (prefill is the expensive phase).
+        for _ in range(self.max_prefill_per_step):
+            with self._lock:
+                free = self.engine.free_slots()
+                if not free or not self._queue:
+                    break
+                req = self._queue.pop(0)
+                slot = free[0]
+                self._slots[slot] = req
+            try:
+                token = self.engine.start(slot, req.prompt, req.sampling)
+            except Exception as e:   # defensive: engine bug ≠ wedged slot
+                with self._lock:
+                    self._slots.pop(slot, None)
+                self.engine.release(slot)
+                self.stats.record_failed()
+                req.finish(error=f"prefill_failed: {e}")
+                continue
+            if req.done.is_set():
+                # Cancelled/expired between admission and prefill
+                # completion: cancel() found no active slot to release
+                # (engine.start had not activated it yet), so release
+                # here or the slot leaks as a ghost forever.
+                with self._lock:
+                    self._slots.pop(slot, None)
+                self.engine.release(slot)
+                continue
+            emitted += 1
+            self._emit(slot, req, token, time.monotonic())
+        # Decode: one token for every active request.  The kill fault's
+        # event coordinate is this dispatch — guarded so an unarmed
+        # plan costs one attribute read.
+        with self._lock:
+            active = dict(self._slots)
+        if active:
+            if faults_mod._active is not None and faults_mod.on_serve_decode():
+                self._die("injected replica kill mid-decode")
+                raise ReplicaKilledError(self._killed)
+            tokens = self.engine.step()
+            now = time.monotonic()
+            for slot, token in tokens.items():
+                req = active.get(slot)
+                if req is not None:
+                    emitted += 1
+                    self._emit(slot, req, token, now)
+        with self._lock:
+            self.stats.record_step(active=len(self._slots),
+                                   slots=self.engine.max_slots,
+                                   queued=len(self._queue))
+        return emitted
+
+    def _die(self, reason: str) -> None:
+        """Fail every queued + in-flight request exactly once and
+        refuse new work — replica death as the router observes it."""
+        with self._lock:
+            self._killed = reason
+            pending = self._queue[:]
+            self._queue.clear()
+            running = list(self._slots.values())
+            self._slots.clear()
+        for req in pending + running:
+            self.stats.record_failed()
+            req.finish(error="replica_killed")
+        n = len(pending) + len(running)
+        if n:
+            logger.warning("serving replica died: %s (%d request(s) "
+                           "failed back to the router)", reason, n)
+        else:
+            logger.info("serving replica retired: %s", reason)
+
+    # --- thread driver ------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    busy = self.step()
+                except ReplicaKilledError:
+                    return
+                except Exception:
+                    logger.exception("batcher step failed; replica down")
+                    self._die("batcher step raised")
+                    return
+                if not busy:
+                    self._wake.wait(timeout=0.005)
+                    self._wake.clear()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="serve-batcher")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self._killed is None:
+            self._die("replica stopped")
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def snapshot(self) -> Dict:
+        snap = self.stats.snapshot()
+        with self._lock:
+            snap.update(queue_depth=len(self._queue),
+                        active_slots=len(self._slots),
+                        max_slots=self.engine.max_slots,
+                        dead=self._killed is not None)
+        return snap

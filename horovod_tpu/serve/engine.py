@@ -1,0 +1,273 @@
+"""Continuous-batching inference engine over ``models.transformer.GPT``.
+
+The serving hot path is two compiled programs:
+
+* **prefill** — one program per *length bucket* ``L``: run the prompt
+  (padded to ``L``) through the model with a fresh ``[1, L]`` KV cache,
+  sample the first token, and write the cache into this request's slot
+  of the engine-wide preallocated cache.  Padding prompts to a small
+  set of bucket shapes bounds recompiles: serving traffic has arbitrary
+  prompt lengths, and an unbucketed engine would compile per length.
+* **decode** — ONE program for the whole slot batch: every active
+  request advances one token per call, each slot at its own depth
+  (``positions`` is per-row, so a request in its 3rd token and one in
+  its 300th share the dispatch).  This is the continuous-batching
+  property: admission never waits for the batch to drain.
+
+Neither program contains a cross-replica collective — the per-token hot
+path is replica-local by construction (the fused computation-collective
+literature's guidance: keep collectives off the token critical path);
+replication happens one level up, in ``serve/router.py`` over process
+sets.
+
+Sampling is greedy / temperature / top-k, resolved **per slot** inside
+the one decode program (a ``where`` lattice, not a recompile), so mixed
+sampling configs batch together.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.transformer import GPT, init_kv_cache
+from ..utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def resolved_config():
+    """The serving layer's config source: the live Config when this
+    process ran ``hvd.init``, else a fresh env parse (same parser, same
+    defaults — the network.py convention, so a bare engine in a script
+    and a served engine under the launcher read identical knobs)."""
+    from .. import basics
+    from ..config import Config
+
+    return basics.config() if basics.is_initialized() else Config.from_env()
+
+
+class PromptTooLongError(ValueError):
+    """Prompt exceeds the largest prefill bucket / cache length."""
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling knobs (greedy when ``temperature == 0``)."""
+
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    top_k: int = 0                 # 0 = full vocab
+    stop_token: Optional[int] = None
+
+
+def _sample(logits, rng, temps, topks):
+    """Per-row sampling over ``[B, V]`` float32 logits: greedy rows
+    (``temp <= 0``) take argmax; the rest draw from temperature-scaled
+    logits restricted to each row's top-k (k per row — ranks against a
+    per-row threshold instead of a static ``lax.top_k`` width)."""
+    greedy = jnp.argmax(logits, axis=-1)
+    ranks = jnp.argsort(jnp.argsort(-logits, axis=-1), axis=-1)
+    k = jnp.where(topks > 0, topks, logits.shape[-1])[:, None]
+    masked = jnp.where(ranks < k, logits, -jnp.inf)
+    scaled = masked / jnp.maximum(temps, 1e-6)[:, None]
+    sampled = jax.random.categorical(rng, scaled, axis=-1)
+    return jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
+
+
+class InferenceEngine:
+    """Slot-based prefill/decode engine; the batcher owns scheduling.
+
+    ``start(slot, prompt, sampling)`` prefixes a request into ``slot``
+    and returns its first token; ``step()`` decodes one token for every
+    active slot.  Per-phase wall time lands on the framework Timeline
+    (phases ``SERVE_PREFILL`` / ``SERVE_DECODE``) when one is active.
+    """
+
+    def __init__(self, model: GPT, params, *,
+                 max_slots: Optional[int] = None,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 max_seq_len: Optional[int] = None,
+                 seed: int = 0):
+        cfg = resolved_config()
+        self._model = model
+        self._params = params
+        self.max_slots = int(max_slots or cfg.serve_max_batch)
+        self.max_seq_len = int(max_seq_len or model.config.max_seq_len)
+        if self.max_seq_len > model.config.max_seq_len:
+            raise ValueError(
+                f"max_seq_len {self.max_seq_len} exceeds the model's "
+                f"positional table ({model.config.max_seq_len})")
+        buckets = tuple(prefill_buckets or cfg.serve_prefill_buckets)
+        # Clamp buckets to the cache length; keep at least one.
+        self.prefill_buckets = tuple(sorted(
+            {min(int(b), self.max_seq_len) for b in buckets if b > 0}))
+        if not self.prefill_buckets:
+            raise ValueError(f"no usable prefill buckets in {buckets}")
+        self._caches = init_kv_cache(model.config, self.max_slots,
+                                     self.max_seq_len)
+        self._positions = np.zeros(self.max_slots, np.int32)
+        self._active = np.zeros(self.max_slots, bool)
+        self._temps = np.zeros(self.max_slots, np.float32)
+        self._topks = np.zeros(self.max_slots, np.int32)
+        self._last_tokens = np.zeros(self.max_slots, np.int32)
+        self._rng = jax.random.PRNGKey(seed)
+        # Trace-time counters: the bounded-recompile contract is
+        # testable (each jitted program bumps its key once per trace).
+        self.trace_counts = collections.Counter()
+        # Donate the engine-wide cache so prefill/decode update it in
+        # place — without donation XLA copies the full [slots, S, H, D]
+        # x 2 x n_layer cache every token, which dominates decode at
+        # real cache sizes.  CPU has no donation support (it would only
+        # warn), so gate on the backend.
+        self._donate = (1,) if jax.default_backend() != "cpu" else ()
+        self._prefill_fns = {L: self._make_prefill(L)
+                             for L in self.prefill_buckets}
+        self._decode_fn = jax.jit(self._decode_impl,
+                                  donate_argnums=self._donate)
+
+    # --- compiled programs --------------------------------------------------
+
+    def _make_prefill(self, L: int):
+        model, n_layer = self._model, self._model.config.n_layer
+
+        def prefill(params, caches, tokens, length, slot, rng, temp, topk):
+            self.trace_counts[f"prefill_{L}"] += 1  # trace-time only
+            positions = jnp.arange(L, dtype=jnp.int32)[None]
+            row = init_kv_cache(model.config, 1, L)
+            logits, row = model.apply({"params": params}, tokens,
+                                      kv_caches=row, positions=positions)
+            last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
+                                                axis=0, keepdims=False)
+            token = _sample(last[None].astype(jnp.float32), rng,
+                            temp[None], topk[None])[0]
+
+            def write(big, chunk):
+                return jax.lax.dynamic_update_slice(
+                    big, chunk.astype(big.dtype), (slot, 0, 0, 0))
+
+            new = [{"k": write(caches[i]["k"], row[i]["k"]),
+                    "v": write(caches[i]["v"], row[i]["v"])}
+                   for i in range(n_layer)]
+            return token, new
+
+        return jax.jit(prefill, donate_argnums=self._donate)
+
+    def _decode_impl(self, params, caches, tokens, positions, temps,
+                     topks, rng):
+        self.trace_counts["decode"] += 1  # trace-time only
+        logits, new = self._model.apply(
+            {"params": params}, tokens[:, None], kv_caches=caches,
+            positions=positions[:, None])
+        nxt = _sample(logits[:, -1].astype(jnp.float32), rng, temps, topks)
+        return nxt, new
+
+    # --- host-side slot API -------------------------------------------------
+
+    def _activity(self, name: str, phase: str, args=None):
+        """Timeline span for one serving phase (no-op without an active
+        framework timeline)."""
+        import contextlib
+
+        from .. import basics
+
+        tl = basics._state.timeline if basics.is_initialized() else None
+        if tl is None or not tl.enabled:
+            return contextlib.nullcontext()
+        return tl.activity(name, phase, args)
+
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def bucket_for(self, prompt_len: int) -> int:
+        for b in self.prefill_buckets:
+            if prompt_len <= b:
+                return b
+        raise PromptTooLongError(
+            f"prompt of {prompt_len} tokens exceeds the largest prefill "
+            f"bucket ({self.prefill_buckets[-1]})")
+
+    def check_prompt(self, prompt_len: int) -> int:
+        """Full admission-time validation (the batcher calls this so an
+        unservable prompt fails before it costs a queue entry): bucket
+        fit AND room to generate.  Returns the bucket."""
+        if prompt_len < 1:
+            raise ValueError("empty prompt")
+        if prompt_len >= self.max_seq_len:
+            raise PromptTooLongError(
+                f"prompt of {prompt_len} tokens leaves no room to "
+                f"generate (cache length {self.max_seq_len})")
+        return self.bucket_for(prompt_len)
+
+    def free_slots(self) -> List[int]:
+        return [int(s) for s in np.nonzero(~self._active)[0]]
+
+    def active_slots(self) -> List[int]:
+        return [int(s) for s in np.nonzero(self._active)[0]]
+
+    def slot_full(self, slot: int) -> bool:
+        """True when the next decode would write past the cache (the
+        next decode writes K/V at index ``_positions[slot]``, valid
+        while it is ``< max_seq_len``)."""
+        return int(self._positions[slot]) >= self.max_seq_len
+
+    def start(self, slot: int, prompt: Sequence[int],
+              sampling: SamplingParams) -> int:
+        """Prefill ``prompt`` into ``slot``; returns the first sampled
+        token.  One compiled program per (bucket, slot-batch) shape."""
+        if self._active[slot]:
+            raise RuntimeError(f"slot {slot} is already active")
+        n = len(prompt)
+        L = self.check_prompt(n)
+        padded = np.zeros((1, L), np.int32)
+        padded[0, :n] = np.asarray(prompt, np.int32)
+        fn = self._prefill_fns[L]
+        with self._activity(f"serve/slot{slot}", "SERVE_PREFILL",
+                            {"bucket": L, "prompt_len": n}):
+            token, self._caches = fn(
+                self._params, self._caches, jnp.asarray(padded),
+                jnp.int32(n), jnp.int32(slot), self._next_rng(),
+                jnp.float32(sampling.temperature),
+                jnp.int32(sampling.top_k))
+            token = int(token)
+        self._active[slot] = True
+        self._positions[slot] = n     # the first generated token's index
+        self._temps[slot] = sampling.temperature
+        self._topks[slot] = sampling.top_k
+        self._last_tokens[slot] = token   # first decode consumes it
+        return token
+
+    def step(self) -> Dict[int, int]:
+        """One decode step for every active slot → ``{slot: token}``.
+        Inactive rows ride along masked (position 0) and are ignored."""
+        active = self.active_slots()
+        if not active:
+            return {}
+        positions = np.where(self._active, self._positions, 0).astype(np.int32)
+        with self._activity("serve/decode", "SERVE_DECODE",
+                            {"batch": len(active)}):
+            nxt, self._caches = self._decode_fn(
+                self._params, self._caches, jnp.asarray(self._last_tokens),
+                jnp.asarray(positions), jnp.asarray(self._temps),
+                jnp.asarray(self._topks), self._next_rng())
+            nxt = np.asarray(nxt)
+        out = {}
+        for s in active:
+            out[s] = int(nxt[s])
+            self._last_tokens[s] = nxt[s]
+            self._positions[s] += 1
+        return out
+
+    def release(self, slot: int) -> None:
+        """Return ``slot`` to the free pool (cache rows are reused —
+        stale keys are invisible behind the position mask)."""
+        self._active[slot] = False
+        self._positions[slot] = 0
+        self._temps[slot] = 0.0
+        self._topks[slot] = 0

@@ -1,0 +1,108 @@
+"""Serving observability: TTFT/TPOT/occupancy accounting.
+
+The two latencies that define an LLM serving SLO are time-to-first-token
+(TTFT: admission + prefill) and time-per-output-token (TPOT: decode
+cadence under continuous batching).  Both are recorded per request by
+the batcher and aggregated here into percentile snapshots with the same
+JSON-friendly shape ``benchmarks/serving_bench.py`` emits, so the live
+``StatsRequest`` endpoint and the offline bench artifact read
+identically.
+
+Bounded memory: samples live in fixed-size rings — a serving process
+that handles millions of requests must not grow its stats linearly.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+def percentile(samples: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile (q in [0, 100]); None on no samples —
+    callers omit the field rather than report a fabricated 0."""
+    if not samples:
+        return None
+    xs = sorted(samples)
+    idx = min(len(xs) - 1, max(0, int(round(q / 100.0 * (len(xs) - 1)))))
+    return xs[idx]
+
+
+class ServingStats:
+    """Thread-safe rolling serving metrics (one instance per batcher).
+
+    ``record_request`` is called once per *finished* request;
+    ``record_step`` once per batcher scheduling step (occupancy is a
+    per-step sample, weighting busy and idle periods equally —
+    the signal that says "add replicas" vs "shrink the fleet").
+    """
+
+    def __init__(self, window: int = 4096) -> None:
+        self._lock = threading.Lock()
+        self._ttft_s = collections.deque(maxlen=window)
+        self._tpot_s = collections.deque(maxlen=window)
+        self._occupancy = collections.deque(maxlen=window)
+        self._queue_depth = collections.deque(maxlen=window)
+        self.completed = 0
+        self.rejected = 0
+        self.expired = 0
+        self.failed = 0
+        self.tokens_out = 0
+        self._t0 = time.monotonic()
+
+    def record_request(self, ttft_s: float, n_tokens: int,
+                       total_s: float) -> None:
+        with self._lock:
+            self.completed += 1
+            self.tokens_out += n_tokens
+            self._ttft_s.append(ttft_s)
+            if n_tokens > 1 and total_s > ttft_s:
+                # TPOT is the inter-token cadence after the first token.
+                self._tpot_s.append((total_s - ttft_s) / (n_tokens - 1))
+
+    def record_step(self, active: int, slots: int, queued: int) -> None:
+        with self._lock:
+            self._occupancy.append(active / max(1, slots))
+            self._queue_depth.append(queued)
+
+    def record_rejected(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def record_expired(self) -> None:
+        with self._lock:
+            self.expired += 1
+
+    def record_failed(self) -> None:
+        with self._lock:
+            self.failed += 1
+
+    def snapshot(self) -> Dict:
+        """One JSON-ready dict — the serving bench summary fields and
+        the ``StatsRequest`` wire payload share this shape."""
+        with self._lock:
+            ttft = list(self._ttft_s)
+            tpot = list(self._tpot_s)
+            occ = list(self._occupancy)
+            queued = list(self._queue_depth)
+            elapsed = max(1e-9, time.monotonic() - self._t0)
+            out = {
+                "requests_completed": self.completed,
+                "requests_rejected": self.rejected,
+                "requests_expired": self.expired,
+                "requests_failed": self.failed,
+                "tokens_out": self.tokens_out,
+                "tok_per_s": round(self.tokens_out / elapsed, 3),
+                "occupancy_mean": (round(sum(occ) / len(occ), 4)
+                                   if occ else None),
+                "queue_depth_mean": (round(sum(queued) / len(queued), 2)
+                                     if queued else None),
+            }
+            for name, samples in (("ttft_ms", ttft), ("tpot_ms", tpot)):
+                for q in (50, 99):
+                    v = percentile(samples, q)
+                    out[f"{name}_p{q}"] = (round(v * 1e3, 3)
+                                           if v is not None else None)
+            return out

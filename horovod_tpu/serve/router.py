@@ -1,0 +1,315 @@
+"""Replicated request router: spread, health, failover.
+
+Horovod's lineage is data-parallel replicas coordinated over
+collectives (SURVEY §0); serving maps the same shape onto request
+traffic: each replica is a model copy spanning a *process set* of mesh
+slots (:func:`replica_slot_groups` partitions the global mesh exactly
+the way ``hvd.add_process_set`` expects), and the router spreads
+requests across replicas round-robin — the control plane is
+collective-aware, the per-token hot path never crosses replicas.
+
+Failure handling mirrors the task-agent liveness design
+(``runner/task_agent.py``): consecutive failures accumulate *strikes*;
+at the configured limit the replica is benched for a probation window,
+after which one half-open attempt may rehabilitate it.  A request that
+was in flight on a dying replica is **drained back into the queue**:
+the router re-submits it under the shared
+:class:`~horovod_tpu.utils.retry.RetryPolicy` (jittered exponential
+backoff — synchronized retries from a fleet of routers would re-create
+the overload that killed the replica), and a response cache keyed by
+``request_id`` guarantees at-most-once delivery to the caller even if
+a retry races a late success.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..runner.common.network import BasicClient
+from ..utils.logging import get_logger
+from ..utils.retry import RetryPolicy, retry_call
+from .engine import resolved_config
+from .server import (CancelRequest, GenerateRequest, GenerateResponse,
+                     StatsRequest)
+
+logger = get_logger(__name__)
+
+# Wire errors after which the SAME request may safely run elsewhere:
+# the replica never produced (or will never deliver) a response.
+_RETRYABLE_ERRORS = ("busy", "replica_killed", "replica_dead")
+
+
+class NoHealthyReplicasError(ConnectionError):
+    """Every replica is dead or benched (may clear after probation)."""
+
+
+class ReplicaUnavailableError(ConnectionError):
+    """The chosen replica refused or lost the request; try another."""
+
+
+def replica_slot_groups(n_replicas: int,
+                        world_size: Optional[int] = None) -> List[List[int]]:
+    """Partition the mesh's slots into ``n_replicas`` contiguous
+    data-parallel groups — the rank lists a deployer feeds to
+    ``hvd.add_process_set`` (one set per replica; contiguous keeps each
+    replica on an ICI-adjacent block)."""
+    from .. import basics
+
+    world = world_size if world_size is not None else basics.size()
+    if n_replicas < 1 or world % n_replicas:
+        raise ValueError(
+            f"cannot split {world} slot(s) into {n_replicas} equal "
+            f"replica group(s)")
+    per = world // n_replicas
+    return [list(range(i * per, (i + 1) * per)) for i in range(n_replicas)]
+
+
+def register_replica_process_sets(n_replicas: int):
+    """Register (or look up) one process set per replica group;
+    returns them in replica order.  Idempotent: an already-registered
+    identical set is reused, so serving restarts don't collide."""
+    from .. import process_sets as ps
+
+    out = []
+    for ranks in replica_slot_groups(n_replicas):
+        existing = ps._table().find(ranks)
+        out.append(existing if existing is not None
+                   else ps.add_process_set(ranks))
+    return out
+
+
+class ReplicaSpec:
+    """Where one replica answers: candidate addresses + its mesh ranks."""
+
+    def __init__(self, name: str, addresses: List[Tuple[str, int]],
+                 ranks: Optional[Sequence[int]] = None):
+        self.name = name
+        self.addresses = list(addresses)
+        self.ranks = list(ranks) if ranks is not None else None
+
+
+class _ReplicaState:
+    def __init__(self, spec: ReplicaSpec):
+        self.spec = spec
+        self.client: Optional[BasicClient] = None
+        self.strikes = 0
+        self.dead_until: Optional[float] = None   # None = healthy
+        self.inflight = 0
+        self.completed = 0
+        self.failed = 0
+
+
+class Router:
+    """Client-side request spreader over serving replicas."""
+
+    def __init__(self, replicas: Sequence[ReplicaSpec], key: bytes, *,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 strikes: Optional[int] = None,
+                 probation_s: Optional[float] = None,
+                 probe_timeout: float = 5.0,
+                 dedupe_window: int = 1024):
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        cfg = resolved_config()
+        self._replicas = [_ReplicaState(r) for r in replicas]
+        self._key = key
+        self._probe_timeout = probe_timeout
+        self._strike_limit = int(strikes if strikes is not None
+                                 else cfg.serve_replica_strikes)
+        self._probation_s = float(probation_s if probation_s is not None
+                                  else cfg.serve_probation_seconds)
+        self._default_deadline_s = cfg.serve_deadline_seconds
+        # One failover pass visits every replica once; the policy adds
+        # backoff'd sweeps on top (half-open probation needs the time).
+        self._retry_policy = retry_policy or RetryPolicy(
+            attempts=2 * len(self._replicas) + 1,
+            base_delay_s=0.05, max_delay_s=2.0)
+        self._lock = threading.Lock()
+        self._rr = itertools.count()
+        self._done: "OrderedDict[str, GenerateResponse]" = OrderedDict()
+        self._dedupe_window = dedupe_window
+
+    # --- health -------------------------------------------------------------
+
+    def _healthy(self, rep: _ReplicaState, now: float) -> bool:
+        if rep.dead_until is None:
+            return True
+        return now >= rep.dead_until    # probation over: half-open try
+
+    def _strike(self, rep: _ReplicaState, fatal: bool = False) -> None:
+        with self._lock:
+            rep.strikes += 1
+            rep.failed += 1
+            rep.client = None    # re-probe on next use
+            if fatal or rep.strikes >= self._strike_limit:
+                rep.dead_until = time.monotonic() + self._probation_s
+                logger.warning(
+                    "replica %s benched for %.1fs (%d strike(s))",
+                    rep.spec.name, self._probation_s, rep.strikes)
+
+    def _mark_ok(self, rep: _ReplicaState) -> None:
+        with self._lock:
+            rep.strikes = 0
+            rep.dead_until = None
+            rep.completed += 1
+
+    def _pick(self) -> _ReplicaState:
+        """Round-robin over healthy replicas, preferring the least
+        loaded among the next candidates (spread, not pile-on).
+
+        Expired probation is **half-open**: exactly one request per
+        window probes the benched replica (its bench is re-armed under
+        the lock before release, so a concurrent wave cannot pile onto
+        a possibly-still-dead peer); success rejoins it via
+        ``_mark_ok``, failure re-strikes."""
+        now = time.monotonic()
+        with self._lock:
+            half_open = [r for r in self._replicas
+                         if r.dead_until is not None
+                         and now >= r.dead_until]
+            if half_open:
+                probe = min(half_open, key=lambda r: r.dead_until)
+                probe.dead_until = now + self._probation_s
+                return probe
+            fully = [r for r in self._replicas if r.dead_until is None]
+            if not fully:
+                soonest = min(
+                    (r.dead_until for r in self._replicas
+                     if r.dead_until is not None), default=None)
+                raise NoHealthyReplicasError(
+                    f"all {len(self._replicas)} replica(s) benched"
+                    + (f"; next probation in "
+                       f"{max(0.0, soonest - now):.1f}s"
+                       if soonest else ""))
+            start = next(self._rr) % len(fully)
+            ordered = fully[start:] + fully[:start]
+            return min(ordered, key=lambda r: r.inflight)
+
+    def _client(self, rep: _ReplicaState) -> BasicClient:
+        with self._lock:
+            client = rep.client
+        if client is None:
+            # Probe outside the lock (network I/O); publish under it so
+            # concurrent callers converge on one client instead of
+            # racing duplicate probes.
+            client = BasicClient(
+                rep.spec.name, rep.spec.addresses, self._key,
+                probe_timeout=self._probe_timeout,
+                # The router owns cross-replica retries; a transparent
+                # same-replica retry here would stack policies.
+                retry_policy=RetryPolicy(attempts=1))
+            with self._lock:
+                if rep.client is None:
+                    rep.client = client
+                else:
+                    client = rep.client
+        return client
+
+    def _cancel_on(self, rep: _ReplicaState, request_id: str) -> None:
+        """Best-effort abandon of a request the router is about to
+        re-run elsewhere — without this, a wire error after admission
+        leaves the original replica decoding an answer nobody will
+        read, and every failover burns two replicas' worth of slots."""
+        try:
+            self._client(rep).request(CancelRequest(request_id),
+                                      idempotent=False, timeout=5.0)
+        except OSError:
+            pass   # replica truly gone: nothing left to cancel
+
+    # --- request path -------------------------------------------------------
+
+    def generate(self, prompt: Sequence[int], *,
+                 max_new_tokens: int = 16, temperature: float = 0.0,
+                 top_k: int = 0, stop_token: Optional[int] = None,
+                 deadline_s: Optional[float] = None,
+                 request_id: Optional[str] = None) -> GenerateResponse:
+        """Route one generation; at-most-once per ``request_id``.
+
+        Retryable failures (dead/busy/killed replica, wire errors)
+        re-enter the queue under the retry policy and land on another
+        replica; terminal errors (deadline, oversized prompt) return
+        as-is."""
+        rid = request_id or uuid.uuid4().hex
+        with self._lock:
+            if rid in self._done:
+                return self._done[rid]
+        req = GenerateRequest(rid, list(prompt),
+                              max_new_tokens=max_new_tokens,
+                              temperature=temperature, top_k=top_k,
+                              stop_token=stop_token,
+                              deadline_s=deadline_s)
+        # Response-read timeout: a generation legitimately runs for the
+        # request's whole deadline — reading it under the snappy probe
+        # timeout would misclassify every slow answer as a dead replica
+        # (and bench the healthy fleet two requests at a time).
+        effective_deadline = (deadline_s if deadline_s is not None
+                              else self._default_deadline_s)
+        wire_timeout = (effective_deadline * 2 + 30.0
+                        if effective_deadline and effective_deadline > 0
+                        else 600.0)
+
+        def attempt() -> GenerateResponse:
+            rep = self._pick()    # NoHealthyReplicasError is retryable:
+            with self._lock:      # probation may clear under backoff
+                rep.inflight += 1
+            try:
+                client = self._client(rep)
+                resp = client.request(req, idempotent=False,
+                                      timeout=wire_timeout)
+            except OSError as e:
+                self._strike(rep)
+                self._cancel_on(rep, rid)
+                raise ReplicaUnavailableError(
+                    f"replica {rep.spec.name}: {e}") from e
+            finally:
+                with self._lock:
+                    rep.inflight -= 1
+            if resp.error in _RETRYABLE_ERRORS:
+                self._strike(rep, fatal=resp.error != "busy")
+                raise ReplicaUnavailableError(
+                    f"replica {rep.spec.name}: {resp.error}")
+            self._mark_ok(rep)
+            return resp
+
+        resp = retry_call(
+            attempt, policy=self._retry_policy,
+            retry_on=(ReplicaUnavailableError, NoHealthyReplicasError),
+            describe=f"serve generate {rid}")
+        with self._lock:
+            self._done[rid] = resp
+            while len(self._done) > self._dedupe_window:
+                self._done.popitem(last=False)
+        return resp
+
+    # --- observability ------------------------------------------------------
+
+    def replica_stats(self, timeout: float = 5.0) -> Dict[str, dict]:
+        """Live ``StatsRequest`` snapshot per reachable replica, plus
+        the router's own health view."""
+        out: Dict[str, dict] = {}
+        now = time.monotonic()
+        for idx, rep in enumerate(self._replicas):
+            entry: Dict[str, object] = {
+                "healthy": self._healthy(rep, now),
+                "strikes": rep.strikes,
+                "inflight": rep.inflight,
+                "completed": rep.completed,
+                "failed": rep.failed,
+            }
+            try:
+                resp = self._client(rep).request(StatsRequest(),
+                                                 idempotent=False,
+                                                 timeout=timeout)
+                entry["stats"] = resp.stats
+            except OSError as e:
+                entry["stats_error"] = str(e)
+            key = rep.spec.name
+            if key in out:   # duplicate display names stay visible
+                key = f"{key}[{idx}]"
+            out[key] = entry
+        return out

@@ -1,0 +1,174 @@
+"""Replica-local serving endpoint on the runner's RPC stack.
+
+One :class:`InferenceServer` fronts one continuous-batching replica: it
+reuses ``runner/common/network.py``'s :class:`BasicService` (threaded
+TCP, HMAC-authenticated frames — the same launcher-minted secret the
+driver/task control plane uses, so a serving fleet needs no second
+credential system).  Each connection handler blocks on its request's
+completion event while the batcher thread schedules; the threaded
+server gives per-request concurrency for free.
+
+Error taxonomy on the wire (``GenerateResponse.error``):
+
+* ``busy`` — admission queue full (backpressure; router retries
+  elsewhere after backoff)
+* ``deadline_exceeded`` — the request's own deadline expired (terminal:
+  retrying a dead deadline elsewhere would waste a second replica)
+* ``replica_killed`` / ``replica_dead`` — this replica died mid-flight
+  / is refusing work (router strikes it and re-runs on a survivor)
+* ``prompt_too_long: ...`` — caller error (terminal)
+
+The ``serve`` fault site's ``drop``/``delay`` modes fire here, before
+admission: a dropped request closes the connection with no response
+(:class:`DropConnection`) — on the router side indistinguishable from
+a replica crashing at the worst moment.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+from .. import faults as faults_mod
+from ..runner.common.network import AckResponse, BasicService, DropConnection
+from ..utils.logging import get_logger
+from .batcher import (ContinuousBatcher, QueueFullError,
+                      ReplicaKilledError)
+from .engine import PromptTooLongError, SamplingParams
+
+logger = get_logger(__name__)
+
+
+class GenerateRequest:
+    def __init__(self, request_id: str, prompt: List[int],
+                 max_new_tokens: int = 16, temperature: float = 0.0,
+                 top_k: int = 0, stop_token: Optional[int] = None,
+                 deadline_s: Optional[float] = None):
+        self.request_id = request_id
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.top_k = top_k
+        self.stop_token = stop_token
+        self.deadline_s = deadline_s
+
+
+class GenerateResponse:
+    def __init__(self, request_id: str, tokens: Optional[List[int]],
+                 error: Optional[str] = None,
+                 ttft_ms: Optional[float] = None):
+        self.request_id = request_id
+        self.tokens = tokens
+        self.error = error
+        self.ttft_ms = ttft_ms
+
+
+class CancelRequest:
+    """Abandon ``request_id`` on this replica (router failover: the
+    request was re-run elsewhere; answered with ``AckResponse``)."""
+
+    def __init__(self, request_id: str):
+        self.request_id = request_id
+
+
+class StatsRequest:
+    pass
+
+
+class StatsResponse:
+    def __init__(self, stats: dict):
+        self.stats = stats
+
+
+class InferenceServer(BasicService):
+    """One serving replica: a batcher behind an authenticated socket.
+
+    ``replica_ranks`` records which mesh slots this replica's model
+    spans (its data-parallel process-set group; see
+    ``serve/router.py::replica_slot_groups``) — advertised in stats so
+    fleet tooling can map replicas back onto the mesh.
+    """
+
+    def __init__(self, batcher: ContinuousBatcher, key: bytes,
+                 name: str = "serve", host: str = "0.0.0.0",
+                 nics: Optional[List[str]] = None,
+                 replica_ranks: Optional[List[int]] = None,
+                 start_batcher: bool = True):
+        super().__init__(name, key, host=host, nics=nics)
+        self._batcher = batcher
+        self.replica_ranks = list(replica_ranks) if replica_ranks else None
+        if start_batcher:
+            batcher.start()
+
+    @property
+    def dead(self) -> bool:
+        return self._batcher.dead
+
+    def _handle(self, req: Any, client_address) -> Any:
+        if isinstance(req, GenerateRequest):
+            return self._generate(req)
+        if isinstance(req, CancelRequest):
+            self._batcher.cancel(req.request_id)
+            return AckResponse()
+        if isinstance(req, StatsRequest):
+            snap = self._batcher.snapshot()
+            if self.replica_ranks is not None:
+                snap["replica_ranks"] = self.replica_ranks
+            return StatsResponse(snap)
+        return super()._handle(req, client_address)
+
+    def _generate(self, req: GenerateRequest) -> GenerateResponse:
+        # Fault site "serve" (drop/delay) — before admission, so a
+        # dropped request costs the replica nothing.
+        if faults_mod._active is not None:
+            if faults_mod.on_serve_request(type(req).__name__) == "drop":
+                raise DropConnection()
+        sampling = SamplingParams(
+            max_new_tokens=req.max_new_tokens,
+            temperature=req.temperature, top_k=req.top_k,
+            stop_token=req.stop_token)
+        try:
+            sr = self._batcher.submit(
+                req.prompt, sampling, request_id=req.request_id,
+                deadline_s=req.deadline_s)
+        except QueueFullError:
+            return GenerateResponse(req.request_id, None, error="busy")
+        except ReplicaKilledError:
+            return GenerateResponse(req.request_id, None,
+                                    error="replica_dead")
+        except PromptTooLongError as e:
+            return GenerateResponse(req.request_id, None,
+                                    error=f"prompt_too_long: {e}")
+        except ValueError as e:
+            # Caller error (empty prompt etc.) — answered terminally; an
+            # escaped exception here would close the socket mid-frame
+            # and make the router misread a poison request as a replica
+            # crash (and bench the healthy fleet retrying it).
+            return GenerateResponse(req.request_id, None,
+                                    error=f"invalid_request: {e}")
+        # The batcher guarantees `done` fires: completion (bounded by
+        # the max-tokens cap), deadline expiry, cancellation, or
+        # replica death (_die).  Wait in a loop rather than under one
+        # arbitrary cap — a deadline-less long generation returning a
+        # TRUNCATED token list as a success would be silent data loss.
+        # The only unguaranteed case is a batcher thread wedged inside
+        # the engine; detect it via `dead` and fail the request loudly.
+        while not sr.done.wait(timeout=30.0):
+            if self._batcher.dead:
+                sr.finish(error="replica_dead")   # idempotent
+        if sr.error is not None:
+            return GenerateResponse(req.request_id, None, error=sr.error)
+        ttft_ms = None
+        if sr.first_token_at is not None:
+            ttft_ms = round((sr.first_token_at - sr.submitted_at) * 1e3, 3)
+        return GenerateResponse(req.request_id, sr.tokens, ttft_ms=ttft_ms)
+
+    def shutdown(self) -> None:
+        self._batcher.stop()
+        super().shutdown()
+
+
+def serve_addresses(server: InferenceServer) -> List[Tuple[str, int]]:
+    """The replica's advertised (ip, port) candidates — what a deployer
+    writes into the router's :class:`~horovod_tpu.serve.router
+    .ReplicaSpec`."""
+    return server.addresses()

@@ -24,8 +24,12 @@ import os
 from typing import Any, Optional, Tuple
 
 # Advertised dense bf16 peak TFLOP/s per chip; override with
-# HVD_TPU_PEAK_TFLOPS for unlisted chips.
+# HVD_TPU_PEAK_TFLOPS for unlisted chips.  v2/v3 advertise bf16-matmul
+# peaks (45 / 123 TFLOP/s per chip) — old slices still show up in
+# serving fleets, and an unmapped kind would silently zero mfu_pct.
 PEAK_TFLOPS = {
+    "TPU v2": 45.0,
+    "TPU v3": 123.0,
     "TPU v4": 275.0,
     "TPU v5 lite": 197.0,
     "TPU v5e": 197.0,
@@ -34,6 +38,8 @@ PEAK_TFLOPS = {
     "TPU v6 lite": 918.0,
     "TPU v6e": 918.0,
 }
+
+_warned_unknown_kinds = set()
 
 
 def peak_tflops(device) -> float:
@@ -78,6 +84,18 @@ def peak_tflops_info(device) -> Tuple[float, str]:
         platform = ""
     if platform == "axon":
         return PEAK_TFLOPS["TPU v5e"], "axon_platform_assumed_v5e"
+    # 0.0 makes every caller drop mfu_pct from its artifact — say so
+    # loudly (once per kind) instead of letting the field vanish.
+    if kind not in _warned_unknown_kinds:
+        _warned_unknown_kinds.add(kind)
+        from .logging import get_logger
+
+        get_logger(__name__).warning(
+            "unknown device kind %r: no PEAK_TFLOPS entry, so mfu_pct "
+            "will be omitted from bench/serving artifacts — set "
+            "HVD_TPU_PEAK_TFLOPS=<peak dense-bf16 TFLOP/s> to supply "
+            "one (known kinds: %s)",
+            kind or "<none>", ", ".join(sorted(PEAK_TFLOPS)))
     return 0.0, f"unknown_device_kind:{kind or '<none>'}"
 
 

@@ -11,12 +11,15 @@ can cite ("N randomized chaos runs green at commit X").
 Default target is the single-controller chaos test (runs anywhere the
 tier-1 suite runs); ``--mp`` switches to the multi-process world test
 (needs a jax build whose CPU backend supports multiprocess computations,
-or real accelerators).
+or real accelerators).  ``--mode serve`` soaks the serving router
+instead: randomized ``serve:step=N,mode=kill`` injection points against
+the replica-failover tests (the training-path loop stays the default).
 
 Usage::
 
     python scripts/chaos_soak.py --runs 20 --out chaos_soak.json
     python scripts/chaos_soak.py --runs 5 --mp --master-seed 7
+    python scripts/chaos_soak.py --runs 20 --mode serve
 """
 
 from __future__ import annotations
@@ -31,8 +34,14 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-SINGLE_TARGET = "tests/test_faults.py"
-MP_TARGET = "tests/multiproc/test_chaos_recovery_mp.py"
+TARGETS = {
+    # (mode, mp) -> pytest target; every target's chaos tests read
+    # HVD_TPU_CHAOS_STEP/_SEED, so one knob pair drives all four.
+    ("train", False): "tests/test_faults.py",
+    ("train", True): "tests/multiproc/test_chaos_recovery_mp.py",
+    ("serve", False): "tests/test_serving.py",
+    ("serve", True): "tests/multiproc/test_serving_mp.py",
+}
 
 
 def run_once(target: str, step: int, seed: int, timeout_s: float) -> dict:
@@ -68,6 +77,10 @@ def main(argv=None) -> int:
     ap.add_argument("--mp", action="store_true",
                     help="soak the multi-process world test instead of "
                          "the single-controller one")
+    ap.add_argument("--mode", choices=("train", "serve"), default="train",
+                    help="'train' loops the elastic-recovery chaos "
+                         "tests; 'serve' soaks the serving router under "
+                         "randomized serve:kill fault specs")
     ap.add_argument("--master-seed", type=int, default=None,
                     help="seed for the (step, seed) draw itself — a "
                          "seeded soak is replayable end to end")
@@ -80,7 +93,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     rng = random.Random(args.master_seed)
-    target = MP_TARGET if args.mp else SINGLE_TARGET
+    target = TARGETS[(args.mode, args.mp)]
     runs = []
     for i in range(args.runs):
         step = rng.randrange(0, args.max_step + 1)
@@ -94,6 +107,7 @@ def main(argv=None) -> int:
 
     summary = {
         "target": target,
+        "mode": args.mode,
         "master_seed": args.master_seed,
         "total": len(runs),
         "passed": sum(r["passed"] for r in runs),

@@ -101,6 +101,9 @@ def test_guarded_init_skip_runs_bare_init():
 
 
 def test_guarded_init_probe_exhaustion_exits_with_line(monkeypatch, capsys):
+    # A cpu-pinned JAX_PLATFORMS would (by design) skip the probe loop;
+    # clear it so this test exercises real probe exhaustion.
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
     monkeypatch.setattr(bp, "_PROBE_SRC", "import sys; sys.exit(1)")
     with pytest.raises(SystemExit):
         bp.guarded_init("m", "u", attempts=2, backoff_s=0.0,
@@ -108,6 +111,43 @@ def test_guarded_init_probe_exhaustion_exits_with_line(monkeypatch, capsys):
     parsed = json.loads(capsys.readouterr().out.strip())
     assert parsed["error"] == "tpu_backend_unavailable"
     assert len(parsed["probe_attempts"]) == 2
+
+
+def test_guarded_init_cpu_pin_skips_probe_budget(monkeypatch):
+    """ISSUE 3 satellite (BENCH_r05): JAX_PLATFORMS=cpu must fast-fail
+    past the probe loop — a cpu-pinned process can never acquire a TPU,
+    so burning attempts x timeout on probes only delays the artifact.
+    The poisoned probe source proves no probe subprocess ever runs."""
+    import horovod_tpu as hvd
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setattr(bp, "_PROBE_SRC", "import sys; sys.exit(1)")
+    bp.guarded_init("m", "u", attempts=2, backoff_s=0.0,
+                    probe_timeout_s=10.0)    # no SystemExit, no probes
+    assert hvd.is_initialized()
+
+
+def test_probe_env_aliases(monkeypatch):
+    """HVD_TPU_PROBE_RETRIES/_BACKOFF are accepted as aliases; the
+    documented _ATTEMPTS/_BACKOFF_S spellings win when both are set."""
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setattr(bp, "_PROBE_SRC", "import sys; sys.exit(1)")
+    seen = {}
+
+    def spy(attempts, backoff_s, probe_timeout_s):
+        seen.update(attempts=attempts, backoff_s=backoff_s)
+        raise bp.BackendUnavailableError([])
+
+    monkeypatch.setattr(bp, "wait_for_backend", spy)
+    monkeypatch.setenv("HVD_TPU_PROBE_RETRIES", "7")
+    monkeypatch.setenv("HVD_TPU_PROBE_BACKOFF", "0.5")
+    with pytest.raises(SystemExit):
+        bp.guarded_init("m", "u")
+    assert seen == {"attempts": 7, "backoff_s": 0.5}
+    monkeypatch.setenv("HVD_TPU_PROBE_ATTEMPTS", "3")
+    with pytest.raises(SystemExit):
+        bp.guarded_init("m", "u")
+    assert seen["attempts"] == 3   # documented spelling wins
 
 
 def test_peak_tflops_prefix_matching(monkeypatch):
@@ -119,6 +159,10 @@ def test_peak_tflops_prefix_matching(monkeypatch):
 
     monkeypatch.delenv("HVD_TPU_PEAK_TFLOPS", raising=False)
     assert peak_tflops_info(Dev("TPU v4"))[1] == "device_kind_table"
+    # ISSUE 3 satellite: v2/v3 are mapped (old slices in serving fleets).
+    assert peak_tflops_info(Dev("TPU v2"))[0] == 45.0
+    assert peak_tflops_info(Dev("TPU v3"))[0] == 123.0
+    assert peak_tflops_info(Dev("TPU v3 chip"))[0] == 123.0
     peak, src = peak_tflops_info(Dev("TPU v5e chip"))
     assert peak == 197.0 and src == "device_kind_prefix:TPU v5e"
     # Different family must NOT prefix-match ("TPU v4i" vs "TPU v4").

@@ -63,6 +63,28 @@ def test_bench_outage_exits_zero_with_error_field():
     assert len(row["probe_attempts"]) == 2
 
 
+def test_serving_bench_json_contract():
+    """ISSUE 3 satellite: the serving bench must produce its JSON
+    report on CPU — tok/s plus TTFT/TPOT percentiles and occupancy."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmarks",
+                                      "serving_bench.py"),
+         "--requests", "4", "--warmup", "1", "--max-new-tokens", "4",
+         "--buckets", "16", "--slots", "2", "--prompt-max", "12"],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "XLA_FLAGS": "", "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "serving_tok_per_s"
+    assert row["unit"] == "tok/s"
+    assert row["value"] > 0
+    assert row["failed"] == 0
+    for key in ("ttft_ms_p50", "ttft_ms_p99", "tpot_ms_p50",
+                "tpot_ms_p99", "occupancy_mean"):
+        assert row[key] is not None and row[key] > 0, (key, row)
+
+
 def test_bench_rejects_nonpositive_batch_size():
     out = subprocess.run(
         [sys.executable, os.path.join(ROOT, "bench.py"), "--preset", "tiny",
