@@ -49,7 +49,8 @@ def main() -> None:
                                  "reducescatter", "alltoall"],
                         help="which collective to sweep (nccl-tests "
                              "busbw factors; see module docstring)")
-    parser.add_argument("--compression", default="none",
+    parser.add_argument("--compression", "--compressor", dest="compression",
+                        default="none",
                         choices=["none", "exact", "fp16", "bf16", "int8"],
                         help="time the fused SPMD gradient wire "
                              "(compressor.spmd_allreduce inside "
@@ -82,6 +83,23 @@ def main() -> None:
                              "sweep so the comparison is direct)")
     parser.add_argument("--cost-beta-gbps", type=float, default=None,
                         help="override HVD_TPU_COST_BETA_GBPS")
+    parser.add_argument("--overlap", action="store_true",
+                        help="sweep the overlap-scheduled microbatch "
+                             "gradient wire — per-microbatch bucketed "
+                             "reduce-scatter with ONE deferred "
+                             "all-gather — against the sequential wire "
+                             "(one allreduce per microbatch) at every "
+                             "size (rows carry path=sequential/"
+                             "overlap); allreduce only")
+    parser.add_argument("--microbatches", type=int, default=4,
+                        help="microbatches per step for --overlap")
+    parser.add_argument("--compute-us-per-microbatch", type=float,
+                        default=0.0,
+                        help="modeled per-microbatch backward time fed "
+                             "to the hidden-comm estimate in the "
+                             "--overlap summary (0 = pure-wire sweep: "
+                             "est reports 0; pass your model's backward "
+                             "time to see the modeled hidden fraction)")
     parser.add_argument("--cpu-mesh", action="store_true",
                         help="force the 8-device virtual CPU mesh "
                              "(functional check, not a perf number)")
@@ -98,12 +116,27 @@ def main() -> None:
     if args.two_phase and args.compression != "none":
         parser.error("--two-phase and --compression are separate "
                      "vehicles; run them as separate sweeps")
+    if args.overlap and args.collective != "allreduce":
+        parser.error("--overlap applies to the allreduce sweep only")
+    if args.overlap and args.two_phase:
+        parser.error("--overlap and --two-phase are separate vehicles; "
+                     "run them as separate sweeps")
+    if args.overlap and args.microbatches < 2:
+        parser.error("--overlap needs --microbatches >= 2")
     # Metric identity carries the vehicle: a compressed-wire sweep must
     # never overwrite the BASELINE allreduce row in trend tooling.
     metric = (f"{args.collective}_busbw_peak" if args.compression == "none"
               else f"allreduce_{args.compression}_wire_busbw_peak")
     if args.two_phase:
         metric = "allreduce_two_phase_busbw_peak"
+    if args.overlap:
+        # --overlap composes with --compression: the tier stays part of
+        # the metric identity so trend tooling never conflates the
+        # exact overlap wire with a compressed one.
+        metric = ("allreduce_overlap_wire_busbw_peak"
+                  if args.compression == "none"
+                  else f"allreduce_overlap_{args.compression}"
+                       "_wire_busbw_peak")
 
     if args.cpu_mesh:
         from horovod_tpu.utils.platform import force_cpu_mesh
@@ -244,6 +277,64 @@ def main() -> None:
 
         runs = {"single_phase": _wire(False), "two_phase": _wire(True)}
 
+    if args.overlap:
+        # Overlap-wire vehicle: the microbatch gradient wire of
+        # optim.make_train_step — one reduce-scatter per microbatch with
+        # a SINGLE deferred all-gather at the update boundary — vs the
+        # sequential wire (one allreduce per microbatch).  algbw/busbw
+        # stay defined over the LOGICAL payload (microbatches × elems)
+        # with the allreduce factor, so the deferred-AG byte saving
+        # ((mb+1)/(2·mb) of the sequential wire bytes) reads directly as
+        # higher effective bandwidth.  On CPU XLA runs collectives
+        # synchronously, so this measures the byte saving only; the
+        # compute-hiding payoff needs async collectives (TPU) and a
+        # backward to hide under — see gpt_bench.py --overlap.
+        import numpy as np
+        from horovod_tpu._compat import shard_map
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from horovod_tpu.ops.compression import Compression as Comp
+
+        comp_cls = {"none": Comp.none, "exact": Comp.none,
+                    "fp16": Comp.fp16, "bf16": Comp.bf16,
+                    "int8": Comp.int8}[args.compression]
+        gm = hvd.global_mesh()
+        stack_sharding = NamedSharding(gm.mesh, P(gm.axis_name))
+        mbs = args.microbatches
+
+        def _global_stack(shape, dt):
+            return jax.make_array_from_callback(
+                shape, stack_sharding,
+                lambda idx: np.ones(
+                    tuple(len(range(*s.indices(dim)))
+                          for s, dim in zip(idx, shape)), dt))
+
+        def _mk_stack(elems):  # noqa: F811 — RS needs n-divisible flats
+            elems = ((elems + n - 1) // n) * n
+            return _global_stack((n, elems), dtype), elems
+
+        def _wire(overlap):
+            def per_slot(xb):  # [1, elems] — this slot's per-mb gradient
+                x = xb[0]
+                if overlap:
+                    acc = jnp.zeros((x.size // max(1, n),), x.dtype)
+                    for _ in range(mbs):
+                        acc = acc + comp_cls.spmd_reducescatter(
+                            x, op="sum", axis=gm.axis_name)
+                    out = comp_cls.spmd_allgather(
+                        acc, axis=gm.axis_name)[: x.size]
+                else:
+                    out = jnp.zeros_like(x)
+                    for _ in range(mbs):
+                        out = out + comp_cls.spmd_allreduce(
+                            x, op="sum", axis=gm.axis_name)
+                return out[None]
+
+            return jax.jit(shard_map(per_slot, mesh=gm.mesh,
+                                     in_specs=P(gm.axis_name),
+                                     out_specs=P(gm.axis_name)))
+
+        runs = {"sequential": _wire(False), "overlap": _wire(True)}
+
     factor = ((2 * (n - 1) / n) if args.collective == "allreduce"
               else (n - 1) / n) if n > 1 else 1.0
 
@@ -268,6 +359,8 @@ def main() -> None:
             payload = real_elems * bytes_per
             if args.collective == "allgather":
                 payload *= n   # algbw over the gathered output bytes
+            if args.overlap:
+                payload *= args.microbatches  # logical grad bytes/step
             algbw = payload / dt / 1e9
             busbw = algbw * factor
             row = {"elems": real_elems, "bytes": payload,
@@ -280,8 +373,12 @@ def main() -> None:
             print(json.dumps(row), flush=True)
         elems *= 4
 
-    two_rows = [r for r in results if r.get("path") == "two_phase"]
-    peak_rows = two_rows if args.two_phase else results
+    if args.two_phase:
+        peak_rows = [r for r in results if r.get("path") == "two_phase"]
+    elif args.overlap:
+        peak_rows = [r for r in results if r.get("path") == "overlap"]
+    else:
+        peak_rows = results
     peak = max(r["busbw_GBps"] for r in peak_rows)
     summary = {"metric": metric, "value": peak,
                "unit": "GB/s", "sizes_swept": len(peak_rows),
@@ -301,6 +398,24 @@ def main() -> None:
             "single_phase_busbw_peak": single_peak,
             "two_phase_vs_single": round(peak / single_peak, 3)
             if single_peak else None,
+        })
+    if args.overlap:
+        from horovod_tpu.ops.fusion import estimate_overlap_hidden_fraction
+
+        seq_peak = max(r["busbw_GBps"] for r in results
+                       if r.get("path") == "sequential")
+        est = estimate_overlap_hidden_fraction(
+            [results[-1]["elems"] * bytes_per], 1 << 62, world_size=n,
+            microbatches=args.microbatches,
+            compute_us_per_microbatch=args.compute_us_per_microbatch)
+        summary.update({
+            "vehicle": "spmd_gradient_wire",
+            "microbatches": args.microbatches,
+            "compression": args.compression,
+            "sequential_busbw_peak": seq_peak,
+            "overlap_vs_sequential": round(peak / seq_peak, 3)
+            if seq_peak else None,
+            "hidden_comm_frac_est": round(est["hidden_frac"], 4),
         })
     print(json.dumps(summary))
     if args.out:

@@ -39,7 +39,26 @@ def main() -> None:
     parser.add_argument("--warmup", type=int, default=1)
     parser.add_argument("--iters", type=int, default=4)
     parser.add_argument("--steps-per-call", type=int, default=5)
+    parser.add_argument("--microbatches", type=int, default=0,
+                        help=">1: accumulate gradients over this many "
+                             "microbatches per step inside one compiled "
+                             "scan (0 = HVD_TPU_MICROBATCHES)")
+    parser.add_argument("--overlap", action=argparse.BooleanOptionalAction,
+                        default=None,
+                        help="overlap-schedule the gradient wire: issue "
+                             "microbatch i-1's bucketed reduce-scatter "
+                             "under microbatch i's backward, all-gather "
+                             "deferred to the update boundary; "
+                             "--no-overlap pins the accumulate-then-"
+                             "reduce baseline (default: "
+                             "HVD_TPU_OVERLAP_REDUCE)")
+    parser.add_argument("--compressor", default="none",
+                        choices=["none", "fp16", "bf16", "int8"],
+                        help="gradient-wire compression tier "
+                             "(hvd.Compression.<tier>)")
     args = parser.parse_args()
+    if args.microbatches < 0:
+        parser.error("--microbatches must be >= 0")
 
     if args.preset == "tiny":
         from horovod_tpu.utils.platform import force_cpu_mesh
@@ -92,7 +111,26 @@ def main() -> None:
     n_params = sum(x.size for x in jax.tree.leaves(params))
     tx = optax.adamw(3e-4)
     loss_fn = lm_loss_fn(model, vocab_chunk_size=args.vocab_chunk)
-    step = hvd.make_train_step(loss_fn, tx, donate=False)
+    compressor = (None if args.compressor == "none"
+                  else getattr(hvd.Compression, args.compressor))
+    # Effective microbatch count: the request clamped to a divisor of
+    # the per-slot batch via the SAME snapping policy the step uses at
+    # trace time (the bench clamps up front so a round-number request
+    # never crashes the run; the step would raise on an explicit
+    # non-divisor).
+    from horovod_tpu.optim.distributed_optimizer import snap_microbatches
+
+    per_slot_rows = max(1, batch // n_chips)
+    mb_req = args.microbatches or hvd.config().microbatches
+    mb = snap_microbatches(mb_req, per_slot_rows)
+    # An explicit --microbatches (even 1) pins the count; only an unset
+    # flag defers to HVD_TPU_MICROBATCHES — so the JSON row always
+    # describes the experiment that actually ran.
+    step = hvd.make_train_step(loss_fn, tx, donate=False,
+                               microbatches=mb if args.microbatches
+                               else (mb if mb > 1 else None),
+                               overlap=args.overlap,
+                               compression=compressor)
     opt_state = tx.init(params)
 
     @partial(jax.jit, donate_argnums=(0, 1))
@@ -131,7 +169,45 @@ def main() -> None:
         "seq_len": seq,
         "attention": cfg.attention,
         "vocab_chunk": args.vocab_chunk,
+        "microbatches": mb,
+        "overlap": bool(args.overlap) if args.overlap is not None
+        else hvd.config().overlap_reduce,
+        "compressor": args.compressor,
     }
+    if mb > 1 and not out["overlap"]:
+        # Nothing is scheduled under the backward: the honest estimate
+        # of hidden communication is zero.
+        out["hidden_comm_frac_est"] = 0.0
+        out["hidden_comm_basis"] = "overlap_off"
+    elif mb > 1:
+        # Estimated hidden-communication fraction of the overlap
+        # schedule (ops/fusion.py cost model): per-microbatch backward
+        # time from the chip's advertised peak when known, else from the
+        # measured wall clock (CPU runs — the basis field records which).
+        from horovod_tpu.ops.fusion import estimate_overlap_hidden_fraction
+        from horovod_tpu.utils.mfu import estimate_compute_us
+
+        sizes = [leaf.size * leaf.dtype.itemsize
+                 for leaf in jax.tree.leaves(params)]
+        step_flops = (chunk_flops / args.steps_per_call
+                      if chunk_flops else None)
+        bwd_us = estimate_compute_us(
+            (2.0 / 3.0) * step_flops / mb if step_flops else None,
+            jax.devices()[0])
+        basis = "modeled_peak"
+        if bwd_us is None:
+            basis = "measured_wall"
+            bwd_us = (dt / (args.iters * args.steps_per_call * mb)) \
+                * (2.0 / 3.0) * 1e6
+        hvd_cfg = hvd.config()
+        est = estimate_overlap_hidden_fraction(
+            sizes, hvd_cfg.fusion_threshold, world_size=n_chips,
+            microbatches=mb, compute_us_per_microbatch=bwd_us,
+            alpha_us=hvd_cfg.cost_alpha_us,
+            beta_gbps=hvd_cfg.cost_beta_gbps)
+        out["hidden_comm_frac_est"] = round(est["hidden_frac"], 4)
+        out["hidden_comm_wire_us_est"] = round(est["wire_us"], 2)
+        out["hidden_comm_basis"] = basis
     if chunk_flops:
         per_chip_flops_s = chunk_flops * args.iters / dt
         out["model_tflops_per_chip"] = round(per_chip_flops_s / 1e12, 2)

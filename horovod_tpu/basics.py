@@ -244,9 +244,20 @@ def _maybe_build_parameter_manager(cfg):
     search additionally spans the two-phase wire knobs: ``two_phase``
     (a 1/2-valued on/off axis — the GP is free to discover that the
     monolithic allreduce wins) and ``pipeline_depth`` (buckets in
-    flight, snapped to an integer in [1, 8]).  All knobs are applied at
-    the re-jit boundary (the next-cycle application point of the
-    reference); see ``optim/autotune.py`` and
+    flight, snapped to an integer in [1, 8]).  With
+    ``HVD_TPU_MICROBATCHES>1`` the search spans the overlap-scheduled
+    microbatch knobs jointly: ``microbatches`` (snapped to a power of
+    two; the train step further snaps to a divisor of the per-slot
+    batch at trace time) and ``overlap`` (1/2 on/off — exposing the
+    wire after the last gradient can win for latency-bound models).
+    With ``HVD_TPU_ERROR_FEEDBACK=1`` the ``compressor`` axis joins
+    (1..4 → none/fp16/bf16/int8): on the EF-carrying paths
+    (DistributedOptimizer / make_zero_train_step) the residual keeps
+    lossy tiers unbiased, so the tuner may trade quantization noise for
+    wire time; a plain make_train_step reduce has no residual state and
+    warns once when a config-driven lossy tier lands on it.
+    All knobs are applied at the re-jit boundary (the next-cycle
+    application point of the reference); see ``optim/autotune.py`` and
     ``_apply_autotuned_knobs``."""
     if not cfg.autotune:
         return None
@@ -269,6 +280,22 @@ def _maybe_build_parameter_manager(cfg):
         knobs["pipeline_depth"] = (1, _MAX_PIPELINE_DEPTH)
         initial["pipeline_depth"] = min(max(1, cfg.pipeline_depth),
                                         _MAX_PIPELINE_DEPTH)
+    joint_microbatch = cfg.microbatches > 1 and size > 1
+    if joint_microbatch:
+        # Power-of-two lattice up to _MAX_MICROBATCHES; the user's
+        # configured count seeds the start point (clamped onto the
+        # lattice — scores must attribute to what the job runs).
+        knobs["microbatches"] = (1, _MAX_MICROBATCHES)
+        initial["microbatches"] = _nearest_pow2(
+            min(max(1, cfg.microbatches), _MAX_MICROBATCHES))
+        knobs["overlap"] = (1, 2)
+        initial["overlap"] = 2 if cfg.overlap_reduce else 1
+    if cfg.error_feedback and size > 1:
+        # Lossy tiers are safe under the EF residual, so the wire dtype
+        # becomes a legitimate search axis (1..4 = none/fp16/bf16/int8).
+        knobs["compressor"] = (1, len(_COMPRESSOR_LATTICE))
+        live_comp = cfg.compression or "none"
+        initial["compressor"] = _COMPRESSOR_LATTICE.index(live_comp) + 1
     if joint:
         # log2 search over [1, size]; proposals snap to the nearest
         # divisor of the slot count (1 and size both mean "flat"
@@ -325,6 +352,17 @@ def _maybe_build_parameter_manager(cfg):
         _state.config = dataclasses.replace(
             _state.config,
             pipeline_depth=int(round(start_vals["pipeline_depth"])))
+    if joint_microbatch:
+        _state.config = dataclasses.replace(
+            _state.config,
+            microbatches=_nearest_pow2(int(round(
+                start_vals["microbatches"]))),
+            overlap_reduce=start_vals["overlap"] >= 1.5)
+    if "compressor" in knobs:
+        idx = min(max(1, int(round(start_vals["compressor"]))),
+                  len(_COMPRESSOR_LATTICE))
+        _state.config = dataclasses.replace(
+            _state.config, compression=_COMPRESSOR_LATTICE[idx - 1])
     logger.info(
         "autotune enabled: tuning %s, %d warmup + %d scored windows "
         "of %d steps%s",
@@ -338,6 +376,27 @@ def _maybe_build_parameter_manager(cfg):
 # Pipeline-depth search ceiling: past ~8 buckets in flight the transient
 # shard buffers outweigh any remaining overlap.
 _MAX_PIPELINE_DEPTH = 8
+
+# Microbatch search ceiling: past 32-way accumulation the per-microbatch
+# batch is too small to keep the MXU busy on any realistic config.
+_MAX_MICROBATCHES = 32
+
+# Compressor search lattice (index 1..4 on the GP's log2 machinery);
+# names are Compression namespace attributes AND legal
+# HVD_TPU_COMPRESSION values, so the applied point round-trips.
+_COMPRESSOR_LATTICE = ("none", "fp16", "bf16", "int8")
+
+
+def _nearest_pow2(value: int) -> int:
+    """Nearest power of two in log space (microbatch proposals must land
+    on a lattice the per-slot batch has a chance of dividing)."""
+    import math
+
+    v = max(1, int(value))
+    lo = 1 << (v.bit_length() - 1)
+    hi = lo * 2
+    return lo if abs(math.log2(v) - math.log2(lo)) <= \
+        abs(math.log2(hi) - math.log2(v)) else hi
 
 
 def _nearest_divisor(value: int, size: int) -> int:
@@ -368,7 +427,9 @@ def _apply_autotuned_knobs(values) -> dict:
     values up on the next trace.  Returns the values as actually
     applied, keyed by KNOB name (the hierarchical inner width snaps to
     the nearest divisor of the slot count; ``pipeline_depth`` snaps to
-    an int in [1, 8]; ``two_phase`` snaps to its 1=off / 2=on lattice) —
+    an int in [1, 8]; ``two_phase``/``overlap`` snap to their 1=off /
+    2=on lattices; ``microbatches`` snaps to a power of two;
+    ``compressor`` snaps to the none/fp16/bf16/int8 lattice) —
     the caller re-points the manager at these, so keys must match
     ``pm.knob_names`` even where the Config field is spelled
     differently (``two_phase`` → ``two_phase_allreduce``)."""
@@ -393,6 +454,19 @@ def _apply_autotuned_knobs(values) -> dict:
         v = min(max(1, int(round(values["pipeline_depth"]))),
                 _MAX_PIPELINE_DEPTH)
         updates["pipeline_depth"] = applied["pipeline_depth"] = v
+    if "microbatches" in values:
+        v = min(_nearest_pow2(int(round(values["microbatches"]))),
+                _MAX_MICROBATCHES)
+        updates["microbatches"] = applied["microbatches"] = v
+    if "overlap" in values:
+        snapped = 2 if values["overlap"] >= 1.5 else 1
+        updates["overlap_reduce"] = snapped == 2
+        applied["overlap"] = snapped
+    if "compressor" in values:
+        idx = min(max(1, int(round(values["compressor"]))),
+                  len(_COMPRESSOR_LATTICE))
+        updates["compression"] = _COMPRESSOR_LATTICE[idx - 1]
+        applied["compressor"] = idx
     st.config = dataclasses.replace(st.config, **updates)
     return applied
 

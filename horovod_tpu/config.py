@@ -35,12 +35,16 @@ DEFAULT_COST_BETA_GBPS = 100.0
 # and the checkpointer).  Parsed here so a typo'd spec fails loudly at
 # init, exactly like every other malformed env knob.
 
-FAULT_SITES = ("collective", "fusion", "discovery", "rpc", "checkpoint",
-               "serve")
+FAULT_SITES = ("collective", "fusion", "accumulate", "discovery", "rpc",
+               "checkpoint", "serve")
 
 _FAULT_MODES = {
     "collective": ("raise",),
     "fusion": ("raise",),
+    # accumulate: fires at the microbatch-loop boundary of the
+    # overlap-scheduled train step (trace time, one event per microbatch
+    # boundary) — the chaos drill for the gradient-accumulation path.
+    "accumulate": ("raise",),
     "discovery": ("flap", "timeout", "error"),
     "rpc": ("drop", "delay"),
     "checkpoint": ("corrupt", "partial"),
@@ -213,6 +217,30 @@ def _env_int_tuple(name: str, default: "tuple") -> "tuple":
     return items
 
 
+def _env_pos_int(name: str, default: int) -> int:
+    """Like :func:`_env_int` but the value must be >= 1 (count knobs
+    where 0 would silently disable a requested feature)."""
+    v = _env_int(name, default)
+    if v < 1:
+        raise ValueError(f"Env var {name!r} must be >= 1, got {v}")
+    return v
+
+
+def _env_choice(name: str, default: Optional[str],
+                choices: "tuple") -> Optional[str]:
+    """Enumerated string knob; unset stays ``default``.  A typo'd tier
+    name must fail at init, not silently run uncompressed."""
+    val = _env(name)
+    if val is None:
+        return default
+    val = val.strip().lower()
+    if val not in choices:
+        raise ValueError(
+            f"Env var {name!r} has unknown value {val!r}; expected one "
+            f"of {choices}")
+    return val
+
+
 def _env_float(name: str, default: float) -> float:
     val = _env(name)
     if val is None:
@@ -243,6 +271,14 @@ class Config:
     pipeline_depth: int = 2                   # HVD_TPU_PIPELINE_DEPTH (buckets in flight)
     cost_alpha_us: float = DEFAULT_COST_ALPHA_US    # HVD_TPU_COST_ALPHA_US (per-collective launch latency)
     cost_beta_gbps: float = DEFAULT_COST_BETA_GBPS  # HVD_TPU_COST_BETA_GBPS (per-hop wire bandwidth)
+
+    # --- overlap-scheduled microbatch training (the fused
+    #     computation-collective scheduling of arXiv:2305.06942 +
+    #     EQuARX-style error-fed quantized transport, arXiv:2506.17615) ---
+    microbatches: int = 1            # HVD_TPU_MICROBATCHES (grad accumulation per step)
+    overlap_reduce: bool = True      # HVD_TPU_OVERLAP_REDUCE (issue mb i-1's reduce-scatter under mb i's backward)
+    error_feedback: bool = False     # HVD_TPU_ERROR_FEEDBACK (carry lossy-wire residual, re-inject next step)
+    compression: Optional[str] = None  # HVD_TPU_COMPRESSION (none|fp16|bf16|int8; unset = call-site argument)
 
     # --- collectives ---
     hierarchical_allreduce: bool = False      # HOROVOD_HIERARCHICAL_ALLREDUCE
@@ -317,6 +353,11 @@ class Config:
             cost_alpha_us=_env_float("COST_ALPHA_US", DEFAULT_COST_ALPHA_US),
             cost_beta_gbps=_env_float("COST_BETA_GBPS",
                                       DEFAULT_COST_BETA_GBPS),
+            microbatches=_env_pos_int("MICROBATCHES", 1),
+            overlap_reduce=_env_bool("OVERLAP_REDUCE", True),
+            error_feedback=_env_bool("ERROR_FEEDBACK", False),
+            compression=_env_choice("COMPRESSION", None,
+                                    ("none", "fp16", "bf16", "int8")),
             hierarchical_allreduce=_env_bool("HIERARCHICAL_ALLREDUCE", False),
             hierarchical_allgather=_env_bool("HIERARCHICAL_ALLGATHER", False),
             batch_d2d_memcopies=_env_bool("BATCH_D2D_MEMCOPIES", True),

@@ -12,6 +12,8 @@ site       threaded through                                      actions (``mode
 ========== ===================================================== =====================
 collective ``ops/collectives.py`` dispatch heartbeat             ``raise`` (HorovodInternalError)
 fusion     ``ops/fusion.py`` two-phase apply (trace time)        ``raise``
+accumulate microbatch-loop boundary of the overlap-scheduled     ``raise``
+           train steps (trace time; one event per microbatch)
 discovery  ``elastic/driver.py`` ScriptDiscovery + poll          ``flap``/``timeout``/``error``
 rpc        ``runner/common/network.py`` BasicClient calls        ``drop``/``delay``
 checkpoint ``checkpoint.py`` Checkpointer.save                   ``corrupt``/``partial``
@@ -49,7 +51,7 @@ logger = get_logger(__name__)
 
 __all__ = [
     "configure", "clear", "inject", "active_spec", "history",
-    "on_collective", "on_fusion", "on_discovery_script",
+    "on_collective", "on_fusion", "on_accumulate", "on_discovery_script",
     "on_discovery_hosts", "on_rpc", "on_checkpoint_save",
     "on_serve_request", "on_serve_decode",
 ]
@@ -203,6 +205,26 @@ def on_fusion(stage: str = "two_phase") -> None:
     if st.should_fire():
         plan.fire("fusion", "raise", at, stage)
         raise _internal_error(f"injected fusion fault at trace #{at} ({stage})")
+
+
+def on_accumulate(microbatch: int = 0) -> None:
+    """Site ``accumulate`` — fires at the microbatch-loop boundary of
+    the overlap-scheduled train steps (trace time, like ``fusion``: the
+    failure surfaces while the gradient-accumulation program is being
+    built).  One event per microbatch boundary, so
+    ``accumulate:step=N`` targets the N-th boundary of the trace."""
+    plan = _active
+    if plan is None:
+        return
+    st = plan.site("accumulate")
+    if st is None:
+        return
+    at = st.counter
+    if st.should_fire():
+        plan.fire("accumulate", "raise", at, f"microbatch={microbatch}")
+        raise _internal_error(
+            f"injected accumulate fault at boundary #{at} "
+            f"(microbatch {microbatch})")
 
 
 def on_discovery_script(script: str = "") -> None:

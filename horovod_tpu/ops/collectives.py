@@ -198,7 +198,10 @@ def _reduce_stack(x, op: str, members: Optional[Sequence[int]],
     if op in (Sum, Average):
         orig_dtype = x.dtype
         x = _mask_for(members, size, 0, x)
-        wire, ctx = compression.compress(x)
+        # Stack-aware hook: block-sensitive tiers (int8) derive their
+        # quantization granularity from the GROUP width n, not the
+        # full-world stack height (process sets mask non-members).
+        wire, ctx = compression.compress_stack(x, n)
         # jnp.sum widens integer accumulators under x64; the reference
         # reduces in the wire dtype, so pin the result dtype.
         r = jnp.sum(wire, axis=0).astype(wire.dtype)

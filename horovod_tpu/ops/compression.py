@@ -17,6 +17,8 @@ wire saving the reference implements by casting before ``ncclAllReduce``.
 
 from __future__ import annotations
 
+import math
+
 import jax.numpy as jnp
 
 
@@ -34,6 +36,30 @@ class Compressor:
     @staticmethod
     def decompress(tensor, ctx):
         raise NotImplementedError
+
+    @classmethod
+    def compress_stack(cls, x, n):
+        """Stack-tier compress hook: ``x`` is the full ``[size, ...]``
+        contributor stack, but only ``n`` rows are live members (process
+        sets mask the rest to the op's neutral element) — block-
+        sensitive tiers must derive their granularity from the
+        REDUCTION-GROUP width, not the stack height.  Default tiers
+        ignore ``n``."""
+        del n
+        return cls.compress(x)
+
+    @classmethod
+    def local_error(cls, x, block_size=None):
+        """Error-feedback residual source: what THIS rank's lossy
+        transport discards of ``x`` — ``x - D(C(x))``, computed locally
+        with no collective.  Exact tiers return zeros (folded away by
+        XLA); error feedback accumulates this and re-injects it into the
+        next step's gradient — the EQuARX recipe that makes lossy wires
+        safe for long runs.  ``block_size`` is the wire's quantization
+        granularity hint (int8 honors it; cast tiers have no blocks)."""
+        del block_size
+        wire, ctx = cls.compress(x)
+        return x - cls.decompress(wire, ctx).astype(x.dtype)
 
     @classmethod
     def spmd_allreduce(cls, x, *, op, axis, groups=None):
@@ -115,14 +141,60 @@ class Int8Compressor(Compressor):
     @staticmethod
     def compress(tensor):
         if jnp.issubdtype(tensor.dtype, jnp.floating):
-            from .quantization import simulate_int8_stack_reduce
+            from .quantization import (simulate_int8_stack_reduce,
+                                       wire_block_size)
 
-            return simulate_int8_stack_reduce(tensor), None
+            # Stack tier: dim 0 is the contributor axis.  The wire path
+            # quantizes each contributor's flat vector in per-destination
+            # chunks of elems/n, so its blocks never exceed that chunk —
+            # derive the SAME effective block here (a fixed 1024 would
+            # quantize at a coarser granularity than the wire whenever
+            # elems/n < 1024, diverging the two tiers' numerics).
+            rows = tensor.shape[0] if tensor.ndim else 1
+            row_elems = (math.prod(tensor.shape[1:])
+                         if tensor.ndim > 1 else 1)
+            block = wire_block_size(row_elems, rows)
+            return simulate_int8_stack_reduce(tensor, block_size=block), None
         return tensor, None
 
     @staticmethod
     def decompress(tensor, ctx):
         return tensor
+
+    @classmethod
+    def compress_stack(cls, x, n):
+        """Process-set-aware stack simulation: a grouped reduce over
+        ``n`` members quantizes wire chunks of ``elems/n`` even when the
+        stack carries the full world's rows (non-members masked) — the
+        block must follow the group width or the two tiers' numerics
+        diverge on process sets."""
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            from .quantization import (simulate_int8_stack_reduce,
+                                       wire_block_size)
+
+            row_elems = math.prod(x.shape[1:]) if x.ndim > 1 else 1
+            block = wire_block_size(row_elems, max(1, int(n)))
+            return simulate_int8_stack_reduce(x, block_size=block), None
+        return x, None
+
+    @classmethod
+    def local_error(cls, x, block_size=None):
+        """Per-leaf EF residual for the int8 wire: the blockwise
+        quant-dequant roundtrip error of this rank's contribution
+        (``quantization.quant_dequant`` — phase 1 of the transport,
+        which is where the loss happens; accumulation is exact f32).
+        ``block_size`` should be the wire's effective block
+        (``quantization.wire_block_size`` for the caller's group width)
+        so the residual quantizes at the wire's granularity; None falls
+        back to the transport's 1024 ceiling.  Leaf-granular: inside a
+        fused multi-leaf bucket the wire's blocks can span leaf
+        boundaries, so this approximates (does not byte-match) the
+        bucket-level error while keeping the EF contraction property."""
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            return jnp.zeros_like(x)
+        from .quantization import quant_dequant
+
+        return x - quant_dequant(x, block_size=block_size or 1024)
 
     @staticmethod
     def _check_op(op, x) -> bool:

@@ -146,20 +146,44 @@ def _dispatch_two_phase_flags(payloads: Sequence[int], world_size: int,
     return plan_two_phase_flags(payloads, world_size, alpha_us, beta_gbps)
 
 
+def plan_overlap_priority(bucket_bytes: Sequence[int], world_size: int,
+                          alpha_us: float, beta_gbps: float) -> List[int]:
+    """Bucket emission order that maximizes hidden communication:
+    descending modeled wire cost (stable on ties).  The earliest-issued
+    collective has the most concurrent compute left to hide under, so
+    the most expensive bucket goes first — the overlap extension of the
+    α–β model (fused computation-collective scheduling, PAPERS.md)."""
+    costs = [phase_cost_us(b, world_size, alpha_us, beta_gbps)
+             for b in bucket_bytes]
+    return sorted(range(len(bucket_bytes)), key=lambda i: (-costs[i], i))
+
+
 def plan_pipeline_order(two_phase_flags: Sequence[bool],
-                        pipeline_depth: int) -> List[Tuple[str, int]]:
+                        pipeline_depth: int,
+                        priority: Optional[Sequence[float]] = None,
+                        ) -> List[Tuple[str, int]]:
     """Software-pipelined emission order over buckets: ``("rs", i)`` /
     ``("ag", i)`` for decomposed buckets, ``("ar", i)`` for single-phase
     ones.  At most ``pipeline_depth`` reduce-scatters are in flight
     before the oldest bucket's all-gather is emitted; depth 1 degenerates
-    to strictly sequential rs/ag pairs.  Deterministic in its inputs —
-    every rank traces the identical collective order (the SPMD
-    dispatch-order contract)."""
+    to strictly sequential rs/ag pairs.  ``priority`` (e.g. per-bucket
+    modeled wire cost) reorders emission descending-priority —
+    most-expensive collectives first, so they have the most compute to
+    hide under — while keeping the rs-before-ag and in-flight-bound
+    invariants.  Deterministic in its inputs — every rank traces the
+    identical collective order (the SPMD dispatch-order contract)."""
     depth = max(1, int(pipeline_depth))
+    idxs: Sequence[int] = range(len(two_phase_flags))
+    if priority is not None:
+        if len(priority) != len(two_phase_flags):
+            raise ValueError(
+                f"priority has {len(priority)} entries for "
+                f"{len(two_phase_flags)} buckets")
+        idxs = sorted(idxs, key=lambda i: (-priority[i], i))
     order: List[Tuple[str, int]] = []
     inflight: List[int] = []
-    for i, tp in enumerate(two_phase_flags):
-        if tp:
+    for i in idxs:
+        if two_phase_flags[i]:
             order.append(("rs", i))
             inflight.append(i)
             if len(inflight) >= depth:
@@ -174,12 +198,15 @@ def plan_pipeline_order(two_phase_flags: Sequence[bool],
 @dataclasses.dataclass(frozen=True)
 class BucketSchedule:
     """A complete fusion plan: bucket membership, per-bucket phase
-    decision, interleaved emission order, and the modeled makespan."""
+    decision, interleaved emission order, and the modeled makespan.
+    ``est_hidden_us`` is the wire time the overlap term expects to hide
+    under concurrent compute (0.0 when no compute estimate was given)."""
 
     buckets: Tuple[Tuple[int, ...], ...]
     two_phase: Tuple[bool, ...]
     order: Tuple[Tuple[str, int], ...]
     est_cost_us: float
+    est_hidden_us: float = 0.0
 
 
 def estimate_schedule_cost_us(bucket_bytes: Sequence[int],
@@ -208,14 +235,23 @@ def plan_bucket_schedule(sizes_bytes: Sequence[int], threshold: int, *,
                          alpha_us: float = DEFAULT_COST_ALPHA_US,
                          beta_gbps: float = DEFAULT_COST_BETA_GBPS,
                          two_phase: bool = True,
-                         pipeline_depth: int = 2) -> BucketSchedule:
+                         pipeline_depth: int = 2,
+                         compute_us: Optional[float] = None,
+                         ) -> BucketSchedule:
     """Full schedule-aware plan for one dtype class: greedy byte-bounded
     buckets (``plan_buckets`` — native-capable), α–β phase decisions and
     the pipelined emission order.  Pure bookkeeping on static sizes, so
     every rank computes the identical schedule.  Delegates the
     flag computation to the native planner when built (same contract;
     equivalence property-tested in tests/test_native.py style in
-    tests/test_fusion.py)."""
+    tests/test_fusion.py).
+
+    ``compute_us`` is the overlap term: the modeled concurrent-compute
+    time (e.g. one microbatch's backward, from ``utils.mfu``) the
+    collectives can hide under.  When given, buckets are emitted in
+    descending wire-cost order (``plan_overlap_priority``) so the most
+    expensive collectives start earliest, and ``est_hidden_us`` reports
+    how much of the modeled makespan the overlap is expected to hide."""
     buckets = plan_buckets(sizes_bytes, threshold)
     payloads = [sum(sizes_bytes[i] for i in b) for b in buckets]
     if two_phase and world_size > 1:
@@ -223,15 +259,56 @@ def plan_bucket_schedule(sizes_bytes: Sequence[int], threshold: int, *,
                                           beta_gbps)
     else:
         flags = [False] * len(buckets)
-    order = plan_pipeline_order(flags, pipeline_depth)
+    priority = None
+    hidden = 0.0
     cost = estimate_schedule_cost_us(payloads, flags, world_size, alpha_us,
                                      beta_gbps)
+    if compute_us is not None and world_size > 1:
+        # ONE source of truth for the emission order: rank-encode
+        # plan_overlap_priority's index order as priority values.
+        order_idx = plan_overlap_priority(payloads, world_size, alpha_us,
+                                          beta_gbps)
+        priority = [0.0] * len(payloads)
+        for rank, bi in enumerate(order_idx):
+            priority[bi] = float(len(payloads) - rank)
+        hidden = min(float(compute_us), cost)
+    order = plan_pipeline_order(flags, pipeline_depth, priority)
     return BucketSchedule(
         buckets=tuple(tuple(b) for b in buckets),
         two_phase=tuple(flags),
         order=tuple(order),
         est_cost_us=cost,
+        est_hidden_us=hidden,
     )
+
+
+def estimate_overlap_hidden_fraction(
+        sizes_bytes: Sequence[int], threshold: int, *, world_size: int,
+        microbatches: int, compute_us_per_microbatch: float,
+        alpha_us: float = DEFAULT_COST_ALPHA_US,
+        beta_gbps: float = DEFAULT_COST_BETA_GBPS) -> dict:
+    """Modeled hidden-communication fraction of the overlap-scheduled
+    microbatch wire: each of the ``microbatches`` microbatches pays one
+    bucketed reduce-scatter pass, with microbatch *i−1*'s pass issued
+    under microbatch *i*'s backward compute — so ``microbatches − 1``
+    passes can hide up to ``compute_us_per_microbatch`` each; the last
+    pass and the single deferred all-gather stay exposed.  Returns
+    ``{"wire_us", "hidden_us", "hidden_frac"}`` (all 0 in a world of
+    one, where there is no wire)."""
+    mb = max(1, int(microbatches))
+    buckets = plan_buckets(sizes_bytes, threshold)
+    payloads = [sum(sizes_bytes[i] for i in b) for b in buckets]
+    rs_us = sum(phase_cost_us(p, world_size, alpha_us, beta_gbps)
+                for p in payloads)
+    ag_us = rs_us  # AG cost == RS cost in the α–β model
+    wire_us = mb * rs_us + ag_us
+    hidden_us = (mb - 1) * min(max(0.0, float(compute_us_per_microbatch)),
+                               rs_us)
+    return {
+        "wire_us": wire_us,
+        "hidden_us": hidden_us,
+        "hidden_frac": (hidden_us / wire_us) if wire_us > 0 else 0.0,
+    }
 
 
 def _native_ffi_ok() -> bool:
@@ -428,6 +505,123 @@ def fused_two_phase_apply(
         for i, ncols in zip(b["members"], b["cols"]):
             piece = jax.lax.dynamic_slice_in_dim(r, offset, ncols, axis=0)
             out[i] = piece.reshape(leaves[i].shape)
+            offset += ncols
+    return out
+
+
+# --- overlap-scheduled microbatch wire ---------------------------------------
+# The gradient wire of the microbatch training path (optim.make_train_step
+# with HVD_TPU_MICROBATCHES > 1): each microbatch's gradients ride one
+# bucketed reduce-scatter pass (emitted while the NEXT microbatch's
+# backward computes — the fused computation-collective overlap), shards
+# accumulate across microbatches, and ONE deferred all-gather at the
+# optimizer-update boundary rebuilds the full averaged gradient.
+
+@dataclasses.dataclass(frozen=True)
+class OverlapBucketPlan:
+    """Static plan for the microbatch overlap wire, computed once at
+    trace time from leaf shapes so the per-microbatch reduce-scatter and
+    the boundary all-gather agree on layout.  ``order`` is the RS
+    emission order (descending modeled wire cost —
+    :func:`plan_overlap_priority`)."""
+
+    members: Tuple[Tuple[int, ...], ...]    # leaf indices per bucket
+    cols: Tuple[Tuple[int, ...], ...]       # flat elems per member
+    payload: Tuple[int, ...]                # bucket elems before padding
+    pad: Tuple[int, ...]                    # zero elems appended per bucket
+    shard_elems: Tuple[int, ...]            # (payload+pad)/n per bucket
+    dtypes: Tuple[Any, ...]                 # bucket dtype
+    order: Tuple[int, ...]                  # RS emission order
+    n: int                                  # reduction-group width
+
+
+def plan_overlap_buckets(leaves: Sequence[jax.Array], threshold: int, *,
+                         world_size: int,
+                         alpha_us: float = DEFAULT_COST_ALPHA_US,
+                         beta_gbps: float = DEFAULT_COST_BETA_GBPS,
+                         ) -> OverlapBucketPlan:
+    """Bucket a gradient pytree's leaves for the overlap wire: greedy
+    byte-bounded buckets per dtype class (``plan_buckets``), padded to
+    the group width, emitted in descending wire-cost order.  Pure
+    bookkeeping on static shapes — every rank computes the identical
+    plan."""
+    n = max(1, int(world_size))
+    by_dtype: dict = {}
+    for i, leaf in enumerate(leaves):
+        by_dtype.setdefault(jnp.asarray(leaf).dtype, []).append(i)
+    members: List[Tuple[int, ...]] = []
+    cols: List[Tuple[int, ...]] = []
+    payload: List[int] = []
+    pad: List[int] = []
+    dtypes: List[Any] = []
+    bucket_bytes: List[int] = []
+    for dtype, idxs in by_dtype.items():
+        sizes = [int(np.prod(leaves[i].shape)) * dtype.itemsize
+                 for i in idxs]
+        for bucket in plan_buckets(sizes, threshold):
+            mem = tuple(idxs[j] for j in bucket)
+            c = tuple(int(np.prod(leaves[i].shape)) for i in mem)
+            elems = sum(c)
+            members.append(mem)
+            cols.append(c)
+            payload.append(elems)
+            pad.append((-elems) % n)
+            dtypes.append(dtype)
+            bucket_bytes.append(sum(sizes[j] for j in bucket))
+    order = plan_overlap_priority(bucket_bytes, n, alpha_us, beta_gbps)
+    return OverlapBucketPlan(
+        members=tuple(members), cols=tuple(cols), payload=tuple(payload),
+        pad=tuple(pad),
+        shard_elems=tuple((p + q) // n for p, q in zip(payload, pad)),
+        dtypes=tuple(dtypes), order=tuple(order), n=n,
+    )
+
+
+def zero_overlap_shards(plan: OverlapBucketPlan) -> Tuple[jax.Array, ...]:
+    """Zero-initialized per-bucket shard accumulators (the scan carry of
+    the microbatch loop)."""
+    return tuple(jnp.zeros((e,), dt)
+                 for e, dt in zip(plan.shard_elems, plan.dtypes))
+
+
+def overlap_reduce_scatter(leaves: Sequence[jax.Array],
+                           plan: OverlapBucketPlan, *, axis: str, op: str,
+                           groups, compression) -> Tuple[jax.Array, ...]:
+    """One bucketed reduce-scatter pass over ``leaves`` (one
+    microbatch's gradients): each bucket is flattened, padded to the
+    group width and reduce-scattered on the compressor's wire, emitted
+    in ``plan.order`` so the most expensive collectives are issued
+    first.  Returns per-bucket shards in bucket-index order.  Must run
+    inside an SPMD region over ``axis``."""
+    shards: List[jax.Array] = [None] * len(plan.members)  # type: ignore
+    for bi in plan.order:
+        flats = [leaves[i].reshape(-1) for i in plan.members[bi]]
+        fused = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+        if plan.pad[bi]:
+            fused = jnp.concatenate(
+                [fused, jnp.zeros((plan.pad[bi],), fused.dtype)])
+        shards[bi] = compression.spmd_reducescatter(
+            fused, op=op, axis=axis, groups=groups)
+    return tuple(shards)
+
+
+def overlap_all_gather(shards: Sequence[jax.Array],
+                       plan: OverlapBucketPlan,
+                       leaves_like: Sequence[jax.Array], *, axis: str,
+                       groups, compression) -> List[jax.Array]:
+    """The deferred all-gather phase at the optimizer-update boundary:
+    gather each bucket's accumulated shard on the compressor's wire,
+    drop the padding and unpack to the leaf shapes of ``leaves_like``.
+    Must run inside an SPMD region over ``axis``."""
+    out: List[jax.Array] = [None] * len(leaves_like)  # type: ignore
+    for bi, shard in enumerate(shards):
+        full = compression.spmd_allgather(shard, axis=axis, groups=groups)
+        full = full[: plan.payload[bi]]
+        offset = 0
+        for i, ncols in zip(plan.members[bi], plan.cols[bi]):
+            piece = jax.lax.dynamic_slice_in_dim(full, offset, ncols, axis=0)
+            out[i] = piece.reshape(leaves_like[i].shape).astype(
+                leaves_like[i].dtype)
             offset += ncols
     return out
 

@@ -166,6 +166,37 @@ def int8_allreduce(x, *, op: str = "sum", axis: str = "hvd", groups=None,
     return out.reshape(orig_shape).astype(orig_dtype)
 
 
+def quant_dequant(x, block_size: int = 1024):
+    """Blockwise int8 quantize→dequantize roundtrip of a single tensor
+    (flattened; shape and dtype preserved) — the LOCAL lossy-transport
+    operator of the int8 wire's phase 1.  ``x - quant_dequant(x)`` is
+    exactly the information this rank's quantization discards, which is
+    what error-feedback residuals accumulate
+    (``Compressor.local_error``)."""
+    f32 = x.astype(jnp.float32).reshape(-1)
+    b = max(1, min(block_size, f32.size)) if f32.size else 1
+    pad = (-f32.size) % b
+    if pad:
+        f32 = jnp.concatenate([f32, jnp.zeros((pad,), jnp.float32)])
+    q, scale = _quantize_blocks(f32.reshape(-1, b))
+    deq = (q.astype(jnp.float32) * scale[..., None]).reshape(-1)
+    if pad:
+        deq = deq[:-pad]
+    return deq.reshape(x.shape).astype(x.dtype)
+
+
+def wire_block_size(elems_per_contributor: int, n: int,
+                    block_size: int = 1024) -> int:
+    """The effective quantization block the wire path uses: the flat
+    per-rank vector splits into ``n`` destination chunks of
+    ``elems/n`` elements, and blocks never span a chunk boundary —
+    so the block is ``min(block_size, ceil(elems/n))``.  Shared with
+    the stack-tier simulation so both tiers quantize at the same
+    granularity."""
+    k = max(1, -(-int(elems_per_contributor) // max(1, int(n))))
+    return max(1, min(int(block_size), k))
+
+
 def simulate_int8_stack_reduce(x_stacked, block_size: int = 1024):
     """Blockwise quant-dequant of each slot's row — the stack-tier
     (single-program) simulation of int8 transport: injects exactly the

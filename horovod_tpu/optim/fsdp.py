@@ -60,6 +60,7 @@ def make_fsdp_train_step(
     donate: bool = True,
     two_phase: Optional[bool] = None,
     pipeline_depth: Optional[int] = None,
+    error_feedback: Optional[bool] = None,
 ):
     """Build ``(shard, step)`` for FSDP training over the framework mesh.
 
@@ -80,13 +81,14 @@ def make_fsdp_train_step(
     recipe (FSDP traffic stays on the fast wire; only reduced grads
     cross slices).
 
-    ``two_phase``/``pipeline_depth`` exist for API uniformity with the
-    other training entry points (``make_train_step``/``make_zero_
-    train_step``): FSDP's communication is emitted by the GSPMD
-    partitioner and is **inherently phase-decomposed** (per-layer
-    all-gather + gradient reduce-scatter, scheduled by the compiler), so
-    there is nothing to switch — passing ``two_phase=False`` warns that
-    the decomposition cannot be disabled here.
+    ``two_phase``/``pipeline_depth``/``error_feedback`` exist for API
+    uniformity with the other training entry points
+    (``make_train_step``/``make_zero_train_step``): FSDP's communication
+    is emitted by the GSPMD partitioner and is **inherently
+    phase-decomposed** (per-layer all-gather + gradient reduce-scatter,
+    scheduled by the compiler) AND exact (there is no lossy transport to
+    error-correct), so there is nothing to switch — passing
+    ``two_phase=False`` or ``error_feedback=True`` warns accordingly.
     """
     from .distributed_optimizer import resolve_mesh_axis
 
@@ -98,6 +100,15 @@ def make_fsdp_train_step(
             "is emitted by the GSPMD partitioner and is inherently "
             "reduce-scatter + all-gather; the flag only affects the "
             "explicit-collective entry points (make_train_step / "
+            "make_zero_train_step)")
+    if error_feedback:
+        from ..utils.logging import get_logger
+
+        get_logger(__name__).warning(
+            "make_fsdp_train_step(error_feedback=True): the GSPMD-"
+            "emitted FSDP wire is exact — there is no lossy transport "
+            "to error-correct; the residual lives in the explicit-"
+            "collective entry points (DistributedOptimizer / "
             "make_zero_train_step)")
     del pipeline_depth  # partitioner-scheduled; accepted for uniformity
 

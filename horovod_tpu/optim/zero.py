@@ -30,7 +30,7 @@ Usage::
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Any, Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +41,18 @@ from jax.sharding import PartitionSpec as P
 from .._compat import shard_map
 from ..ops import collectives as C
 from ..ops import spmd
+
+
+class ZeroStateWithResidual(NamedTuple):
+    """ZeRO optimizer state plus the error-feedback residual of the
+    lossy gradient reduce-scatter wire (``error_feedback=True``): each
+    slot carries its own accumulated local quantization error and
+    re-injects it into the next step's gradients — the EQuARX recipe.
+    The structure itself tells the step whether EF is on, so no
+    trace-time config read can disagree with what ``init`` built."""
+
+    inner: Any
+    residual: Any
 
 
 def _flat_pad(leaf: jax.Array, n: int) -> jax.Array:
@@ -61,6 +73,7 @@ def make_zero_train_step(
     compression=None,
     has_aux: bool = False,
     donate: bool = True,
+    error_feedback: Optional[bool] = None,
 ):
     """Build ``(init, step)`` for ZeRO-1 training over the framework mesh.
 
@@ -81,16 +94,32 @@ def make_zero_train_step(
     needs a whole-tensor or whole-tree view — ``clip_by_global_norm``,
     LAMB trust ratios — see only 1/n flat shards here and will silently
     diverge from DP; keep such transforms outside the sharded inner
-    optimizer (e.g. clip gradients in ``loss_fn``/before the step)."""
+    optimizer (e.g. clip gradients in ``loss_fn``/before the step).
+
+    ``error_feedback`` (None = ``HVD_TPU_ERROR_FEEDBACK``) carries each
+    slot's lossy-wire quantization error in the returned state
+    (:class:`ZeroStateWithResidual`) and re-injects it into the next
+    step's gradients before the reduce-scatter — no-op on the exact
+    wire."""
     from ..ops.compression import Compression
-    from .distributed_optimizer import resolve_mesh_axis
+    from .distributed_optimizer import (_resolve_compression,
+                                        resolve_mesh_axis)
 
     if op not in (C.Average, C.Sum):
         raise ValueError(f"ZeRO gradient reduction supports Average/Sum, "
                          f"got {op!r}")
-    compression = compression or Compression.none
     mesh_obj, axis = resolve_mesh_axis(mesh, axis_name)
     n = mesh_obj.shape[axis]
+
+    def _ef_on() -> bool:
+        if error_feedback is not None:
+            return bool(error_feedback)
+        from .. import basics
+
+        if basics.is_initialized():
+            cfg = basics.config()
+            return cfg.error_feedback
+        return False
 
     # Compression applies to the GRADIENT reduce-scatter wire only
     # (Compressor.spmd_reducescatter — int8 overrides with quantized
@@ -100,8 +129,12 @@ def make_zero_train_step(
     # resolution (params freeze at grid points — caught in review r3).
     # Gradient noise, by contrast, is averaged and scaled by lr before
     # touching the masters: the standard gradient-compression trade.
+    def _comp():
+        # Trace-time tier (explicit arg wins; else HVD_TPU_COMPRESSION).
+        return _resolve_compression(compression)
+
     def rs_wire(bucket, spmd_op):
-        return compression.spmd_reducescatter(bucket, op=spmd_op, axis=axis)
+        return _comp().spmd_reducescatter(bucket, op=spmd_op, axis=axis)
 
     def ag_wire(shard):
         return lax.all_gather(shard, axis, axis=0, tiled=True)
@@ -115,7 +148,14 @@ def make_zero_train_step(
     def init_body(params):
         shard_params = jax.tree.map(my_shard, params)
         st = optimizer.init(shard_params)
-        return jax.tree.map(lambda x: jnp.asarray(x)[None], st)
+        st = jax.tree.map(lambda x: jnp.asarray(x)[None], st)
+        if _ef_on():
+            residual = jax.tree.map(
+                lambda p: (jnp.zeros_like(p)[None]
+                           if jnp.issubdtype(p.dtype, jnp.floating)
+                           else jnp.zeros((1,), p.dtype)), params)
+            return ZeroStateWithResidual(inner=st, residual=residual)
+        return st
 
     init = jax.jit(shard_map(init_body, mesh=mesh_obj, in_specs=(P(),),
                              out_specs=P(axis), check=False))
@@ -157,6 +197,10 @@ def make_zero_train_step(
                 if basics.is_initialized() else 64 * 1024 * 1024)
 
     def step_body(params, opt_state, batch):
+        residual = None
+        if isinstance(opt_state, ZeroStateWithResidual):
+            residual = jax.tree.map(lambda x: x[0], opt_state.residual)
+            opt_state = opt_state.inner
         opt_state = jax.tree.map(lambda x: x[0], opt_state)
         grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
         if has_aux:
@@ -164,6 +208,31 @@ def make_zero_train_step(
         else:
             loss, grads = grad_fn(params, batch)
             aux = None
+
+        new_residual = residual
+        # EF applies only while the wire is actually lossy; on the
+        # exact wire the residual rides along untouched (still
+        # allocated, so a config-driven tier can turn lossy at a
+        # re-jit boundary without a state-structure change).
+        if residual is not None and _comp() is not Compression.none:
+            # EF: correct with last step's transport error before the
+            # lossy reduce-scatter, then record what this wire loses
+            # (leaf-granular roundtrip — Compressor.local_error; blocks
+            # inside a multi-leaf bucket can span leaf boundaries, so
+            # this approximates the exact bucket-level error while
+            # keeping the EF contraction property).
+            from ..ops.quantization import wire_block_size
+
+            comp = _comp()
+            grads = jax.tree.map(
+                lambda g, r: g + r.astype(g.dtype)
+                if r.shape == g.shape else g, grads, residual)
+            new_residual = jax.tree.map(
+                lambda g, r: (comp.local_error(
+                    g, block_size=wire_block_size(g.size, n)).astype(
+                        r.dtype)
+                    if r.shape == g.shape else r),
+                grads, residual)
 
         # Fused collectives: leaves ride one reduce-scatter + one
         # all-gather per bucket (all gradients are ready simultaneously
@@ -210,6 +279,11 @@ def make_zero_train_step(
         params = treedef.unflatten(new_leaves)
         loss = spmd.allreduce(loss, op="average", axis=axis)
         opt_state = jax.tree.map(lambda x: jnp.asarray(x)[None], opt_state)
+        if new_residual is not None:
+            opt_state = ZeroStateWithResidual(
+                inner=opt_state,
+                residual=jax.tree.map(lambda x: jnp.asarray(x)[None],
+                                      new_residual))
         if has_aux:
             aux = jax.tree.map(lambda a: jnp.asarray(a)[None], aux)
             return params, opt_state, loss, aux

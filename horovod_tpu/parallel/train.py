@@ -69,17 +69,35 @@ def make_spmd_train_step(
     *,
     has_aux: bool = False,
     donate: bool = True,
+    microbatches: Optional[int] = None,
 ):
     """Build ``step(params, opt_state, batch) -> (params, opt_state,
     loss[, aux])`` for pre-sharded inputs (see module docstring).
 
     ``loss_fn(params, batch) -> loss`` (or ``(loss, aux)``), written as
     *global* array math — per-axis partitioning is GSPMD's job.
-    """
+
+    ``microbatches`` (None = ``HVD_TPU_MICROBATCHES``, read at trace
+    time) accumulates gradients over that many microbatches of the
+    global batch inside ONE compiled scan before the single optimizer
+    update — gradient accumulation with a bounded recompile count.  The
+    data-parallel reduction stays GSPMD's job: the partitioner emits one
+    reduce per microbatch inside the scan body, which XLA's async
+    collective scheduler can run under the next microbatch's backward
+    (the explicit-collective twin with per-bucket double buffering lives
+    in ``optim.make_train_step``).  ``aux`` comes back stacked
+    ``[microbatches, ...]``."""
 
     def step(params, opt_state, batch):
+        from ..optim.distributed_optimizer import (_microbatch_grads,
+                                                   _resolve_microbatches)
+
         grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
-        if has_aux:
+        mb = _resolve_microbatches(microbatches, batch)
+        if mb > 1:
+            loss, grads, aux, _ = _microbatch_grads(
+                grad_fn, params, batch, mb, has_aux=has_aux)
+        elif has_aux:
             (loss, aux), grads = grad_fn(params, batch)
         else:
             loss, grads = grad_fn(params, batch)

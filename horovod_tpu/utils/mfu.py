@@ -99,6 +99,21 @@ def peak_tflops_info(device) -> Tuple[float, str]:
     return 0.0, f"unknown_device_kind:{kind or '<none>'}"
 
 
+def estimate_compute_us(flops: Optional[float], device) -> Optional[float]:
+    """Modeled wall time of ``flops`` at the chip's advertised dense-bf16
+    peak — the compute term of the overlap cost model (how much backward
+    time is available to hide a collective under; see
+    ``ops.fusion.estimate_overlap_hidden_fraction``).  None when the
+    peak is unknown or ``flops`` is missing — callers fall back to a
+    measured wall time rather than report a fabricated estimate."""
+    if not flops:
+        return None
+    peak = peak_tflops(device)
+    if not peak:
+        return None
+    return float(flops) / (peak * 1e12) * 1e6
+
+
 def aot_compile_with_flops(jitted, *args) -> Tuple[Any, Optional[float]]:
     """AOT-compile ``jitted(*args)``; returns ``(runnable, flops)`` where
     ``runnable`` is the compiled executable (or ``jitted`` unchanged if

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Diff two benchmark JSON artifacts and fail on regression.
+
+CI guard for the bench trajectory: compare the metrics shared by two
+``BENCH_*.json`` (or ``BUSBW_*.json`` / bench one-liner) artifacts and
+exit non-zero when any shared metric regressed by more than
+``--threshold`` (default 10%).
+
+Accepted file shapes (everything the in-tree benchmarks emit):
+
+* a single JSON object (``bench.py`` / ``gpt_bench.py`` one-liners) —
+  its ``metric``/``value`` pair plus any numeric perf fields become
+  metrics;
+* ``{"summary": {...}, "rows": [...]}`` (``allreduce_bench.py --out``) —
+  the summary is read, rows are ignored (per-size noise isn't a metric);
+* a JSON list or JSONL stream of such objects.
+
+Direction is inferred from the metric name: names containing
+``ms``/``time``/``latency``/``ttft``/``tpot`` are lower-is-better,
+everything else (throughput, busbw, mfu, fractions) higher-is-better.
+
+Exit codes: 0 ok (improvements included), 1 regression(s), 3 no shared
+metrics (a diff that compares nothing must be loud, not green) — pass
+``--allow-disjoint`` to downgrade that to 0 for trajectory bootstraps.
+
+Usage::
+
+    python scripts/bench_regress.py BENCH_r05.json BENCH_r06.json
+    python scripts/bench_regress.py old.json new.json --threshold 0.05
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# Numeric fields that are configuration/provenance, not performance —
+# a changed seq_len is a different experiment, not a regression.
+_NON_METRIC_KEYS = {
+    "vs_baseline", "n_params", "seq_len", "vocab_chunk", "elems", "bytes",
+    "n_slots", "sizes_swept", "max_elems", "microbatches", "pipeline_depth",
+    "bench_buckets", "per_chip_batch", "probe_attempts", "requests",
+    "warmup", "iters", "steps_per_call",
+}
+
+_LOWER_IS_BETTER_TOKENS = ("_ms", "_us", "time", "latency", "ttft", "tpot")
+
+
+def _rows(path: str):
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        # JSONL stream: one object per line.
+        doc = [json.loads(line) for line in text.splitlines()
+               if line.strip()]
+    if isinstance(doc, dict):
+        if "summary" in doc and isinstance(doc["summary"], dict):
+            return [doc["summary"]]
+        return [doc]
+    if isinstance(doc, list):
+        out = []
+        for item in doc:
+            if isinstance(item, dict):
+                out.append(item.get("summary", item)
+                           if isinstance(item.get("summary"), dict)
+                           else item)
+        return out
+    raise ValueError(f"{path}: unrecognized artifact shape")
+
+
+def extract_metrics(path: str) -> dict:
+    """``{metric_name: value}`` for every numeric perf field in the
+    artifact.  A row's headline ``value`` is keyed by its ``metric``;
+    auxiliary numeric fields are keyed ``<metric>.<field>`` (or bare
+    ``<field>`` for rows without a metric name)."""
+    metrics: dict = {}
+    for row in _rows(path):
+        name = row.get("metric")
+        if row.get("error"):
+            continue  # a measured outage is not a datapoint to diff
+        for key, val in row.items():
+            if key in _NON_METRIC_KEYS or isinstance(val, bool):
+                continue
+            if key.endswith("_est"):
+                # Cost-model ESTIMATES (hidden_comm_frac_est, ...) are
+                # derived, sometimes from wall-clock bases — jitter
+                # there is not a perf regression.
+                continue
+            if not isinstance(val, (int, float)):
+                continue
+            if key == "metric":
+                continue
+            if key == "value" and name:
+                metrics[name] = float(val)
+            elif name:
+                metrics[f"{name}.{key}"] = float(val)
+            else:
+                metrics[key] = float(val)
+    return metrics
+
+
+def lower_is_better(name: str) -> bool:
+    low = name.lower()
+    return any(tok in low for tok in _LOWER_IS_BETTER_TOKENS)
+
+
+def compare(old: dict, new: dict, threshold: float):
+    """Returns ``(report_rows, regressions)`` over the shared metrics."""
+    report, regressions = [], []
+    for name in sorted(set(old) & set(new)):
+        o, v = old[name], new[name]
+        if o == 0:
+            # Nothing to regress FROM (outage rounds emit 0.0); only a
+            # direction exists when the old value is meaningful.
+            continue
+        change = (v - o) / abs(o)
+        worse = -change if not lower_is_better(name) else change
+        row = {"metric": name, "old": o, "new": v,
+               "change_pct": round(change * 100.0, 2),
+               "direction": "lower_is_better" if lower_is_better(name)
+               else "higher_is_better",
+               "regressed": worse > threshold}
+        report.append(row)
+        if row["regressed"]:
+            regressions.append(row)
+    return report, regressions
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="fail on >threshold regression between two bench "
+                    "artifacts")
+    parser.add_argument("old", help="baseline artifact (BENCH_*.json)")
+    parser.add_argument("new", help="candidate artifact")
+    parser.add_argument("--threshold", type=float, default=0.10,
+                        help="relative regression tolerance "
+                             "(default 0.10 = 10%%)")
+    parser.add_argument("--allow-disjoint", action="store_true",
+                        help="exit 0 when the artifacts share no "
+                             "metrics (default: exit 3 — a diff that "
+                             "compares nothing must not read as green)")
+    args = parser.parse_args(argv)
+
+    old = extract_metrics(args.old)
+    new = extract_metrics(args.new)
+    report, regressions = compare(old, new, args.threshold)
+    out = {
+        "old": args.old, "new": args.new, "threshold": args.threshold,
+        "compared": len(report), "regressions": len(regressions),
+        "rows": report,
+    }
+    print(json.dumps(out, indent=1))
+    if not report:
+        print(f"bench_regress: no shared metrics between {args.old} and "
+              f"{args.new}", file=sys.stderr)
+        return 0 if args.allow_disjoint else 3
+    if regressions:
+        for r in regressions:
+            print(f"bench_regress: REGRESSION {r['metric']}: "
+                  f"{r['old']} -> {r['new']} ({r['change_pct']:+.2f}%)",
+                  file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

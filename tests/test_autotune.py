@@ -347,6 +347,57 @@ class TestAutotuneEndToEnd:
             hvd.shutdown()
             hvd.init()
 
+    def test_microbatch_overlap_compressor_joint_search(self):
+        """ISSUE 4: with HVD_TPU_MICROBATCHES>1 (+ERROR_FEEDBACK) the GP
+        searches {fusion_threshold, microbatches, overlap, compressor}
+        jointly; every applied point lands at a re-jit boundary without
+        retrace errors, microbatch proposals stay on the power-of-two
+        lattice, and the live config mirrors the last applied point."""
+        import jax.numpy as jnp
+        import optax
+
+        from horovod_tpu.optim.autotune import AutotunedTrainStep
+
+        hvd.shutdown()
+        try:
+            hvd.init(Config(autotune=True, microbatches=2,
+                            error_feedback=True,
+                            autotune_warmup_samples=1,
+                            autotune_steps_per_sample=2,
+                            autotune_max_samples=4))
+            pm = hvd.parameter_manager()
+            assert pm.knob_names == ["compressor", "fusion_threshold",
+                                     "microbatches", "overlap"]
+
+            rng = np.random.RandomState(0)
+            x = jnp.asarray(rng.randn(64, 16).astype(np.float32))
+            y = jnp.asarray(x @ rng.randn(16, 1).astype(np.float32))
+            tx = hvd.DistributedOptimizer(optax.sgd(0.05))
+            step = hvd.make_train_step(
+                lambda p, b: jnp.mean((b[0] @ p["w"] - b[1]) ** 2), tx)
+            assert isinstance(step, AutotunedTrainStep)
+            params = {"w": jnp.zeros((16, 1))}
+            opt_state = tx.init(params)
+            for _ in range(24):
+                params, opt_state, loss = step(params, opt_state, (x, y))
+            assert pm.frozen
+            assert step.applied_knobs
+            for knobs in step.applied_knobs:
+                mb = knobs["microbatches"]
+                assert mb >= 1 and (mb & (mb - 1)) == 0  # pow2 lattice
+                assert knobs["overlap"] in (1, 2)
+                assert 1 <= knobs["compressor"] <= 4
+            last = step.applied_knobs[-1]
+            assert hvd.config().microbatches == last["microbatches"]
+            assert hvd.config().overlap_reduce == (last["overlap"] == 2)
+            lattice = ("none", "fp16", "bf16", "int8")
+            assert hvd.config().compression \
+                == lattice[last["compressor"] - 1]
+            assert jnp.isfinite(loss)
+        finally:
+            hvd.shutdown()
+            hvd.init()
+
     def test_manager_seeded_with_live_threshold(self, tmp_path):
         hvd.shutdown()
         try:

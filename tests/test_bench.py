@@ -9,7 +9,9 @@ import sys
 
 import pytest
 
-pytestmark = pytest.mark.slow  # end-to-end bench harness runs (50-60s each)
+# End-to-end bench harness runs (50-60s each) carry their own
+# @pytest.mark.slow; the bench_regress smoke tests below are pure-Python
+# and tier-1-safe (no module-wide slow mark).
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -41,6 +43,7 @@ def test_bench_fp16_allreduce_flag():
     assert row["value"] > 0
 
 
+@pytest.mark.slow
 def test_bench_outage_exits_zero_with_error_field():
     """Round-4 verdict (weak #2): a backend outage is a *measured*
     outcome, not a crash — bench.py must exit 0 and self-describe the
@@ -63,6 +66,7 @@ def test_bench_outage_exits_zero_with_error_field():
     assert len(row["probe_attempts"]) == 2
 
 
+@pytest.mark.slow
 def test_serving_bench_json_contract():
     """ISSUE 3 satellite: the serving bench must produce its JSON
     report on CPU — tok/s plus TTFT/TPOT percentiles and occupancy."""
@@ -85,6 +89,7 @@ def test_serving_bench_json_contract():
         assert row[key] is not None and row[key] > 0, (key, row)
 
 
+@pytest.mark.slow
 def test_bench_rejects_nonpositive_batch_size():
     out = subprocess.run(
         [sys.executable, os.path.join(ROOT, "bench.py"), "--preset", "tiny",
@@ -96,6 +101,7 @@ def test_bench_rejects_nonpositive_batch_size():
     assert "positive" in out.stderr
 
 
+@pytest.mark.slow
 def test_every_benchmark_entrypoint_is_outage_proof():
     """Round-3 failure class, closed for good: any benchmark that
     initializes the framework must acquire the backend through
@@ -129,3 +135,106 @@ def test_every_benchmark_entrypoint_is_outage_proof():
     assert not offenders, (
         f"benchmarks bypassing guarded_init: {offenders} — route them "
         "through horovod_tpu.utils.backend_probe.guarded_init")
+
+
+@pytest.mark.slow
+def test_gpt_bench_overlap_contract():
+    """ISSUE 4 acceptance: `gpt_bench.py --microbatches N --overlap`
+    emits a JSON row with tokens/s AND the estimated hidden-comm
+    fraction on CPU."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmarks", "gpt_bench.py"),
+         "--preset", "tiny", "--microbatches", "4", "--overlap",
+         "--compressor", "bf16", "--iters", "1", "--steps-per-call", "1",
+         "--warmup", "0"],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "XLA_FLAGS": "", "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    assert row["unit"] == "tokens/sec/chip" and row["value"] > 0
+    assert row["microbatches"] == 4
+    assert row["overlap"] is True
+    assert row["compressor"] == "bf16"
+    assert 0.0 <= row["hidden_comm_frac_est"] <= 1.0
+    assert row["hidden_comm_frac_est"] > 0.0
+    assert row["hidden_comm_basis"] in ("modeled_peak", "measured_wall")
+
+
+# --- scripts/bench_regress.py (tier-1-safe: pure-Python JSON diffing) --------
+
+def _regress(tmp_path, old, new, *flags):
+    a, b = tmp_path / "old.json", tmp_path / "new.json"
+    a.write_text(json.dumps(old))
+    b.write_text(json.dumps(new))
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "bench_regress.py"),
+         str(a), str(b), *flags],
+        capture_output=True, text=True, timeout=60)
+
+
+def test_bench_regress_passes_on_improvement(tmp_path):
+    old = {"metric": "tok_per_s", "value": 100.0, "mfu_pct": 10.0}
+    new = {"metric": "tok_per_s", "value": 120.0, "mfu_pct": 12.0}
+    out = _regress(tmp_path, old, new)
+    assert out.returncode == 0, out.stderr
+    report = json.loads(out.stdout)
+    assert report["regressions"] == 0 and report["compared"] == 2
+
+
+def test_bench_regress_fails_on_regression(tmp_path):
+    old = {"metric": "tok_per_s", "value": 100.0}
+    new = {"metric": "tok_per_s", "value": 85.0}   # -15% > 10% tolerance
+    out = _regress(tmp_path, old, new)
+    assert out.returncode == 1
+    assert "REGRESSION" in out.stderr
+    report = json.loads(out.stdout)
+    assert report["rows"][0]["regressed"] is True
+
+
+def test_bench_regress_threshold_flag(tmp_path):
+    old = {"metric": "tok_per_s", "value": 100.0}
+    new = {"metric": "tok_per_s", "value": 95.0}   # -5%
+    assert _regress(tmp_path, old, new).returncode == 0
+    assert _regress(tmp_path, old, new,
+                    "--threshold", "0.02").returncode == 1
+
+
+def test_bench_regress_lower_is_better_metrics(tmp_path):
+    old = {"metric": "serving", "value": 50.0, "ttft_ms_p99": 100.0}
+    new = {"metric": "serving", "value": 50.0, "ttft_ms_p99": 150.0}
+    out = _regress(tmp_path, old, new)
+    assert out.returncode == 1
+    report = json.loads(out.stdout)
+    bad = [r for r in report["rows"] if r["regressed"]]
+    assert bad[0]["metric"] == "serving.ttft_ms_p99"
+    assert bad[0]["direction"] == "lower_is_better"
+
+
+def test_bench_regress_disjoint_is_loud(tmp_path):
+    old = {"metric": "a", "value": 1.0}
+    new = {"metric": "b", "value": 1.0}
+    assert _regress(tmp_path, old, new).returncode == 3
+    assert _regress(tmp_path, old, new,
+                    "--allow-disjoint").returncode == 0
+
+
+def test_bench_regress_reads_summary_artifacts(tmp_path):
+    """allreduce_bench --out shape: {"summary": ..., "rows": ...} —
+    the summary is the comparable surface."""
+    old = {"summary": {"metric": "allreduce_busbw_peak", "value": 10.0},
+           "rows": [{"elems": 1, "busbw_GBps": 1.0}]}
+    new = {"summary": {"metric": "allreduce_busbw_peak", "value": 4.0},
+           "rows": []}
+    out = _regress(tmp_path, old, new)
+    assert out.returncode == 1
+
+
+def test_bench_regress_skips_outage_rows(tmp_path):
+    """A measured-outage artifact (error field, value 0) must not count
+    as a baseline to regress from OR a regression itself."""
+    outage = {"metric": "tok_per_s", "value": 0.0,
+              "error": "tpu_backend_unavailable"}
+    good = {"metric": "tok_per_s", "value": 100.0}
+    assert _regress(tmp_path, outage, good,
+                    "--allow-disjoint").returncode == 0

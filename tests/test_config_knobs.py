@@ -97,6 +97,66 @@ class TestKnobBehavior:
         out = hvd.grouped_allreduce([x], op=hvd.Sum)[0]
         assert float(np.asarray(out)[0]) == hvd.size()
 
+    def test_microbatch_overlap_knobs_parse(self, monkeypatch):
+        for var in ("MICROBATCHES", "OVERLAP_REDUCE", "ERROR_FEEDBACK",
+                    "COMPRESSION"):
+            monkeypatch.delenv(f"HOROVOD_{var}", raising=False)
+            monkeypatch.delenv(f"HVD_TPU_{var}", raising=False)
+        cfg = Config.from_env()
+        assert cfg.microbatches == 1
+        assert cfg.overlap_reduce is True
+        assert cfg.error_feedback is False
+        assert cfg.compression is None
+        monkeypatch.setenv("HVD_TPU_MICROBATCHES", "4")
+        monkeypatch.setenv("HVD_TPU_OVERLAP_REDUCE", "0")
+        monkeypatch.setenv("HVD_TPU_ERROR_FEEDBACK", "1")
+        monkeypatch.setenv("HVD_TPU_COMPRESSION", "int8")
+        cfg = Config.from_env()
+        assert cfg.microbatches == 4
+        assert cfg.overlap_reduce is False
+        assert cfg.error_feedback is True
+        assert cfg.compression == "int8"
+
+    def test_microbatch_knob_rejects_nonpositive(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_MICROBATCHES", "0")
+        with pytest.raises(ValueError, match="MICROBATCHES"):
+            Config.from_env()
+
+    def test_compression_knob_rejects_unknown_tier(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_COMPRESSION", "int4")
+        with pytest.raises(ValueError, match="COMPRESSION"):
+            Config.from_env()
+
+    def test_compression_env_drives_train_step_wire(
+            self, restore_session_init):
+        """The knob is consumed at trace time: with
+        HVD_TPU_COMPRESSION=bf16 a step built WITHOUT a compression
+        argument rides the bf16 wire (close to, not identical to, the
+        exact wire)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        def loss_fn(params, batch):
+            x, y = batch
+            return jnp.mean((x @ params["w"] - y) ** 2)
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 16).astype(np.float32)
+        y = (x @ rng.randn(16).astype(np.float32))
+        params = {"w": jnp.zeros((16,), jnp.float32)}
+        tx = optax.sgd(0.1)
+
+        _reinit(Config(compression="bf16"))
+        step = hvd.make_train_step(loss_fn, tx, donate=False)
+        p_cfg, _, _ = step(params, tx.init(params), (x, y))
+        _reinit(Config())
+        step = hvd.make_train_step(loss_fn, tx, donate=False)
+        p_exact, _, _ = step(params, tx.init(params), (x, y))
+        np.testing.assert_allclose(np.asarray(p_cfg["w"]),
+                                   np.asarray(p_exact["w"]), atol=2e-2)
+
     def test_elastic_timeout_default_from_config(self,
                                                  restore_session_init):
         from horovod_tpu.elastic.driver import ElasticDriver, FixedDiscovery

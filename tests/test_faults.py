@@ -10,6 +10,7 @@ import os
 import subprocess
 import time
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -255,6 +256,65 @@ class TestCollectiveSite:
         with faults.inject("fusion:step=0"):
             with pytest.raises(HorovodInternalError, match="fusion"):
                 faults.on_fusion("two_phase_apply")
+
+
+class TestAccumulateSite:
+    """ISSUE 4 satellite: the microbatch-loop boundary is a chaos site
+    like every other hot path — trace time, one event per boundary."""
+
+    def test_spec_parses(self):
+        c = parse_fault_spec("accumulate:step=2")["accumulate"]
+        assert c == FaultClause(site="accumulate", step=2)
+        with pytest.raises(ValueError, match="unknown mode"):
+            parse_fault_spec("accumulate:step=1,mode=drop")
+
+    def test_unit_fires_at_boundary_index(self):
+        with faults.inject("accumulate:step=1") as plan:
+            faults.on_accumulate(0)   # boundary 0: no fire
+            with pytest.raises(HorovodInternalError, match="accumulate"):
+                faults.on_accumulate(1)
+            assert plan.history[0][0] == "accumulate"
+
+    def test_microbatch_train_step_raises_at_trace(self):
+        import optax
+
+        import horovod_tpu as hvd
+        from horovod_tpu.optim import make_train_step
+
+        def loss_fn(params, batch):
+            x, y = batch
+            return ((x @ params["w"] - y) ** 2).mean()
+
+        x = np.random.RandomState(0).randn(64, 4).astype(np.float32)
+        y = x.sum(axis=1)
+        params = {"w": jnp.zeros((4,), jnp.float32)}
+        tx = optax.sgd(0.1)
+        step = make_train_step(loss_fn, tx, donate=False, microbatches=4)
+        with faults.inject("accumulate:step=1"):
+            with pytest.raises(HorovodInternalError, match="accumulate"):
+                step(params, tx.init(params), (x, y))
+        # Disarmed: the same step builds and runs clean.
+        p, _, loss = step(params, tx.init(params), (x, y))
+        assert np.isfinite(float(loss))
+
+    def test_spmd_step_threads_the_site(self):
+        import optax
+
+        from horovod_tpu.parallel.train import make_spmd_train_step
+
+        def loss_fn(params, batch):
+            x, y = batch
+            return ((x @ params["w"] - y) ** 2).mean()
+
+        x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        y = x.sum(axis=1)
+        params = {"w": jnp.zeros((4,), jnp.float32)}
+        tx = optax.sgd(0.1)
+        step = make_spmd_train_step(loss_fn, tx, donate=False,
+                                    microbatches=2)
+        with faults.inject("accumulate:step=0"):
+            with pytest.raises(HorovodInternalError, match="accumulate"):
+                step(params, tx.init(params), (x, y))
 
 
 class TestDiscoverySite:

@@ -10,10 +10,11 @@ import pytest
 
 import horovod_tpu as hvd
 from horovod_tpu.ops.fusion import (
-    allreduce_cost_us, estimate_schedule_cost_us, fused_allreduce_pytree,
+    allreduce_cost_us, estimate_overlap_hidden_fraction,
+    estimate_schedule_cost_us, fused_allreduce_pytree,
     fused_two_phase_apply, phase_cost_us, plan_bucket_schedule, plan_buckets,
-    plan_buckets_py, plan_pipeline_order, plan_two_phase_flags,
-    two_phase_crossover_bytes,
+    plan_buckets_py, plan_overlap_buckets, plan_overlap_priority,
+    plan_pipeline_order, plan_two_phase_flags, two_phase_crossover_bytes,
 )
 
 
@@ -108,6 +109,100 @@ class TestPipelineOrder:
             elif kind == "ag":
                 inflight -= 1
             assert inflight <= 3
+
+
+class TestOverlapCostModel:
+    """The overlap extension of the α–β model: bucket emission ordered
+    by modeled wire cost so the most expensive collectives start
+    earliest (most compute left to hide under), plus the hidden-comm
+    estimate the benches report."""
+
+    def test_priority_orders_by_descending_wire_cost(self):
+        # phase cost is monotone in bytes → priority = size order.
+        order = plan_overlap_priority([10, 1 << 26, 1 << 20], 8,
+                                      10.0, 100.0)
+        assert order == [1, 2, 0]
+
+    def test_priority_stable_on_ties(self):
+        assert plan_overlap_priority([64, 64, 64], 8, 1.0, 1.0) \
+            == [0, 1, 2]
+
+    def test_pipeline_order_honors_priority(self):
+        costs = [1.0, 100.0, 10.0]
+        order = plan_pipeline_order([True] * 3, 2, priority=costs)
+        # Highest-cost bucket's RS is emitted first...
+        assert order[0] == ("rs", 1)
+        # ...and every bucket still completes exactly once with rs
+        # preceding its ag.
+        done = [i for kind, i in order if kind in ("ag", "ar")]
+        assert sorted(done) == [0, 1, 2]
+        for i in range(3):
+            assert order.index(("rs", i)) < order.index(("ag", i))
+
+    def test_pipeline_order_priority_respects_depth(self):
+        order = plan_pipeline_order([True] * 6, 2,
+                                    priority=[5, 4, 3, 2, 1, 0])
+        inflight = 0
+        for kind, _ in order:
+            inflight += {"rs": 1, "ag": -1, "ar": 0}[kind]
+            assert inflight <= 2
+
+    def test_pipeline_order_priority_length_mismatch_raises(self):
+        with pytest.raises(ValueError, match="priority"):
+            plan_pipeline_order([True, True], 2, priority=[1.0])
+
+    def test_schedule_with_compute_orders_and_estimates(self):
+        sizes = [1 << 20, 64 << 20, 8 << 20]
+        s = plan_bucket_schedule(sizes, 1 << 20, world_size=8,
+                                 alpha_us=1e-6, beta_gbps=1.0,
+                                 compute_us=1e9)
+        # Emission leads with the most expensive bucket's phase...
+        assert s.order[0][1] == 1
+        # ...and the whole modeled makespan hides under huge compute.
+        assert s.est_hidden_us == pytest.approx(s.est_cost_us)
+        tight = plan_bucket_schedule(sizes, 1 << 20, world_size=8,
+                                     alpha_us=1e-6, beta_gbps=1.0,
+                                     compute_us=1.0)
+        assert tight.est_hidden_us == pytest.approx(1.0)
+        none = plan_bucket_schedule(sizes, 1 << 20, world_size=8)
+        assert none.est_hidden_us == 0.0
+
+    def test_hidden_fraction_closed_form(self):
+        # mb RS passes + 1 AG, each costing rs_us: with unbounded
+        # compute, (mb-1) RS passes hide → frac = (mb-1)/(mb+1).
+        est = estimate_overlap_hidden_fraction(
+            [1 << 26], 1 << 30, world_size=8, microbatches=4,
+            compute_us_per_microbatch=1e12)
+        assert est["hidden_frac"] == pytest.approx(3.0 / 5.0)
+        assert est["wire_us"] > 0
+
+    def test_hidden_fraction_zero_without_compute(self):
+        est = estimate_overlap_hidden_fraction(
+            [1 << 26], 1 << 30, world_size=8, microbatches=4,
+            compute_us_per_microbatch=0.0)
+        assert est["hidden_frac"] == 0.0
+
+    def test_hidden_fraction_world_of_one(self):
+        est = estimate_overlap_hidden_fraction(
+            [1 << 26], 1 << 30, world_size=1, microbatches=4,
+            compute_us_per_microbatch=1e9)
+        assert est["wire_us"] == 0.0 and est["hidden_frac"] == 0.0
+
+    def test_plan_overlap_buckets_layout(self):
+        leaves = [np.zeros((37,), np.float32), np.zeros((100,), np.float32),
+                  np.zeros((3,), np.float32)]
+        plan = plan_overlap_buckets(leaves, 512, world_size=8)
+        assert plan.n == 8
+        # Every leaf lands in exactly one bucket.
+        members = [i for mem in plan.members for i in mem]
+        assert sorted(members) == [0, 1, 2]
+        # Shards cover payload+pad exactly.
+        for bi in range(len(plan.members)):
+            assert (plan.payload[bi] + plan.pad[bi]) % 8 == 0
+            assert plan.shard_elems[bi] * 8 \
+                == plan.payload[bi] + plan.pad[bi]
+        # Emission order is a permutation of the buckets.
+        assert sorted(plan.order) == list(range(len(plan.members)))
 
 
 class TestBucketSchedule:

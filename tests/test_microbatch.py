@@ -1,0 +1,424 @@
+"""Overlap-scheduled microbatch training (ISSUE 4 tentpole).
+
+Three contracts:
+
+* **Numerical equivalence** — the overlapped N-microbatch step produces
+  the same params/opt_state as the sequential single-batch step (the
+  microbatch split + per-microbatch reduce-scatter + deferred all-gather
+  is a pure re-association of the same averages).
+* **Bounded recompiles** — the scan-based accumulation traces the loss
+  a constant number of times regardless of the microbatch count, and
+  repeated steps never retrace.
+* **Error feedback** — with the int8 wire, the EF residual
+  (``DistributedOptimizerState.residual`` / ``ZeroStateWithResidual``)
+  recovers gradient components the quantizer persistently rounds to
+  zero: int8+EF tracks the fp32 trajectory where plain int8 starves.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.optim import DistributedOptimizer, make_train_step
+from horovod_tpu.optim.distributed_optimizer import (
+    DistributedOptimizerState, _resolve_microbatches)
+from horovod_tpu.parallel.train import make_spmd_train_step
+
+
+def _data(n=64, d=5, seed=0):
+    rng = np.random.RandomState(seed)
+    w_true = rng.randn(d).astype(np.float32)
+    x = rng.randn(n, d).astype(np.float32)
+    y = x @ w_true + 0.01 * rng.randn(n).astype(np.float32)
+    return x, y
+
+
+def loss_fn(params, batch):
+    x, y = batch
+    pred = x @ params["w"] + params["b"]
+    return jnp.mean((pred - y) ** 2)
+
+
+def _init_params(d=5):
+    return {"w": jnp.zeros((d,), jnp.float32),
+            "b": jnp.zeros((), jnp.float32)}
+
+
+def _run(step, params, opt_state, batch, steps=3):
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, batch)
+        jax.block_until_ready(loss)
+    return params, opt_state, loss
+
+
+def _assert_trees_close(a, b, **tol):
+    leaves_a, leaves_b = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(leaves_a) == len(leaves_b)
+    for la, lb in zip(leaves_a, leaves_b):
+        np.testing.assert_allclose(np.asarray(la, np.float64),
+                                   np.asarray(lb, np.float64), **tol)
+
+
+class TestMicrobatchEquivalence:
+    """Acceptance criterion: overlapped N-microbatch step ==
+    sequential single-batch step within fp tolerance, params AND
+    opt_state."""
+
+    @pytest.mark.parametrize("overlap", [True, False])
+    def test_matches_sequential_multi_step(self, overlap, world_size):
+        x, y = _data()
+        params = _init_params()
+        tx = optax.adam(0.05)
+
+        seq = make_train_step(loss_fn, tx, donate=False)
+        mbd = make_train_step(loss_fn, tx, donate=False,
+                              microbatches=4, overlap=overlap)
+        p1, s1, l1 = _run(seq, params, tx.init(params), (x, y))
+        p2, s2, l2 = _run(mbd, params, tx.init(params), (x, y))
+        _assert_trees_close(p1, p2, rtol=2e-5, atol=1e-6)
+        _assert_trees_close(s1, s2, rtol=2e-5, atol=1e-6)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+
+    def test_per_slot_microbatch_count_uses_full_split(self, world_size):
+        # per-slot batch = 64/8 = 8 rows; microbatches=8 → 1-row
+        # microbatches, still equivalent.
+        x, y = _data()
+        params = _init_params()
+        tx = optax.sgd(0.1)
+        seq = make_train_step(loss_fn, tx, donate=False)
+        mbd = make_train_step(loss_fn, tx, donate=False, microbatches=8)
+        p1, _, _ = _run(seq, params, tx.init(params), (x, y), steps=1)
+        p2, _, _ = _run(mbd, params, tx.init(params), (x, y), steps=1)
+        _assert_trees_close(p1, p2, rtol=2e-5, atol=1e-6)
+
+    def test_with_distributed_optimizer(self, world_size):
+        """DistributedOptimizer owns the reduce: microbatches accumulate
+        locally, one boundary allreduce — same result as sequential."""
+        x, y = _data()
+        params = _init_params()
+        dopt = DistributedOptimizer(optax.sgd(0.1))
+        seq = make_train_step(loss_fn, dopt, donate=False)
+        mbd = make_train_step(loss_fn, dopt, donate=False, microbatches=4)
+        p1, _, _ = _run(seq, params, dopt.init(params), (x, y), steps=2)
+        p2, _, _ = _run(mbd, params, dopt.init(params), (x, y), steps=2)
+        _assert_trees_close(p1, p2, rtol=2e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("comp", ["bf16", "int8"])
+    def test_compressed_overlap_wire_close_to_exact(self, comp,
+                                                    world_size):
+        """The per-microbatch RS + deferred AG ride the compressor's
+        wire; quantization noise stays bounded."""
+        x, y = _data()
+        params = _init_params()
+        tx = optax.sgd(0.1)
+        exact = make_train_step(loss_fn, tx, donate=False)
+        lossy = make_train_step(loss_fn, tx, donate=False, microbatches=4,
+                                overlap=True,
+                                compression=getattr(hvd.Compression, comp))
+        p1, _, _ = _run(exact, params, tx.init(params), (x, y), steps=1)
+        p2, _, _ = _run(lossy, params, tx.init(params), (x, y), steps=1)
+        _assert_trees_close(p1, p2, rtol=5e-2, atol=5e-2)
+
+    def test_spmd_train_step_microbatches(self, world_size):
+        x, y = _data()
+        params = _init_params()
+        tx = optax.adam(0.05)
+        seq = make_spmd_train_step(loss_fn, tx, donate=False)
+        mbd = make_spmd_train_step(loss_fn, tx, donate=False,
+                                   microbatches=4)
+        p1, s1, _ = _run(seq, params, tx.init(params), (x, y))
+        p2, s2, _ = _run(mbd, params, tx.init(params), (x, y))
+        _assert_trees_close(p1, p2, rtol=2e-5, atol=1e-6)
+        _assert_trees_close(s1, s2, rtol=2e-5, atol=1e-6)
+
+    def test_has_aux_stacked_per_microbatch(self, world_size):
+        x, y = _data()
+
+        def loss_aux(params, batch):
+            l = loss_fn(params, batch)
+            return l, {"l2": jnp.sum(params["w"] ** 2)}
+
+        tx = optax.sgd(0.1)
+        params = _init_params()
+        step = make_train_step(loss_aux, tx, has_aux=True, donate=False,
+                               microbatches=4, overlap=True)
+        _, _, _, aux = step(params, tx.init(params), (x, y))
+        # [size, microbatches] — per-slot aux stacked over microbatches.
+        assert aux["l2"].shape == (world_size, 4)
+
+    def test_explicit_nondivisor_raises(self, world_size):
+        x, y = _data()  # per-slot batch = 8 rows
+        tx = optax.sgd(0.1)
+        step = make_train_step(loss_fn, tx, donate=False, microbatches=3)
+        with pytest.raises(ValueError, match="does not divide"):
+            step(_init_params(), tx.init(_init_params()), (x, y))
+
+    def test_config_driven_count_snaps_to_divisor(self, world_size):
+        from horovod_tpu.config import Config
+
+        x, y = _data()
+        hvd.shutdown()
+        try:
+            hvd.init(Config(microbatches=3))  # per-slot 8 rows → snaps to 2
+            tx = optax.sgd(0.1)
+            params = _init_params()
+            step = make_train_step(loss_fn, tx, donate=False)
+            seq = make_train_step(loss_fn, tx, donate=False,
+                                  microbatches=1)
+            p1, _, l1 = step(params, tx.init(params), (x, y))
+            p2, _, _ = seq(params, tx.init(params), (x, y))
+            assert jnp.isfinite(l1)
+            _assert_trees_close(p1, p2, rtol=2e-5, atol=1e-6)
+        finally:
+            hvd.shutdown()
+            hvd.init()
+
+    def test_resolve_microbatches_contract(self):
+        batch = (np.zeros((12, 3)),)
+        assert _resolve_microbatches(4, batch) == 4
+        assert _resolve_microbatches(1, batch) == 1
+        assert _resolve_microbatches(None, batch) == 1  # session config
+        with pytest.raises(ValueError, match="does not divide"):
+            _resolve_microbatches(5, batch)
+        with pytest.raises(ValueError, match="does not divide"):
+            _resolve_microbatches(24, batch)  # > batch rows
+
+
+class TestBoundedRecompile:
+    """The scan-based step compiles ONE program: the loss traces a
+    constant number of times regardless of microbatch count, and
+    repeated calls never retrace."""
+
+    def _counting_loss(self):
+        traces = []
+
+        def fn(params, batch):
+            traces.append(1)
+            return loss_fn(params, batch)
+
+        return fn, traces
+
+    @pytest.mark.parametrize("mb,overlap", [(4, True), (8, False)])
+    def test_trace_count_constant_in_microbatches(self, mb, overlap,
+                                                  world_size):
+        x, y = _data()
+        tx = optax.sgd(0.1)
+        fn, traces = self._counting_loss()
+        step = make_train_step(fn, tx, donate=False, microbatches=mb,
+                               overlap=overlap)
+        params = _init_params()
+        state = tx.init(params)
+        params, state, loss = step(params, state, (x, y))
+        jax.block_until_ready(loss)
+        first = len(traces)
+        # Peel + scan body (+ jit/shard_map eval passes), NOT ∝ mb.
+        assert first <= 6, f"loss traced {first} times for mb={mb}"
+        for _ in range(3):
+            params, state, loss = step(params, state, (x, y))
+        jax.block_until_ready(loss)
+        assert len(traces) == first, "repeated steps retraced the loss"
+
+    def test_spmd_step_bounded(self, world_size):
+        x, y = _data()
+        tx = optax.sgd(0.1)
+        fn, traces = self._counting_loss()
+        step = make_spmd_train_step(fn, tx, donate=False, microbatches=8)
+        params = _init_params()
+        state = tx.init(params)
+        params, state, loss = step(params, state, (x, y))
+        first = len(traces)
+        assert first <= 6
+        params, state, loss = step(params, state, (x, y))
+        assert len(traces) == first
+
+
+class TestErrorFeedback:
+    """EQuARX-style error feedback: the residual carried in
+    ``DistributedOptimizerState`` accumulates per-step quantization
+    error and re-injects it, making the lossy wire unbiased."""
+
+    def test_state_residual_structure(self, world_size):
+        params = _init_params()
+        on = DistributedOptimizer(optax.sgd(0.1),
+                                  compression=hvd.Compression.int8,
+                                  error_feedback=True)
+        st = on.init(params)
+        assert isinstance(st, DistributedOptimizerState)
+        assert st.residual["w"].shape == params["w"].shape
+        assert float(jnp.abs(st.residual["w"]).sum()) == 0.0
+        off = DistributedOptimizer(optax.sgd(0.1),
+                                   compression=hvd.Compression.int8)
+        st_off = off.init(params)
+        assert st_off.residual["w"].shape == ()  # 0-d placeholder
+
+    def test_residual_updates_with_int8_wire(self, world_size):
+        x, y = _data()
+        params = _init_params()
+        dopt = DistributedOptimizer(optax.sgd(0.1),
+                                    compression=hvd.Compression.int8,
+                                    error_feedback=True)
+        step = make_train_step(loss_fn, dopt, donate=False)
+        _, st, _ = step(params, dopt.init(params), (x, y))
+        # d=5 < one wire chunk per slot → per-element scales are exact,
+        # so use a wide layer to see loss: check residual is FINITE and
+        # the step ran; nonzero-ness is covered by the tracking test.
+        assert all(bool(jnp.all(jnp.isfinite(r)))
+                   for r in jax.tree.leaves(st.residual))
+
+    def test_residual_stays_zero_on_exact_wire(self, world_size):
+        x, y = _data()
+        params = _init_params()
+        dopt = DistributedOptimizer(optax.sgd(0.1), error_feedback=True)
+        step = make_train_step(loss_fn, dopt, donate=False)
+        _, st, _ = step(params, dopt.init(params), (x, y))
+        assert float(jnp.abs(st.residual["w"]).sum()) == 0.0
+
+    def test_int8_error_feedback_tracks_fp32(self, world_size):
+        """The toy-model drift demo: interleaved weights whose gradients
+        sit below the int8 wire's per-block resolution (absmax/254 of
+        their block-mates) are rounded to zero EVERY step — plain int8
+        never learns them; the EF residual accumulates until it crosses
+        the threshold and fires, tracking fp32.  Stochastic minibatches
+        keep the large gradients (and thus the block absmax) alive for
+        the whole run."""
+        rng = np.random.RandomState(0)
+        d = 64
+        mask = (np.arange(d) % 2 == 0)
+        X = rng.randn(512, d).astype(np.float32) * mask
+        w_true = np.where(mask, 1.0, 0.0).astype(np.float32)
+        Y = X @ w_true + 0.5 * rng.randn(512).astype(np.float32)
+        target, alpha = 3.0, 2e-4
+
+        def toy_loss(params, batch):
+            xb, yb = batch
+            w = params["w"]
+            return (jnp.mean((xb @ w - yb) ** 2)
+                    + alpha * jnp.sum((w[1::2] - target) ** 2))
+
+        def run(compression, ef, steps=64):
+            params = {"w": jnp.zeros((d,), jnp.float32)}
+            tx = DistributedOptimizer(optax.adam(0.1),
+                                      compression=compression,
+                                      error_feedback=ef)
+            step = make_train_step(toy_loss, tx, donate=False)
+            st = tx.init(params)
+            curve = []
+            for t in range(steps):
+                i = (t % 8) * 64
+                params, st, loss = step(params, st,
+                                        (X[i:i + 64], Y[i:i + 64]))
+                jax.block_until_ready(loss)
+                curve.append(float(loss))
+            return np.array(curve), params
+
+        c_fp, p_fp = run(None, False)
+        c_i8, p_i8 = run(hvd.Compression.int8, False)
+        c_ef, p_ef = run(hvd.Compression.int8, True)
+
+        def w_small(p):
+            return float(np.mean(np.asarray(p["w"])[1::2]))
+
+        # fp32 learns the small-gradient weights; plain int8 starves
+        # them; EF recovers most of the way.
+        assert w_small(p_fp) > 2.5
+        assert w_small(p_i8) < 1.0, (
+            "plain int8 learned the sub-resolution weights — the drift "
+            "this test exists to demonstrate is gone")
+        assert w_small(p_ef) > 2.0 * w_small(p_i8)
+        # And the EF loss curve hugs fp32 tighter than plain int8's.
+        dev_i8 = np.abs(c_i8 - c_fp)[8:].mean()
+        dev_ef = np.abs(c_ef - c_fp)[8:].mean()
+        assert dev_ef < dev_i8
+
+    def test_backward_passes_per_step_with_ef(self, world_size):
+        """EF composes with local aggregation: the residual only moves
+        on boundary steps (the only steps that touch the wire)."""
+        x, y = _data()
+        params = _init_params()
+        dopt = DistributedOptimizer(optax.sgd(0.1),
+                                    compression=hvd.Compression.int8,
+                                    error_feedback=True,
+                                    backward_passes_per_step=2)
+        step = make_train_step(loss_fn, dopt, donate=False)
+        st = dopt.init(params)
+        p1, st, _ = step(params, st, (x, y))      # interior: no wire
+        interior_res = jax.tree.map(np.asarray, st.residual)
+        p2, st, _ = step(p1, st, (x, y))          # boundary
+        for key in params:  # interior step: no parameter movement
+            np.testing.assert_array_equal(np.asarray(p1[key]),
+                                          np.asarray(params[key]))
+        _assert_trees_close(interior_res,
+                            jax.tree.map(jnp.zeros_like, interior_res))
+        assert jnp.isfinite(jax.tree.leaves(p2)[0]).all()
+
+
+class TestZeroErrorFeedback:
+    def test_zero_ef_state_and_training(self, world_size):
+        from horovod_tpu.optim.zero import (ZeroStateWithResidual,
+                                            make_zero_train_step)
+
+        x, y = _data()
+        params = _init_params()
+        init, step = make_zero_train_step(
+            loss_fn, optax.sgd(0.1), compression=hvd.Compression.int8,
+            error_feedback=True, donate=False)
+        st = init(params)
+        assert isinstance(st, ZeroStateWithResidual)
+        # Residual: one row per slot, parameter-shaped.
+        assert st.residual["w"].shape == (world_size, 5)
+        first = None
+        for _ in range(10):
+            params, st, loss = step(params, st, (x, y))
+            jax.block_until_ready(loss)
+            first = float(loss) if first is None else first
+        assert isinstance(st, ZeroStateWithResidual)
+        assert float(loss) < first
+
+    def test_zero_ef_close_to_exact(self, world_size):
+        from horovod_tpu.optim.zero import make_zero_train_step
+
+        x, y = _data()
+        params = _init_params()
+        init_e, step_e = make_zero_train_step(loss_fn, optax.sgd(0.1),
+                                              donate=False)
+        init_q, step_q = make_zero_train_step(
+            loss_fn, optax.sgd(0.1), compression=hvd.Compression.int8,
+            error_feedback=True, donate=False)
+        p1, _, _ = step_e(params, init_e(params), (x, y))
+        p2, _, _ = step_q(params, init_q(params), (x, y))
+        _assert_trees_close(p1, p2, rtol=5e-2, atol=5e-2)
+
+    def test_zero_without_ef_keeps_plain_state(self, world_size):
+        from horovod_tpu.optim.zero import (ZeroStateWithResidual,
+                                            make_zero_train_step)
+
+        init, _ = make_zero_train_step(loss_fn, optax.sgd(0.1),
+                                       donate=False)
+        st = init(_init_params())
+        assert not isinstance(st, ZeroStateWithResidual)
+
+
+class TestFsdpUniformityKnob:
+    def test_fsdp_error_feedback_warns_and_runs(self, world_size,
+                                                caplog):
+        import logging
+
+        from horovod_tpu.optim.fsdp import make_fsdp_train_step
+
+        root = logging.getLogger("horovod_tpu")
+        root.propagate = True
+        try:
+            with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+                shard, step = make_fsdp_train_step(
+                    loss_fn, optax.sgd(0.1), error_feedback=True,
+                    donate=False)
+        finally:
+            root.propagate = False
+        assert any("error_feedback" in r.message for r in caplog.records)
+        x, y = _data(n=8)
+        params, opt_state = shard(_init_params())
+        p, _, loss = step(params, opt_state, (x, y))
+        assert jnp.isfinite(loss)

@@ -240,3 +240,94 @@ class TestInt8ContractGuards:
         with pytest.raises(ValueError, match="Adasum"):
             hvd.allreduce(jnp.ones((world_size, 4)), op=hvd.Adasum,
                           compression=Compression.fp16)
+
+
+class TestStackTierBlockSize:
+    """ISSUE 4 satellite: the stack-tier simulation must quantize at the
+    WIRE's block granularity — blocks never span a per-destination chunk
+    of ``elems/n`` — and preserve the input dtype."""
+
+    def test_wire_block_size_derivation(self):
+        from horovod_tpu.ops.quantization import wire_block_size
+
+        assert wire_block_size(64, 8) == 8          # chunk < ceiling
+        assert wire_block_size(1 << 20, 8) == 1024  # ceiling caps
+        assert wire_block_size(5, 8) == 1           # sub-element chunks
+        assert wire_block_size(80, 8) == 10
+        assert wire_block_size(1, 1) == 1
+
+    def test_compress_matches_wire_granularity(self):
+        from horovod_tpu.ops.compression import Compression
+        from horovod_tpu.ops.quantization import simulate_int8_stack_reduce
+
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(8, 80).astype(np.float32))
+        got, ctx = Compression.int8.compress(x)
+        # 80 elems over 8 contributors → chunks (and blocks) of 10, NOT
+        # the old hardcoded 1024 (which would share one scale per row).
+        want = simulate_int8_stack_reduce(x, block_size=10)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        old = simulate_int8_stack_reduce(x, block_size=1024)
+        assert not np.array_equal(np.asarray(got), np.asarray(old)), (
+            "mixed-magnitude rows must quantize differently at chunk "
+            "granularity than at one scale per row")
+
+    @pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float32,
+                                       jnp.float16])
+    def test_compress_preserves_dtype(self, dtype):
+        from horovod_tpu.ops.compression import Compression
+
+        x = jnp.asarray(np.random.RandomState(1).randn(8, 48), dtype)
+        wire, ctx = Compression.int8.compress(x)
+        assert wire.dtype == dtype
+        out = Compression.int8.decompress(wire, ctx)
+        assert out.dtype == dtype
+
+    def test_quant_dequant_roundtrip(self):
+        from horovod_tpu.ops.quantization import quant_dequant
+
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.randn(1000).astype(np.float32))
+        out = quant_dequant(x, block_size=64)
+        assert out.shape == x.shape and out.dtype == x.dtype
+        # Per-block relative error bounded by absmax/254 per element.
+        err = np.abs(np.asarray(out) - np.asarray(x))
+        blocks = np.asarray(x)[:960].reshape(-1, 64)
+        bound = np.abs(blocks).max(axis=1) / 254.0 + 1e-7
+        assert (err[:960].reshape(-1, 64) <= bound[:, None] + 1e-6).all()
+
+    def test_local_error_zero_for_exact_tiers(self):
+        from horovod_tpu.ops.compression import Compression
+
+        x = jnp.asarray(np.random.RandomState(3).randn(64), jnp.float32)
+        assert float(jnp.abs(Compression.none.local_error(x)).max()) == 0.0
+        # int8 local error equals the quant-dequant residue at the
+        # requested block size.
+        from horovod_tpu.ops.quantization import quant_dequant
+
+        e = Compression.int8.local_error(x, block_size=8)
+        np.testing.assert_allclose(
+            np.asarray(e), np.asarray(x - quant_dequant(x, block_size=8)),
+            rtol=1e-6, atol=1e-7)
+
+    def test_compress_stack_uses_group_width(self):
+        """Process-set stacks carry the full world's rows with
+        non-members masked; the simulation's block must follow the
+        REDUCTION-GROUP width, not the stack height."""
+        from horovod_tpu.ops.compression import Compression
+        from horovod_tpu.ops.quantization import simulate_int8_stack_reduce
+
+        rng = np.random.RandomState(4)
+        x = jnp.asarray(rng.randn(8, 80).astype(np.float32))
+        # Group of 2 members → wire chunks of ceil(80/2)=40, not 80/8=10.
+        got, _ = Compression.int8.compress_stack(x, 2)
+        want = simulate_int8_stack_reduce(x, block_size=40)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        # Full-world group (n == rows) matches plain compress.
+        got_full, _ = Compression.int8.compress_stack(x, 8)
+        plain, _ = Compression.int8.compress(x)
+        np.testing.assert_array_equal(np.asarray(got_full),
+                                      np.asarray(plain))
+        # Exact tiers pass through regardless of n.
+        got_none, _ = Compression.none.compress_stack(x, 2)
+        np.testing.assert_array_equal(np.asarray(got_none), np.asarray(x))
