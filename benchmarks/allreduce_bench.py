@@ -419,10 +419,16 @@ def main() -> None:
         })
     print(json.dumps(summary))
     if args.out:
+        # Diagnostic telemetry block (bench_regress skips "metrics"):
+        # per-tier wire bytes + dispatch counts behind the busbw rows.
+        from horovod_tpu.obs import export as obs_export
+
         with open(args.out, "w") as f:
             json.dump({"platform": jax.default_backend(),
                        "device_kind": jax.devices()[0].device_kind,
-                       "summary": summary, "rows": results}, f, indent=1)
+                       "summary": summary, "rows": results,
+                       "metrics": obs_export.json_snapshot()["metrics"]},
+                      f, indent=1)
 
 
 if __name__ == "__main__":

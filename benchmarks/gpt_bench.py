@@ -208,6 +208,10 @@ def main() -> None:
         out["hidden_comm_frac_est"] = round(est["hidden_frac"], 4)
         out["hidden_comm_wire_us_est"] = round(est["wire_us"], 2)
         out["hidden_comm_basis"] = basis
+        from horovod_tpu.obs import instrument as obs_instr_est
+
+        obs_instr_est.set_hidden_comm_estimate(est["wire_us"],
+                                               est["hidden_us"])
     if chunk_flops:
         per_chip_flops_s = chunk_flops * args.iters / dt
         out["model_tflops_per_chip"] = round(per_chip_flops_s / 1e12, 2)
@@ -217,6 +221,14 @@ def main() -> None:
         # Unconditional: the provenance of mfu_pct — or of its absence
         # (unknown device kind) — must be explicit in the artifact.
         out["peak_tflops_source"] = peak_source
+    # Final telemetry snapshot (diagnostic block — bench_regress skips
+    # it): wire bytes per tier, step-time distribution, microbatch plan.
+    from horovod_tpu.obs import export as obs_export
+    from horovod_tpu.obs import instrument as obs_instr
+
+    if "mfu_pct" in out:
+        obs_instr.set_mfu(out["mfu_pct"])
+    out["metrics"] = obs_export.json_snapshot()["metrics"]
     print(json.dumps(out))
     sys.stdout.flush()
 

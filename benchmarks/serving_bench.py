@@ -176,10 +176,14 @@ def main() -> None:
     }
     print(json.dumps(summary))
     if args.out:
+        # Diagnostic telemetry block (bench_regress skips "metrics").
+        from horovod_tpu.obs import export as obs_export
+
         with open(args.out, "w") as f:
             json.dump({"platform": jax.default_backend(),
                        "device_kind": jax.devices()[0].device_kind,
-                       "summary": summary, "stats": snap, "rows": rows},
+                       "summary": summary, "stats": snap, "rows": rows,
+                       "metrics": obs_export.json_snapshot()["metrics"]},
                       f, indent=1)
 
 

@@ -52,6 +52,7 @@ from . import elastic  # noqa: F401
 from . import data  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import faults  # noqa: F401
+from . import obs  # noqa: F401
 from .version import __version__  # noqa: F401
 from .runner.run_func import launch as run  # noqa: F401  (hvd.run parity)
 

@@ -72,6 +72,7 @@ class _GlobalState:
         self.stall_inspector = None
         self.cross_monitor = None   # horovod_tpu.utils.cross_stall (multi-process)
         self.parameter_manager = None
+        self.metrics_port = None    # bound HVD_TPU_METRICS_PORT (obs/export)
         self.lock = threading.Lock()
 
 
@@ -180,6 +181,21 @@ def init(config: Optional[Config] = None) -> None:
             warn_after_s=cfg.stall_check_time_seconds,
             shutdown_after_s=cfg.stall_shutdown_time_seconds,
         )
+        # Telemetry gate + optional local scrape port.  The registry is
+        # NOT reset here: like the fault plan above, counters span the
+        # process across elastic re-inits so rates stay meaningful.
+        from .obs import metrics as _obs_metrics
+
+        _obs_metrics.configure(enabled=cfg.metrics,
+                               window=cfg.metrics_window)
+        if cfg.metrics and cfg.metrics_port > 0:
+            from .obs import export as _obs_export
+
+            # One exporter per controller process; peers offset the
+            # configured port by their process index so a multi-process
+            # host exposes every rank.
+            _state.metrics_port = _obs_export.start_http_exporter(
+                cfg.metrics_port + jax.process_index())
         _state.parameter_manager = _maybe_build_parameter_manager(cfg)
         _state.initialized = True
         _state.cross_monitor = _maybe_start_cross_monitor(cfg)
@@ -551,6 +567,11 @@ def shutdown() -> None:
         if _state.cross_monitor is not None:
             _state.cross_monitor.stop()
             _state.cross_monitor = None
+        if _state.metrics_port is not None:
+            from .obs import export as _obs_export
+
+            _obs_export.stop_http_exporter()
+            _state.metrics_port = None
         _state.initialized = False
         # Compiled-collective caches hold the old mesh; drop them so a
         # re-init (elastic restart, tests) rebuilds against the new mesh.

@@ -241,6 +241,20 @@ def _env_choice(name: str, default: Optional[str],
     return val
 
 
+def _env_straggler_factor() -> float:
+    """``HVD_TPU_STRAGGLER_FACTOR`` must exceed 1: at <= 1x the world
+    median, half the fleet (or all of it) is "straggling" by
+    definition — a misconfiguration that must fail at init, not page an
+    operator forever."""
+    v = _env_float("STRAGGLER_FACTOR", 2.0)
+    if v <= 1.0:
+        raise ValueError(
+            f"Env var 'STRAGGLER_FACTOR' must be > 1.0 (a rank is a "
+            f"straggler when its step time exceeds factor x the world "
+            f"median), got {v}")
+    return v
+
+
 def _env_float(name: str, default: float) -> float:
     val = _env(name)
     if val is None:
@@ -290,6 +304,12 @@ class Config:
     timeline: Optional[str] = None            # HOROVOD_TIMELINE (trace file path)
     timeline_mark_cycles: bool = False        # HOROVOD_TIMELINE_MARK_CYCLES
     log_level: str = "warning"                # HOROVOD_LOG_LEVEL
+    # Unified telemetry (horovod_tpu/obs/; the fleet-telemetry layer of
+    # the "Collective Communication for 100k+ GPUs" line).
+    metrics: bool = True                      # HVD_TPU_METRICS (registry + instrumentation gate)
+    metrics_port: int = 0                     # HVD_TPU_METRICS_PORT (0 = no local HTTP scrape port)
+    metrics_window: int = 1024                # HVD_TPU_METRICS_WINDOW (histogram ring size)
+    straggler_factor: float = 2.0             # HVD_TPU_STRAGGLER_FACTOR (x world-median step time)
 
     # --- stall detection (reference: stall_inspector.cc) ---
     stall_check_disable: bool = False         # HOROVOD_STALL_CHECK_DISABLE
@@ -364,6 +384,10 @@ class Config:
             hierarchical_inner_size=_env_int("HIERARCHICAL_INNER", 0),
             timeline=timeline or None,
             timeline_mark_cycles=_env_bool("TIMELINE_MARK_CYCLES", False),
+            metrics=_env_bool("METRICS", True),
+            metrics_port=_env_int("METRICS_PORT", 0),
+            metrics_window=_env_pos_int("METRICS_WINDOW", 1024),
+            straggler_factor=_env_straggler_factor(),
             log_level=(_env("LOG_LEVEL", "warning") or "warning").lower(),
             stall_check_disable=_env_bool("STALL_CHECK_DISABLE", False),
             stall_check_time_seconds=_env_float("STALL_CHECK_TIME_SECONDS", 60.0),

@@ -24,6 +24,7 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence, Set
 
 from .. import faults as faults_mod
+from ..obs import instrument as _obs
 from ..utils.logging import get_logger
 from ..utils.retry import RetryPolicy, retry_call
 from .state import HostsUpdatedInterrupt
@@ -176,6 +177,7 @@ class ElasticDriver:
                                    f"{self.blacklist_decay_s:.0f}s"
                                    if self.blacklist_decay_s > 0
                                    else "never")
+                    _obs.on_blacklist("blacklisted")
                 self._blacklist[host] = time.monotonic()
 
     def record_success(self, host: str) -> None:
@@ -185,6 +187,8 @@ class ElasticDriver:
             had = self._failures.pop(host, 0)
             lifted = self._blacklist.pop(host, None) is not None
         if lifted or had:
+            if lifted:
+                _obs.on_blacklist("cleared")
             logger.info("Host %s recovered (strikes reset%s)", host,
                         ", blacklist lifted" if lifted else "")
 
@@ -199,6 +203,7 @@ class ElasticDriver:
             # a single new failure re-blacklists without a full cycle.
             del self._blacklist[host]
             self._failures[host] = max(0, self.blacklist_after - 1)
+            _obs.on_blacklist("probation")
             logger.info("Blacklist decayed for host %s (probation)", host)
             return False
         return True
@@ -229,6 +234,7 @@ class ElasticDriver:
                 return False
             logger.error("Host discovery failed %d times consecutively"
                          " (%s); treating membership as lost", n, e)
+            _obs.on_membership_loss(len(self.hosts))
             found = {}
         with self._lock:
             found = {h: s for h, s in found.items()

@@ -306,6 +306,9 @@ def run(func: Callable) -> Callable:
                     last_failure_t = now
                     consecutive_failures += 1
                     delay = _reset_backoff_s(consecutive_failures)
+                    from ..obs import instrument as _obs
+
+                    _obs.on_elastic_reset("rollback")
                     logger.warning(
                         "Collective failure (%s); rolling back to last "
                         "commit and re-initializing (reset %d%s, backoff "
@@ -319,6 +322,9 @@ def run(func: Callable) -> Callable:
                     state.sync()
                 else:  # HostsUpdatedInterrupt: graceful, no rollback/backoff
                     consecutive_failures = 0
+                    from ..obs import instrument as _obs
+
+                    _obs.on_elastic_reset("resize")
                     logger.info("Membership changed; re-initializing "
                                 "without rollback")
                     _reinitialize()

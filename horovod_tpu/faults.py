@@ -108,6 +108,9 @@ class FaultPlan:
         with self._lock:
             self.history.append((site, at, mode + (f":{detail}" if detail
                                                    else "")))
+        from .obs import instrument as _obs
+
+        _obs.on_fault(site)
         logger.warning("fault injected: site=%s mode=%s at=%d %s",
                        site, mode, at, detail)
 

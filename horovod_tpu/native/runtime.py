@@ -435,6 +435,15 @@ class NativeTimeline:
             if self._h:
                 self._lib.hvd_tl_mark_cycle(self._h, ts_us)
 
+    def counter(self, name: str, ts_us: float,
+                series_json: str = "") -> None:
+        """Counter ("C") event; ``series_json`` is an object body
+        without braces (see TimelineWriter::Counter)."""
+        with self._hlock:
+            if self._h and series_json:
+                self._lib.hvd_tl_counter(self._h, name.encode(), ts_us,
+                                         series_json.encode())
+
     def events_written(self) -> int:
         with self._hlock:
             if not self._h:

@@ -326,6 +326,13 @@ void hvd_tl_mark_cycle(void* h, double ts_us) {
   if (h) static_cast<TimelineWriter*>(h)->MarkCycle(ts_us);
 }
 
+void hvd_tl_counter(void* h, const char* name, double ts_us,
+                    const char* series_json) {
+  if (h && name && series_json) {
+    static_cast<TimelineWriter*>(h)->Counter(name, ts_us, series_json);
+  }
+}
+
 int64_t hvd_tl_events_written(void* h) {
   return h ? static_cast<TimelineWriter*>(h)->events_written() : -1;
 }

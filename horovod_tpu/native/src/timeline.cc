@@ -76,6 +76,22 @@ void TimelineWriter::MarkCycle(double ts_us) {
   Enqueue(std::string(buf));
 }
 
+void TimelineWriter::Counter(const std::string& name, double ts_us,
+                             const std::string& series_json) {
+  if (series_json.empty()) return;
+  // The free-form track name stays in the unbounded std::string part
+  // (same rule as Record's tensor name): a fixed buffer would truncate
+  // long names mid-string and corrupt the JSON array.
+  char head[160];
+  std::snprintf(head, sizeof(head),
+                "\", \"cat\": \"counter\", \"ph\": \"C\", "
+                "\"ts\": %.3f, \"pid\": %d, \"tid\": 0, ",
+                ts_us, static_cast<int>(::getpid()));
+  std::string line = "{\"name\": \"" + JsonEscape(name) + head;
+  line += "\"args\": {" + series_json + "}}";
+  Enqueue(std::move(line));
+}
+
 void TimelineWriter::WriterLoop() {
   for (;;) {
     std::deque<std::string> batch;

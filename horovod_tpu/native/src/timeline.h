@@ -37,6 +37,12 @@ class TimelineWriter {
   // Instant ("i") event — the reference's cycle markers.
   void MarkCycle(double ts_us);
 
+  // Counter ("C") event: one counter track per `name`; `series_json`
+  // is a JSON object body without braces, e.g. "\"tokens_per_s\": 12.5"
+  // (the args object IS the series map in the trace-event format).
+  void Counter(const std::string& name, double ts_us,
+               const std::string& series_json);
+
   void Close();  // drains queue, finalizes JSON array, joins thread
 
   int64_t events_written() const { return events_written_; }

@@ -1,0 +1,27 @@
+"""Unified training telemetry (``horovod_tpu.obs``).
+
+One process-wide registry every layer records into, one export surface
+every operator scrapes from:
+
+* :mod:`~horovod_tpu.obs.metrics` — thread-safe Counter/Gauge/Histogram
+  registry (bounded rings, bounded label cardinality); home of the
+  ``percentile``/``Ring`` primitives ``serve/metrics.py`` consumes.
+* :mod:`~horovod_tpu.obs.instrument` — the hooks wired into the train
+  step, fusion planner, collectives dispatch, autotuner, retry/fault/
+  elastic layers and the stall inspector.
+* :mod:`~horovod_tpu.obs.aggregate` — cross-rank min/max/mean/p99 over
+  the host-ops tier plus straggler detection
+  (``HVD_TPU_STRAGGLER_FACTOR``).
+* :mod:`~horovod_tpu.obs.export` — Prometheus text exposition + JSON
+  snapshot, served as a ``MetricsRequest`` on every
+  ``BasicService`` (HMAC control plane) and on the optional local
+  scrape port ``HVD_TPU_METRICS_PORT``.
+
+Knobs: ``HVD_TPU_METRICS`` (default on), ``HVD_TPU_METRICS_PORT``,
+``HVD_TPU_METRICS_WINDOW``, ``HVD_TPU_STRAGGLER_FACTOR`` — see
+``docs/metrics.md`` for the metric catalog and scrape recipes.
+"""
+
+from . import aggregate, export, instrument, metrics  # noqa: F401
+
+__all__ = ["aggregate", "export", "instrument", "metrics"]

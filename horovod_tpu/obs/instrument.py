@@ -1,0 +1,354 @@
+"""Instrumentation hooks: where each layer's signals enter the registry.
+
+The layers already compute these numbers — the train step times its own
+dispatches, the fusion planner knows its bucket bytes, the autotuner
+scores windows, the elastic driver counts strikes.  This module is the
+thin adapter between those call sites and :mod:`horovod_tpu.obs.metrics`
+so (a) metric names/labels are defined in exactly one place (the
+catalog, ``docs/metrics.md``) and (b) every call site keeps the
+``faults``-style hot-path contract: one ``enabled()`` check, then a few
+dict/float ops, no device work, no exceptions that could take down the
+path being observed.
+
+Label cardinality discipline (the registry caps per-family series, but
+hooks should never get near the cap): ``tier``/``site``/``kind``/
+``transition`` labels come from closed sets; the collective ``op`` label
+is the dispatch-table name (7 values); the retry ``what`` label is the
+first token of the call-site description, not the full string.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Dict, Optional
+
+from . import metrics as _m
+
+__all__ = [
+    "enabled", "record_microbatch_plan",
+    "wrap_step", "on_fusion_plan", "on_collective_dispatch", "on_retry",
+    "on_fault", "on_elastic_reset", "on_blacklist", "on_membership_loss",
+    "on_stall", "on_autotune_window", "on_autotune_apply", "autotune_log",
+    "set_mfu", "set_hidden_comm_estimate",
+]
+
+
+# The hot-path gate, re-exported so call sites import one module.
+enabled = _m.enabled
+
+
+def _reg() -> _m.MetricsRegistry:
+    return _m.registry()
+
+
+# --- train step --------------------------------------------------------------
+
+def _batch_rows_tokens(batch) -> "tuple[int, int]":
+    """(rows, tokens) from the batch pytree's first leaf: rows = leading
+    dim; tokens = rows x seq when the leaf is at least 2-D (the LM
+    convention), else rows."""
+    import jax
+
+    leaves = jax.tree.leaves(batch)
+    if not leaves:
+        return 0, 0
+    shape = getattr(leaves[0], "shape", ())
+    rows = int(shape[0]) if len(shape) >= 1 else 1
+    tokens = rows * int(shape[1]) if len(shape) >= 2 else rows
+    return rows, tokens
+
+
+def wrap_step(step_fn, *, kind: str = "train"):
+    """Wrap a jitted train step with per-call accounting: a step-time
+    histogram, step/sample/token counters, and a tokens/s gauge —
+    mirrored onto the timeline as Chrome-trace counter ("C") events so
+    scraped gauges and Perfetto tracks line up.
+
+    The recorded time is dispatch-to-dispatch wall time on the host.
+    Under async dispatch that is not device latency for any single
+    step, but at steady state (donated buffers force the runtime to
+    hold at most one step in flight) it converges to true step time —
+    the same basis the autotuner scores windows on.
+
+    Tracer calls (the step consumed inside an enclosing jit/scan, e.g.
+    a benchmark's step chunk) bypass recording entirely: wall-clock at
+    trace time is meaningless and would poison the histogram.  Returns
+    ``step_fn`` unchanged when metrics are off."""
+    if not _m.enabled():
+        return step_fn
+    from .._compat import is_tracer
+
+    reg = _reg()
+    hist = reg.histogram(
+        "hvd_tpu_step_time_seconds",
+        "train-step dispatch-to-dispatch wall time").labels(kind=kind)
+    steps = reg.counter("hvd_tpu_steps_total",
+                        "train steps dispatched").labels(kind=kind)
+    samples = reg.counter("hvd_tpu_samples_total",
+                          "global batch rows consumed")
+    tokens = reg.counter("hvd_tpu_tokens_total",
+                         "tokens consumed (rows x seq for >=2-D batches)")
+    rate = reg.gauge("hvd_tpu_tokens_per_s",
+                     "instantaneous tokens/s of the last step")
+
+    def instrumented_step(params, opt_state, batch, *rest):
+        import jax
+
+        # Inside an enclosing jit every argument is a tracer together,
+        # so probing the batch's first leaf suffices — flattening the
+        # full params+opt_state pytree here would be a permanent
+        # per-step cost on large models.
+        leaves = jax.tree.leaves(batch)
+        if leaves and is_tracer(leaves[0]):
+            return step_fn(params, opt_state, batch, *rest)
+        t0 = time.perf_counter()
+        out = step_fn(params, opt_state, batch, *rest)
+        dt = time.perf_counter() - t0
+        rows, toks = _batch_rows_tokens(batch)
+        hist.observe(dt)
+        steps.inc()
+        samples.inc(rows)
+        tokens.inc(toks)
+        if dt > 0:
+            rate.set(toks / dt)
+        _timeline_counter("train" if kind == "train" else kind, {
+            "step_time_ms": dt * 1e3,
+            "tokens_per_s": (toks / dt) if dt > 0 else 0.0,
+        })
+        return out
+
+    instrumented_step._hvd_tpu_instrumented = True  # introspection/tests
+    instrumented_step.__wrapped__ = step_fn
+    return instrumented_step
+
+
+def _timeline_counter(name: str, values: Dict[str, float]) -> None:
+    """Mirror gauges onto the live timeline's counter track (no-op when
+    no timeline is configured)."""
+    from .. import basics
+
+    if not basics.is_initialized():
+        return
+    tl = basics._state.timeline
+    if tl is not None and tl.enabled:
+        tl.counter(name, values)
+
+
+def set_hidden_comm_estimate(wire_us: float, hidden_us: float) -> None:
+    """Record a hidden-communication estimate computed outside a full
+    schedule plan (``fusion.estimate_overlap_hidden_fraction`` — the
+    microbatch overlap wire's model, where per-microbatch compute time
+    is known: the benches' FLOPs-based path)."""
+    if not _m.enabled():
+        return
+    reg = _reg()
+    reg.gauge("hvd_tpu_est_wire_cost_us",
+              "cost-model makespan of the latest schedule").set(wire_us)
+    reg.gauge("hvd_tpu_est_hidden_us",
+              "cost-model wire time hidden under compute").set(hidden_us)
+    if wire_us > 0:
+        reg.gauge("hvd_tpu_hidden_comm_frac",
+                  "hidden / total modeled wire time").set(
+                      hidden_us / wire_us)
+
+
+def set_mfu(pct: float) -> None:
+    """Record model-FLOPs utilization, computed where the FLOPs are
+    known (``utils.mfu`` via the benchmarks' AOT-compiled cost)."""
+    if not _m.enabled():
+        return
+    _reg().gauge("hvd_tpu_mfu_pct",
+                 "model FLOPs utilization, percent of chip peak").set(pct)
+
+
+def record_microbatch_plan(mb: int, *, overlap: bool) -> None:
+    """Trace-time record of the accumulation schedule the step compiled
+    with (``_resolve_microbatches`` / ``_microbatch_grads``)."""
+    if not _m.enabled():
+        return
+    reg = _reg()
+    reg.gauge("hvd_tpu_microbatches",
+              "gradient-accumulation microbatches per step").set(mb)
+    reg.gauge("hvd_tpu_overlap_reduce",
+              "1 when the microbatch wire is overlap-scheduled").set(
+                  1.0 if overlap else 0.0)
+
+
+# --- ops: fusion planner + collectives dispatch ------------------------------
+
+def on_fusion_plan(tier: str, *, bytes_on_wire: int, buckets: int,
+                   compression_ratio: Optional[float] = None,
+                   est_cost_us: Optional[float] = None,
+                   est_hidden_us: Optional[float] = None) -> None:
+    """Trace-time plan record from the fusion layer.  ``tier`` is the
+    wire that was planned (``spmd`` single-phase, ``two_phase``,
+    ``overlap``); counters accumulate planned bytes per *trace* (the
+    compiled program then replays the plan every step), gauges hold the
+    latest per-step plan."""
+    if not _m.enabled():
+        return
+    reg = _reg()
+    reg.counter("hvd_tpu_wire_bytes_total",
+                "bytes put on the wire, by tier (host tier: per "
+                "dispatch; SPMD tiers: per trace — the compiled plan "
+                "replays each step)").labels(tier=tier).inc(bytes_on_wire)
+    reg.counter("hvd_tpu_fusion_traces_total",
+                "fusion plans built, by tier").labels(tier=tier).inc()
+    reg.gauge("hvd_tpu_wire_bytes_per_step",
+              "planned wire bytes per step, by tier").labels(
+                  tier=tier).set(bytes_on_wire)
+    reg.gauge("hvd_tpu_fusion_buckets",
+              "buckets in the latest fusion plan, by tier").labels(
+                  tier=tier).set(buckets)
+    if compression_ratio is not None:
+        reg.gauge("hvd_tpu_compression_ratio",
+                  "wire bytes / exact bytes of the latest plan").set(
+                      compression_ratio)
+    if est_cost_us is not None:
+        reg.gauge("hvd_tpu_est_wire_cost_us",
+                  "cost-model makespan of the latest schedule").set(
+                      est_cost_us)
+    if est_hidden_us is not None:
+        reg.gauge("hvd_tpu_est_hidden_us",
+                  "cost-model wire time hidden under compute").set(
+                      est_hidden_us)
+        if est_cost_us:
+            reg.gauge("hvd_tpu_hidden_comm_frac",
+                      "hidden / total modeled wire time").set(
+                          est_hidden_us / est_cost_us)
+
+
+def on_collective_dispatch(op: str, nbytes: int) -> None:
+    """Host-tier dispatch accounting (``ops/collectives.py`` slot-tier
+    entry points): one event per actual dispatch, with the lifted
+    tensor's payload bytes."""
+    if not _m.enabled():
+        return
+    reg = _reg()
+    reg.counter("hvd_tpu_collective_dispatch_total",
+                "slot-tier collective dispatches, by op").labels(
+                    op=op).inc()
+    if nbytes > 0:
+        reg.counter("hvd_tpu_wire_bytes_total", "").labels(
+            tier="slots").inc(nbytes)
+
+
+# --- recovery layers ---------------------------------------------------------
+
+def on_retry(what: str) -> None:
+    """One retry attempt (``utils.retry.retry_call``).  ``what`` is the
+    first token of the call-site description — a closed set (``rpc``,
+    ``discovery``, ``restore``...), not the full free-form string."""
+    if not _m.enabled():
+        return
+    _reg().counter("hvd_tpu_retries_total",
+                   "retry attempts, by call-site family").labels(
+                       what=(what.split() or ["call"])[0]).inc()
+
+
+def on_fault(site: str) -> None:
+    """One injected-fault firing (``faults.FaultPlan.fire``)."""
+    if not _m.enabled():
+        return
+    _reg().counter("hvd_tpu_faults_fired_total",
+                   "injected fault firings, by site").labels(
+                       site=site).inc()
+
+
+def on_elastic_reset(kind: str) -> None:
+    """One elastic reset (``rollback`` on HorovodInternalError,
+    ``resize`` on HostsUpdatedInterrupt)."""
+    if not _m.enabled():
+        return
+    _reg().counter("hvd_tpu_elastic_resets_total",
+                   "elastic resets, by cause").labels(kind=kind).inc()
+
+
+def on_blacklist(transition: str) -> None:
+    """Host blacklist lifecycle (``elastic.driver``): ``blacklisted``,
+    ``probation`` (decay half-open), ``cleared`` (success after
+    probation)."""
+    if not _m.enabled():
+        return
+    _reg().counter("hvd_tpu_host_blacklist_total",
+                   "host blacklist transitions").labels(
+                       transition=transition).inc()
+
+
+def on_membership_loss(hosts: int) -> None:
+    """Discovery declared membership lost (K consecutive failures);
+    ``hosts`` is the fleet size that was dropped."""
+    if not _m.enabled():
+        return
+    reg = _reg()
+    reg.counter("hvd_tpu_discovery_membership_loss_total",
+                "discovery membership-loss events").inc()
+    reg.gauge("hvd_tpu_discovery_lost_hosts",
+              "host count at the last membership loss").set(hosts)
+
+
+def on_stall(kind: str) -> None:
+    """Stall-inspector escalation: ``warn`` or ``shutdown``."""
+    if not _m.enabled():
+        return
+    _reg().counter("hvd_tpu_stall_events_total",
+                   "stall-inspector escalations").labels(kind=kind).inc()
+
+
+# --- autotune decision log ---------------------------------------------------
+
+# Bounded decision log: the JSON snapshot carries it verbatim (the
+# Prometheus surface gets only the counters/gauges — a log is not a
+# time series).
+_autotune_log: "collections.deque" = collections.deque(maxlen=64)
+
+
+def on_autotune_window(samples_per_s: float,
+                       suggestion: Optional[Dict[str, Any]]) -> None:
+    """One scored autotune window and the manager's response."""
+    if not _m.enabled():
+        return
+    reg = _reg()
+    reg.counter("hvd_tpu_autotune_windows_total",
+                "scored autotune windows").inc()
+    reg.gauge("hvd_tpu_autotune_samples_per_s",
+              "last scored window's samples/s").set(samples_per_s)
+    if suggestion is not None:
+        reg.counter("hvd_tpu_autotune_proposals_total",
+                    "autotune knob proposals").inc()
+    _autotune_log.append({
+        "event": "window",
+        "samples_per_s": round(float(samples_per_s), 3),
+        "proposal": dict(suggestion) if suggestion is not None else None,
+    })
+
+
+def on_autotune_apply(applied: Dict[str, Any], frozen: bool) -> None:
+    """A proposal was installed (re-jit boundary); ``frozen`` marks the
+    terminal freeze at the best point."""
+    if not _m.enabled():
+        return
+    reg = _reg()
+    reg.counter("hvd_tpu_autotune_applied_total",
+                "autotune proposals applied (re-jits)").inc()
+    reg.gauge("hvd_tpu_autotune_frozen",
+              "1 once the tuner froze at its best point").set(
+                  1.0 if frozen else 0.0)
+    for knob, value in applied.items():
+        try:
+            v = float(value)
+        except (TypeError, ValueError):
+            continue
+        reg.gauge("hvd_tpu_autotune_knob",
+                  "last applied autotune knob value").labels(
+                      knob=knob).set(v)
+    _autotune_log.append({
+        "event": "freeze" if frozen else "apply",
+        "applied": dict(applied),
+    })
+
+
+def autotune_log() -> list:
+    """Copy of the bounded decision log (JSON snapshot payload)."""
+    return list(_autotune_log)

@@ -51,6 +51,7 @@ from .compression import Compression
 from . import adasum as adasum_mod
 from . import fusion as fusion_mod
 from .. import faults as faults_mod
+from ..obs import instrument as _obs
 from .._compat import shard_map
 
 # --- reduction-op constants (reference: hvd.Sum / hvd.Average / ...) --------
@@ -99,7 +100,7 @@ def _members_key(process_set) -> Optional[Tuple[int, ...]]:
     return process_set.ranks
 
 
-def _heartbeat(name: str) -> None:
+def _heartbeat(name: str, kind: str = "", payload=()) -> None:
     # Fault site "collective": one counter tick per dispatch; raises
     # HorovodInternalError when the armed plan fires.  The guard keeps
     # the unset-plan hot path at a single attribute read.
@@ -110,6 +111,15 @@ def _heartbeat(name: str) -> None:
         st.stall_inspector.record_activity(name)
     if st.cross_monitor is not None:
         st.cross_monitor.record_dispatch(name)
+    # Telemetry: one dispatch event with the payload bytes actually put
+    # on the slot-tier wire.  ``kind`` is the static entry-point name —
+    # NOT the caller's free-form tensor ``name``, which would be
+    # unbounded label cardinality.  Host values without an ``nbytes``
+    # (lists, scalars) count 0 bytes rather than pay an early
+    # np.asarray just to be measured.
+    if kind and _obs.enabled():
+        nbytes = sum(int(getattr(t, "nbytes", 0)) for t in payload)
+        _obs.on_collective_dispatch(kind, nbytes)
 
 
 def _lift(x, name: str = "tensor") -> jax.Array:
@@ -369,7 +379,7 @@ def allreduce_slots(tensor, *, op: str = Average, process_set=None,
         raise ValueError(f"Unknown op {op!r}; expected one of {_REDUCE_OPS}")
     _check_compression_op(op, compression)
     st = _st()
-    _heartbeat(name)
+    _heartbeat(name, "allreduce", (tensor,))
     with x64_transport(tensor):
         with st.timeline.activity(name, "ENQUEUE", {"op": op}):
             x = _lift(tensor, name)
@@ -451,7 +461,7 @@ def grouped_allreduce_slots(tensors: Sequence[Any], *, op: str = Average,
         raise ValueError(f"Unknown op {op!r}; expected one of {_REDUCE_OPS}")
     _check_compression_op(op, compression)
     st = _st()
-    _heartbeat(name)
+    _heartbeat(name, "grouped_allreduce", tensors)
     with x64_transport(*tensors):
         xs = tuple(_lift(t, f"{name}[{i}]") for i, t in enumerate(tensors))
         if op == Adasum:
@@ -498,7 +508,7 @@ def allgather_slots(tensor, *, process_set=None, name: str = "allgather"):
     this tier are an object-level concern; the process-level public API
     (:func:`allgather`) handles raggedness via a two-round protocol."""
     st = _st()
-    _heartbeat(name)
+    _heartbeat(name, "allgather", (tensor,))
     with x64_transport(tensor):
         x = _lift(tensor, name)
         if x.ndim < 2:
@@ -529,7 +539,7 @@ def broadcast_slots(tensor, root_rank: int = 0, *, process_set=None,
     process sets).  At this tier the process-set and global variants
     coincide: the single returned array is what members observe."""
     st = _st()
-    _heartbeat(name)
+    _heartbeat(name, "broadcast", (tensor,))
     with x64_transport(tensor):
         x = _lift(tensor, name)
         if process_set is not None and root_rank not in process_set.ranks:
@@ -572,7 +582,7 @@ def alltoall_slots(tensor, *, process_set=None, name: str = "alltoall"):
     shapes don't exist under XLA (deliberate design difference from the
     reference's ``MPI_Alltoallv``)."""
     st = _st()
-    _heartbeat(name)
+    _heartbeat(name, "alltoall", (tensor,))
     with x64_transport(tensor):
         x = _lift(tensor, name)
         members = _members_key(process_set)
@@ -621,7 +631,7 @@ def reducescatter_slots(tensor, *, op: str = Sum, process_set=None,
     if op not in (Sum, Average):
         raise ValueError(f"reducescatter supports Sum/Average, got {op!r}")
     st = _st()
-    _heartbeat(name)
+    _heartbeat(name, "reducescatter", (tensor,))
     with x64_transport(tensor):
         x = _lift(tensor, name)
         members = _members_key(process_set)
@@ -706,7 +716,7 @@ def grouped_reducescatter_slots(tensors: Sequence[Any], *, op: str = Sum,
     if op not in (Sum, Average):
         raise ValueError(f"reducescatter supports Sum/Average, got {op!r}")
     st = _st()
-    _heartbeat(name)
+    _heartbeat(name, "grouped_reducescatter", tensors)
     with x64_transport(*tensors):
         xs = tuple(_lift(t, f"{name}[{i}]") for i, t in enumerate(tensors))
         members = _members_key(process_set)

@@ -138,6 +138,10 @@ class Int8Compressor(Compressor):
     physical wire to shrink in-process).
     """
 
+    # Declared wire width for byte accounting (ops/fusion.wire_ratio):
+    # one byte per element on the wire; the per-block scales add <1%.
+    wire_itemsize = 1
+
     @staticmethod
     def compress(tensor):
         if jnp.issubdtype(tensor.dtype, jnp.floating):

@@ -105,6 +105,12 @@ class AutotunedTrainStep:
             jax.block_until_ready(out)
             dt = time.perf_counter() - self._t0
             suggestion = self._record_synchronized(self._window_samples, dt)
+            from ..obs import instrument as _obs
+
+            # Decision log: every scored window and what the manager
+            # proposed (docs/metrics.md §autotune).
+            _obs.on_autotune_window(
+                self._window_samples / dt if dt > 0 else 0.0, suggestion)
             self._window_steps = 0
             self._window_samples = 0.0
             if suggestion is not None:
@@ -148,6 +154,9 @@ class AutotunedTrainStep:
         # existing consumers; the joint search is in applied_knobs.
         self.applied.append(applied.get("fusion_threshold"))
         self.applied_knobs.append(applied)
+        from ..obs import instrument as _obs
+
+        _obs.on_autotune_apply(applied, self._pm.frozen)
         logger.info(
             "autotune %s %s (%d applied so far)",
             "froze at" if self._pm.frozen else "trying", applied,

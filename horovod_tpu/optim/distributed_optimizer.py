@@ -35,6 +35,7 @@ from typing import Any, Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax import lax
 from jax.sharding import PartitionSpec as P
@@ -47,6 +48,7 @@ from ..ops import spmd
 from ..ops.adasum import adasum_pytree
 from ..ops.compression import Compression
 from ..ops.fusion import fused_allreduce_pytree
+from ..obs import instrument as _obs
 from ..utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -197,12 +199,26 @@ def _microbatch_grads(grad_fn, params, batch, mb, *, has_aux=False,
         n = fusion._uniform_group_width(axis, groups)
         use_overlap = n is not None and n > 1
 
+    if _obs.enabled():
+        _obs.record_microbatch_plan(mb, overlap=bool(use_overlap))
+
     if use_overlap:
         leaves0, treedef = jax.tree.flatten(g0)
         plan = fusion.plan_overlap_buckets(
             leaves0, threshold, world_size=n, alpha_us=alpha_us,
             beta_gbps=beta_gbps)
         comp = compression or Compression.none
+        if _obs.enabled() and plan.members:
+            # Trace-time plan record for the overlap wire: mb RS passes
+            # plus ONE deferred AG ride this plan per step.
+            exact = sum(p * np.dtype(d).itemsize
+                        for p, d in zip(plan.payload, plan.dtypes))
+            ratio = fusion.wire_ratio(
+                comp, max(np.dtype(plan.dtypes[0]).itemsize, 1))
+            _obs.on_fusion_plan(
+                "overlap",
+                bytes_on_wire=int(exact * ratio * (mb + 1)),
+                buckets=len(plan.members), compression_ratio=ratio)
 
         def rs(leaves):
             return fusion.overlap_reduce_scatter(
@@ -630,8 +646,11 @@ def make_train_step(
     def build():
         # A fresh jit wrapper re-traces, so trace-time reads of
         # config().fusion_threshold (here and inside a wrapped
-        # DistributedOptimizer) pick up autotune proposals.
-        return jax.jit(body, donate_argnums=donate_argnums)
+        # DistributedOptimizer) pick up autotune proposals.  The obs
+        # wrapper records step wall time / tokens per dispatch (no-op
+        # when HVD_TPU_METRICS=0 — it returns the jitted step itself).
+        return _obs.wrap_step(
+            jax.jit(body, donate_argnums=donate_argnums), kind="train")
 
     pm = (basics._state.parameter_manager
           if basics.is_initialized() else None)

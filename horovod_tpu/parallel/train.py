@@ -108,4 +108,7 @@ def make_spmd_train_step(
         return params, opt_state, loss
 
     donate_argnums = (0, 1) if donate else ()
-    return jax.jit(step, donate_argnums=donate_argnums)
+    from ..obs import instrument as _obs
+
+    return _obs.wrap_step(jax.jit(step, donate_argnums=donate_argnums),
+                          kind="spmd")

@@ -43,6 +43,24 @@ class AckResponse:
     pass
 
 
+class MetricsRequest:
+    """Scrape this process's telemetry registry (``horovod_tpu.obs``)
+    over the HMAC control plane — answered by EVERY :class:`BasicService`
+    (task agents, the serving endpoint, test services), so a metrics
+    scrape needs no second port or credential.  ``fmt`` selects the
+    rendered payload: ``"json"`` (snapshot only) or ``"prometheus"``
+    (snapshot + text exposition)."""
+
+    def __init__(self, fmt: str = "json"):
+        self.fmt = fmt
+
+
+class MetricsResponse:
+    def __init__(self, snapshot: dict, prometheus: Optional[str] = None):
+        self.snapshot = snapshot
+        self.prometheus = prometheus
+
+
 class DropConnection(Exception):
     """Raised from a ``BasicService._handle`` override to close the
     connection without writing a response — the wire signature of a
@@ -182,6 +200,14 @@ class BasicService:
     def _handle(self, req: Any, client_address) -> Any:
         if isinstance(req, PingRequest):
             return PingResponse(self.name, client_address[0])
+        if isinstance(req, MetricsRequest):
+            from ...obs import export as _obs_export
+
+            return MetricsResponse(
+                snapshot=_obs_export.json_snapshot(),
+                prometheus=(_obs_export.render_prometheus()
+                            if getattr(req, "fmt", "json") == "prometheus"
+                            else None))
         return AckResponse()
 
     def shutdown(self) -> None:

@@ -10,24 +10,19 @@ identically.
 
 Bounded memory: samples live in fixed-size rings — a serving process
 that handles millions of requests must not grow its stats linearly.
+The ring and percentile primitives live in :mod:`horovod_tpu.obs.
+metrics` (the unified telemetry layer); this module is a thin consumer
+that keeps the serving-specific snapshot shape (``percentile`` stays
+importable from here for existing callers).
 """
 
 from __future__ import annotations
 
-import collections
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict
 
-
-def percentile(samples: List[float], q: float) -> Optional[float]:
-    """Nearest-rank percentile (q in [0, 100]); None on no samples —
-    callers omit the field rather than report a fabricated 0."""
-    if not samples:
-        return None
-    xs = sorted(samples)
-    idx = min(len(xs) - 1, max(0, int(round(q / 100.0 * (len(xs) - 1)))))
-    return xs[idx]
+from ..obs.metrics import Ring, percentile  # noqa: F401 (re-export)
 
 
 class ServingStats:
@@ -41,10 +36,10 @@ class ServingStats:
 
     def __init__(self, window: int = 4096) -> None:
         self._lock = threading.Lock()
-        self._ttft_s = collections.deque(maxlen=window)
-        self._tpot_s = collections.deque(maxlen=window)
-        self._occupancy = collections.deque(maxlen=window)
-        self._queue_depth = collections.deque(maxlen=window)
+        self._ttft_s = Ring(window)
+        self._tpot_s = Ring(window)
+        self._occupancy = Ring(window)
+        self._queue_depth = Ring(window)
         self.completed = 0
         self.rejected = 0
         self.expired = 0
@@ -83,10 +78,10 @@ class ServingStats:
         """One JSON-ready dict — the serving bench summary fields and
         the ``StatsRequest`` wire payload share this shape."""
         with self._lock:
-            ttft = list(self._ttft_s)
-            tpot = list(self._tpot_s)
-            occ = list(self._occupancy)
-            queued = list(self._queue_depth)
+            ttft = self._ttft_s.values()
+            tpot = self._tpot_s.values()
+            occ = self._occupancy.values()
+            queued = self._queue_depth.values()
             elapsed = max(1e-9, time.monotonic() - self._t0)
             out = {
                 "requests_completed": self.completed,

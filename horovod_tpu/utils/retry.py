@@ -106,6 +106,9 @@ def retry_call(
                 raise
             if on_retry is not None:
                 on_retry(attempt, e)
+            from ..obs import instrument as _obs
+
+            _obs.on_retry(describe or getattr(fn, "__name__", "call"))
             logger.debug("%s failed (attempt %d/%s): %s; retrying in %.2fs",
                          describe or getattr(fn, "__name__", "call"),
                          attempt,

@@ -101,6 +101,9 @@ class StallInspector:
                     "host-side work; wrap that in stall_inspector().pause().",
                     idle, self._warn_after_s,
                 )
+                from ..obs import instrument as _obs
+
+                _obs.on_stall("warn")
                 with self._lock:
                     self._warned = True
             if self._shutdown_after_s > 0 and idle > self._shutdown_after_s:
@@ -108,6 +111,9 @@ class StallInspector:
                     "Stall exceeded shutdown threshold (%.0f s); aborting.",
                     self._shutdown_after_s,
                 )
+                from ..obs import instrument as _obs
+
+                _obs.on_stall("shutdown")
                 self._on_shutdown()
 
     def stop(self) -> None:

@@ -96,6 +96,30 @@ class Timeline:
             "args": {"tensor": name, **(args or {})},
         })
 
+    def counter(self, name: str, values: Optional[dict] = None,
+                ts_us: Optional[float] = None) -> None:
+        """Chrome-trace counter (``"C"``) event: one counter *track* per
+        ``name``, one series per key of ``values`` — how scraped gauges
+        (obs/export) and traces line up on the same Perfetto time axis
+        (the step wrapper mirrors step_time_ms / tokens_per_s here each
+        step).  Non-numeric values are dropped: the trace viewer's
+        counter tracks plot numbers only."""
+        series = {k: float(v) for k, v in (values or {}).items()
+                  if isinstance(v, (int, float))}
+        if not series:
+            return
+        ts = self._now_us() if ts_us is None else ts_us
+        native = self._native
+        if native is not None:
+            body = ", ".join(f"{json.dumps(str(k))}: {json.dumps(v)}"
+                             for k, v in series.items())
+            native.counter(name, ts, body)
+            return
+        self._emit({
+            "name": name, "cat": "counter", "ph": "C", "ts": ts,
+            "pid": os.getpid(), "tid": 0, "args": series,
+        })
+
     def mark_cycle(self) -> None:
         """Instant marker per dispatch cycle (reference:
         ``HOROVOD_TIMELINE_MARK_CYCLES``)."""
@@ -120,7 +144,13 @@ class Timeline:
         try:
             yield
         finally:
-            self.record(name, phase, start, self._now_us() - start, args)
+            # Re-check after the yield: a timeline closed mid-activity
+            # (elastic reset tearing down hvd state while a step is in
+            # flight) must drop the event, not hand it to a writer whose
+            # file/native handle is already gone.
+            if self.enabled:
+                self.record(name, phase, start, self._now_us() - start,
+                            args)
 
     def close(self) -> None:
         with self._lock:

@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Pretty-print a horovod_tpu telemetry snapshot.
+
+Three sources, one rendering (docs/metrics.md):
+
+* a benchmark artifact with an embedded ``metrics`` block::
+
+      python scripts/metrics_dump.py BENCH_r06.json
+
+* a live job's control plane — a ``MetricsRequest`` over the runner's
+  HMAC wire (any ``BasicService``: a task agent, the serving endpoint)::
+
+      python scripts/metrics_dump.py --connect HOST:PORT \\
+          --secret-file /path/to/secret
+
+* a live job's local HTTP scrape port (``HVD_TPU_METRICS_PORT``)::
+
+      python scripts/metrics_dump.py --url http://HOST:9100
+
+``--json`` dumps the raw snapshot instead of the table (pipe to jq);
+``--prometheus`` (wire/HTTP sources) prints the text exposition.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def render(families: dict) -> str:
+    """Human-readable table of ``{family: [series...]}``."""
+    lines = []
+    for name in sorted(families):
+        for series in families[name]:
+            labels = series.get("labels", {})
+            label_s = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+            if "count" in series:   # histogram summary
+                body = (f"count={series['count']} "
+                        f"mean={_fmt(series.get('mean'))} "
+                        f"p50={_fmt(series.get('p50'))} "
+                        f"p99={_fmt(series.get('p99'))}")
+            else:
+                body = _fmt(series.get("value"))
+            lines.append(f"{name}{'{' + label_s + '}' if label_s else ''}"
+                         f"  {body}")
+    return "\n".join(lines)
+
+
+def from_artifact(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    block = doc.get("metrics")
+    if block is None:
+        raise SystemExit(
+            f"{path}: no embedded 'metrics' block (pre-telemetry artifact, "
+            "or the bench ran with HVD_TPU_METRICS=0)")
+    # Both shapes are accepted: the compact {family: [series]} map the
+    # benches embed, and a full json_snapshot dict.
+    if "metrics" in block and isinstance(block["metrics"], dict):
+        return block
+    return {"metrics": block}
+
+
+def from_wire(target: str, secret_file: str, prometheus: bool) -> dict:
+    from horovod_tpu.runner.common.network import BasicClient, MetricsRequest
+
+    host, _, port = target.rpartition(":")
+    with open(secret_file, "rb") as f:
+        key = f.read().strip()
+    client = BasicClient("metrics", [(host or "127.0.0.1", int(port))], key)
+    resp = client.request(
+        MetricsRequest(fmt="prometheus" if prometheus else "json"))
+    out = dict(resp.snapshot)
+    if resp.prometheus is not None:
+        out["prometheus"] = resp.prometheus
+    return out
+
+
+def from_url(url: str, prometheus: bool) -> dict:
+    import urllib.request
+
+    path = "/metrics" if prometheus else "/metrics.json"
+    with urllib.request.urlopen(url.rstrip("/") + path, timeout=10) as r:
+        body = r.read().decode()
+    if prometheus:
+        return {"prometheus": body, "metrics": {}}
+    return json.loads(body)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="pretty-print a horovod_tpu metrics snapshot")
+    parser.add_argument("artifact", nargs="?",
+                        help="bench JSON artifact with a 'metrics' block")
+    parser.add_argument("--connect", metavar="HOST:PORT",
+                        help="scrape a live BasicService over the HMAC "
+                             "wire (MetricsRequest)")
+    parser.add_argument("--secret-file",
+                        help="launcher-minted secret for --connect")
+    parser.add_argument("--url", help="scrape a live HTTP exporter "
+                                      "(HVD_TPU_METRICS_PORT)")
+    parser.add_argument("--json", action="store_true",
+                        help="raw JSON instead of the table")
+    parser.add_argument("--prometheus", action="store_true",
+                        help="print the Prometheus text exposition "
+                             "(--connect/--url sources)")
+    args = parser.parse_args(argv)
+
+    sources = [bool(args.artifact), bool(args.connect), bool(args.url)]
+    if sum(sources) != 1:
+        parser.error("pick exactly one source: an artifact path, "
+                     "--connect, or --url")
+    if args.connect and not args.secret_file:
+        parser.error("--connect needs --secret-file (the HMAC key)")
+
+    if args.artifact:
+        snap = from_artifact(args.artifact)
+    elif args.connect:
+        snap = from_wire(args.connect, args.secret_file, args.prometheus)
+    else:
+        snap = from_url(args.url, args.prometheus)
+
+    if args.prometheus and snap.get("prometheus") is not None:
+        print(snap["prometheus"], end="")
+        return 0
+    if args.json:
+        print(json.dumps(snap, indent=1, sort_keys=True))
+        return 0
+    meta = {k: v for k, v in snap.items()
+            if k not in ("metrics", "autotune_log", "prometheus")}
+    if meta:
+        print("# " + json.dumps(meta, sort_keys=True))
+    print(render(snap.get("metrics", {})))
+    if snap.get("autotune_log"):
+        print("# autotune decision log (most recent last):")
+        for entry in snap["autotune_log"]:
+            print("#   " + json.dumps(entry, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -238,3 +238,62 @@ def test_bench_regress_skips_outage_rows(tmp_path):
     good = {"metric": "tok_per_s", "value": 100.0}
     assert _regress(tmp_path, outage, good,
                     "--allow-disjoint").returncode == 0
+
+
+def test_bench_regress_skips_metrics_block(tmp_path):
+    """The embedded telemetry snapshot is diagnostic, not a regression
+    signal: two artifacts differing only in their metrics block
+    compare clean."""
+    metrics_a = {"hvd_tpu_steps_total": [{"labels": {}, "value": 10.0}]}
+    metrics_b = {"hvd_tpu_steps_total": [{"labels": {}, "value": 9999.0}]}
+    old = {"metric": "tok_per_s", "value": 100.0, "metrics": metrics_a}
+    new = {"metric": "tok_per_s", "value": 100.0, "metrics": metrics_b}
+    out = _regress(tmp_path, old, new)
+    assert out.returncode == 0, out.stderr
+    report = json.loads(out.stdout)
+    assert report["compared"] == 1          # only tok_per_s
+    assert report["regressions"] == 0
+
+
+def _metrics_dump(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "metrics_dump.py"),
+         *args],
+        capture_output=True, text=True, timeout=60)
+
+
+def test_metrics_dump_renders_artifact_block(tmp_path):
+    art = tmp_path / "bench.json"
+    art.write_text(json.dumps({
+        "metric": "tok_per_s", "value": 100.0,
+        "metrics": {
+            "hvd_tpu_steps_total": [
+                {"labels": {"kind": "train"}, "value": 3.0}],
+            "hvd_tpu_step_time_seconds": [
+                {"labels": {"kind": "train"}, "count": 3, "sum": 0.3,
+                 "p50": 0.1, "p90": 0.12, "p99": 0.2, "mean": 0.1}],
+        },
+    }))
+    out = _metrics_dump(str(art))
+    assert out.returncode == 0, out.stderr
+    assert "hvd_tpu_steps_total{kind=train}  3" in out.stdout
+    assert "count=3" in out.stdout and "p99=0.2" in out.stdout
+    # --json round-trips the block verbatim.
+    raw = _metrics_dump(str(art), "--json")
+    assert raw.returncode == 0
+    assert "hvd_tpu_steps_total" in json.loads(raw.stdout)["metrics"]
+
+
+def test_metrics_dump_missing_block_is_loud(tmp_path):
+    art = tmp_path / "old.json"
+    art.write_text(json.dumps({"metric": "tok_per_s", "value": 1.0}))
+    out = _metrics_dump(str(art))
+    assert out.returncode != 0
+    assert "no embedded 'metrics' block" in out.stderr
+
+
+def test_metrics_dump_requires_exactly_one_source(tmp_path):
+    assert _metrics_dump().returncode != 0
+    art = tmp_path / "a.json"
+    art.write_text("{}")
+    assert _metrics_dump(str(art), "--url", "http://x").returncode != 0

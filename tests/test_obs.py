@@ -1,0 +1,474 @@
+"""Unified telemetry tests: registry semantics, Prometheus exposition,
+the HMAC-wire scrape, straggler detection, and the end-to-end loop
+(train under an injected fault → scrape → assert the signals).
+
+The default registry is process-global and deliberately never reset by
+re-init (counters span elastic recoveries), so suite-order-independent
+tests assert DELTAS against values read before acting, and unit tests
+construct private ``MetricsRegistry`` instances.
+"""
+
+import json
+import re
+import threading
+
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.obs import aggregate, export, instrument
+from horovod_tpu.obs.metrics import MetricsRegistry, Ring, percentile
+
+
+def _value(snap, name, **labels):
+    """Value of one series in a snapshot dict (0.0 when absent — the
+    delta convention treats never-recorded as zero)."""
+    for series in snap.get(name, []):
+        if series.get("labels", {}) == {str(k): str(v)
+                                        for k, v in labels.items()}:
+            return series.get("value", series.get("count"))
+    return 0.0
+
+
+class TestRegistry:
+    def test_counter_gauge_histogram_basics(self):
+        reg = MetricsRegistry(window=8)
+        reg.counter("c", "help c").inc()
+        reg.counter("c").inc(2.5)
+        reg.gauge("g").set(7)
+        h = reg.histogram("h")
+        for v in range(10):
+            h.observe(float(v))
+        snap = reg.snapshot()
+        assert _value(snap, "c") == 3.5
+        assert _value(snap, "g") == 7.0
+        (hs,) = snap["h"]
+        # Exact count/sum survive ring eviction (window=8 < 10 samples).
+        assert hs["count"] == 10 and hs["sum"] == 45.0
+        assert hs["p50"] is not None and 2.0 <= hs["p50"] <= 9.0
+
+    def test_counter_rejects_negative(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError, match=">= 0"):
+            reg.counter("c").inc(-1)
+
+    def test_kind_conflict_raises(self):
+        reg = MetricsRegistry()
+        reg.counter("x")
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("x")
+
+    def test_labels_are_distinct_series(self):
+        reg = MetricsRegistry()
+        fam = reg.counter("wire")
+        fam.labels(tier="spmd").inc(10)
+        fam.labels(tier="slots").inc(1)
+        snap = reg.snapshot()
+        assert _value(snap, "wire", tier="spmd") == 10
+        assert _value(snap, "wire", tier="slots") == 1
+
+    def test_cardinality_cap_collapses_to_overflow(self):
+        reg = MetricsRegistry(max_label_sets=3)
+        fam = reg.counter("c")
+        for i in range(10):
+            fam.labels(tensor=f"t{i}").inc()
+        snap = reg.snapshot()
+        series = snap["c"]
+        # 3 real series + 1 overflow bucket, never 10.
+        assert len(series) == 4
+        assert _value(snap, "c", other="true") == 7.0
+
+    def test_concurrent_counter_writers_are_exact(self):
+        reg = MetricsRegistry()
+        fam = reg.counter("n")
+        barrier = threading.Barrier(8)
+
+        def work():
+            barrier.wait()
+            for _ in range(1000):
+                fam.inc()
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert _value(reg.snapshot(), "n") == 8000.0
+
+    def test_ring_and_percentile_primitives(self):
+        r = Ring(4)
+        for v in (1.0, 2.0, 3.0, 4.0, 5.0):
+            r.append(v)
+        assert r.values() == [2.0, 3.0, 4.0, 5.0]
+        assert r.mean() == 3.5
+        assert percentile([], 50) is None
+        assert percentile([1.0, 2.0, 3.0], 50) == 2.0
+
+    def test_serving_stats_reuses_obs_primitives(self):
+        # The dedupe satellite: ServingStats is a thin consumer now.
+        from horovod_tpu.serve.metrics import ServingStats
+        from horovod_tpu.serve import metrics as serve_metrics
+
+        assert serve_metrics.percentile is percentile
+        s = ServingStats(window=4)
+        s.record_request(ttft_s=0.1, n_tokens=5, total_s=0.5)
+        s.record_step(active=2, slots=4, queued=1)
+        snap = s.snapshot()
+        assert snap["requests_completed"] == 1
+        assert snap["ttft_ms_p50"] == 100.0
+        assert isinstance(s._ttft_s, Ring)
+
+
+_PROM_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? (-?[0-9.eE+-]+|NaN)$")
+
+
+def _parse_prometheus(text):
+    """Minimal exposition-format checker: every non-comment line is a
+    sample, every sample belongs to a declared family, families are
+    declared once.  Returns {family: n_samples}."""
+    declared = {}
+    samples = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP"):
+            continue
+        if line.startswith("# TYPE"):
+            _, _, name, kind = line.split(None, 3)
+            assert name not in declared, f"duplicate family {name}"
+            declared[name] = kind
+            continue
+        m = _PROM_SAMPLE.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        sample_name = m.group(1)
+        base = re.sub(r"_(sum|count)$", "", sample_name)
+        assert sample_name in declared or base in declared, \
+            f"sample {sample_name} has no TYPE declaration"
+        samples[base if base in declared else sample_name] = \
+            samples.get(base, 0) + 1
+        float(m.group(3))
+    return samples
+
+
+class TestPrometheusExposition:
+    def test_escaping_and_label_rendering(self):
+        reg = MetricsRegistry()
+        reg.counter("esc_total", 'help with \\ and\nnewline').labels(
+            path='a"b\\c\nd').inc()
+        text = export.render_prometheus(reg)
+        # Help: backslash + newline escaped, stays one line.
+        help_line = [l for l in text.splitlines()
+                     if l.startswith("# HELP")][0]
+        assert help_line == "# HELP esc_total help with \\\\ and\\nnewline"
+        sample = [l for l in text.splitlines() if not l.startswith("#")][0]
+        assert sample == 'esc_total{path="a\\"b\\\\c\\nd"} 1'
+        _parse_prometheus(text)
+
+    def test_histogram_renders_as_summary(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", "latency").labels(kind="x")
+        for v in (0.1, 0.2, 0.3):
+            h.observe(v)
+        text = export.render_prometheus(reg)
+        assert "# TYPE lat_seconds summary" in text
+        assert 'lat_seconds{kind="x",quantile="0.5"} 0.2' in text
+        assert 'lat_seconds_count{kind="x"} 3' in text
+        assert _parse_prometheus(text) == {"lat_seconds": 5}
+
+    def test_unset_gauge_renders_no_sample(self):
+        reg = MetricsRegistry()
+        reg.gauge("g", "never set")
+        text = export.render_prometheus(reg)
+        assert "# TYPE g gauge" in text
+        assert not [l for l in text.splitlines() if l.startswith("g ")]
+
+    def test_live_registry_renders_parseable_no_duplicates(self):
+        # Whatever the suite recorded so far must round-trip.
+        _parse_prometheus(export.render_prometheus())
+
+
+class TestWireScrape:
+    def test_metrics_request_over_hmac_wire(self):
+        from horovod_tpu.runner.common.network import (
+            BasicClient, BasicService, MetricsRequest)
+
+        instrument._reg().counter("hvd_tpu_wire_probe_total").inc()
+        key = b"obs-test-secret"
+        svc = BasicService("obs-test", key, host="127.0.0.1")
+        try:
+            client = BasicClient("obs-test",
+                                 [("127.0.0.1", svc.port)], key)
+            resp = client.request(MetricsRequest(fmt="prometheus"))
+            assert resp.snapshot["metrics"]["hvd_tpu_wire_probe_total"]
+            assert resp.prometheus is not None
+            _parse_prometheus(resp.prometheus)
+            # json fmt skips the text payload.
+            resp2 = client.request(MetricsRequest())
+            assert resp2.prometheus is None
+            assert "metrics" in resp2.snapshot
+        finally:
+            svc.shutdown()
+
+    def test_http_exporter_serves_both_formats(self):
+        import urllib.request
+
+        port = export.start_http_exporter(0, host="127.0.0.1")
+        try:
+            assert port
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                _parse_prometheus(r.read().decode())
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics.json",
+                    timeout=10) as r:
+                doc = json.loads(r.read().decode())
+            assert "metrics" in doc and "ts_unix" in doc
+        finally:
+            export.stop_http_exporter()
+
+
+class TestStragglerDetection:
+    def test_flags_exactly_the_slow_rank(self):
+        trace = [1.0, 1.05, 0.97, 3.2, 1.01, 0.99, 1.02, 1.0]
+        assert aggregate.detect_stragglers(trace, factor=2.0) == [3]
+
+    def test_uniform_world_flags_nobody(self):
+        trace = [1.0, 1.02, 0.98, 1.01, 0.99, 1.0, 1.03, 0.97]
+        assert aggregate.detect_stragglers(trace, factor=2.0) == []
+
+    def test_exact_threshold_is_not_flagged(self):
+        assert aggregate.detect_stragglers([1.0, 1.0, 2.0], 2.0) == []
+
+    def test_idle_or_single_rank_world(self):
+        assert aggregate.detect_stragglers([0.0, 0.0], 2.0) == []
+        assert aggregate.detect_stragglers([5.0], 2.0) == []
+
+    def test_check_publishes_gauges_and_warns_once(self):
+        trace = [1.0, 1.0, 1.0, 4.0]
+        flagged = aggregate.check_stragglers(trace, factor=2.0, my_rank=3)
+        assert flagged == [3]
+        snap = instrument._reg().snapshot()
+        assert _value(snap, "hvd_tpu_straggler_suspect") == 1.0
+        assert _value(snap, "hvd_tpu_step_time_skew") == 4.0
+        # From a healthy rank's view the suspect gauge is 0.
+        aggregate.check_stragglers(trace, factor=2.0, my_rank=0)
+        snap = instrument._reg().snapshot()
+        assert _value(snap, "hvd_tpu_straggler_suspect") == 0.0
+
+    def test_cross_rank_summary_single_process(self):
+        out = aggregate.cross_rank_summary({"my_gauge": 3.0})
+        assert out["my_gauge"]["per_rank"] == [3.0]
+        assert out["my_gauge"]["min"] == out["my_gauge"]["max"] == 3.0
+
+
+class TestInstrumentation:
+    def test_wrap_step_noop_when_disabled(self, monkeypatch):
+        from horovod_tpu.obs import metrics as m
+
+        monkeypatch.setattr(m, "_enabled", False)
+        fn = lambda p, o, b: (p, o, 0.0)  # noqa: E731
+        assert instrument.wrap_step(fn) is fn
+
+    def test_wrap_step_records_steps_tokens(self):
+        import jax.numpy as jnp
+
+        before = _value(instrument._reg().snapshot(),
+                        "hvd_tpu_steps_total", kind="train")
+        fn = lambda p, o, b: (p, o, 0.0)  # noqa: E731
+        wrapped = instrument.wrap_step(fn, kind="train")
+        assert wrapped is not fn and wrapped._hvd_tpu_instrumented
+        batch = jnp.ones((4, 16))
+        wrapped({}, {}, batch)
+        snap = instrument._reg().snapshot()
+        assert _value(snap, "hvd_tpu_steps_total",
+                      kind="train") == before + 1
+        assert _value(snap, "hvd_tpu_tokens_per_s") > 0
+
+    def test_wrap_step_bypasses_tracers(self):
+        import jax
+        import jax.numpy as jnp
+
+        before = _value(instrument._reg().snapshot(),
+                        "hvd_tpu_steps_total", kind="train")
+        wrapped = instrument.wrap_step(
+            lambda p, o, b: (p, o, b.sum()), kind="train")
+
+        @jax.jit
+        def outer(b):
+            return wrapped({}, {}, b)[2]
+
+        outer(jnp.ones((4, 4)))
+        after = _value(instrument._reg().snapshot(),
+                       "hvd_tpu_steps_total", kind="train")
+        # The traced call must not poison the histogram/counters.
+        assert after == before
+
+    def test_retry_counter(self):
+        from horovod_tpu.utils.retry import RetryPolicy, retry_call
+
+        before = _value(instrument._reg().snapshot(),
+                        "hvd_tpu_retries_total", what="obs_retry_probe")
+        attempts = []
+
+        def flaky():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise OSError("flake")
+            return "ok"
+
+        retry_call(flaky, policy=RetryPolicy(attempts=5, base_delay_s=0.0),
+                   retry_on=(OSError,), describe="obs_retry_probe x",
+                   sleep=lambda s: None)
+        after = _value(instrument._reg().snapshot(),
+                       "hvd_tpu_retries_total", what="obs_retry_probe")
+        assert after == before + 2
+
+    def test_autotune_decision_log_bounded(self):
+        for i in range(100):
+            instrument.on_autotune_window(float(i), None)
+        log = instrument.autotune_log()
+        assert len(log) <= 64
+        assert log[-1]["samples_per_s"] == 99.0
+
+    def test_timeline_counter_events(self, tmp_path):
+        from horovod_tpu.utils.timeline import Timeline
+
+        for use_native in (True, False):
+            path = tmp_path / f"tl{use_native}.json"
+            tl = Timeline(str(path), use_native=use_native)
+            tl.counter("train", {"tokens_per_s": 12.5,
+                                 "note": "dropped-non-numeric"})
+            tl.record("t", "EXECUTE", 0.0, 1.0)
+            tl.close()
+            events = json.load(open(path))
+            counters = [e for e in events if e["ph"] == "C"]
+            assert len(counters) == 1, f"use_native={use_native}"
+            assert counters[0]["name"] == "train"
+            assert counters[0]["args"] == {"tokens_per_s": 12.5}
+
+
+class TestConfigKnobs:
+    def test_metrics_knobs_parse(self, monkeypatch):
+        from horovod_tpu.config import Config
+
+        monkeypatch.setenv("HVD_TPU_METRICS", "0")
+        monkeypatch.setenv("HVD_TPU_METRICS_PORT", "9100")
+        monkeypatch.setenv("HVD_TPU_METRICS_WINDOW", "64")
+        monkeypatch.setenv("HVD_TPU_STRAGGLER_FACTOR", "3.5")
+        cfg = Config.from_env()
+        assert cfg.metrics is False
+        assert cfg.metrics_port == 9100
+        assert cfg.metrics_window == 64
+        assert cfg.straggler_factor == 3.5
+
+    def test_straggler_factor_must_exceed_one(self, monkeypatch):
+        from horovod_tpu.config import Config
+
+        monkeypatch.setenv("HVD_TPU_STRAGGLER_FACTOR", "0.8")
+        with pytest.raises(ValueError, match="STRAGGLER_FACTOR"):
+            Config.from_env()
+
+    def test_metrics_window_must_be_positive(self, monkeypatch):
+        from horovod_tpu.config import Config
+
+        monkeypatch.setenv("HVD_TPU_METRICS_WINDOW", "0")
+        with pytest.raises(ValueError, match="METRICS_WINDOW"):
+            Config.from_env()
+
+
+class TestEndToEnd:
+    def test_train_under_fault_scrape_and_assert(self, monkeypatch):
+        """The acceptance loop: a few steps of make_train_step with
+        metrics enabled and an HVD_TPU_FAULT_SPEC collective fault that
+        elastic.run retries through; scrape via MetricsRequest; assert
+        the step-time histogram, wire-bytes counters, the fault-site
+        counter, and valid Prometheus text."""
+        import jax.numpy as jnp
+
+        from horovod_tpu import faults
+        from horovod_tpu.elastic import ObjectState, run
+        from horovod_tpu.elastic import state as state_mod
+        from horovod_tpu.runner.common.network import (
+            BasicClient, BasicService, MetricsRequest)
+
+        monkeypatch.setattr(state_mod.time, "sleep", lambda s: None)
+        snap0 = instrument._reg().snapshot()
+        before_faults = _value(snap0, "hvd_tpu_faults_fired_total",
+                               site="collective")
+        before_steps = _value(snap0, "hvd_tpu_steps_total", kind="train")
+        before_resets = _value(snap0, "hvd_tpu_elastic_resets_total",
+                               kind="rollback")
+        before_slots = _value(snap0, "hvd_tpu_wire_bytes_total",
+                              tier="slots")
+
+        spec = "collective:step=2"
+        monkeypatch.setenv("HVD_TPU_FAULT_SPEC", spec)
+        tx = optax.sgd(0.1)
+        loss_fn = lambda p, b: ((p["w"] * b).sum() ** 2)  # noqa: E731
+        x = np.ones((hvd.size(), 2), np.float32)
+        state = ObjectState(step=0)
+
+        @run
+        def train(state):
+            # Rebuilt per attempt: a reset re-inits the mesh, so the
+            # step re-traces against the live world.
+            step = hvd.make_train_step(loss_fn, tx, donate=False)
+            params = {"w": jnp.ones((4,))}
+            opt_state = tx.init(params)
+            batch = jnp.ones((8, 4))
+            while state.step < 4:
+                hvd.allreduce(x, op=hvd.Sum, name="obs_e2e")
+                params, opt_state, loss = step(params, opt_state, batch)
+                state.step += 1
+                state.commit()
+            return float(loss)
+
+        from horovod_tpu import basics
+
+        try:
+            with faults.inject(spec):
+                train(state)
+                assert [h[0] for h in faults.history()] == ["collective"]
+        finally:
+            # The mid-test reset re-ran hvd.init() with the fault spec
+            # in the environment; restore a pristine session config.
+            monkeypatch.delenv("HVD_TPU_FAULT_SPEC")
+            faults.clear()
+            basics.shutdown()
+            basics.init()
+
+        snap = instrument._reg().snapshot()
+        assert _value(snap, "hvd_tpu_faults_fired_total",
+                      site="collective") == before_faults + 1
+        assert _value(snap, "hvd_tpu_elastic_resets_total",
+                      kind="rollback") == before_resets + 1
+        # 4 committed steps + the pre-fault attempt's progress.
+        steps = _value(snap, "hvd_tpu_steps_total", kind="train")
+        assert steps >= before_steps + 4
+        hist = [s for s in snap["hvd_tpu_step_time_seconds"]
+                if s["labels"] == {"kind": "train"}][0]
+        assert hist["count"] >= 4 and hist["p50"] > 0
+        # Wire bytes: the step's fused SPMD gradient wire (trace-time
+        # plan) and the slot-tier allreduce dispatches.
+        assert _value(snap, "hvd_tpu_wire_bytes_total", tier="spmd") > 0
+        assert _value(snap, "hvd_tpu_wire_bytes_total",
+                      tier="slots") > before_slots
+
+        # Scrape over the HMAC control plane and validate the text
+        # exposition end-to-end.
+        key = b"obs-e2e-secret"
+        svc = BasicService("obs-e2e", key, host="127.0.0.1")
+        try:
+            client = BasicClient("obs-e2e", [("127.0.0.1", svc.port)], key)
+            resp = client.request(MetricsRequest(fmt="prometheus"))
+        finally:
+            svc.shutdown()
+        wire = resp.snapshot["metrics"]
+        assert _value(wire, "hvd_tpu_faults_fired_total",
+                      site="collective") == before_faults + 1
+        families = _parse_prometheus(resp.prometheus)
+        assert "hvd_tpu_step_time_seconds" in families
+        assert "hvd_tpu_wire_bytes_total" in families
+        assert "hvd_tpu_faults_fired_total" in families

@@ -47,6 +47,35 @@ class TestTimeline:
         events = json.load(open(path))
         assert any(e["name"] == "CYCLE" and e["ph"] == "i" for e in events)
 
+    @pytest.mark.parametrize("use_native", [True, False])
+    def test_close_mid_activity_drops_event_safely(self, tmp_path,
+                                                   use_native):
+        """A timeline closed while an activity is open (elastic reset
+        mid-step) must drop that activity's event — never write to a
+        closed backend — and leave a valid JSON file."""
+        path = tmp_path / f"race{use_native}.json"
+        tl = Timeline(str(path), use_native=use_native)
+        tl.record("kept", "EXECUTE", 0.0, 1.0)
+        with tl.activity("x", "EXECUTE"):
+            tl.close()          # elastic teardown racing the step
+            assert not tl.enabled
+        # Reopenable output: the array was finalized exactly once, and
+        # the in-flight activity is absent.
+        events = json.load(open(path))
+        assert {e["args"]["tensor"] for e in events
+                if "args" in e} == {"kept"}
+        tl.close()              # idempotent
+
+    def test_counter_events_render_as_counter_track(self, tmp_path):
+        path = tmp_path / "counters.json"
+        tl = Timeline(str(path))
+        tl.counter("train", {"step_time_ms": 3.5, "tokens_per_s": 100.0})
+        tl.close()
+        events = json.load(open(path))
+        (c,) = [e for e in events if e["ph"] == "C"]
+        assert c["name"] == "train"
+        assert c["args"] == {"step_time_ms": 3.5, "tokens_per_s": 100.0}
+
 
 @pytest.fixture
 def stall_records():
