@@ -1,0 +1,89 @@
+"""hvdlint: distributed-correctness static analysis for horovod_tpu.
+
+Usage (CLI wraps this, ``scripts/hvdlint.py``)::
+
+    from horovod_tpu import analysis
+    findings = analysis.run(repo_root)      # AST analyzers, no jax
+    findings += analysis.run_jaxpr_checks() # traced-program analyzer
+
+The analyzers and the check catalog live in :mod:`.core`,
+:mod:`.rank_divergence`, :mod:`.knobs`, :mod:`.locks`,
+:mod:`.registries` and :mod:`.jaxpr_check`; docs/lint.md is the
+operator-facing catalog.  Zero unsuppressed findings is a tier-1
+invariant (``tests/test_analysis.py``), so every future PR inherits
+the gate.
+
+This module deliberately avoids importing jax (or the rest of the
+package) at import time: the AST tier stays runnable as a seconds-fast
+pre-commit/CI step with no accelerator stack.  Only
+:func:`run_jaxpr_checks` and :func:`record_findings_metric` touch
+heavier machinery, lazily.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+from .core import (CHECK_CATALOG, Checker, Finding, LintConfig,
+                   all_check_ids, iter_source_files, run_checks)
+
+__all__ = [
+    "CHECK_CATALOG", "Checker", "Finding", "LintConfig", "all_check_ids",
+    "iter_source_files", "run_checks", "default_checkers", "run",
+    "run_jaxpr_checks", "record_findings_metric",
+]
+
+
+def default_checkers() -> List[type]:
+    from .knobs import KnobChecker
+    from .locks import LockChecker
+    from .rank_divergence import RankDivergenceChecker
+    from .registries import FaultSiteChecker, MetricNameChecker
+    return [RankDivergenceChecker, KnobChecker, LockChecker,
+            FaultSiteChecker, MetricNameChecker]
+
+
+def repo_root() -> Path:
+    """The repo the installed package was imported from (package parent
+    — where docs/ lives in a source checkout)."""
+    return Path(__file__).resolve().parent.parent.parent
+
+
+def run(root: Optional[Path] = None,
+        select: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run the AST analyzers over the package; returns unsuppressed
+    findings (empty = clean)."""
+    cfg = LintConfig(root=Path(root) if root else repo_root(),
+                     select=list(select) if select else None)
+    return run_checks(cfg)
+
+
+def run_jaxpr_checks() -> List[Finding]:
+    """Run the traced-program analyzer (imports jax; seconds, not
+    milliseconds)."""
+    from . import jaxpr_check
+    return jaxpr_check.run_jaxpr_checks()
+
+
+def record_findings_metric(findings: Sequence[Finding]) -> None:
+    """Publish per-check finding counts as
+    ``hvd_tpu_lint_findings_total{check=…}`` so lint state shows up in
+    metrics snapshots next to the signals it protects.  Fail-soft: a
+    metrics layer that is off (HVD_TPU_METRICS=0) records nothing."""
+    from ..obs import metrics as _m
+    if not _m.enabled():
+        return
+    fam = _m.registry().counter(
+        "hvd_tpu_lint_findings_total",
+        "Unsuppressed hvdlint findings per check id, accumulated over "
+        "in-process analyzer runs")
+    counts: dict = {}
+    for f in findings:
+        counts[f.check] = counts.get(f.check, 0) + 1
+    for check, n in sorted(counts.items()):
+        fam.labels(check=check).inc(n)
+    if not counts:
+        # A clean run still leaves a scrapeable series: 0 findings is
+        # the signal dashboards alert on the absence of.
+        fam.labels(check="none").inc(0)

@@ -1,0 +1,194 @@
+"""Registry consistency (``unknown-fault-site`` /
+``fault-site-doc-drift`` / ``metric-name`` / ``metric-doc-drift``).
+
+Two catalogs drifted by convention before this PR; both are now
+checked against their single sources of truth:
+
+* **Fault sites.**  ``config.FAULT_SITES`` (and its ``_FAULT_MODES``
+  grammar) is the namespace.  Every literal spec passed to
+  ``faults.inject("site:…")`` and every ``faults.on_<site>*`` hook
+  called in the package must name a declared site, and every declared
+  site must have a row in ``docs/fault_injection.md`` — a chaos drill
+  against an undeclared site silently no-ops, which invalidates the
+  run it was supposed to harden.
+* **Metric names.**  Registrations on the obs registry
+  (``.counter("…")`` / ``.gauge("…")`` / ``.histogram("…")`` with a
+  literal name) must follow the naming rules — ``hvd_tpu_`` prefix,
+  counters end ``_total``, gauges/histograms must not — and appear in
+  the ``docs/metrics.md`` catalog.  Dashboards are written against the
+  docs; an undocumented series is invisible operational surface.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Optional, Set, Tuple
+
+from .core import Checker, LintConfig, SourceModule, terminal_name as _terminal
+
+_METRIC_KINDS = ("counter", "gauge", "histogram")
+
+
+class FaultSiteChecker(Checker):
+    checks = ("unknown-fault-site", "fault-site-doc-drift")
+
+    def __init__(self, cfg: LintConfig) -> None:
+        super().__init__(cfg)
+        self.sites: Set[str] = set()
+        self.site_line: int = 1
+        self.config_path: str = ""
+        self.hooks: Set[str] = set()       # on_* defs in faults.py
+        # (path, line, site) for inject() literals; (path, line, hook)
+        self.inject_refs: list = []
+        self.hook_refs: list = []
+
+    def check_module(self, mod: SourceModule) -> None:
+        if mod.path.endswith("/config.py"):
+            self.config_path = mod.path
+            for node in mod.tree.body:
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == "FAULT_SITES"
+                        for t in node.targets):
+                    self.site_line = node.lineno
+                    if isinstance(node.value, (ast.Tuple, ast.List)):
+                        self.sites = {
+                            e.value for e in node.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)}
+        if mod.path.endswith("/faults.py"):
+            for node in mod.tree.body:
+                if isinstance(node, ast.FunctionDef) and \
+                        node.name.startswith("on_"):
+                    self.hooks.add(node.name)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _terminal(node.func)
+            if name == "inject" and _receiver_is(node.func, "faults"):
+                if node.args and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    spec = node.args[0].value
+                    for clause in spec.split(";"):
+                        site = clause.strip().partition(":")[0].strip()
+                        if site:
+                            self.inject_refs.append(
+                                (mod.path, node.lineno, site))
+            elif name.startswith("on_") and _receiver_is(node.func, "faults") \
+                    and not mod.path.endswith("/faults.py"):
+                self.hook_refs.append((mod.path, node.lineno, name))
+
+    def finalize(self) -> None:
+        if not self.sites:
+            raise RuntimeError("hvdlint: config.FAULT_SITES not found — "
+                               "fault-site checks need the grammar")
+        doc = self.cfg.doc_text(self.cfg.fault_doc)
+        for path, line, site in self.inject_refs:
+            if site not in self.sites:
+                self.emit(
+                    "unknown-fault-site", path, line,
+                    f"faults.inject() names site {site!r}, not in the "
+                    f"config.py grammar {sorted(self.sites)} — the drill "
+                    f"would no-op")
+        for path, line, hook in self.hook_refs:
+            if hook not in self.hooks:
+                self.emit(
+                    "unknown-fault-site", path, line,
+                    f"faults.{hook}() has no hook definition in "
+                    f"faults.py — the site cannot fire")
+        for site in sorted(self.sites):
+            # A documented site has a catalog row: a table line starting
+            # with | `site` |.
+            if not re.search(rf"^\|\s*`{re.escape(site)}`\s*\|", doc,
+                             re.MULTILINE):
+                self.emit(
+                    "fault-site-doc-drift", self.config_path, self.site_line,
+                    f"fault site {site!r} has no row in the "
+                    f"{self.cfg.fault_doc} site catalog")
+
+
+def _receiver_is(func: ast.expr, modname: str) -> bool:
+    """True only for the package idiom ``faults.x(...)`` — bare ``on_*``
+    names are callback parameters all over the tree (retry hooks,
+    elastic callbacks), not fault hooks."""
+    return (isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == modname)
+
+
+class MetricNameChecker(Checker):
+    checks = ("metric-name", "metric-doc-drift")
+
+    def __init__(self, cfg: LintConfig) -> None:
+        super().__init__(cfg)
+        # name -> (kind, path, line) first registration seen
+        self.metrics: Dict[str, Tuple[str, str, int]] = {}
+
+    def check_module(self, mod: SourceModule) -> None:
+        if mod.path.endswith("obs/metrics.py"):
+            return  # the generic registry itself registers nothing
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _terminal(node.func)
+            if kind not in _METRIC_KINDS or not node.args:
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                continue
+            name = arg.value
+            if not name.startswith("hvd_tpu_"):
+                # Same method names exist off the registry (e.g.
+                # Timeline.counter takes a free-form track name); only
+                # registry-shaped receivers are held to metric rules.
+                if _metric_receiver(node.func):
+                    self.emit(
+                        "metric-name", mod.path, node.lineno,
+                        f"metric {name!r} must carry the hvd_tpu_ prefix "
+                        f"(docs/metrics.md naming rules)")
+                continue
+            if kind == "counter" and not name.endswith("_total"):
+                self.emit(
+                    "metric-name", mod.path, node.lineno,
+                    f"counter {name!r} must end in _total "
+                    f"(docs/metrics.md naming rules)")
+            if kind in ("gauge", "histogram") and name.endswith("_total"):
+                self.emit(
+                    "metric-name", mod.path, node.lineno,
+                    f"{kind} {name!r} must not end in _total — that "
+                    f"suffix is the counter marker")
+            prev = self.metrics.get(name)
+            if prev and prev[0] != kind:
+                self.emit(
+                    "metric-name", mod.path, node.lineno,
+                    f"{name!r} registered as {kind} here but as "
+                    f"{prev[0]} at {prev[1]}:{prev[2]} — one family, "
+                    f"one kind")
+            self.metrics.setdefault(name, (kind, mod.path, node.lineno))
+
+    def finalize(self) -> None:
+        doc = self.cfg.doc_text(self.cfg.metrics_doc)
+        documented = set(re.findall(r"hvd_tpu_[a-z0-9_]+", doc))
+        for name, (kind, path, line) in sorted(self.metrics.items()):
+            if name not in documented:
+                self.emit(
+                    "metric-doc-drift", path, line,
+                    f"{kind} {name!r} is registered but missing from the "
+                    f"{self.cfg.metrics_doc} catalog")
+
+
+def _metric_receiver(func: ast.expr) -> bool:
+    """Is the receiver registry-shaped (``registry().counter``,
+    ``reg.gauge``, ``self._registry.histogram``)?"""
+    if not isinstance(func, ast.Attribute):
+        return False
+    recv = func.value
+    text = ""
+    if isinstance(recv, ast.Call):
+        text = _terminal(recv.func)
+    elif isinstance(recv, ast.Attribute):
+        text = recv.attr
+    elif isinstance(recv, ast.Name):
+        text = recv.id
+    return "reg" in text.lower()

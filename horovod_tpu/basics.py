@@ -64,15 +64,15 @@ class _GlobalState:
     ``horovod/common/global_state.h``, unverified)."""
 
     def __init__(self) -> None:
-        self.initialized: bool = False
-        self.config: Optional[Config] = None
-        self.mesh = None            # horovod_tpu.mesh.GlobalMesh
-        self.process_sets = None    # horovod_tpu.process_sets.ProcessSetTable
-        self.timeline = None        # horovod_tpu.utils.timeline.Timeline
-        self.stall_inspector = None
-        self.cross_monitor = None   # horovod_tpu.utils.cross_stall (multi-process)
-        self.parameter_manager = None
-        self.metrics_port = None    # bound HVD_TPU_METRICS_PORT (obs/export)
+        self.initialized: bool = False   # guarded-by: lock
+        self.config: Optional[Config] = None   # guarded-by: lock
+        self.mesh = None            # guarded-by: lock (horovod_tpu.mesh.GlobalMesh)
+        self.process_sets = None    # guarded-by: lock (process_sets.ProcessSetTable)
+        self.timeline = None        # guarded-by: lock (utils.timeline.Timeline)
+        self.stall_inspector = None  # guarded-by: lock
+        self.cross_monitor = None   # guarded-by: lock (utils.cross_stall, multi-process)
+        self.parameter_manager = None   # guarded-by: lock
+        self.metrics_port = None    # guarded-by: lock (bound HVD_TPU_METRICS_PORT)
         self.lock = threading.Lock()
 
 
@@ -353,23 +353,23 @@ def _maybe_build_parameter_manager(cfg):
             "HOROVOD_AUTOTUNE=1 overrides fusion_threshold=%d (outside "
             "the tunable range [%d, %d]): starting from %d",
             cfg.fusion_threshold, lo, hi, start)
-        _state.config = dataclasses.replace(_state.config,
-                                            fusion_threshold=start)
+        _state.config = dataclasses.replace(  # hvdlint: disable=unguarded-mutation -- runs under init()'s `with _state.lock:` (sole caller)
+            _state.config, fusion_threshold=start)
     if joint:
         # The manager's start point must equal the live config (scores
         # are attributed to it): snap and store.
         start_inner = _nearest_divisor(
             int(round(start_vals["hierarchical_inner_size"])), size)
-        _state.config = dataclasses.replace(
+        _state.config = dataclasses.replace(  # hvdlint: disable=unguarded-mutation -- runs under init()'s `with _state.lock:` (sole caller)
             _state.config, hierarchical_inner_size=start_inner)
     if joint_two_phase:
         # Same invariant for the two-phase knobs: the live config must
         # equal the clamped start point the first windows run.
-        _state.config = dataclasses.replace(
+        _state.config = dataclasses.replace(  # hvdlint: disable=unguarded-mutation -- runs under init()'s `with _state.lock:` (sole caller)
             _state.config,
             pipeline_depth=int(round(start_vals["pipeline_depth"])))
     if joint_microbatch:
-        _state.config = dataclasses.replace(
+        _state.config = dataclasses.replace(  # hvdlint: disable=unguarded-mutation -- runs under init()'s `with _state.lock:` (sole caller)
             _state.config,
             microbatches=_nearest_pow2(int(round(
                 start_vals["microbatches"]))),
@@ -377,7 +377,7 @@ def _maybe_build_parameter_manager(cfg):
     if "compressor" in knobs:
         idx = min(max(1, int(round(start_vals["compressor"]))),
                   len(_COMPRESSOR_LATTICE))
-        _state.config = dataclasses.replace(
+        _state.config = dataclasses.replace(  # hvdlint: disable=unguarded-mutation -- runs under init()'s `with _state.lock:` (sole caller)
             _state.config, compression=_COMPRESSOR_LATTICE[idx - 1])
     logger.info(
         "autotune enabled: tuning %s, %d warmup + %d scored windows "
@@ -483,7 +483,11 @@ def _apply_autotuned_knobs(values) -> dict:
                   len(_COMPRESSOR_LATTICE))
         updates["compression"] = _COMPRESSOR_LATTICE[idx - 1]
         applied["compressor"] = idx
-    st.config = dataclasses.replace(st.config, **updates)
+    # The swap races with concurrent trace-time config() readers
+    # (serving threads, a re-jitting train step) — publish under the
+    # state lock like every other _state mutation.
+    with st.lock:
+        st.config = dataclasses.replace(st.config, **updates)
     return applied
 
 
@@ -738,16 +742,19 @@ def start_timeline(path: str, mark_cycles: bool = False) -> None:
     from .utils.timeline import Timeline
 
     st = _require_init()
-    if st.timeline is not None:
-        st.timeline.close()
-    st.timeline = Timeline(_per_process_path(path), mark_cycles=mark_cycles)
+    with st.lock:
+        if st.timeline is not None:
+            st.timeline.close()
+        st.timeline = Timeline(_per_process_path(path),
+                               mark_cycles=mark_cycles)
 
 
 def stop_timeline() -> None:
     """Reference: ``hvd.stop_timeline()``."""
     st = _require_init()
-    if st.timeline is not None:
-        st.timeline.close()
     from .utils.timeline import Timeline
 
-    st.timeline = Timeline(None)
+    with st.lock:
+        if st.timeline is not None:
+            st.timeline.close()
+        st.timeline = Timeline(None)

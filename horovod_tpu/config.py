@@ -38,6 +38,30 @@ DEFAULT_COST_BETA_GBPS = 100.0
 FAULT_SITES = ("collective", "fusion", "accumulate", "discovery", "rpc",
                "checkpoint", "serve")
 
+
+# --- pre-init knob registry --------------------------------------------------
+# Knobs legitimately read via raw ``os.environ`` outside this module:
+# launcher/platform wiring consumed before ``init()`` builds the Config,
+# import-time gates (FFI registration), logging that must work during
+# init itself, and benchmark-subprocess sentinels.  Together with
+# ``Config.from_env`` this tuple IS the knob namespace —
+# ``hvdlint``'s knob checker (horovod_tpu/analysis/knobs.py) rejects any
+# env name outside it and any raw read of a knob not listed here, so a
+# new knob must either land a Config field or be registered (and
+# documented in docs/env_vars.md) explicitly.
+PRE_INIT_KNOBS = (
+    # process wiring (set by horovodtpurun / ray / spark for workers)
+    "COORDINATOR_ADDR", "NUM_PROCESSES", "PROCESS_ID", "SECRET_KEY",
+    # read during/before init() itself
+    "LOG_LEVEL", "LOG_HIDE_TIME", "METRICS", "FAULT_SPEC",
+    # import-time gate for the native FFI tier
+    "USE_NATIVE_FFI",
+    # benchmark outage defense (runs pre-init, often in subprocesses)
+    "PEAK_TFLOPS", "COMPILE_CACHE", "PROBE_ATTEMPTS", "PROBE_RETRIES",
+    "PROBE_BACKOFF_S", "PROBE_BACKOFF", "PROBE_TIMEOUT_S",
+    "BENCH_EXEC_ATTEMPT",
+)
+
 _FAULT_MODES = {
     "collective": ("raise",),
     "fusion": ("raise",),

@@ -142,10 +142,10 @@ class ElasticDriver:
         self.failure_threshold = (
             failure_threshold if failure_threshold is not None
             else cfg.discovery_failure_threshold)
-        self._hosts: Dict[str, int] = {}
-        self._failures: Dict[str, int] = {}
-        self._blacklist: Dict[str, float] = {}   # host -> blacklisted-at
-        self._poll_failures = 0                  # consecutive discovery errors
+        self._hosts: Dict[str, int] = {}         # guarded-by: _lock
+        self._failures: Dict[str, int] = {}      # guarded-by: _lock
+        self._blacklist: Dict[str, float] = {}   # guarded-by: _lock (host -> blacklisted-at)
+        self._poll_failures = 0                  # guarded-by: _lock (consecutive discovery errors)
         self._callbacks: List[Callable[[Set[str], Set[str]], None]] = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -201,8 +201,8 @@ class ElasticDriver:
                 time.monotonic() - at >= self.blacklist_decay_s:
             # Half-open: eligible again, one strike short of the limit —
             # a single new failure re-blacklists without a full cycle.
-            del self._blacklist[host]
-            self._failures[host] = max(0, self.blacklist_after - 1)
+            del self._blacklist[host]  # hvdlint: disable=unguarded-mutation -- _locked suffix contract: every caller holds _lock (blacklisted(), poll_once())
+            self._failures[host] = max(0, self.blacklist_after - 1)  # hvdlint: disable=unguarded-mutation -- _locked suffix contract: every caller holds _lock
             _obs.on_blacklist("probation")
             logger.info("Blacklist decayed for host %s (probation)", host)
             return False

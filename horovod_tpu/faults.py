@@ -98,7 +98,7 @@ class FaultPlan:
     def __init__(self, clauses: Dict[str, FaultClause], raw: str) -> None:
         self.raw = raw
         self._sites = {site: _SiteState(c) for site, c in clauses.items()}
-        self.history: List[Tuple[str, int, str]] = []
+        self.history: List[Tuple[str, int, str]] = []  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def site(self, name: str) -> Optional[_SiteState]:
@@ -115,7 +115,7 @@ class FaultPlan:
                        site, mode, at, detail)
 
 
-_active: Optional[FaultPlan] = None
+_active: Optional[FaultPlan] = None   # guarded-by: _lock
 _lock = threading.Lock()
 
 

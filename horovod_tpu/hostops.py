@@ -102,7 +102,7 @@ def head_slots() -> List[int]:
 
 
 _slot_sets_lock = threading.Lock()
-_slot_sets: Dict[Tuple[int, ...], ProcessSet] = {}
+_slot_sets: Dict[Tuple[int, ...], ProcessSet] = {}   # guarded-by: _slot_sets_lock
 
 
 def slot_set(slot_ranks: Sequence[int]) -> ProcessSet:

@@ -21,8 +21,8 @@ logger = get_logger(__name__)
 ABI_VERSION = 2
 
 _lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_load_failed = False
+_lib: Optional[ctypes.CDLL] = None   # guarded-by: _lock
+_load_failed = False                 # guarded-by: _lock
 
 c_i8, c_i32, c_i64 = ctypes.c_int8, ctypes.c_int32, ctypes.c_int64
 c_int, c_dbl, c_void = ctypes.c_int, ctypes.c_double, ctypes.c_void_p

@@ -46,8 +46,8 @@ SO_PATH = os.path.join(_HERE, "libhvdtpu_ffi.so")
 _TARGETS = ("hvd_bucket_pack", "hvd_bucket_unpack", "hvd_adasum_combine")
 
 _lock = threading.Lock()
-_registered = False
-_failed = False
+_registered = False   # guarded-by: _lock
+_failed = False       # guarded-by: _lock
 
 
 def _needs_build() -> bool:

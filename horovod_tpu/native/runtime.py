@@ -412,7 +412,7 @@ class NativeTimeline:
 
     def __init__(self, path: str, mark_cycles: bool = False) -> None:
         self._lib = _lib()
-        self._h = self._lib.hvd_tl_open(path.encode(), int(mark_cycles))
+        self._h = self._lib.hvd_tl_open(path.encode(), int(mark_cycles))  # guarded-by: _hlock
         if not self._h:
             raise OSError(f"cannot open timeline file {path!r}")
         # Guards handle lifetime: close() frees the native writer, so a

@@ -156,7 +156,7 @@ class _Server(http.server.ThreadingHTTPServer):
     allow_reuse_address = True
 
 
-_server: Optional[_Server] = None
+_server: Optional[_Server] = None   # guarded-by: _server_lock
 _server_lock = threading.Lock()
 
 

@@ -89,7 +89,7 @@ class Counter:
 
     def __init__(self, lock: threading.RLock) -> None:
         self._lock = lock
-        self.value = 0.0
+        self.value = 0.0        # guarded-by: _lock
 
     def inc(self, n: float = 1.0) -> None:
         if n < 0:
@@ -105,7 +105,7 @@ class Gauge:
 
     def __init__(self, lock: threading.RLock) -> None:
         self._lock = lock
-        self.value: Optional[float] = None
+        self.value: Optional[float] = None   # guarded-by: _lock
 
     def set(self, v: float) -> None:
         with self._lock:
@@ -124,9 +124,9 @@ class Histogram:
 
     def __init__(self, lock: threading.RLock, window: int) -> None:
         self._lock = lock
-        self._ring = Ring(window)
-        self.count = 0
-        self.sum = 0.0
+        self._ring = Ring(window)   # guarded-by: _lock
+        self.count = 0              # guarded-by: _lock
+        self.sum = 0.0              # guarded-by: _lock
 
     def observe(self, v: float) -> None:
         with self._lock:
@@ -165,8 +165,8 @@ class MetricFamily:
         self._lock = lock
         self._window = window
         self._max_label_sets = max_label_sets
-        self._children: Dict[Tuple[Tuple[str, str], ...], Any] = {}
-        self._overflowed = False
+        self._children: Dict[Tuple[Tuple[str, str], ...], Any] = {}  # guarded-by: _lock
+        self._overflowed = False                                     # guarded-by: _lock
 
     _OVERFLOW_KEY = (("other", "true"),)
 
@@ -238,7 +238,7 @@ class MetricsRegistry:
         self._lock = threading.RLock()
         self.window = int(window)
         self.max_label_sets = int(max_label_sets)
-        self._families: Dict[str, MetricFamily] = {}
+        self._families: Dict[str, MetricFamily] = {}   # guarded-by: _lock
 
     def _family(self, name: str, kind: str, help: str,
                 window: Optional[int] = None) -> MetricFamily:

@@ -99,8 +99,8 @@ class ProcessSetTable:
 
     def __init__(self, global_mesh) -> None:
         self._lock = threading.Lock()
-        self._next_id = 0
-        self._table: Dict[int, ProcessSet] = {}
+        self._next_id = 0                       # guarded-by: _lock
+        self._table: Dict[int, ProcessSet] = {}  # guarded-by: _lock
         self._world_size = global_mesh.size
         self.global_process_set = self.register(ProcessSet(range(global_mesh.size)))
 

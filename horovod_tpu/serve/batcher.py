@@ -96,9 +96,9 @@ class ContinuousBatcher:
         self.max_new_tokens_cap = cfg.serve_max_new_tokens
         self.stats = ServingStats()
         self._lock = threading.Lock()
-        self._queue: List[ServeRequest] = []
-        self._slots: Dict[int, ServeRequest] = {}
-        self._killed: Optional[str] = None
+        self._queue: List[ServeRequest] = []         # guarded-by: _lock
+        self._slots: Dict[int, ServeRequest] = {}    # guarded-by: _lock
+        self._killed: Optional[str] = None           # guarded-by: _lock
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._wake = threading.Event()

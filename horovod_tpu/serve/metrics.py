@@ -36,15 +36,15 @@ class ServingStats:
 
     def __init__(self, window: int = 4096) -> None:
         self._lock = threading.Lock()
-        self._ttft_s = Ring(window)
-        self._tpot_s = Ring(window)
-        self._occupancy = Ring(window)
-        self._queue_depth = Ring(window)
-        self.completed = 0
-        self.rejected = 0
-        self.expired = 0
-        self.failed = 0
-        self.tokens_out = 0
+        self._ttft_s = Ring(window)       # guarded-by: _lock
+        self._tpot_s = Ring(window)       # guarded-by: _lock
+        self._occupancy = Ring(window)    # guarded-by: _lock
+        self._queue_depth = Ring(window)  # guarded-by: _lock
+        self.completed = 0                # guarded-by: _lock
+        self.rejected = 0                 # guarded-by: _lock
+        self.expired = 0                  # guarded-by: _lock
+        self.failed = 0                   # guarded-by: _lock
+        self.tokens_out = 0               # guarded-by: _lock
         self._t0 = time.monotonic()
 
     def record_request(self, ttft_s: float, n_tokens: int,

@@ -96,12 +96,14 @@ class ReplicaSpec:
 class _ReplicaState:
     def __init__(self, spec: ReplicaSpec):
         self.spec = spec
-        self.client: Optional[BasicClient] = None
-        self.strikes = 0
-        self.dead_until: Optional[float] = None   # None = healthy
-        self.inflight = 0
-        self.completed = 0
-        self.failed = 0
+        # Health/load state is owned by the Router that holds this
+        # replica entry — all mutation happens under ITS lock.
+        self.client: Optional[BasicClient] = None  # guarded-by: Router._lock
+        self.strikes = 0                           # guarded-by: Router._lock
+        self.dead_until: Optional[float] = None    # guarded-by: Router._lock
+        self.inflight = 0                          # guarded-by: Router._lock
+        self.completed = 0                         # guarded-by: Router._lock
+        self.failed = 0                            # guarded-by: Router._lock
 
 
 class Router:
@@ -131,7 +133,7 @@ class Router:
             base_delay_s=0.05, max_delay_s=2.0)
         self._lock = threading.Lock()
         self._rr = itertools.count()
-        self._done: "OrderedDict[str, GenerateResponse]" = OrderedDict()
+        self._done: "OrderedDict[str, GenerateResponse]" = OrderedDict()  # guarded-by: _lock
         self._dedupe_window = dedupe_window
 
     # --- health -------------------------------------------------------------

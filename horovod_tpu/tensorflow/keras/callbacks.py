@@ -129,6 +129,10 @@ class LearningRateWarmupCallback(keras.callbacks.Callback):
     def on_epoch_end(self, epoch, logs=None):
         if epoch + 1 == int(math.ceil(self.warmup_epochs)):
             _set_lr(self.model.optimizer, self.initial_lr)
+            # Rank-conditioned branches must stay collective-free (the
+            # hvdlint rank-divergent-collective gate checks this file):
+            # the LR set above runs on EVERY rank, only the log is
+            # rank-0.
             if self.verbose and rank() == 0:
                 print(f"\nEpoch {epoch + 1}: finished gradual learning "
                       f"rate warmup to {self.initial_lr}.")

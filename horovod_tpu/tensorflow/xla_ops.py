@@ -42,8 +42,8 @@ _SRC = os.path.join(os.path.dirname(_HERE), "native", "src", "tf_xla_ops.cc")
 _SO = os.path.join(os.path.dirname(_HERE), "native", "libhvdtpu_tf_xla.so")
 
 _lock = threading.Lock()
-_lib = None          # tf.load_op_library module
-_load_error: Optional[str] = None
+_lib = None          # guarded-by: _lock (tf.load_op_library module)
+_load_error: Optional[str] = None   # guarded-by: _lock
 
 # Trace-time closure table: table_key -> fn(np_in) -> np_out.  Keys are
 # allocated per op emission; entries live as long as the process (they

@@ -52,7 +52,7 @@ class CrossProcessMonitor:
         # dispatch path costs one lock + set probe for repeats and the
         # queue stays bounded by the distinct-name count.
         self._queue = NativeTensorQueue()
-        self._inflight: Set[str] = set()
+        self._inflight: Set[str] = set()   # guarded-by: _inflight_lock
         self._inflight_lock = threading.Lock()
         self._stop = threading.Event()
         self.failure: Optional[str] = None

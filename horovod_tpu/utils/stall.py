@@ -36,8 +36,8 @@ class StallInspector:
         self._shutdown_after_s = shutdown_after_s
         self._on_shutdown = on_shutdown or (lambda: os._exit(17))
         self._lock = threading.Lock()
-        self._last_activity: Optional[float] = None
-        self._warned = False
+        self._last_activity: Optional[float] = None  # guarded-by: _lock
+        self._warned = False                         # guarded-by: _lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 

@@ -45,9 +45,9 @@ class Timeline:
         self._path = path
         self._mark_cycles = mark_cycles
         self._lock = threading.Lock()
-        self._file = None
-        self._native = None
-        self._first = True
+        self._file = None     # guarded-by: _lock
+        self._native = None   # guarded-by: _lock
+        self._first = True    # guarded-by: _lock
         self._t0 = time.perf_counter_ns()
         if path:
             if use_native:

@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""hvdlint: distributed-correctness static analysis over horovod_tpu.
+
+Runs the AST analyzers (rank-divergent collectives, knob consistency,
+lock discipline + lock-order cycles, fault-site/metric registry drift)
+and — with ``--jaxpr`` — the traced-program analyzer that proves the
+train step's collective sequence identical across simulated ranks and
+consistent with the fusion planner's bucket schedule.  The check
+catalog, suppression syntax and policy live in docs/lint.md.
+
+Exit codes (the ``scripts/bench_regress.py`` contract so the same CI
+harness gates on both): 0 clean, 1 unsuppressed finding(s), 3 nothing
+analyzed (an empty run must be loud, not green), 2 internal error.
+
+Usage::
+
+    python scripts/hvdlint.py                 # table, AST tier only
+    python scripts/hvdlint.py --jaxpr         # + traced-program checks
+    python scripts/hvdlint.py --json out.json # artifact next to BENCH_*
+    python scripts/hvdlint.py --select rank-divergent-collective
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import types
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _import_analysis(light: bool):
+    """Import horovod_tpu.analysis; with ``light`` the parent package's
+    heavy import (jax) is bypassed via a namespace stub — the AST tier
+    answers in seconds with no accelerator stack, fit for a
+    pre-commit hook."""
+    sys.path.insert(0, str(REPO))
+    if light and "horovod_tpu" not in sys.modules:
+        stub = types.ModuleType("horovod_tpu")
+        stub.__path__ = [str(REPO / "horovod_tpu")]
+        sys.modules["horovod_tpu"] = stub
+    import horovod_tpu.analysis as analysis
+    return analysis
+
+
+def _table(findings) -> str:
+    if not findings:
+        return "hvdlint: clean (0 unsuppressed findings)"
+    w_loc = max(len(f"{f.path}:{f.line}") for f in findings)
+    w_chk = max(len(f.check) for f in findings)
+    lines = [f"hvdlint: {len(findings)} unsuppressed finding(s)", ""]
+    for f in findings:
+        loc = f"{f.path}:{f.line}"
+        lines.append(f"  {loc:<{w_loc}}  {f.severity:<7}  "
+                     f"{f.check:<{w_chk}}  {f.message}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="distributed-correctness static analysis "
+                    "(docs/lint.md)")
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="also write a JSON artifact (use '-' for stdout)")
+    ap.add_argument("--jaxpr", action="store_true",
+                    help="run the traced-program analyzer too (imports "
+                         "jax; seconds, not milliseconds)")
+    ap.add_argument("--select", action="append", default=None,
+                    metavar="CHECK-ID",
+                    help="run only these check ids (repeatable)")
+    ap.add_argument("--root", default=str(REPO),
+                    help="repo root (default: this script's repo)")
+    args = ap.parse_args(argv)
+
+    try:
+        analysis = _import_analysis(light=not args.jaxpr)
+        if args.select:
+            unknown = [c for c in args.select
+                       if c not in analysis.CHECK_CATALOG]
+            if unknown:
+                print(f"hvdlint: unknown check id(s) {unknown}; known: "
+                      f"{sorted(analysis.CHECK_CATALOG)}", file=sys.stderr)
+                return 2
+        if not analysis.iter_source_files(
+                analysis.LintConfig(root=Path(args.root))):
+            # An empty analysis must be loud, not green (the
+            # bench_regress "no shared metrics" analogue).
+            print(f"hvdlint: no python sources under {args.root}/"
+                  f"horovod_tpu — nothing analyzed", file=sys.stderr)
+            return 3
+        findings = analysis.run(Path(args.root), select=args.select)
+        if args.jaxpr and (args.select is None
+                           or "jaxpr-rank-divergence" in args.select):
+            findings = list(findings) + list(analysis.run_jaxpr_checks())
+            # In-process run with the full stack up: record lint state
+            # into the metrics registry (hvd_tpu_lint_findings_total).
+            analysis.record_findings_metric(findings)
+    except Exception as e:  # internal error ≠ finding ≠ clean
+        print(f"hvdlint: internal error: {e}", file=sys.stderr)
+        return 2
+
+    print(_table(findings))
+    if args.json:
+        payload = {
+            "tool": "hvdlint",
+            "root": str(args.root),
+            "jaxpr": bool(args.jaxpr),
+            "select": args.select,
+            "findings": [f.as_dict() for f in findings],
+            "counts": _counts(findings),
+        }
+        text = json.dumps(payload, indent=2, sort_keys=True)
+        if args.json == "-":
+            print(text)
+        else:
+            Path(args.json).write_text(text + "\n")
+            print(f"hvdlint: JSON artifact written to {args.json}")
+    return 1 if findings else 0
+
+
+def _counts(findings) -> dict:
+    out: dict = {}
+    for f in findings:
+        out[f.check] = out.get(f.check, 0) + 1
+    return out
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,591 @@
+"""hvdlint: the distributed-correctness static-analysis subsystem.
+
+Two layers of coverage:
+
+* **Fixture tests** — a minimal fake package per check with a good and
+  a bad variant, proving each analyzer fires exactly on its violation
+  class (rank-divergent collective, knob drift, lock discipline,
+  lock-order cycle, registry drift, suppression lifecycle) and that a
+  deliberately rank-divergent fused plan fails the jaxpr check.
+* **The gate** — every analyzer over the real package asserting ZERO
+  unsuppressed findings, which is what makes the invariants stick for
+  every future PR (acceptance criterion of the analysis issue).
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import analysis
+from horovod_tpu.analysis import jaxpr_check
+from horovod_tpu.analysis.core import LintConfig, run_checks
+from horovod_tpu.analysis.knobs import KnobChecker
+from horovod_tpu.analysis.locks import LockChecker
+from horovod_tpu.analysis.rank_divergence import RankDivergenceChecker
+from horovod_tpu.analysis.registries import (FaultSiteChecker,
+                                             MetricNameChecker)
+
+pytestmark = pytest.mark.analysis
+
+REPO = Path(__file__).resolve().parent.parent
+
+# Minimal config.py for fixture packages: enough surface for the knob
+# and fault-site checkers to key off (they parse THIS, not the real one).
+FIXTURE_CONFIG = '''
+import dataclasses, os
+
+PRE_INIT_KNOBS = ("PROCESS_ID",)
+FAULT_SITES = ("collective", "rpc")
+_NOOP_KNOBS = {"CYCLE_TIME": "no cycle loop here"}
+
+
+def _env(name, default=None):
+    for p in ("HOROVOD_", "HVD_TPU_"):
+        v = os.environ.get(p + name)
+        if v is not None:
+            return v
+    return default
+
+
+def _env_int(name, default):
+    v = _env(name)
+    return int(v) if v is not None else default
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    fusion_threshold: int = 1
+    cycle_time_ms: float = 1.0
+
+    @staticmethod
+    def from_env():
+        return Config(
+            fusion_threshold=_env_int("FUSION_THRESHOLD", 1),
+            cycle_time_ms=_env_int("CYCLE_TIME", 1),
+        )
+'''
+
+FIXTURE_ENV_DOC = """
+| `HOROVOD_FUSION_THRESHOLD` | 1 | bucket bytes |
+| `HOROVOD_CYCLE_TIME` | 1.0 | no-op |
+| `HVD_TPU_PROCESS_ID` | unset | rank wiring |
+"""
+
+FIXTURE_FAULT_DOC = """
+| `collective` | dispatch | raise | boom |
+| `rpc` | client | drop | gone |
+"""
+
+# Consumes Config.fusion_threshold so the fixture baseline is clean.
+FIXTURE_CONSUMER = "def use(cfg):\n    return cfg.fusion_threshold\n"
+
+
+def lint(tmp_path, files, checkers, docs=None, select=None):
+    """Materialize a fixture package and run the given checkers."""
+    pkg = tmp_path / "horovod_tpu"
+    pkg.mkdir(parents=True, exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    for rel, text in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+    docdir = tmp_path / "docs"
+    docdir.mkdir(exist_ok=True)
+    for name, text in {"env_vars.md": FIXTURE_ENV_DOC,
+                       "fault_injection.md": FIXTURE_FAULT_DOC,
+                       "metrics.md": "", **(docs or {})}.items():
+        (docdir / name).write_text(text)
+    cfg = LintConfig(root=tmp_path, select=select)
+    return run_checks(cfg, checker_classes=checkers)
+
+
+def checks_of(findings):
+    return sorted({f.check for f in findings})
+
+
+# --- rank-divergent collectives ---------------------------------------------
+
+BAD_RANK_BRANCH = """
+from . import rank, allreduce
+
+def log_and_sync(x):
+    if rank() == 0:
+        x = allreduce(x)   # only rank 0 reaches the rendezvous
+    return x
+"""
+
+BAD_RANK_EARLY_EXIT = """
+from . import rank, barrier
+
+def save(x):
+    r = rank()
+    if r != 0:
+        return None
+    barrier()   # only rank 0 still executing
+    return x
+"""
+
+GOOD_RANK_BRANCH = """
+from . import rank, allreduce
+
+def log_and_sync(x):
+    x = allreduce(x)       # every rank participates...
+    if rank() == 0:
+        print("synced", x)  # ...and only the log is rank-conditioned
+    return x
+"""
+
+
+def test_rank_divergent_collective_positive(tmp_path):
+    fs = lint(tmp_path, {"m.py": BAD_RANK_BRANCH},
+              [RankDivergenceChecker])
+    assert checks_of(fs) == ["rank-divergent-collective"]
+    assert "allreduce" in fs[0].message
+
+
+def test_rank_divergent_early_exit_positive(tmp_path):
+    fs = lint(tmp_path, {"m.py": BAD_RANK_EARLY_EXIT},
+              [RankDivergenceChecker])
+    assert checks_of(fs) == ["rank-divergent-collective"]
+    assert "early exit" in fs[0].message
+
+
+def test_rank_conditioned_logging_negative(tmp_path):
+    # The keras-callbacks pattern: rank-0 verbose print, collective
+    # hoisted out — provably collective-free conditioned branch.
+    fs = lint(tmp_path, {"m.py": GOOD_RANK_BRANCH},
+              [RankDivergenceChecker])
+    assert fs == []
+
+
+def test_keras_callbacks_rank_branches_are_collective_free(tmp_path):
+    """The real tensorflow/keras/callbacks.py: its rank-0-verbose
+    logging (and every sibling rank-conditioned path) must stay
+    provably collective-free — this pins the file specifically, beyond
+    the whole-tree gate."""
+    src = (REPO / "horovod_tpu" / "tensorflow" / "keras"
+           / "callbacks.py").read_text()
+    fs = lint(tmp_path, {"callbacks.py": src}, [RankDivergenceChecker])
+    assert fs == [], "\n".join(f.format() for f in fs)
+
+
+# --- knob consistency --------------------------------------------------------
+
+def test_unknown_knob(tmp_path):
+    fs = lint(tmp_path,
+              {"config.py": FIXTURE_CONFIG, "c.py": FIXTURE_CONSUMER,
+               "m.py": 'import os\nV = os.environ.get("HVD_TPU_MYSTERY")\n'},
+              [KnobChecker])
+    assert "unknown-knob" in checks_of(fs)
+
+
+def test_raw_env_read_of_declared_knob(tmp_path):
+    fs = lint(tmp_path,
+              {"config.py": FIXTURE_CONFIG, "c.py": FIXTURE_CONSUMER,
+               "m.py": 'import os\n'
+                       'V = os.environ.get("HVD_TPU_FUSION_THRESHOLD")\n'},
+              [KnobChecker])
+    assert "raw-env-read" in checks_of(fs)
+
+
+def test_pre_init_knob_read_is_allowed(tmp_path):
+    fs = lint(tmp_path,
+              {"config.py": FIXTURE_CONFIG, "c.py": FIXTURE_CONSUMER,
+               "m.py": 'import os\n'
+                       'V = os.environ.get("HVD_TPU_PROCESS_ID")\n'},
+              [KnobChecker])
+    assert fs == []
+
+
+def test_undocumented_knob(tmp_path):
+    fs = lint(tmp_path,
+              {"config.py": FIXTURE_CONFIG, "c.py": FIXTURE_CONSUMER},
+              [KnobChecker],
+              docs={"env_vars.md": "| `HOROVOD_CYCLE_TIME` | 1.0 | x |\n"
+                                   "| `HVD_TPU_PROCESS_ID` | unset | x |\n"})
+    assert checks_of(fs) == ["undocumented-knob"]
+    assert "FUSION_THRESHOLD" in fs[0].message
+
+
+def test_unconsumed_knob(tmp_path):
+    # No module reads .fusion_threshold -> dead knob.  cycle_time_ms is
+    # in _NOOP_KNOBS, so it stays exempt.
+    fs = lint(tmp_path, {"config.py": FIXTURE_CONFIG}, [KnobChecker])
+    assert checks_of(fs) == ["unconsumed-knob"]
+    assert "fusion_threshold" in fs[0].message
+
+
+# --- lock discipline ---------------------------------------------------------
+
+BAD_LOCK = """
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []   # guarded-by: _lock
+
+    def ok(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def racy(self, x):
+        self._items.append(x)   # no lock held
+"""
+
+GOOD_LOCK = BAD_LOCK.replace(
+    "    def racy(self, x):\n        self._items.append(x)   # no lock held\n",
+    "")
+
+LOCK_CYCLE = """
+import threading
+
+_la = threading.Lock()
+_lb = threading.Lock()
+
+def ab():
+    with _la:
+        with _lb:
+            pass
+
+def ba():
+    with _lb:
+        with _la:
+            pass
+"""
+
+CROSS_FN_CYCLE = """
+import threading
+
+_la = threading.Lock()
+_lb = threading.Lock()
+
+def inner_b():
+    with _lb:
+        pass
+
+def holds_a():
+    with _la:
+        inner_b()
+
+def inner_a():
+    with _la:
+        pass
+
+def holds_b():
+    with _lb:
+        inner_a()
+"""
+
+
+def test_unguarded_mutation_positive(tmp_path):
+    fs = lint(tmp_path, {"m.py": BAD_LOCK}, [LockChecker])
+    assert checks_of(fs) == ["unguarded-mutation"]
+    assert "_items" in fs[0].message
+
+
+def test_guarded_mutation_negative(tmp_path):
+    assert lint(tmp_path, {"m.py": GOOD_LOCK}, [LockChecker]) == []
+
+
+def test_lock_order_cycle_nested(tmp_path):
+    fs = lint(tmp_path, {"m.py": LOCK_CYCLE}, [LockChecker])
+    assert checks_of(fs) == ["lock-order-cycle"]
+    assert "_la" in fs[0].message and "_lb" in fs[0].message
+
+
+def test_lock_order_cycle_one_line_with(tmp_path):
+    # `with _la, _lb:` vs `with _lb, _la:` — the ABBA one-liner form
+    # must edge exactly like the nested form.
+    src = ("import threading\n"
+           "_la = threading.Lock()\n"
+           "_lb = threading.Lock()\n"
+           "def ab():\n"
+           "    with _la, _lb:\n"
+           "        pass\n"
+           "def ba():\n"
+           "    with _lb, _la:\n"
+           "        pass\n")
+    fs = lint(tmp_path, {"m.py": src}, [LockChecker])
+    assert checks_of(fs) == ["lock-order-cycle"]
+
+
+def test_lock_order_cycle_through_calls(tmp_path):
+    # A->B via holds_a->inner_b, B->A via holds_b->inner_a: cycle only
+    # visible through the call graph.
+    fs = lint(tmp_path, {"m.py": CROSS_FN_CYCLE}, [LockChecker])
+    assert checks_of(fs) == ["lock-order-cycle"]
+
+
+def test_lock_order_no_cycle(tmp_path):
+    fs = lint(tmp_path,
+              {"m.py": LOCK_CYCLE.replace(
+                  "with _lb:\n        with _la:", "with _lb:\n        if 1:")},
+              [LockChecker])
+    assert fs == []
+
+
+def test_unguarded_mutation_inside_closure(tmp_path):
+    # Thread-target closures execute later, NOT under any enclosing
+    # with — their mutations must stay visible to the checker.
+    src = BAD_LOCK.replace(
+        "    def racy(self, x):\n        self._items.append(x)   # no lock held\n",
+        "    def spawn(self, x):\n"
+        "        def worker():\n"
+        "            self._items.append(x)   # closure, no lock held\n"
+        "        return worker\n")
+    fs = lint(tmp_path, {"m.py": src}, [LockChecker])
+    assert checks_of(fs) == ["unguarded-mutation"]
+
+
+def test_wrong_lock_does_not_satisfy_guard(tmp_path):
+    # Holding a DIFFERENT object's same-named lock is the race this
+    # check exists for — exact lock identity is required.
+    src = """
+import threading
+
+class Box:
+    def __init__(self, other):
+        self._lock = threading.Lock()
+        self._other = other
+        self._items = []   # guarded-by: _lock
+
+    def racy(self, x):
+        with self._other._lock:
+            self._items.append(x)   # wrong lock!
+"""
+    fs = lint(tmp_path, {"m.py": src}, [LockChecker])
+    assert checks_of(fs) == ["unguarded-mutation"]
+
+
+# --- suppressions ------------------------------------------------------------
+
+def test_suppression_honored(tmp_path):
+    suppressed = BAD_LOCK.replace(
+        "self._items.append(x)   # no lock held",
+        "self._items.append(x)   # hvdlint: disable=unguarded-mutation "
+        "-- fixture: caller holds the lock")
+    assert lint(tmp_path, {"m.py": suppressed}, [LockChecker]) == []
+
+
+def test_suppression_expired_is_reported(tmp_path):
+    # A suppression matching nothing must not rot silently.
+    fs = lint(tmp_path,
+              {"m.py": GOOD_LOCK + "\nX = 1  # hvdlint: "
+               "disable=unguarded-mutation -- stale excuse\n"},
+              [LockChecker])
+    assert checks_of(fs) == ["useless-suppression"]
+
+
+def test_suppression_without_justification_is_a_finding(tmp_path):
+    fs = lint(tmp_path,
+              {"m.py": "X = 1  # hvdlint: disable=unguarded-mutation\n"},
+              [LockChecker])
+    assert checks_of(fs) == ["bad-suppression"]
+
+
+def test_suppression_unknown_id_is_a_finding(tmp_path):
+    fs = lint(tmp_path,
+              {"m.py": "X = 1  # hvdlint: disable=not-a-check -- why\n"},
+              [LockChecker])
+    assert checks_of(fs) == ["bad-suppression"]
+
+
+def test_select_scoped_run_keeps_suppressions_matched(tmp_path):
+    # A --select run that deselects the suppressed check must not
+    # misread the (legitimate) suppression as useless: matching happens
+    # against the full finding set, filtering after.
+    suppressed = BAD_LOCK.replace(
+        "self._items.append(x)   # no lock held",
+        "self._items.append(x)   # hvdlint: disable=unguarded-mutation "
+        "-- fixture: caller holds the lock")
+    fs = lint(tmp_path, {"m.py": suppressed}, [LockChecker],
+              select=["useless-suppression"])
+    assert fs == []
+
+
+def test_suppression_in_string_literal_is_ignored(tmp_path):
+    fs = lint(tmp_path,
+              {"m.py": 'DOC = "# hvdlint: disable=unguarded-mutation"\n'},
+              [LockChecker])
+    assert fs == []
+
+
+# --- registry consistency ----------------------------------------------------
+
+def test_unknown_fault_site(tmp_path):
+    fs = lint(tmp_path,
+              {"config.py": FIXTURE_CONFIG, "c.py": FIXTURE_CONSUMER,
+               "m.py": "from . import faults\n\n"
+                       "def drill():\n"
+                       '    with faults.inject("nosite:step=1"):\n'
+                       "        pass\n"},
+              [FaultSiteChecker])
+    assert checks_of(fs) == ["unknown-fault-site"]
+
+
+def test_fault_site_doc_drift(tmp_path):
+    fs = lint(tmp_path,
+              {"config.py": FIXTURE_CONFIG, "c.py": FIXTURE_CONSUMER},
+              [FaultSiteChecker],
+              docs={"fault_injection.md": "| `collective` | x | raise | y |\n"})
+    assert checks_of(fs) == ["fault-site-doc-drift"]
+    assert "rpc" in fs[0].message
+
+
+def test_metric_naming_rules(tmp_path):
+    src = (
+        "def instrument(reg):\n"
+        '    reg.counter("hvd_tpu_good_total").inc()\n'
+        '    reg.counter("hvd_tpu_bad_counter").inc()\n'      # no _total
+        '    reg.gauge("hvd_tpu_bad_gauge_total").set(1)\n'   # _total gauge
+    )
+    fs = lint(tmp_path, {"m.py": src}, [MetricNameChecker],
+              docs={"metrics.md": "hvd_tpu_good_total hvd_tpu_bad_counter "
+                                  "hvd_tpu_bad_gauge_total"})
+    assert checks_of(fs) == ["metric-name"]
+    assert len(fs) == 2
+
+
+def test_metric_doc_drift(tmp_path):
+    fs = lint(tmp_path,
+              {"m.py": 'def f(reg):\n'
+                       '    reg.counter("hvd_tpu_undocumented_total")\n'},
+              [MetricNameChecker], docs={"metrics.md": "# catalog\n"})
+    assert checks_of(fs) == ["metric-doc-drift"]
+
+
+# --- jaxpr analyzer ----------------------------------------------------------
+
+def _toy():
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, batch):
+        x, y = batch
+        return jnp.mean((x @ params["w"] + params["b"] - y) ** 2)
+
+    params = {"w": jnp.zeros((64, 32)), "b": jnp.zeros((32,))}
+    tx = optax.sgd(0.1)
+    batch = (jnp.ones((16, 64)), jnp.ones((16, 32)))
+    return loss_fn, params, tx, batch
+
+
+def test_jaxpr_checks_pass_on_shipped_factories():
+    assert analysis.run_jaxpr_checks() == []
+
+
+def test_jaxpr_check_catches_rank_divergent_fused_plan():
+    import jax
+
+    from horovod_tpu.optim.distributed_optimizer import make_train_step
+
+    loss_fn, params, tx, batch = _toy()
+
+    def bad_factory():
+        # Deliberately rank-divergent fused plan: rank 0 compiles the
+        # overlapped RS+AG wire, every other rank the plain allreduce —
+        # the schedules rendezvous differently and would deadlock.
+        if jax.process_index() == 0:
+            return make_train_step(loss_fn, tx, microbatches=2,
+                                   overlap=True)
+        return make_train_step(loss_fn, tx)
+
+    fs = jaxpr_check.check_step_rank_consistency(
+        bad_factory, lambda: (params, tx.init(params), batch))
+    assert len(fs) == 1
+    assert fs[0].check == "jaxpr-rank-divergence"
+    assert "reduce_scatter" in fs[0].message
+
+
+def test_jaxpr_extractor_sees_collectives_in_subjaxprs():
+    import jax
+
+    from horovod_tpu.optim.distributed_optimizer import make_train_step
+
+    loss_fn, params, tx, batch = _toy()
+    step = make_train_step(loss_fn, tx, microbatches=2, overlap=True)
+    jaxpr = jax.make_jaxpr(lambda *a: step(*a))(params, tx.init(params),
+                                                batch)
+    seq = jaxpr_check.extract_collective_sequence(jaxpr)
+    # 1 bucket x 2 microbatches reduce-scatter + 1 deferred all-gather
+    # + the loss-mean psum, all nested under shard_map/scan/pjit.
+    assert sum(1 for p in seq if "reduce_scatter" in p) == 2
+    assert sum(1 for p in seq if "all_gather" in p) == 1
+
+
+# --- observability tie-in ----------------------------------------------------
+
+def test_lint_findings_metric_recorded():
+    from horovod_tpu.analysis.core import Finding
+    from horovod_tpu.obs import metrics as obs_metrics
+
+    analysis.record_findings_metric([
+        Finding("unknown-knob", "x.py", 1, "m"),
+        Finding("unknown-knob", "y.py", 2, "m"),
+        Finding("metric-name", "z.py", 3, "m"),
+    ])
+    snap = obs_metrics.registry().snapshot()
+    series = {tuple(sorted(s["labels"].items())): s["value"]
+              for s in snap["hvd_tpu_lint_findings_total"]}
+    assert series[(("check", "unknown-knob"),)] >= 2
+    assert series[(("check", "metric-name"),)] >= 1
+
+
+# --- the gate ----------------------------------------------------------------
+
+def test_repo_tree_is_clean():
+    """THE acceptance invariant: zero unsuppressed findings over the
+    shipped package.  Any future PR that introduces a rank-divergent
+    collective, an undocumented knob, an unguarded mutation or catalog
+    drift fails tier-1 right here."""
+    findings = analysis.run(REPO)
+    assert findings == [], "\n" + "\n".join(f.format() for f in findings)
+
+
+def test_check_catalog_matches_docs():
+    """docs/lint.md documents every check id (and no stale ones)."""
+    doc = (REPO / "docs" / "lint.md").read_text()
+    for check_id in analysis.CHECK_CATALOG:
+        assert f"`{check_id}`" in doc, f"{check_id} missing from docs/lint.md"
+
+
+def test_cli_exit_contract(tmp_path):
+    """scripts/hvdlint.py: 0 on the clean tree + JSON artifact shape."""
+    out = tmp_path / "lint.json"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "hvdlint.py"),
+         "--json", str(out)],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(out.read_text())
+    assert payload["tool"] == "hvdlint"
+    assert payload["findings"] == []
+    assert payload["counts"] == {}
+
+
+def test_cli_nonzero_on_findings(tmp_path):
+    """A planted violation exits 1 and lands in the artifact."""
+    pkg = tmp_path / "horovod_tpu"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "config.py").write_text(FIXTURE_CONFIG)
+    (pkg / "c.py").write_text(FIXTURE_CONSUMER)
+    (pkg / "bad.py").write_text(BAD_RANK_BRANCH)
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "env_vars.md").write_text(FIXTURE_ENV_DOC)
+    (docs / "fault_injection.md").write_text(FIXTURE_FAULT_DOC)
+    (docs / "metrics.md").write_text("")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "hvdlint.py"),
+         "--root", str(tmp_path), "--json", "-"],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "rank-divergent-collective" in proc.stdout
