@@ -56,6 +56,10 @@ def main() -> None:
                         choices=["none", "fp16", "bf16", "int8"],
                         help="gradient-wire compression tier "
                              "(hvd.Compression.<tier>)")
+    parser.add_argument("--trace", default=None, metavar="DIR",
+                        help="write a merged per-run trace artifact "
+                             "(Perfetto JSON + critical-path report; "
+                             "docs/tracing.md) into DIR")
     args = parser.parse_args()
     if args.microbatches < 0:
         parser.error("--microbatches must be >= 0")
@@ -229,6 +233,17 @@ def main() -> None:
     if "mfu_pct" in out:
         obs_instr.set_mfu(out["mfu_pct"])
     out["metrics"] = obs_export.json_snapshot()["metrics"]
+    if args.trace:
+        # Merged per-run trace artifact (single-process merge) plus the
+        # headline critical-path report embedded under "trace" — a
+        # diagnostic block like "metrics"; bench_regress skips both.
+        from horovod_tpu.obs import trace as obs_trace
+
+        os.makedirs(args.trace, exist_ok=True)
+        tpath = os.path.join(args.trace, f"TRACE_{out['metric']}.json")
+        rep = obs_trace.dump_merged(tpath)
+        out["trace"] = {"file": tpath,
+                        **({"critical_path": rep} if rep else {})}
     print(json.dumps(out))
     sys.stdout.flush()
 

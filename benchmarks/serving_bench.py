@@ -65,6 +65,10 @@ def main() -> None:
     parser.add_argument("--cpu-mesh", action="store_true",
                         help="force the virtual CPU mesh (functional "
                              "check, not a perf number)")
+    parser.add_argument("--trace", default=None, metavar="DIR",
+                        help="write a merged per-run trace artifact "
+                             "(Perfetto JSON + critical-path report; "
+                             "docs/tracing.md) into DIR")
     parser.add_argument("--out", default=None,
                         help="also write the full run as a JSON artifact")
     args = parser.parse_args()
@@ -84,6 +88,7 @@ def main() -> None:
     import jax.numpy as jnp
 
     from horovod_tpu.models.transformer import GPT, GPTConfig
+    from horovod_tpu.obs import trace as obs_trace
     from horovod_tpu.serve import (ContinuousBatcher, InferenceEngine,
                                    QueueFullError, SamplingParams,
                                    ServingStats)
@@ -117,17 +122,44 @@ def main() -> None:
                               temperature=args.temperature,
                               top_k=args.top_k)
 
+    def submit_one(prompt):
+        if not args.trace:
+            return batcher.submit(prompt, sampling)
+        # --trace: root one trace per request at admission (the router's
+        # job in a real deployment).  submit() only enqueues, so the
+        # root span's interval (submit -> finish) is only known at
+        # completion: mint the identity now — the batcher captures it,
+        # parenting its queued/prefill/decode phases under it — and
+        # record the span itself in drive() once the request finishes.
+        with obs_trace.use_context(obs_trace.new_context()):
+            return batcher.submit(prompt, sampling)
+
     def drive(prompts):
         pending = collections.deque(prompts)
         live = []
         while pending or any(not r.done.is_set() for r in live):
             while pending:
                 try:
-                    live.append(batcher.submit(pending[0], sampling))
+                    live.append(submit_one(pending[0]))
                     pending.popleft()
                 except QueueFullError:
                     break
             batcher.step()
+        if args.trace:
+            # Deferred roots: each request's span covers its full
+            # submit->finish latency (monotonic, re-anchored onto the
+            # span clock like the batcher's phases), so the artifact's
+            # critical-path report attributes real request latency.
+            now_us, now_mono = obs_trace.now_us(), time.monotonic()
+            for r in live:
+                if r.trace_ctx is None or r.finished_at is None:
+                    continue
+                obs_trace.record_span(
+                    "hvd_tpu_serve_request", parent=None,
+                    start_us=now_us - (now_mono - r.submitted_at) * 1e6,
+                    dur_us=(r.finished_at - r.submitted_at) * 1e6,
+                    ctx=r.trace_ctx,
+                    args={"bench": METRIC, "tokens": len(r.tokens)})
         return live
 
     # Warmup compiles EVERY prefill bucket plus the decoder — a bucket
@@ -138,6 +170,8 @@ def main() -> None:
     warm += [mk_prompt() for _ in range(max(0, args.warmup - len(warm)))]
     drive(warm)
     batcher.stats = ServingStats()  # measured window starts clean
+    if args.trace:
+        obs_trace.clear()   # the artifact covers the measured window only
     t0 = time.perf_counter()
     done = drive([mk_prompt() for _ in range(args.requests)])
     elapsed = time.perf_counter() - t0
@@ -174,6 +208,16 @@ def main() -> None:
         "model": {"layers": args.layers, "d_model": args.d_model,
                   "heads": args.heads, "vocab": args.vocab},
     }
+    trace_block = None
+    if args.trace:
+        # Merged per-run trace artifact (single-process merge) — a
+        # diagnostic block like "metrics"; bench_regress skips "trace".
+        os.makedirs(args.trace, exist_ok=True)
+        tpath = os.path.join(args.trace, f"TRACE_{METRIC}.json")
+        rep = obs_trace.dump_merged(tpath)
+        trace_block = {"file": tpath,
+                       **({"critical_path": rep} if rep else {})}
+        summary["trace"] = trace_block
     print(json.dumps(summary))
     if args.out:
         # Diagnostic telemetry block (bench_regress skips "metrics").
@@ -183,7 +227,8 @@ def main() -> None:
             json.dump({"platform": jax.default_backend(),
                        "device_kind": jax.devices()[0].device_kind,
                        "summary": summary, "stats": snap, "rows": rows,
-                       "metrics": obs_export.json_snapshot()["metrics"]},
+                       "metrics": obs_export.json_snapshot()["metrics"],
+                       **({"trace": trace_block} if trace_block else {})},
                       f, indent=1)
 
 

@@ -39,9 +39,10 @@ def default_checkers() -> List[type]:
     from .knobs import KnobChecker
     from .locks import LockChecker
     from .rank_divergence import RankDivergenceChecker
-    from .registries import FaultSiteChecker, MetricNameChecker
+    from .registries import (FaultSiteChecker, MetricNameChecker,
+                             SpanNameChecker)
     return [RankDivergenceChecker, KnobChecker, LockChecker,
-            FaultSiteChecker, MetricNameChecker]
+            FaultSiteChecker, MetricNameChecker, SpanNameChecker]
 
 
 def repo_root() -> Path:

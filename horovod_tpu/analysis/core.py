@@ -97,6 +97,12 @@ CHECK_CATALOG: "Dict[str, Tuple[str, str]]" = {
     "metric-doc-drift": (
         "error", "registered obs metric missing from the docs/metrics.md "
                  "catalog"),
+    "span-name": (
+        "error", "trace span violates naming rules (hvd_tpu_ prefix on "
+                 "every literal span/record_span/instant name)"),
+    "span-doc-drift": (
+        "error", "recorded trace span missing from the docs/tracing.md "
+                 "span catalog"),
     "jaxpr-rank-divergence": (
         "error", "traced train-step collective sequence differs across "
                  "simulated rank environments, or disagrees with the "
@@ -231,6 +237,7 @@ class LintConfig:
     env_vars_doc: str = "docs/env_vars.md"
     fault_doc: str = "docs/fault_injection.md"
     metrics_doc: str = "docs/metrics.md"
+    tracing_doc: str = "docs/tracing.md"
     select: Optional[Sequence[str]] = None   # None = all checks
     exclude_dirs: Tuple[str, ...] = ("__pycache__",)
 

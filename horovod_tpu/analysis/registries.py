@@ -17,6 +17,16 @@ checked against their single sources of truth:
   counters end ``_total``, gauges/histograms must not — and appear in
   the ``docs/metrics.md`` catalog.  Dashboards are written against the
   docs; an undocumented series is invisible operational surface.
+* **Span names** (``span-name`` / ``span-doc-drift``).  Literal span
+  names passed to the tracing layer (``trace.span("…")`` /
+  ``trace.record_span("…")`` / ``trace.instant("…")`` on any
+  trace-module receiver, plus the ``_record_phase(req, "…", …)``
+  span-forwarding helper convention) must carry the ``hvd_tpu_`` prefix
+  and have a
+  row in the ``docs/tracing.md`` span catalog — ``trace_merge``'s
+  critical-path reports and the flight-recorder postmortems are read
+  against that catalog, so an undocumented span is a hop nobody can
+  attribute.
 """
 
 from __future__ import annotations
@@ -176,6 +186,72 @@ class MetricNameChecker(Checker):
                     "metric-doc-drift", path, line,
                     f"{kind} {name!r} is registered but missing from the "
                     f"{self.cfg.metrics_doc} catalog")
+
+
+class SpanNameChecker(Checker):
+    checks = ("span-name", "span-doc-drift")
+
+    _FUNCS = ("span", "record_span", "instant")
+    _FORWARDER = "_record_phase"
+
+    def __init__(self, cfg: LintConfig) -> None:
+        super().__init__(cfg)
+        # name -> (path, line) first recording seen
+        self.spans: Dict[str, Tuple[str, int]] = {}
+
+    def check_module(self, mod: SourceModule) -> None:
+        if mod.path.endswith("obs/trace.py"):
+            return  # the generic tracing layer itself records nothing
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            term = _terminal(node.func)
+            if term == self._FORWARDER and len(node.args) >= 2:
+                # span-forwarding helper convention: name is the second
+                # positional (``self._record_phase(req, "name", ...)``)
+                arg = node.args[1]
+            elif term in self._FUNCS and _trace_receiver(node.func) \
+                    and node.args:
+                arg = node.args[0]
+            else:
+                continue
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                continue
+            name = arg.value
+            if not name.startswith("hvd_tpu_"):
+                self.emit(
+                    "span-name", mod.path, node.lineno,
+                    f"span {name!r} must carry the hvd_tpu_ prefix "
+                    f"({self.cfg.tracing_doc} naming rules)")
+                continue
+            self.spans.setdefault(name, (mod.path, node.lineno))
+
+    def finalize(self) -> None:
+        doc = self.cfg.doc_text(self.cfg.tracing_doc)
+        documented = set(re.findall(r"hvd_tpu_[a-z0-9_]+", doc))
+        for name, (path, line) in sorted(self.spans.items()):
+            if name not in documented:
+                self.emit(
+                    "span-doc-drift", path, line,
+                    f"span {name!r} is recorded but missing from the "
+                    f"{self.cfg.tracing_doc} span catalog")
+
+
+def _trace_receiver(func: ast.expr) -> bool:
+    """Is the receiver the tracing module (``trace.span``,
+    ``trace_mod.record_span``, ``_trace.instant``)?  Same-named methods
+    exist elsewhere (``Timeline`` has free-form track names) and are
+    not held to span rules."""
+    if not isinstance(func, ast.Attribute):
+        return False
+    recv = func.value
+    text = ""
+    if isinstance(recv, ast.Attribute):
+        text = recv.attr
+    elif isinstance(recv, ast.Name):
+        text = recv.id
+    return "trace" in text.lower()
 
 
 def _metric_receiver(func: ast.expr) -> bool:

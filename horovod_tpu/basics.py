@@ -184,10 +184,19 @@ def init(config: Optional[Config] = None) -> None:
         # Telemetry gate + optional local scrape port.  The registry is
         # NOT reset here: like the fault plan above, counters span the
         # process across elastic re-inits so rates stay meaningful.
+        from .obs import flight as _obs_flight
         from .obs import metrics as _obs_metrics
+        from .obs import trace as _obs_trace
 
         _obs_metrics.configure(enabled=cfg.metrics,
                                window=cfg.metrics_window)
+        # Tracing + flight recorder: pin the lazy env gates to the
+        # resolved Config; like the metrics registry, the span/event
+        # rings are NOT cleared across elastic re-inits.
+        _obs_trace.configure(enabled=cfg.trace, ring=cfg.trace_ring)
+        _obs_flight.configure(enabled=cfg.flight,
+                              directory=cfg.flight_dir,
+                              ring=cfg.flight_ring)
         if cfg.metrics and cfg.metrics_port > 0:
             from .obs import export as _obs_export
 

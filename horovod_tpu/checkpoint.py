@@ -36,6 +36,7 @@ import numpy as np
 
 from . import faults as faults_mod
 from ._compat import sanitize_checkpoint_tree
+from .obs import trace as trace_mod
 from .utils.logging import get_logger
 from .utils.retry import RetryPolicy, retry_call
 
@@ -194,6 +195,10 @@ class Checkpointer:
         """Write ``tree`` as checkpoint ``step`` (async by default) plus
         its digest sidecar.  Returns False if the manager's save policy
         skipped it."""
+        with trace_mod.span("hvd_tpu_ckpt_save", args={"step": int(step)}):
+            return self._traced_save(step, tree, force=force)
+
+    def _traced_save(self, step: int, tree: Any, *, force: bool) -> bool:
         import orbax.checkpoint as ocp
 
         tree = sanitize_checkpoint_tree(tree)
@@ -242,19 +247,21 @@ class Checkpointer:
         )
 
     def _verified_restore(self, step: int, template: Optional[Any]) -> Any:
-        got = self._restore_step(step, template)
-        # Digest verification is byte-exact, so it only applies to
-        # as-saved restores: a template legitimately *transforms* the
-        # content (dtype casts, shardings — orbax restores into the
-        # template's spec), which is not corruption.
-        if self._verify and template is None:
-            want = self._read_digest(step)
-            if want is not None and _digestable(got) \
-                    and pytree_digest(got) != want:
-                raise CheckpointCorruptionError(
-                    f"checkpoint step {step} failed digest verification "
-                    f"under {self._dir}")
-        return got
+        with trace_mod.span("hvd_tpu_ckpt_restore",
+                            args={"step": int(step)}):
+            got = self._restore_step(step, template)
+            # Digest verification is byte-exact, so it only applies to
+            # as-saved restores: a template legitimately *transforms* the
+            # content (dtype casts, shardings — orbax restores into the
+            # template's spec), which is not corruption.
+            if self._verify and template is None:
+                want = self._read_digest(step)
+                if want is not None and _digestable(got) \
+                        and pytree_digest(got) != want:
+                    raise CheckpointCorruptionError(
+                        f"checkpoint step {step} failed digest "
+                        f"verification under {self._dir}")
+            return got
 
     def restore(self, step: Optional[int] = None,
                 template: Optional[Any] = None,

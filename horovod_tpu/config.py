@@ -54,6 +54,9 @@ PRE_INIT_KNOBS = (
     "COORDINATOR_ADDR", "NUM_PROCESSES", "PROCESS_ID", "SECRET_KEY",
     # read during/before init() itself
     "LOG_LEVEL", "LOG_HIDE_TIME", "METRICS", "FAULT_SPEC",
+    # tracing + flight recorder (lazy env gates — launcher/agent
+    # processes and crash paths read them before/without init)
+    "TRACE", "FLIGHT", "FLIGHT_DIR",
     # import-time gate for the native FFI tier
     "USE_NATIVE_FFI",
     # benchmark outage defense (runs pre-init, often in subprocesses)
@@ -334,6 +337,13 @@ class Config:
     metrics_port: int = 0                     # HVD_TPU_METRICS_PORT (0 = no local HTTP scrape port)
     metrics_window: int = 1024                # HVD_TPU_METRICS_WINDOW (histogram ring size)
     straggler_factor: float = 2.0             # HVD_TPU_STRAGGLER_FACTOR (x world-median step time)
+    # Distributed tracing + crash flight recorder (horovod_tpu/obs/
+    # trace.py + flight.py; docs/tracing.md).
+    trace: bool = True                        # HVD_TPU_TRACE (span recording gate)
+    trace_ring: int = 2048                    # HVD_TPU_TRACE_RING (per-process span ring size)
+    flight: bool = True                       # HVD_TPU_FLIGHT (crash-dump gate)
+    flight_dir: str = ""                      # HVD_TPU_FLIGHT_DIR ("" = <tempdir>/hvd_tpu_flight)
+    flight_ring: int = 512                    # HVD_TPU_FLIGHT_RING (event ring size)
 
     # --- stall detection (reference: stall_inspector.cc) ---
     stall_check_disable: bool = False         # HOROVOD_STALL_CHECK_DISABLE
@@ -412,6 +422,11 @@ class Config:
             metrics_port=_env_int("METRICS_PORT", 0),
             metrics_window=_env_pos_int("METRICS_WINDOW", 1024),
             straggler_factor=_env_straggler_factor(),
+            trace=_env_bool("TRACE", True),
+            trace_ring=_env_pos_int("TRACE_RING", 2048),
+            flight=_env_bool("FLIGHT", True),
+            flight_dir=_env("FLIGHT_DIR", "") or "",
+            flight_ring=_env_pos_int("FLIGHT_RING", 512),
             log_level=(_env("LOG_LEVEL", "warning") or "warning").lower(),
             stall_check_disable=_env_bool("STALL_CHECK_DISABLE", False),
             stall_check_time_seconds=_env_float("STALL_CHECK_TIME_SECONDS", 60.0),

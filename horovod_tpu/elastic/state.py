@@ -306,9 +306,18 @@ def run(func: Callable) -> Callable:
                     last_failure_t = now
                     consecutive_failures += 1
                     delay = _reset_backoff_s(consecutive_failures)
+                    from ..obs import flight as _flight
                     from ..obs import instrument as _obs
 
                     _obs.on_elastic_reset("rollback")
+                    # The crash ships its own postmortem: the rollback
+                    # event plus everything already in the rings (the
+                    # fault-site span, the failing step's trace) land in
+                    # one rank-tagged dump before recovery mutates state.
+                    _flight.record("elastic_rollback", error=str(err)[:300],
+                                   resets=resets,
+                                   consecutive=consecutive_failures)
+                    _flight.dump("horovod_internal_error")
                     logger.warning(
                         "Collective failure (%s); rolling back to last "
                         "commit and re-initializing (reset %d%s, backoff "
@@ -322,9 +331,11 @@ def run(func: Callable) -> Callable:
                     state.sync()
                 else:  # HostsUpdatedInterrupt: graceful, no rollback/backoff
                     consecutive_failures = 0
+                    from ..obs import flight as _flight
                     from ..obs import instrument as _obs
 
                     _obs.on_elastic_reset("resize")
+                    _flight.record("elastic_resize", resets=resets)
                     logger.info("Membership changed; re-initializing "
                                 "without rollback")
                     _reinitialize()

@@ -99,6 +99,7 @@ class FaultPlan:
         self.raw = raw
         self._sites = {site: _SiteState(c) for site, c in clauses.items()}
         self.history: List[Tuple[str, int, str]] = []  # guarded-by: _lock
+        self._dumped_sites: set = set()                # guarded-by: _lock
         self._lock = threading.Lock()
 
     def site(self, name: str) -> Optional[_SiteState]:
@@ -108,9 +109,27 @@ class FaultPlan:
         with self._lock:
             self.history.append((site, at, mode + (f":{detail}" if detail
                                                    else "")))
+            first_for_site = site not in self._dumped_sites
+            self._dumped_sites.add(site)
+        from .obs import flight as _flight
         from .obs import instrument as _obs
+        from .obs import trace as _trace
 
         _obs.on_fault(site)
+        # The firing lands in the dispatching thread's live trace (a
+        # collective fault parents under the step span, a serve fault
+        # under the request) and in the flight recorder, which dumps
+        # on the FIRST firing per site: a chaos failure's postmortem
+        # must exist even if recovery never runs, but a probability-mode
+        # site firing on every dispatch must not turn the hot path into
+        # per-firing file I/O (every firing still lands in the ring, so
+        # the terminal-error dump carries the full record).
+        _trace.instant("hvd_tpu_fault",
+                       args={"site": site, "mode": mode, "at": at,
+                             "detail": detail})
+        _flight.record("fault", site=site, mode=mode, at=at, detail=detail)
+        if first_for_site:
+            _flight.dump(f"fault_{site}")
         logger.warning("fault injected: site=%s mode=%s at=%d %s",
                        site, mode, at, detail)
 

@@ -80,6 +80,7 @@ _SIGNATURES = {
                              c_char_p]),
     "hvd_tl_mark_cycle": (None, [c_void, c_dbl]),
     "hvd_tl_counter": (None, [c_void, c_char_p, c_dbl, c_char_p]),
+    "hvd_tl_flow": (None, [c_void, c_char_p, c_char_p, c_char_p, c_dbl]),
     "hvd_tl_events_written": (c_i64, [c_void]),
     "hvd_tl_close_destroy": (None, [c_void]),
 }

@@ -444,6 +444,16 @@ class NativeTimeline:
                 self._lib.hvd_tl_counter(self._h, name.encode(), ts_us,
                                          series_json.encode())
 
+    def flow(self, name: str, phase: str, flow_id: str,
+             ts_us: float) -> None:
+        """Flow ("s"/"f") event bound by ``flow_id`` (see
+        TimelineWriter::Flow)."""
+        with self._hlock:
+            if self._h:
+                self._lib.hvd_tl_flow(self._h, name.encode(),
+                                      phase.encode(), flow_id.encode(),
+                                      ts_us)
+
     def events_written(self) -> int:
         with self._hlock:
             if not self._h:

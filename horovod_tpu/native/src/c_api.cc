@@ -333,6 +333,13 @@ void hvd_tl_counter(void* h, const char* name, double ts_us,
   }
 }
 
+void hvd_tl_flow(void* h, const char* name, const char* phase,
+                 const char* id, double ts_us) {
+  if (h && name && phase && id) {
+    static_cast<TimelineWriter*>(h)->Flow(name, phase, id, ts_us);
+  }
+}
+
 int64_t hvd_tl_events_written(void* h) {
   return h ? static_cast<TimelineWriter*>(h)->events_written() : -1;
 }

@@ -92,6 +92,22 @@ void TimelineWriter::Counter(const std::string& name, double ts_us,
   Enqueue(std::move(line));
 }
 
+void TimelineWriter::Flow(const std::string& name, const std::string& phase,
+                          const std::string& id, double ts_us) {
+  if (phase != "s" && phase != "f") return;
+  char head[160];
+  std::snprintf(head, sizeof(head),
+                "\"ts\": %.3f, \"pid\": %d, \"tid\": 0",
+                ts_us, static_cast<int>(::getpid()));
+  std::string line = "{\"name\": \"" + JsonEscape(name) +
+                     "\", \"cat\": \"flow\", \"ph\": \"" + phase +
+                     "\", \"id\": \"" + JsonEscape(id) + "\", ";
+  line += head;
+  if (phase == "f") line += ", \"bp\": \"e\"";
+  line += "}";
+  Enqueue(std::move(line));
+}
+
 void TimelineWriter::WriterLoop() {
   for (;;) {
     std::deque<std::string> batch;

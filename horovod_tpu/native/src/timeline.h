@@ -43,6 +43,13 @@ class TimelineWriter {
   void Counter(const std::string& name, double ts_us,
                const std::string& series_json);
 
+  // Flow event: `phase` is "s" (start) or "f" (finish, rendered with
+  // bp:"e" so it binds to the enclosing slice); `id` is the flow key —
+  // the tracing layer uses the RPC client span id, so the same id on
+  // two ranks' files draws one arrow after merging.
+  void Flow(const std::string& name, const std::string& phase,
+            const std::string& id, double ts_us);
+
   void Close();  // drains queue, finalizes JSON array, joins thread
 
   int64_t events_written() const { return events_written_; }

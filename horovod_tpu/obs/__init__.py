@@ -16,12 +16,23 @@ every operator scrapes from:
   snapshot, served as a ``MetricsRequest`` on every
   ``BasicService`` (HMAC control plane) and on the optional local
   scrape port ``HVD_TPU_METRICS_PORT``.
+* :mod:`~horovod_tpu.obs.trace` — cross-rank distributed tracing:
+  W3C-style span contexts rooted per train step / serve request,
+  propagated over every ``BasicClient``/``BasicService`` frame,
+  collected via ``TraceRequest`` and merged by
+  ``scripts/trace_merge.py`` (docs/tracing.md).
+* :mod:`~horovod_tpu.obs.flight` — crash flight recorder: a bounded
+  ring of spans + fault/retry/elastic events, dumped rank-tagged on
+  ``HorovodInternalError``, stall shutdown and fault firings.
 
 Knobs: ``HVD_TPU_METRICS`` (default on), ``HVD_TPU_METRICS_PORT``,
-``HVD_TPU_METRICS_WINDOW``, ``HVD_TPU_STRAGGLER_FACTOR`` — see
-``docs/metrics.md`` for the metric catalog and scrape recipes.
+``HVD_TPU_METRICS_WINDOW``, ``HVD_TPU_STRAGGLER_FACTOR``,
+``HVD_TPU_TRACE``, ``HVD_TPU_TRACE_RING``, ``HVD_TPU_FLIGHT``,
+``HVD_TPU_FLIGHT_DIR``, ``HVD_TPU_FLIGHT_RING`` — see
+``docs/metrics.md`` / ``docs/tracing.md`` for catalogs and recipes.
 """
 
-from . import aggregate, export, instrument, metrics  # noqa: F401
+from . import aggregate, export, flight, instrument, metrics, trace  # noqa: F401
 
-__all__ = ["aggregate", "export", "instrument", "metrics"]
+__all__ = ["aggregate", "export", "flight", "instrument", "metrics",
+           "trace"]

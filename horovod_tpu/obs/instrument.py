@@ -20,10 +20,12 @@ first token of the call-site description, not the full string.
 from __future__ import annotations
 
 import collections
+import itertools
 import time
 from typing import Any, Dict, Optional
 
 from . import metrics as _m
+from . import trace as _trace
 
 __all__ = [
     "enabled", "record_microbatch_plan",
@@ -92,6 +94,8 @@ def wrap_step(step_fn, *, kind: str = "train"):
     rate = reg.gauge("hvd_tpu_tokens_per_s",
                      "instantaneous tokens/s of the last step")
 
+    step_seq = itertools.count()
+
     def instrumented_step(params, opt_state, batch, *rest):
         import jax
 
@@ -103,7 +107,12 @@ def wrap_step(step_fn, *, kind: str = "train"):
         if leaves and is_tracer(leaves[0]):
             return step_fn(params, opt_state, batch, *rest)
         t0 = time.perf_counter()
-        out = step_fn(params, opt_state, batch, *rest)
+        # One trace per step (docs/tracing.md): the root every hop this
+        # dispatch causes — collective faults, checkpoint saves on the
+        # same thread, elastic RPC — parents under.
+        with _trace.span("hvd_tpu_step", root=True,
+                         args={"kind": kind, "step": next(step_seq)}):
+            out = step_fn(params, opt_state, batch, *rest)
         dt = time.perf_counter() - t0
         rows, toks = _batch_rows_tokens(batch)
         hist.observe(dt)

@@ -13,8 +13,10 @@ whose HMAC does not match the launcher-minted secret is dropped.
 
 from __future__ import annotations
 
+import contextlib
 import hmac
 import hashlib
+import os
 import pickle
 import socket
 import socketserver
@@ -23,6 +25,7 @@ import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 from ... import faults as faults_mod
+from ...obs import trace as trace_mod
 from ...utils.retry import RetryPolicy, retry_call
 from .secret import DIGEST_LEN
 
@@ -34,9 +37,17 @@ class PingRequest:
 
 
 class PingResponse:
-    def __init__(self, service_name: str, source_address: str):
+    """``clock_us`` is the peer's span clock (``obs.trace.now_us``) at
+    response build — the raw material for Cristian's-algorithm clock
+    offset estimation (``obs.trace.estimate_clock_offset``), which
+    ``scripts/trace_merge.py`` uses to put every rank's spans on one
+    time axis."""
+
+    def __init__(self, service_name: str, source_address: str,
+                 clock_us: Optional[float] = None):
         self.service_name = service_name
         self.source_address = source_address
+        self.clock_us = clock_us
 
 
 class AckResponse:
@@ -59,6 +70,30 @@ class MetricsResponse:
     def __init__(self, snapshot: dict, prometheus: Optional[str] = None):
         self.snapshot = snapshot
         self.prometheus = prometheus
+
+
+class TraceRequest:
+    """Fetch this process's recent-span ring (``horovod_tpu.obs.trace``)
+    over the HMAC control plane — answered by EVERY
+    :class:`BasicService`, so ``scripts/trace_merge.py`` can collect a
+    cross-rank trace with the credential it already holds.  ``clear``
+    drains the ring (a collector that owns what it fetched)."""
+
+    def __init__(self, clear: bool = False):
+        self.clear = clear
+
+
+class TraceResponse:
+    """``spans`` is the ring snapshot (oldest first); ``now_us`` is the
+    peer's span clock at response build (a second offset anchor beside
+    ``PingResponse.clock_us``); ``rank``/``pid`` tag provenance."""
+
+    def __init__(self, spans: list, now_us: float,
+                 rank: Optional[int] = None, pid: Optional[int] = None):
+        self.spans = spans
+        self.now_us = now_us
+        self.rank = rank
+        self.pid = pid
 
 
 class DropConnection(Exception):
@@ -154,8 +189,21 @@ class BasicService:
                     req = read_message(self.request, outer._key)
                 except (PermissionError, ConnectionError, ValueError):
                     return  # unauthenticated/broken peer: drop silently
+                # Distributed tracing: a request carrying a propagated
+                # context gets a server span parented to the caller's
+                # client span, installed as this handler thread's
+                # current context — work the handler delegates further
+                # (nested RPCs, batcher submissions) parents under it.
+                ctx = trace_mod.extract(req)
+                span = (trace_mod.span("hvd_tpu_rpc_server", parent=ctx,
+                                       kind="server",
+                                       args={"req": type(req).__name__,
+                                             "service": outer.name})
+                        if ctx is not None and trace_mod.enabled()
+                        else contextlib.nullcontext())
                 try:
-                    resp = outer._handle(req, self.client_address)
+                    with span:
+                        resp = outer._handle(req, self.client_address)
                 except DropConnection:
                     return  # handler chose to die on the wire: no reply
                 try:
@@ -199,7 +247,8 @@ class BasicService:
 
     def _handle(self, req: Any, client_address) -> Any:
         if isinstance(req, PingRequest):
-            return PingResponse(self.name, client_address[0])
+            return PingResponse(self.name, client_address[0],
+                                clock_us=trace_mod.now_us())
         if isinstance(req, MetricsRequest):
             from ...obs import export as _obs_export
 
@@ -208,6 +257,11 @@ class BasicService:
                 prometheus=(_obs_export.render_prometheus()
                             if getattr(req, "fmt", "json") == "prometheus"
                             else None))
+        if isinstance(req, TraceRequest):
+            return TraceResponse(
+                spans=trace_mod.snapshot(clear=getattr(req, "clear", False)),
+                now_us=trace_mod.now_us(), rank=trace_mod.process_rank(),
+                pid=os.getpid())
         return AckResponse()
 
     def shutdown(self) -> None:
@@ -243,9 +297,14 @@ class BasicClient:
     address (dead candidates are expected — that's what probing is),
     and ``ping()`` stays single-shot because liveness accounting
     (missed-ping counters) owns its own schedule.
+
+    ``name=None`` is the diagnostic wildcard (``scripts/trace_merge.py``
+    scraping whatever service owns a port): the probe accepts whichever
+    peer answers and adopts its advertised ``service_name``.
     """
 
-    def __init__(self, name: str, addresses: List[Tuple[str, int]],
+    def __init__(self, name: Optional[str],
+                 addresses: List[Tuple[str, int]],
                  key: bytes, probe_timeout: float = 5.0,
                  retry_policy: Optional[RetryPolicy] = None):
         self.name = name
@@ -263,15 +322,35 @@ class BasicClient:
         for addr in addresses:
             try:
                 resp = self._call(PingRequest(), addr)
-                if isinstance(resp, PingResponse) and resp.service_name == self.name:
+                if isinstance(resp, PingResponse) \
+                        and self.name in (None, resp.service_name):
+                    if self.name is None:
+                        self.name = resp.service_name
                     return tuple(addr)
             except OSError as e:
                 errs.append((addr, e))
         raise ConnectionError(
-            f"no address of service {self.name!r} answered: {errs}")
+            f"no address of service {self.name or '<any>'!r} "
+            f"answered: {errs}")
 
     def _call(self, req: Any, addr: Optional[Tuple[str, int]] = None,
               timeout: Optional[float] = None) -> Any:
+        # Distributed tracing: every control-plane exchange is a client
+        # span (child of the calling thread's step/request trace, or a
+        # fresh root for unparented calls — elastic driver chatter stays
+        # visible), with the context propagated on the request so the
+        # peer's server span parents correctly across the process
+        # boundary.
+        if not trace_mod.enabled():
+            return self._call_inner(req, addr, timeout)
+        with trace_mod.span("hvd_tpu_rpc_client", kind="client",
+                            args={"req": type(req).__name__,
+                                  "service": self.name}) as ctx:
+            trace_mod.inject(req, ctx)
+            return self._call_inner(req, addr, timeout)
+
+    def _call_inner(self, req: Any, addr: Optional[Tuple[str, int]] = None,
+                    timeout: Optional[float] = None) -> Any:
         # Fault site "rpc": drop (ConnectionError before the write — the
         # retry policy's job to absorb) or delay (a slow peer).
         if faults_mod._active is not None:

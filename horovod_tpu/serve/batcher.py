@@ -29,6 +29,8 @@ import time
 from typing import Dict, List, Optional, Sequence
 
 from .. import faults as faults_mod
+from ..obs import flight as flight_mod
+from ..obs import trace as trace_mod
 from ..utils.logging import get_logger
 from .engine import (InferenceEngine, PromptTooLongError, SamplingParams,
                      resolved_config)
@@ -64,6 +66,10 @@ class ServeRequest:
     error: Optional[str] = None
     done: threading.Event = dataclasses.field(
         default_factory=threading.Event)
+    # Trace context captured at submit (the server handler's span): the
+    # batcher thread reconstructs queued/prefill/decode phase spans
+    # against it, so the request's trace crosses the thread handoff.
+    trace_ctx: Optional[tuple] = None
 
     def finish(self, error: Optional[str] = None) -> None:
         if self.done.is_set():
@@ -129,7 +135,8 @@ class ContinuousBatcher:
             prompt=list(prompt), sampling=sampling,
             deadline=(time.monotonic() + limit) if limit and limit > 0
             else None,
-            submitted_at=time.monotonic())
+            submitted_at=time.monotonic(),
+            trace_ctx=trace_mod.current())
         with self._lock:
             if self._killed is not None:
                 raise ReplicaKilledError(self._killed)
@@ -183,11 +190,33 @@ class ContinuousBatcher:
             self.stats.record_expired()
             r.finish(error="deadline_exceeded")
 
+    def _record_phase(self, req: ServeRequest, name: str,
+                      start_mono: float, end_mono: float, **args) -> None:
+        """One reconstructed phase span on the request's trace (the
+        batcher thread has no ambient context — phases are parented to
+        the context captured at submit, with monotonic timestamps
+        re-anchored onto the span clock)."""
+        if req.trace_ctx is None or not trace_mod.enabled():
+            return
+        now_us, now_mono = trace_mod.now_us(), time.monotonic()
+        start_us = now_us - (now_mono - start_mono) * 1e6
+        trace_mod.record_span(name, parent=req.trace_ctx,
+                              start_us=start_us,
+                              dur_us=(end_mono - start_mono) * 1e6,
+                              args=args or None)
+
     def _finish_slot(self, slot: int, req: ServeRequest) -> None:
         with self._lock:
             self._slots.pop(slot, None)
         self.engine.release(slot)
         req.finish()
+        if req.first_token_at is not None:
+            # The decode phase of this request's trace: first token to
+            # completion (what dominates long generations' latency —
+            # the critical-path report should name it).
+            self._record_phase(req, "hvd_tpu_serve_decode",
+                               req.first_token_at, req.finished_at,
+                               tokens=len(req.tokens))
         self.stats.record_request(
             ttft_s=(req.first_token_at or req.finished_at)
             - req.submitted_at,
@@ -225,6 +254,7 @@ class ContinuousBatcher:
                 req = self._queue.pop(0)
                 slot = free[0]
                 self._slots[slot] = req
+            prefill_t0 = time.monotonic()
             try:
                 token = self.engine.start(slot, req.prompt, req.sampling)
             except Exception as e:   # defensive: engine bug ≠ wedged slot
@@ -234,6 +264,11 @@ class ContinuousBatcher:
                 self.stats.record_failed()
                 req.finish(error=f"prefill_failed: {e}")
                 continue
+            self._record_phase(req, "hvd_tpu_serve_queued",
+                               req.submitted_at, prefill_t0)
+            self._record_phase(req, "hvd_tpu_serve_prefill", prefill_t0,
+                               time.monotonic(),
+                               prompt_len=len(req.prompt), slot=slot)
             if req.done.is_set():
                 # Cancelled/expired between admission and prefill
                 # completion: cancel() found no active slot to release
@@ -280,6 +315,7 @@ class ContinuousBatcher:
             self.stats.record_failed()
             req.finish(error="replica_killed")
         n = len(pending) + len(running)
+        flight_mod.record("replica_died", reason=reason, failed=n)
         if n:
             logger.warning("serving replica died: %s (%d request(s) "
                            "failed back to the router)", reason, n)

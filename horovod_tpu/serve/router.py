@@ -30,6 +30,7 @@ import uuid
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..obs import trace as trace_mod
 from ..runner.common.network import BasicClient
 from ..utils.logging import get_logger
 from ..utils.retry import RetryPolicy, retry_call
@@ -278,10 +279,18 @@ class Router:
             self._mark_ok(rep)
             return resp
 
-        resp = retry_call(
-            attempt, policy=self._retry_policy,
-            retry_on=(ReplicaUnavailableError, NoHealthyReplicasError),
-            describe=f"serve generate {rid}")
+        # One trace per request, rooted at admission (docs/tracing.md):
+        # the failover attempts' RPC client spans, the replica's server
+        # span, and the batcher's queued/prefill/decode phases all
+        # parent under it, so the merged trace answers "where did this
+        # request's latency go" across processes.
+        with trace_mod.span("hvd_tpu_serve_request", root=True,
+                            args={"request_id": rid,
+                                  "max_new_tokens": max_new_tokens}):
+            resp = retry_call(
+                attempt, policy=self._retry_policy,
+                retry_on=(ReplicaUnavailableError, NoHealthyReplicasError),
+                describe=f"serve generate {rid}")
         with self._lock:
             self._done[rid] = resp
             while len(self._done) > self._dedupe_window:

@@ -106,9 +106,13 @@ def retry_call(
                 raise
             if on_retry is not None:
                 on_retry(attempt, e)
+            from ..obs import flight as _flight
             from ..obs import instrument as _obs
 
             _obs.on_retry(describe or getattr(fn, "__name__", "call"))
+            _flight.record("retry",
+                           what=describe or getattr(fn, "__name__", "call"),
+                           attempt=attempt, error=str(e)[:200])
             logger.debug("%s failed (attempt %d/%s): %s; retrying in %.2fs",
                          describe or getattr(fn, "__name__", "call"),
                          attempt,

@@ -101,9 +101,11 @@ class StallInspector:
                     "host-side work; wrap that in stall_inspector().pause().",
                     idle, self._warn_after_s,
                 )
+                from ..obs import flight as _flight
                 from ..obs import instrument as _obs
 
                 _obs.on_stall("warn")
+                _flight.record("stall_warn", idle_s=round(idle, 1))
                 with self._lock:
                     self._warned = True
             if self._shutdown_after_s > 0 and idle > self._shutdown_after_s:
@@ -111,9 +113,14 @@ class StallInspector:
                     "Stall exceeded shutdown threshold (%.0f s); aborting.",
                     self._shutdown_after_s,
                 )
+                from ..obs import flight as _flight
                 from ..obs import instrument as _obs
 
                 _obs.on_stall("shutdown")
+                # The default shutdown hook is os._exit — the dump is
+                # the only record of what this process was doing.
+                _flight.record("stall_shutdown", idle_s=round(idle, 1))
+                _flight.dump("stall_shutdown")
                 self._on_shutdown()
 
     def stop(self) -> None:

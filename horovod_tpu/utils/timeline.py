@@ -120,6 +120,29 @@ class Timeline:
             "pid": os.getpid(), "tid": 0, "args": series,
         })
 
+    def flow(self, name: str, flow_id: str, phase: str,
+             ts_us: Optional[float] = None) -> None:
+        """Chrome-trace flow event: ``phase`` is ``"s"`` (start, at the
+        producing slice) or ``"f"`` (finish, at the consuming slice),
+        bound by ``flow_id`` — how a cross-process span edge (an RPC
+        client span on one rank, its server span on another) renders as
+        an arrow once per-process files are merged (the tracing layer
+        keys flows by the client span id; see docs/tracing.md)."""
+        if phase not in ("s", "f"):
+            raise ValueError(f"flow phase must be 's' or 'f', got {phase!r}")
+        ts = self._now_us() if ts_us is None else ts_us
+        native = self._native
+        if native is not None:
+            native.flow(name, phase, str(flow_id), ts)
+            return
+        event = {
+            "name": name, "cat": "flow", "ph": phase, "id": str(flow_id),
+            "ts": ts, "pid": os.getpid(), "tid": 0,
+        }
+        if phase == "f":
+            event["bp"] = "e"   # bind to the enclosing slice
+        self._emit(event)
+
     def mark_cycle(self) -> None:
         """Instant marker per dispatch cycle (reference:
         ``HOROVOD_TIMELINE_MARK_CYCLES``)."""

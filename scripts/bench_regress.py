@@ -37,14 +37,16 @@ import sys
 
 # Numeric fields that are configuration/provenance, not performance —
 # a changed seq_len is a different experiment, not a regression.  The
-# "metrics" block is the embedded telemetry snapshot (horovod_tpu.obs):
-# diagnostic context for a human reading the artifact, not a regression
-# signal (its counters scale with run length, not performance).
+# "metrics" block is the embedded telemetry snapshot (horovod_tpu.obs)
+# and "trace" the embedded per-run trace pointer + critical-path report
+# (--trace; docs/tracing.md): diagnostic context for a human reading
+# the artifact, not a regression signal (counters scale with run
+# length, span timings with scheduling noise — not performance).
 _NON_METRIC_KEYS = {
     "vs_baseline", "n_params", "seq_len", "vocab_chunk", "elems", "bytes",
     "n_slots", "sizes_swept", "max_elems", "microbatches", "pipeline_depth",
     "bench_buckets", "per_chip_batch", "probe_attempts", "requests",
-    "warmup", "iters", "steps_per_call", "metrics",
+    "warmup", "iters", "steps_per_call", "metrics", "trace",
 }
 
 _LOWER_IS_BETTER_TOKENS = ("_ms", "_us", "time", "latency", "ttft", "tpot")

@@ -6,7 +6,11 @@ Each iteration draws a fresh (fault step, RNG seed) pair, exports it via
 ``HVD_TPU_CHAOS_STEP``/``HVD_TPU_CHAOS_SEED``, and runs the
 ``chaos``-marked pytest suite in a subprocess.  The summary records
 every run's knobs, exit code and duration — soak evidence a later PR
-can cite ("N randomized chaos runs green at commit X").
+can cite ("N randomized chaos runs green at commit X").  Each iteration
+runs with its own ``HVD_TPU_FLIGHT_DIR``; a failed iteration's
+flight-recorder dump paths (its postmortem: the fault firing, the
+in-flight spans, what recovery did — docs/tracing.md) are recorded in
+its summary row under ``flight_dumps``.
 
 Default target is the single-controller chaos test (runs anywhere the
 tier-1 suite runs); ``--mp`` switches to the multi-process world test
@@ -25,9 +29,11 @@ Usage::
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import random
+import shutil
 import subprocess
 import sys
 import time
@@ -44,12 +50,17 @@ TARGETS = {
 }
 
 
-def run_once(target: str, step: int, seed: int, timeout_s: float) -> dict:
+def run_once(target: str, step: int, seed: int, timeout_s: float,
+             flight_dir: str) -> dict:
     env = dict(os.environ)
     env.update({
         "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
         "HVD_TPU_CHAOS_STEP": str(step),
         "HVD_TPU_CHAOS_SEED": str(seed),
+        # Per-iteration flight-recorder directory: a failed iteration's
+        # postmortem dumps (obs/flight.py; docs/tracing.md) are recorded
+        # in the summary below — one `cat` away.
+        "HVD_TPU_FLIGHT_DIR": flight_dir,
     })
     cmd = [sys.executable, "-m", "pytest", target, "-q", "-m", "chaos",
            "-p", "no:cacheprovider"]
@@ -60,14 +71,23 @@ def run_once(target: str, step: int, seed: int, timeout_s: float) -> dict:
         rc, tail = proc.returncode, proc.stdout[-2000:]
     except subprocess.TimeoutExpired:
         rc, tail = -1, f"timeout after {timeout_s}s"
-    return {
+    passed = rc == 0
+    result = {
         "step": step,
         "seed": seed,
         "rc": rc,
-        "passed": rc == 0,
+        "passed": passed,
         "duration_s": round(time.monotonic() - t0, 2),
-        "tail": tail if rc != 0 else "",
+        "tail": tail if not passed else "",
     }
+    dumps = sorted(glob.glob(os.path.join(flight_dir, "*.json")))
+    if passed:
+        # Chaos drills dump on every injected firing even when recovery
+        # succeeds; only failures keep their postmortems on disk.
+        shutil.rmtree(flight_dir, ignore_errors=True)
+    else:
+        result["flight_dumps"] = dumps
+    return result
 
 
 def main(argv=None) -> int:
@@ -90,17 +110,24 @@ def main(argv=None) -> int:
                     help="per-iteration pytest timeout in seconds")
     ap.add_argument("--out", default="chaos_soak.json",
                     help="summary JSON path (default chaos_soak.json)")
+    ap.add_argument("--flight-root", default=None,
+                    help="root for per-iteration flight-recorder dump "
+                         "dirs (default: <out>.flight/); failed "
+                         "iterations keep their dumps, passed ones are "
+                         "cleaned up")
     args = ap.parse_args(argv)
 
     rng = random.Random(args.master_seed)
     target = TARGETS[(args.mode, args.mp)]
+    flight_root = os.path.abspath(args.flight_root or args.out + ".flight")
     runs = []
     for i in range(args.runs):
         step = rng.randrange(0, args.max_step + 1)
         seed = rng.randrange(0, 1 << 30)
         print(f"[chaos_soak] run {i + 1}/{args.runs}: "
               f"target={target} step={step} seed={seed}", flush=True)
-        result = run_once(target, step, seed, args.timeout)
+        result = run_once(target, step, seed, args.timeout,
+                          os.path.join(flight_root, f"iter_{i:04d}"))
         print(f"[chaos_soak]   -> {'PASS' if result['passed'] else 'FAIL'} "
               f"({result['duration_s']}s)", flush=True)
         runs.append(result)
@@ -112,8 +139,13 @@ def main(argv=None) -> int:
         "total": len(runs),
         "passed": sum(r["passed"] for r in runs),
         "failed": sum(not r["passed"] for r in runs),
+        "flight_root": flight_root,
         "runs": runs,
     }
+    try:   # all-green soak: don't leave an empty dump root behind
+        os.rmdir(flight_root)
+    except OSError:
+        pass
     with open(args.out, "w") as f:
         json.dump(summary, f, indent=2)
     print(f"[chaos_soak] {summary['passed']}/{summary['total']} passed; "

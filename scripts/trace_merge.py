@@ -1,0 +1,213 @@
+#!/usr/bin/env python
+"""Merge per-process span sets into ONE Perfetto-loadable trace.
+
+Every process records its spans (``horovod_tpu/obs/trace.py``) against
+its **own wall clock**; this script puts them all on one time axis and
+emits a single Chrome-trace JSON that chrome://tracing or
+https://ui.perfetto.dev opens directly — cross-process parent→child
+edges (an RPC client span on the router, its server span on a replica)
+render as flow arrows.
+
+Two sources (mix freely; docs/tracing.md has the full recipe):
+
+* **files** — flight-recorder dumps
+  (``hvd_tpu_flight_r<rank>_*.json``), ``TraceResponse``-shaped dumps,
+  or bare span-list JSON::
+
+      python scripts/trace_merge.py merged.json dump_r0.json dump_r1.json
+
+  File sources carry no clock anchor, so their offset defaults to 0
+  (pass ``--offset LABEL=US`` for post-hoc corrections).
+
+* **live processes** — any ``BasicService`` (a task agent, a serving
+  replica) over the runner's HMAC wire: ``PingRequest`` RTT samples
+  estimate the peer's clock offset (Cristian's algorithm — the
+  minimum-RTT sample bounds the error by RTT/2), then a
+  ``TraceRequest`` fetches the span ring::
+
+      python scripts/trace_merge.py merged.json \\
+          --connect HOST:PORT --connect HOST:PORT \\
+          --secret-file /path/to/secret
+
+``--report`` appends a per-trace **critical-path report** — which
+hop/phase dominated each trace's wall time (TTFT or step time) — to
+stdout and into the artifact's ``metadata``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.obs import trace as trace_mod  # noqa: E402
+
+
+def load_spans(path: str) -> Tuple[str, List[dict]]:
+    """``(label, spans)`` from any of the accepted file shapes: a
+    flight-recorder dump (``{"spans": [...], "rank": ...}``), a dumped
+    ``TraceResponse`` (same key), or a bare span list."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        spans = doc.get("spans")
+        if not isinstance(spans, list):
+            raise SystemExit(f"{path}: no 'spans' list (not a flight dump "
+                             f"or trace collection)")
+        rank = doc.get("rank")
+        label = f"rank{rank}" if rank is not None else \
+            os.path.splitext(os.path.basename(path))[0]
+        return label, spans
+    if isinstance(doc, list):
+        return os.path.splitext(os.path.basename(path))[0], doc
+    raise SystemExit(f"{path}: unrecognized artifact shape")
+
+
+def collect_live(target: str, key: bytes, pings: int,
+                 clear: bool) -> Tuple[str, float, float, List[dict]]:
+    """``(label, offset_us, err_us, spans)`` from a live BasicService:
+    ping RTT samples anchor the peer clock, TraceRequest fetches the
+    ring."""
+    from horovod_tpu.runner.common.network import (BasicClient, PingRequest,
+                                                   TraceRequest)
+
+    host, _, port = target.rpartition(":")
+    # name=None: diagnostic wildcard — scrape whichever BasicService
+    # owns the port (driver, task agent, inference server, ...).
+    client = BasicClient(None, [(host or "127.0.0.1", int(port))], key)
+    samples = []
+    for _ in range(max(1, pings)):
+        send = trace_mod.now_us()
+        resp = client.request(PingRequest())
+        recv = trace_mod.now_us()
+        peer = getattr(resp, "clock_us", None)
+        # recv < send happens when NTP steps the wall clock mid-sample —
+        # exactly the skewed-clock incident this tool serves; drop the
+        # sample instead of letting the estimator reject the collection.
+        if peer is not None and recv >= send:
+            samples.append((send, recv, float(peer)))
+    if samples:
+        offset, err = trace_mod.estimate_clock_offset(samples)
+    else:   # pre-tracing peer: no clock on the ping — fall back to 0
+        offset, err = 0.0, float("inf")
+    tr = client.request(TraceRequest(clear=clear))
+    label = f"rank{tr.rank}" if tr.rank is not None else target
+    return label, offset, err, list(tr.spans)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="merge per-process span sets into one Perfetto file")
+    parser.add_argument("out", help="merged Chrome-trace JSON output path")
+    parser.add_argument("inputs", nargs="*",
+                        help="flight dumps / span-list JSON files")
+    parser.add_argument("--connect", action="append", default=[],
+                        metavar="HOST:PORT",
+                        help="collect from a live BasicService over the "
+                             "HMAC wire (repeatable)")
+    parser.add_argument("--secret-file",
+                        help="launcher-minted secret for --connect")
+    parser.add_argument("--pings", type=int, default=9,
+                        help="RTT samples per --connect peer for the "
+                             "clock-offset estimate (default 9)")
+    parser.add_argument("--clear", action="store_true",
+                        help="drain each live peer's ring after fetching "
+                             "(the collector owns what it fetched)")
+    parser.add_argument("--offset", action="append", default=[],
+                        metavar="LABEL=US",
+                        help="manual clock offset (µs, peer − reference) "
+                             "for a file source's label (repeatable)")
+    parser.add_argument("--report", action="store_true",
+                        help="print the per-trace critical-path report "
+                             "(also embedded in the artifact metadata)")
+    args = parser.parse_args(argv)
+
+    if not args.inputs and not args.connect:
+        parser.error("nothing to merge: pass input files and/or --connect")
+    if args.connect and not args.secret_file:
+        parser.error("--connect needs --secret-file (the HMAC key)")
+
+    manual: Dict[str, float] = {}
+    for spec in args.offset:
+        label, _, us = spec.partition("=")
+        try:
+            manual[label] = float(us)
+        except ValueError:
+            parser.error(f"--offset {spec!r}: expected LABEL=MICROSECONDS")
+
+    groups: Dict[str, Tuple[float, List[dict]]] = {}
+    provenance: Dict[str, dict] = {}
+
+    def add(label: str, offset: float, spans: List[dict],
+            source: str, err: Optional[float] = None) -> None:
+        base = label
+        n = 2
+        while label in groups:   # two rank0 dumps must not silently merge
+            label = f"{base}#{n}"
+            n += 1
+        groups[label] = (offset, spans)
+        provenance[label] = {"source": source, "spans": len(spans),
+                             "clock_offset_us": offset}
+        if err is not None and err != float("inf"):
+            provenance[label]["offset_error_bound_us"] = err
+
+    for path in args.inputs:
+        label, spans = load_spans(path)
+        add(label, manual.get(label, 0.0), spans, source=path)
+    key = None
+    if args.connect:
+        with open(args.secret_file, "rb") as f:
+            key = f.read().strip()
+    for target in args.connect:
+        label, offset, err, spans = collect_live(target, key, args.pings,
+                                                 args.clear)
+        add(label, manual.get(label, offset), spans, source=target, err=err)
+
+    all_spans = [s for _, (_, spans) in sorted(groups.items())
+                 for s in spans]
+    if not all_spans:
+        raise SystemExit("no spans collected (tracing off — HVD_TPU_TRACE=0 "
+                         "— or the rings were already drained)")
+    events = trace_mod.merge_traces(groups)
+    dangling = trace_mod.unresolved_parents(all_spans)
+
+    reports = []
+    if args.report:
+        for tid in trace_mod.trace_ids(all_spans):
+            reports.append(trace_mod.critical_path(all_spans, tid))
+        reports.sort(key=lambda r: -r["total_us"])
+
+    doc = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "tool": "horovod_tpu scripts/trace_merge.py",
+            "processes": provenance,
+            "traces": len(trace_mod.trace_ids(all_spans)),
+            "spans": len(all_spans),
+            "unresolved_parents": dangling,
+            **({"critical_paths": reports} if reports else {}),
+        },
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1, default=str)
+
+    print(f"trace_merge: {len(all_spans)} span(s) from {len(groups)} "
+          f"process(es), {doc['metadata']['traces']} trace(s) -> {args.out}")
+    if dangling:
+        print(f"trace_merge: WARNING {len(dangling)} unresolved parent "
+              f"span(s) — a process's ring was not collected (or rolled "
+              f"over): {dangling[:5]}", file=sys.stderr)
+    for rep in reports:
+        print(json.dumps({k: rep[k] for k in
+                          ("trace_id", "root", "total_us", "dominant",
+                           "dominant_self_us", "path")}, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,7 +29,8 @@ from horovod_tpu.analysis.knobs import KnobChecker
 from horovod_tpu.analysis.locks import LockChecker
 from horovod_tpu.analysis.rank_divergence import RankDivergenceChecker
 from horovod_tpu.analysis.registries import (FaultSiteChecker,
-                                             MetricNameChecker)
+                                             MetricNameChecker,
+                                             SpanNameChecker)
 
 pytestmark = pytest.mark.analysis
 
@@ -459,6 +460,57 @@ def test_metric_doc_drift(tmp_path):
                        '    reg.counter("hvd_tpu_undocumented_total")\n'},
               [MetricNameChecker], docs={"metrics.md": "# catalog\n"})
     assert checks_of(fs) == ["metric-doc-drift"]
+
+
+def test_span_naming_rules(tmp_path):
+    src = (
+        "from ..obs import trace as trace_mod\n\n"
+        "def hop():\n"
+        '    with trace_mod.span("hvd_tpu_good"):\n'
+        "        pass\n"
+        '    trace_mod.instant("bare_name")\n'          # no prefix
+        '    trace_mod.record_span("also_bare", parent=None,\n'
+        "                          start_us=0.0, dur_us=1.0)\n"
+    )
+    fs = lint(tmp_path, {"m.py": src}, [SpanNameChecker],
+              docs={"tracing.md": "hvd_tpu_good"})
+    assert checks_of(fs) == ["span-name"]
+    assert len(fs) == 2
+
+
+def test_span_rules_cover_record_phase_forwarder(tmp_path):
+    # batcher-style span-forwarding helper: the name rides in the
+    # SECOND positional — self._record_phase(req, "name", t0, t1).
+    src = (
+        "class B:\n"
+        "    def work(self, req):\n"
+        '        self._record_phase(req, "bare_phase", 0.0, 1.0)\n'
+        '        self._record_phase(req, "hvd_tpu_phase_ok", 0.0, 1.0)\n'
+    )
+    fs = lint(tmp_path, {"m.py": src}, [SpanNameChecker],
+              docs={"tracing.md": "hvd_tpu_phase_ok"})
+    assert checks_of(fs) == ["span-name"]
+    assert "bare_phase" in fs[0].message
+
+
+def test_span_doc_drift(tmp_path):
+    fs = lint(tmp_path,
+              {"m.py": "from ..obs import trace\n\n"
+                       "def hop():\n"
+                       '    with trace.span("hvd_tpu_undocumented"):\n'
+                       "        pass\n"},
+              [SpanNameChecker], docs={"tracing.md": "# span catalog\n"})
+    assert checks_of(fs) == ["span-doc-drift"]
+
+
+def test_span_rules_ignore_non_trace_receivers(tmp_path):
+    # Timeline-style .span()/.record() lookalikes on other receivers
+    # carry free-form names and are not held to span rules.
+    fs = lint(tmp_path,
+              {"m.py": "def f(timeline):\n"
+                       '    timeline.span("free-form name")\n'},
+              [SpanNameChecker], docs={"tracing.md": ""})
+    assert fs == []
 
 
 # --- jaxpr analyzer ----------------------------------------------------------

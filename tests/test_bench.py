@@ -90,6 +90,35 @@ def test_serving_bench_json_contract():
 
 
 @pytest.mark.slow
+def test_serving_bench_trace_artifact(tmp_path):
+    """ISSUE 7 satellite: ``--trace DIR`` writes a merged Perfetto
+    trace for the measured window and embeds its path + critical-path
+    report under ``"trace"`` (which bench_regress skips)."""
+    trace_dir = str(tmp_path / "traces")
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmarks",
+                                      "serving_bench.py"),
+         "--requests", "3", "--warmup", "1", "--max-new-tokens", "4",
+         "--buckets", "16", "--slots", "2", "--prompt-max", "12",
+         "--trace", trace_dir],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "XLA_FLAGS": "", "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    tblock = row["trace"]
+    assert os.path.isfile(tblock["file"]), tblock
+    with open(tblock["file"]) as f:
+        perfetto = json.load(f)
+    events = perfetto["traceEvents"]
+    assert any(e.get("ph") == "X" and
+               e.get("name") == "hvd_tpu_serve_request" for e in events)
+    # The report names the phase that dominated request latency.
+    assert tblock["critical_path"]["total_us"] > 0
+    assert tblock["critical_path"]["dominant"]
+
+
+@pytest.mark.slow
 def test_bench_rejects_nonpositive_batch_size():
     out = subprocess.run(
         [sys.executable, os.path.join(ROOT, "bench.py"), "--preset", "tiny",
@@ -248,6 +277,23 @@ def test_bench_regress_skips_metrics_block(tmp_path):
     metrics_b = {"hvd_tpu_steps_total": [{"labels": {}, "value": 9999.0}]}
     old = {"metric": "tok_per_s", "value": 100.0, "metrics": metrics_a}
     new = {"metric": "tok_per_s", "value": 100.0, "metrics": metrics_b}
+    out = _regress(tmp_path, old, new)
+    assert out.returncode == 0, out.stderr
+    report = json.loads(out.stdout)
+    assert report["compared"] == 1          # only tok_per_s
+    assert report["regressions"] == 0
+
+
+def test_bench_regress_skips_trace_block(tmp_path):
+    """The embedded per-run trace pointer + critical-path report
+    (--trace; docs/tracing.md) is diagnostic like "metrics": two
+    artifacts differing only there compare clean."""
+    trace_a = {"file": "a/TRACE_x.json",
+               "critical_path": {"total_us": 100.0, "dominant": "d"}}
+    trace_b = {"file": "b/TRACE_x.json",
+               "critical_path": {"total_us": 9e9, "dominant": "other"}}
+    old = {"metric": "tok_per_s", "value": 100.0, "trace": trace_a}
+    new = {"metric": "tok_per_s", "value": 100.0, "trace": trace_b}
     out = _regress(tmp_path, old, new)
     assert out.returncode == 0, out.stderr
     report = json.loads(out.stdout)

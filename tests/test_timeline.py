@@ -76,6 +76,47 @@ class TestTimeline:
         assert c["name"] == "train"
         assert c["args"] == {"step_time_ms": 3.5, "tokens_per_s": 100.0}
 
+    @pytest.mark.parametrize("use_native", [True, False])
+    def test_flow_events_bind_by_id(self, tmp_path, use_native):
+        """A flow pair ("s" at the producer, "f" with bp:"e" at the
+        consumer) sharing one id is how a cross-process RPC edge renders
+        as a Perfetto arrow (docs/tracing.md) — both backends must emit
+        the same shape."""
+        path = tmp_path / f"flow{use_native}.json"
+        tl = Timeline(str(path), use_native=use_native)
+        tl.record("rpc", "EXECUTE", 0.0, 5.0)
+        tl.flow("hvd_tpu_rpc_client", "abc123", "s", ts_us=1.0)
+        tl.flow("hvd_tpu_rpc_client", "abc123", "f", ts_us=4.0)
+        tl.close()
+        events = json.load(open(path))
+        flows = [e for e in events if e["ph"] in ("s", "f")]
+        assert {e["ph"] for e in flows} == {"s", "f"}
+        assert {e["id"] for e in flows} == {"abc123"}
+        (fin,) = [e for e in flows if e["ph"] == "f"]
+        assert fin["bp"] == "e"   # binds to the enclosing slice
+        for e in flows:
+            assert "ts" in e and "pid" in e
+
+    @pytest.mark.parametrize("use_native", [True, False])
+    def test_flow_after_close_is_dropped_safely(self, tmp_path,
+                                                use_native):
+        """Same close-race contract as activity(): a flow emitted after
+        an elastic teardown closed the timeline must be dropped, not
+        corrupt the finalized file."""
+        path = tmp_path / f"flowrace{use_native}.json"
+        tl = Timeline(str(path), use_native=use_native)
+        tl.flow("kept", "id1", "s", ts_us=1.0)
+        tl.close()
+        tl.flow("dropped", "id2", "f", ts_us=2.0)
+        events = json.load(open(path))
+        assert [e["id"] for e in events if e["ph"] in ("s", "f")] == ["id1"]
+
+    def test_flow_rejects_unknown_phase(self, tmp_path):
+        tl = Timeline(str(tmp_path / "p.json"))
+        with pytest.raises(ValueError, match="flow phase"):
+            tl.flow("x", "id", "t")
+        tl.close()
+
 
 @pytest.fixture
 def stall_records():
