@@ -100,6 +100,21 @@ def main() -> None:
                              "--overlap summary (0 = pure-wire sweep: "
                              "est reports 0; pass your model's backward "
                              "time to see the modeled hidden fraction)")
+    parser.add_argument("--topology", default=None, metavar="PODSxCHIPS",
+                        help="sweep the topology-aware schedule compiler "
+                             "(horovod_tpu/topo/) on a simulated "
+                             "two-tier mesh: flat vs two-phase vs "
+                             "hierarchical busbw at every size, one row "
+                             "per path, plus the compiler's own pick "
+                             "('chosen') and the per-tier modeled costs "
+                             "— CPU-runnable (docs/topology.md); "
+                             "allreduce only")
+    parser.add_argument("--dcn-alpha-us", type=float, default=None,
+                        help="override HVD_TPU_TOPO_ALPHA_DCN_US for "
+                             "the --topology cost model")
+    parser.add_argument("--dcn-beta-gbps", type=float, default=None,
+                        help="override HVD_TPU_TOPO_BETA_DCN_GBPS for "
+                             "the --topology cost model")
     parser.add_argument("--cpu-mesh", action="store_true",
                         help="force the 8-device virtual CPU mesh "
                              "(functional check, not a perf number)")
@@ -123,6 +138,12 @@ def main() -> None:
                      "run them as separate sweeps")
     if args.overlap and args.microbatches < 2:
         parser.error("--overlap needs --microbatches >= 2")
+    if args.topology:
+        if args.collective != "allreduce":
+            parser.error("--topology applies to the allreduce sweep only")
+        if args.two_phase or args.overlap or args.compression != "none":
+            parser.error("--topology is its own vehicle; run other "
+                         "sweeps separately")
     # Metric identity carries the vehicle: a compressed-wire sweep must
     # never overwrite the BASELINE allreduce row in trend tooling.
     metric = (f"{args.collective}_busbw_peak" if args.compression == "none"
@@ -137,6 +158,8 @@ def main() -> None:
                   if args.compression == "none"
                   else f"allreduce_overlap_{args.compression}"
                        "_wire_busbw_peak")
+    if args.topology:
+        metric = "allreduce_topo_hierarchical_busbw_peak"
 
     if args.cpu_mesh:
         from horovod_tpu.utils.platform import force_cpu_mesh
@@ -156,6 +179,21 @@ def main() -> None:
     n = hvd.size()
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     bytes_per = 2 if args.dtype == "bfloat16" else 4
+
+    def _global_stack(shape, dt):
+        # Multi-controller safe: each process materializes only its
+        # addressable shards (a host-built jnp.ones cannot be
+        # device_put onto a multi-process mesh).  Shared by every
+        # vehicle block below.
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        gm = hvd.global_mesh()
+        return jax.make_array_from_callback(
+            shape, NamedSharding(gm.mesh, P(gm.axis_name)),
+            lambda idx: np.ones(
+                tuple(len(range(*s.indices(dim)))
+                      for s, dim in zip(idx, shape)), dt))
 
     # (run_fn(stack), payload_bytes(elems), busbw factor) per collective
     # — nccl-tests conventions; `elems` is one slot's contribution.
@@ -194,8 +232,6 @@ def main() -> None:
         comp_cls = {"exact": Comp.none, "fp16": Comp.fp16,
                     "bf16": Comp.bf16, "int8": Comp.int8}[args.compression]
         gm = hvd.global_mesh()
-        stack_sharding = NamedSharding(gm.mesh, P(gm.axis_name))
-
         def per_slot(xb):  # [1, elems] — this slot's gradient shard
             red = comp_cls.spmd_allreduce(xb[0], op="sum",
                                           axis=gm.axis_name)
@@ -206,16 +242,6 @@ def main() -> None:
             return shard_map(per_slot, mesh=gm.mesh,
                              in_specs=P(gm.axis_name),
                              out_specs=P(gm.axis_name))(stack)
-
-        def _global_stack(shape, dt):
-            # Multi-controller safe: each process materializes only its
-            # addressable shards (a host-local jnp.ones cannot be
-            # device_put onto a multi-process mesh).
-            return jax.make_array_from_callback(
-                shape, stack_sharding,
-                lambda idx: np.ones(
-                    tuple(len(range(*s.indices(dim)))
-                          for s, dim in zip(idx, shape)), dt))
 
         def run(s):  # noqa: F811 — compressed vehicle replaces the map
             return spmd_wire(s)
@@ -246,15 +272,7 @@ def main() -> None:
             cost_beta_gbps=(args.cost_beta_gbps if args.cost_beta_gbps
                             is not None else 1.0))
         gm = hvd.global_mesh()
-        stack_sharding = NamedSharding(gm.mesh, P(gm.axis_name))
         nbuckets = max(1, args.bench_buckets)
-
-        def _global_stack(shape, dt):
-            return jax.make_array_from_callback(
-                shape, stack_sharding,
-                lambda idx: np.ones(
-                    tuple(len(range(*s.indices(dim)))
-                          for s, dim in zip(idx, shape)), dt))
 
         def _mk_stack(elems):  # noqa: F811 — bucket-splittable payload
             elems = ((elems + n * nbuckets - 1) // (n * nbuckets)) \
@@ -298,15 +316,7 @@ def main() -> None:
                     "fp16": Comp.fp16, "bf16": Comp.bf16,
                     "int8": Comp.int8}[args.compression]
         gm = hvd.global_mesh()
-        stack_sharding = NamedSharding(gm.mesh, P(gm.axis_name))
         mbs = args.microbatches
-
-        def _global_stack(shape, dt):
-            return jax.make_array_from_callback(
-                shape, stack_sharding,
-                lambda idx: np.ones(
-                    tuple(len(range(*s.indices(dim)))
-                          for s, dim in zip(idx, shape)), dt))
 
         def _mk_stack(elems):  # noqa: F811 — RS needs n-divisible flats
             elems = ((elems + n - 1) // n) * n
@@ -334,6 +344,73 @@ def main() -> None:
                                      out_specs=P(gm.axis_name)))
 
         runs = {"sequential": _wire(False), "overlap": _wire(True)}
+
+    topo_ctx = None
+    if args.topology:
+        # Topology vehicle: the compiled-schedule wire of
+        # horovod_tpu/topo/schedule.py executed inside shard_map over
+        # the simulated two-tier mesh — every path runs the SAME
+        # executor, only the compiled algorithm differs, so the rows
+        # compare schedule against schedule, not harness against
+        # harness.  On CPU all links are loopback, so the busbw deltas
+        # measure wire-byte and launch-count structure (hierarchical
+        # moves 1/C of the payload on the "DCN" groups), not real DCN
+        # contention; the modeled per-tier costs ride along in each row
+        # for the modeled-vs-chosen agreement check.
+        import dataclasses
+
+        import numpy as np
+        from horovod_tpu import basics
+        from horovod_tpu._compat import shard_map
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from horovod_tpu.config import parse_topo_spec
+        from horovod_tpu.ops.compression import Compression as Comp
+        from horovod_tpu.topo import costmodel as topo_cost
+        from horovod_tpu.topo import schedule as topo_sched
+        from horovod_tpu.topo.topology import MeshTopology
+
+        pods, chips = parse_topo_spec(args.topology)
+        if pods * chips != n:
+            parser.error(f"--topology {args.topology} declares "
+                         f"{pods * chips} slots but the mesh has {n}")
+        cfg_updates = {"topo_spec": args.topology}
+        if args.cost_alpha_us is not None:
+            cfg_updates["cost_alpha_us"] = args.cost_alpha_us
+        if args.cost_beta_gbps is not None:
+            cfg_updates["cost_beta_gbps"] = args.cost_beta_gbps
+        if args.dcn_alpha_us is not None:
+            cfg_updates["topo_alpha_dcn_us"] = args.dcn_alpha_us
+        if args.dcn_beta_gbps is not None:
+            cfg_updates["topo_beta_dcn_gbps"] = args.dcn_beta_gbps
+        basics._state.config = dataclasses.replace(basics.config(),
+                                                   **cfg_updates)
+        topo = MeshTopology(pods=pods, chips_per_pod=chips)
+        params = topo_cost.default_params()
+        gm = hvd.global_mesh()
+
+        def _mk_stack(elems):  # noqa: F811 — hierarchical RS needs n | elems
+            elems = ((elems + n - 1) // n) * n
+            return _global_stack((n, elems), dtype), elems
+
+        def _wire(algo):
+            def per_slot(xb):  # [1, elems] — this slot's gradient
+                sched = topo_sched.compile_bucket_schedule(
+                    int(xb.shape[-1]) * bytes_per, topo, params,
+                    force=algo)
+                red = topo_sched.execute_schedule(
+                    xb[0], sched, axis=gm.axis_name, op="sum",
+                    compression=Comp.none)
+                return red[None]
+
+            return jax.jit(shard_map(per_slot, mesh=gm.mesh,
+                                     in_specs=P(gm.axis_name),
+                                     out_specs=P(gm.axis_name)))
+
+        runs = {"flat": _wire("flat"), "two_phase": _wire("two_phase"),
+                "hierarchical": _wire("hierarchical")}
+        topo_ctx = {"topo": topo, "params": params, "agreement": [],
+                    "choose": lambda b: topo_sched.compile_bucket_schedule(
+                        int(b), topo, params)}
 
     factor = ((2 * (n - 1) / n) if args.collective == "allreduce"
               else (n - 1) / n) if n > 1 else 1.0
@@ -369,6 +446,22 @@ def main() -> None:
                    "busbw_GBps": round(busbw, 3), "n_slots": n}
             if path:
                 row["path"] = path
+            if topo_ctx is not None:
+                t, p = topo_ctx["topo"], topo_ctx["params"]
+                from horovod_tpu.topo.costmodel import (
+                    flat_cost_us, hierarchical_cost_us)
+
+                flat_us = flat_cost_us(payload, t, p)
+                hier_us = hierarchical_cost_us(payload, t, p)
+                row["modeled_flat_us"] = round(flat_us, 3)
+                row["modeled_hierarchical_us"] = round(hier_us, 3)
+                # The compiler's own resolution (native twin when
+                # built), so the agreement check cross-examines the
+                # dispatched choice against the unrounded Python model.
+                row["chosen"] = topo_ctx["choose"](payload).algo
+                topo_ctx["agreement"].append(
+                    (row["chosen"] == "hierarchical")
+                    == (hier_us < flat_us))
             results.append(row)
             print(json.dumps(row), flush=True)
         elems *= 4
@@ -377,6 +470,9 @@ def main() -> None:
         peak_rows = [r for r in results if r.get("path") == "two_phase"]
     elif args.overlap:
         peak_rows = [r for r in results if r.get("path") == "overlap"]
+    elif args.topology:
+        peak_rows = [r for r in results
+                     if r.get("path") == "hierarchical"]
     else:
         peak_rows = results
     peak = max(r["busbw_GBps"] for r in peak_rows)
@@ -398,6 +494,31 @@ def main() -> None:
             "single_phase_busbw_peak": single_peak,
             "two_phase_vs_single": round(peak / single_peak, 3)
             if single_peak else None,
+        })
+    if args.topology:
+        from horovod_tpu.topo.costmodel import hierarchical_crossover_bytes
+
+        t, p = topo_ctx["topo"], topo_ctx["params"]
+        flat_peak = max(r["busbw_GBps"] for r in results
+                        if r.get("path") == "flat")
+        tp_peak = max(r["busbw_GBps"] for r in results
+                      if r.get("path") == "two_phase")
+        # Where the model says hierarchical wins, the compiler must
+        # have picked it (and vice versa) — the agreement surface the
+        # acceptance test asserts over, computed per size against the
+        # UNROUNDED modeled costs (the row fields are display-rounded).
+        agreement = all(topo_ctx["agreement"])
+        summary.update({
+            "vehicle": "topo_schedule_wire",
+            "topology": t.describe(),
+            "flat_busbw_peak": flat_peak,
+            "two_phase_busbw_peak": tp_peak,
+            "hierarchical_vs_flat": round(peak / flat_peak, 3)
+            if flat_peak else None,
+            "crossover_bytes": hierarchical_crossover_bytes(t, p),
+            "modeled_vs_chosen_agree": agreement,
+            "dcn_alpha_us": p.dcn.alpha_us,
+            "dcn_beta_gbps": p.dcn.beta_gbps,
         })
     if args.overlap:
         from horovod_tpu.ops.fusion import estimate_overlap_hidden_fraction

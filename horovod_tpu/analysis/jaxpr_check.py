@@ -218,4 +218,49 @@ def run_jaxpr_checks(microbatches: int = 2) -> List[Finding]:
             f"plan_bucket_schedule is rank-dependent: rank 0 plans "
             f"{schedules[0]}, rank 1 plans {schedules[1]} — the bucket "
             f"schedule must be identical on every rank"))
+
+    # 6. Hierarchical topo schedules (topo/schedule.py): the same train
+    # step compiled under a forced two-tier topology must trace the
+    # identical collective sequence on every simulated rank — the
+    # cross-pod exchange is a rendezvous over axis_index_groups, so a
+    # rank-conditioned schedule here deadlocks pods, not just ranks.
+    if world > 1 and world % 2 == 0:
+        import dataclasses
+
+        from ..topo.schedule import compile_bucket_schedule
+        from ..topo.topology import MeshTopology
+
+        old_cfg = basics._state.config
+        topo_cfg = dataclasses.replace(
+            old_cfg, topo_schedule="hierarchical",
+            topo_spec=f"2x{world // 2}")
+        # Analysis-only config override, restored in finally
+        # (single-threaded CI harness).
+        try:
+            basics._state.config = topo_cfg
+            findings += check_step_rank_consistency(
+                lambda: make_train_step(loss_fn, tx),
+                lambda: (params, tx.init(params), batch),
+                path="horovod_tpu/topo/schedule.py",
+                what="make_train_step(topo_schedule=hierarchical)")
+        finally:
+            basics._state.config = old_cfg
+
+        # The compiled IR itself must be rank-invariant too (static
+        # bytes in, schedule out) — the GC3 "verifiable compiler
+        # output" property.
+        topo = MeshTopology(pods=2, chips_per_pod=world // 2)
+        topo_scheds = []
+        for r in (0, 1):
+            with simulate_rank_env(r):
+                topo_scheds.append(compile_bucket_schedule(
+                    1 << 22, topo))
+        if topo_scheds[0] != topo_scheds[1]:
+            findings.append(Finding(
+                "jaxpr-rank-divergence", "horovod_tpu/topo/schedule.py",
+                1,
+                f"compile_bucket_schedule is rank-dependent: rank 0 "
+                f"compiles {topo_scheds[0]}, rank 1 compiles "
+                f"{topo_scheds[1]} — the schedule IR must be identical "
+                f"on every rank"))
     return findings

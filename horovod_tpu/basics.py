@@ -281,6 +281,10 @@ def _maybe_build_parameter_manager(cfg):
     lossy tiers unbiased, so the tuner may trade quantization noise for
     wire time; a plain make_train_step reduce has no residual state and
     warns once when a config-driven lossy tier lands on it.
+    With ``HVD_TPU_TOPO_SCHEDULE`` on (any value but ``off``) over a
+    genuinely two-tier mesh, the ``topo_schedule`` axis joins (1..3 =
+    flat/two_phase/hierarchical — docs/topology.md): the per-tier cost
+    model proposes, the GP disposes.
     All knobs are applied at the re-jit boundary (the next-cycle
     application point of the reference); see ``optim/autotune.py`` and
     ``_apply_autotuned_knobs``."""
@@ -321,6 +325,27 @@ def _maybe_build_parameter_manager(cfg):
         knobs["compressor"] = (1, len(_COMPRESSOR_LATTICE))
         live_comp = cfg.compression or "none"
         initial["compressor"] = _COMPRESSOR_LATTICE.index(live_comp) + 1
+    if cfg.topo_schedule != "off" and size > 1:
+        # Topology-aware schedule axis (1..3 = flat/two_phase/
+        # hierarchical): the cost model's choice ("auto") seeds the
+        # search, and the GP is free to discover the model's priors are
+        # wrong for this job — its winner pins the schedule explicitly.
+        # Resolve from the cfg in hand, not config_topology(): the
+        # manager builds before _state.initialized flips, so trace-time
+        # helpers can't see the declared spec yet.
+        from .topo.topology import MeshTopology, resolve_topology
+
+        try:
+            topo = resolve_topology(size, cfg.topo_spec)
+        except ValueError:
+            topo = MeshTopology(pods=1, chips_per_pod=size)
+        if topo.two_tier:
+            knobs["topo_schedule"] = (1, len(_TOPO_LATTICE))
+            live_topo = cfg.topo_schedule
+            initial["topo_schedule"] = (
+                _TOPO_LATTICE.index(live_topo) + 1
+                if live_topo in _TOPO_LATTICE
+                else len(_TOPO_LATTICE))   # auto seeds at hierarchical
     if joint:
         # log2 search over [1, size]; proposals snap to the nearest
         # divisor of the slot count (1 and size both mean "flat"
@@ -411,6 +436,10 @@ _MAX_MICROBATCHES = 32
 # HVD_TPU_COMPRESSION values, so the applied point round-trips.
 _COMPRESSOR_LATTICE = ("none", "fp16", "bf16", "int8")
 
+# Topo-schedule search lattice (1..3; "auto" is the cost model deciding
+# and is what the knob replaces, so it is not itself a search point).
+_TOPO_LATTICE = ("flat", "two_phase", "hierarchical")
+
 
 def _nearest_pow2(value: int) -> int:
     """Nearest power of two in log space (microbatch proposals must land
@@ -492,6 +521,11 @@ def _apply_autotuned_knobs(values) -> dict:
                   len(_COMPRESSOR_LATTICE))
         updates["compression"] = _COMPRESSOR_LATTICE[idx - 1]
         applied["compressor"] = idx
+    if "topo_schedule" in values:
+        idx = min(max(1, int(round(values["topo_schedule"]))),
+                  len(_TOPO_LATTICE))
+        updates["topo_schedule"] = _TOPO_LATTICE[idx - 1]
+        applied["topo_schedule"] = idx
     # The swap races with concurrent trace-time config() readers
     # (serving threads, a re-jitting train step) — publish under the
     # state lock like every other _state mutation.

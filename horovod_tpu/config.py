@@ -36,7 +36,7 @@ DEFAULT_COST_BETA_GBPS = 100.0
 # init, exactly like every other malformed env knob.
 
 FAULT_SITES = ("collective", "fusion", "accumulate", "discovery", "rpc",
-               "checkpoint", "serve")
+               "checkpoint", "serve", "dcn")
 
 
 # --- pre-init knob registry --------------------------------------------------
@@ -79,7 +79,53 @@ _FAULT_MODES = {
     # kill fires at the continuous batcher's decode dispatch (replica
     # death mid-decode — the router-failover drill).
     "serve": ("drop", "delay", "kill"),
+    # dcn: fires ONLY at the cross-pod exchange step of a hierarchical
+    # collective schedule (topo/schedule.py) — the slow-tier link is
+    # the one that actually fails in multi-pod fleets.  drop/partition
+    # raise HorovodInternalError while the exchange is being emitted
+    # (trace time, like `fusion`); delay sleeps delay_ms there.
+    "dcn": ("drop", "delay", "partition"),
 }
+
+
+# --- two-tier topology spec grammar (HVD_TPU_TOPO_SPEC) ----------------------
+# ``PODSxCHIPS`` — e.g. ``4x8`` declares 4 pods of 8 chips, pods laid
+# out contiguously along the 1-D mesh axis (slots [0..7] are pod 0).
+# Parsed here (like the fault-spec grammar) so a typo'd spec fails
+# loudly at init and so horovod_tpu.topo can consume the parse without
+# a config->topo import cycle.
+
+def parse_topo_spec(spec: str) -> "tuple[int, int]":
+    """Parse ``HVD_TPU_TOPO_SPEC`` into ``(pods, chips_per_pod)``.
+    Raises ``ValueError`` on anything but two positive ints joined by
+    ``x`` — a malformed topology must not silently run flat."""
+    body = spec.strip().lower()
+    pods_s, sep, chips_s = body.partition("x")
+    if not sep or not pods_s.strip() or not chips_s.strip():
+        raise ValueError(
+            f"topo spec: expected PODSxCHIPS (e.g. '4x8'), got {spec!r}")
+    try:
+        pods, chips = int(pods_s.strip()), int(chips_s.strip())
+    except ValueError as e:
+        raise ValueError(
+            f"topo spec: expected PODSxCHIPS with integer factors, got "
+            f"{spec!r}") from e
+    if pods < 1 or chips < 1:
+        raise ValueError(
+            f"topo spec: factors must be >= 1, got {pods}x{chips}")
+    return pods, chips
+
+
+def _validated_topo_spec(spec: Optional[str]) -> Optional[str]:
+    """Empty/unset → None; anything else must parse (fail at init)."""
+    if not spec or not spec.strip():
+        return None
+    parse_topo_spec(spec)  # raises ValueError on a malformed spec
+    return spec
+
+
+# Schedule algorithms the topo compiler can emit / be pinned to.
+TOPO_SCHEDULES = ("off", "auto", "flat", "two_phase", "hierarchical")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -321,6 +367,15 @@ class Config:
     error_feedback: bool = False     # HVD_TPU_ERROR_FEEDBACK (carry lossy-wire residual, re-inject next step)
     compression: Optional[str] = None  # HVD_TPU_COMPRESSION (none|fp16|bf16|int8; unset = call-site argument)
 
+    # --- topology-aware collective scheduling (horovod_tpu/topo/;
+    #     the "schedules as compiler output" direction of GC3 and the
+    #     100k-GPU collectives line in PAPERS.md) ---
+    topo_spec: Optional[str] = None    # HVD_TPU_TOPO_SPEC ("PODSxCHIPS"; unset = infer from jax.devices())
+    topo_schedule: str = "off"         # HVD_TPU_TOPO_SCHEDULE (off|auto|flat|two_phase|hierarchical)
+    topo_cost_freeze: bool = False     # HVD_TPU_TOPO_COST_FREEZE (pin the per-tier α/β; stop online refinement)
+    topo_alpha_dcn_us: float = 100.0   # HVD_TPU_TOPO_ALPHA_DCN_US (per-hop launch latency on the inter-pod tier)
+    topo_beta_dcn_gbps: float = 10.0   # HVD_TPU_TOPO_BETA_DCN_GBPS (per-hop bandwidth on the inter-pod tier)
+
     # --- collectives ---
     hierarchical_allreduce: bool = False      # HOROVOD_HIERARCHICAL_ALLREDUCE
     hierarchical_allgather: bool = False      # HOROVOD_HIERARCHICAL_ALLGATHER (no-op: warns)
@@ -412,6 +467,12 @@ class Config:
             error_feedback=_env_bool("ERROR_FEEDBACK", False),
             compression=_env_choice("COMPRESSION", None,
                                     ("none", "fp16", "bf16", "int8")),
+            topo_spec=_validated_topo_spec(_env("TOPO_SPEC")),
+            topo_schedule=_env_choice("TOPO_SCHEDULE", "off",
+                                      TOPO_SCHEDULES) or "off",
+            topo_cost_freeze=_env_bool("TOPO_COST_FREEZE", False),
+            topo_alpha_dcn_us=_env_float("TOPO_ALPHA_DCN_US", 100.0),
+            topo_beta_dcn_gbps=_env_float("TOPO_BETA_DCN_GBPS", 10.0),
             hierarchical_allreduce=_env_bool("HIERARCHICAL_ALLREDUCE", False),
             hierarchical_allgather=_env_bool("HIERARCHICAL_ALLGATHER", False),
             batch_d2d_memcopies=_env_bool("BATCH_D2D_MEMCOPIES", True),

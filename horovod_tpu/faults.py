@@ -19,6 +19,8 @@ rpc        ``runner/common/network.py`` BasicClient calls        ``drop``/``dela
 checkpoint ``checkpoint.py`` Checkpointer.save                   ``corrupt``/``partial``
 serve      ``serve/server.py`` request handler (drop/delay);     ``drop``/``delay``/``kill``
            ``serve/batcher.py`` decode dispatch (kill)
+dcn        ``topo/schedule.py`` cross-pod exchange step only     ``drop``/``delay``/``partition``
+           (trace time; intra-pod phases never fire)
 ========== ===================================================== =====================
 
 A plan comes from ``HVD_TPU_FAULT_SPEC`` (grammar parsed in
@@ -53,7 +55,7 @@ __all__ = [
     "configure", "clear", "inject", "active_spec", "history",
     "on_collective", "on_fusion", "on_accumulate", "on_discovery_script",
     "on_discovery_hosts", "on_rpc", "on_checkpoint_save",
-    "on_serve_request", "on_serve_decode",
+    "on_serve_request", "on_serve_decode", "on_dcn",
 ]
 
 
@@ -247,6 +249,38 @@ def on_accumulate(microbatch: int = 0) -> None:
         raise _internal_error(
             f"injected accumulate fault at boundary #{at} "
             f"(microbatch {microbatch})")
+
+
+def on_dcn(stage: str = "xpod") -> None:
+    """Site ``dcn`` — fires ONLY at the cross-pod exchange step of a
+    hierarchical collective schedule (``topo/schedule.py``), never at
+    the intra-pod phases: the slow inter-pod tier is the link that
+    actually fails in multi-pod fleets, and a chaos drill should hit
+    exactly it.  Trace time, like ``fusion`` — the failure surfaces
+    while the cross-pod exchange is being emitted.  ``drop`` and
+    ``partition`` raise ``HorovodInternalError`` (partition carries the
+    pods-unreachable message recovery tooling greps for); ``delay``
+    sleeps ``delay_ms`` (a congested DCN link stretching trace/compile
+    time)."""
+    plan = _active
+    if plan is None:
+        return
+    st = plan.site("dcn")
+    if st is None:
+        return
+    at = st.counter
+    if st.should_fire():
+        mode = st.clause.mode or "drop"
+        plan.fire("dcn", mode, at, stage)
+        if mode == "delay":
+            time.sleep(st.clause.delay_ms / 1000.0)
+            return
+        if mode == "partition":
+            raise _internal_error(
+                f"injected dcn partition at exchange #{at} ({stage}): "
+                f"cross-pod peers unreachable")
+        raise _internal_error(
+            f"injected dcn drop at exchange #{at} ({stage})")
 
 
 def on_discovery_script(script: str = "") -> None:

@@ -18,7 +18,7 @@ from . import build as _build
 
 logger = get_logger(__name__)
 
-ABI_VERSION = 2
+ABI_VERSION = 3
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None   # guarded-by: _lock
@@ -34,6 +34,10 @@ _SIGNATURES = {
                                      ctypes.POINTER(c_i32)]),
     "hvd_tpu_plan_two_phase": (c_i64, [ctypes.POINTER(c_i64), c_i64, c_i64,
                                        c_dbl, c_dbl, ctypes.POINTER(c_i8)]),
+    "hvd_tpu_plan_hierarchical": (c_i64, [ctypes.POINTER(c_i64), c_i64,
+                                          c_i64, c_i64, c_dbl, c_dbl,
+                                          c_dbl, c_dbl,
+                                          ctypes.POINTER(c_i8)]),
     # controller
     "hvd_ctrl_create": (c_void, [c_i32, c_i64, c_i64]),
     "hvd_ctrl_destroy": (None, [c_void]),

@@ -58,3 +58,39 @@ def plan_two_phase_flags(bucket_bytes: Sequence[int], world_size: int,
             f"Invalid schedule planner input (n={n}, world={world_size}, "
             f"alpha_us={alpha_us}, beta_gbps={beta_gbps})")
     return [bool(flags[i]) for i in range(n)]
+
+
+_ALGO_NAMES = ("flat", "two_phase", "hierarchical")
+
+
+def plan_hierarchical(bucket_bytes: Sequence[int], pods: int, chips: int,
+                      alpha_ici_us: float, beta_ici_gbps: float,
+                      alpha_dcn_us: float,
+                      beta_dcn_gbps: float) -> List[str]:
+    """Native two-tier schedule choice per bucket (same contract as
+    ``topo.schedule.choose_algo``; equivalence is property-tested in
+    tests/test_topo.py).  Returns one of flat/two_phase/hierarchical
+    per bucket."""
+    lib = bindings.load()
+    if lib is None:
+        from ..topo.costmodel import TierParams, TopoCostParams
+        from ..topo.schedule import choose_algo
+        from ..topo.topology import MeshTopology
+
+        topo = MeshTopology(pods=pods, chips_per_pod=chips)
+        params = TopoCostParams(
+            ici=TierParams(alpha_ici_us, beta_ici_gbps),
+            dcn=TierParams(alpha_dcn_us, beta_dcn_gbps))
+        return [choose_algo(int(b), topo, params) for b in bucket_bytes]
+    n = len(bucket_bytes)
+    sizes_arr = (ctypes.c_int64 * n)(*[int(b) for b in bucket_bytes])
+    algos = (ctypes.c_int8 * n)()
+    rc = lib.hvd_tpu_plan_hierarchical(
+        sizes_arr, n, int(pods), int(chips), float(alpha_ici_us),
+        float(beta_ici_gbps), float(alpha_dcn_us), float(beta_dcn_gbps),
+        algos)
+    if rc < 0:
+        raise ValueError(
+            f"Invalid hierarchical planner input (n={n}, "
+            f"pods={pods}, chips={chips})")
+    return [_ALGO_NAMES[algos[i]] for i in range(n)]

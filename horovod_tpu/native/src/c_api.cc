@@ -85,7 +85,7 @@ extern "C" {
 
 // ---- version ---------------------------------------------------------------
 
-int64_t hvd_tpu_native_abi_version() { return 2; }
+int64_t hvd_tpu_native_abi_version() { return 3; }
 
 // ---- controller ------------------------------------------------------------
 

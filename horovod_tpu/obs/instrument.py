@@ -32,7 +32,8 @@ __all__ = [
     "wrap_step", "on_fusion_plan", "on_collective_dispatch", "on_retry",
     "on_fault", "on_elastic_reset", "on_blacklist", "on_membership_loss",
     "on_stall", "on_autotune_window", "on_autotune_apply", "autotune_log",
-    "set_mfu", "set_hidden_comm_estimate",
+    "set_mfu", "set_hidden_comm_estimate", "on_topo_plan",
+    "on_topo_estimator",
 ]
 
 
@@ -125,6 +126,7 @@ def wrap_step(step_fn, *, kind: str = "train"):
             "step_time_ms": dt * 1e3,
             "tokens_per_s": (toks / dt) if dt > 0 else 0.0,
         })
+        _refine_topo_estimator(dt)
         return out
 
     instrumented_step._hvd_tpu_instrumented = True  # introspection/tests
@@ -184,6 +186,17 @@ def record_microbatch_plan(mb: int, *, overlap: bool) -> None:
                   1.0 if overlap else 0.0)
 
 
+def _refine_topo_estimator(step_time_s: float) -> None:
+    """Feed one finished step into the topo cost estimator (the online
+    α/β refinement loop of docs/topology.md).  No-op — one module
+    check — unless a topo schedule compiled this step's wire."""
+    from ..topo import costmodel as _topo_cost
+
+    est = _topo_cost._estimator
+    if est is not None:
+        est.refine_from_step(step_time_s)
+
+
 # --- ops: fusion planner + collectives dispatch ------------------------------
 
 def on_fusion_plan(tier: str, *, bytes_on_wire: int, buckets: int,
@@ -241,6 +254,53 @@ def on_collective_dispatch(op: str, nbytes: int) -> None:
     if nbytes > 0:
         reg.counter("hvd_tpu_wire_bytes_total", "").labels(
             tier="slots").inc(nbytes)
+
+
+# --- topology-aware scheduling (horovod_tpu/topo/) ---------------------------
+
+def on_topo_plan(algo_buckets: Dict[str, int], *,
+                 tier_bytes: Dict[str, int],
+                 est_cost_us: Dict[str, float]) -> None:
+    """Trace-time record of one compiled topo plan (all buckets of one
+    fused apply): per-tier wire bytes (counters accumulate per trace,
+    like the fusion tiers; the compiled program replays the plan every
+    step), the cost model's per-tier makespan, and the per-algorithm
+    bucket counts (``algo`` labels come from the closed
+    flat/two_phase/hierarchical set)."""
+    if not _m.enabled():
+        return
+    reg = _reg()
+    for algo, buckets in algo_buckets.items():
+        reg.counter("hvd_tpu_topo_schedules_total",
+                    "topo schedules compiled, by algorithm").labels(
+                        algo=algo).inc(buckets)
+    for tier, nbytes in tier_bytes.items():
+        reg.counter("hvd_tpu_topo_wire_bytes_total",
+                    "bytes the compiled topo schedule puts on each "
+                    "tier's wire (per trace; the program replays the "
+                    "plan every step)").labels(tier=tier).inc(nbytes)
+        reg.gauge("hvd_tpu_topo_wire_bytes_per_step",
+                  "latest topo plan's per-step bytes, by tier").labels(
+                      tier=tier).set(nbytes)
+    for tier, cost in est_cost_us.items():
+        reg.gauge("hvd_tpu_topo_est_cost_us",
+                  "cost-model makespan of the latest topo schedule, "
+                  "by tier").labels(tier=tier).set(cost)
+
+
+def on_topo_estimator(tier: str, alpha_us: float,
+                      beta_gbps: float) -> None:
+    """The online estimator's current per-tier α/β point
+    (``topo/costmodel.OnlineEstimator``)."""
+    if not _m.enabled():
+        return
+    reg = _reg()
+    reg.gauge("hvd_tpu_topo_cost_alpha_us",
+              "estimated per-hop launch latency, by tier").labels(
+                  tier=tier).set(alpha_us)
+    reg.gauge("hvd_tpu_topo_cost_beta_gbps",
+              "estimated per-hop bandwidth, by tier").labels(
+                  tier=tier).set(beta_gbps)
 
 
 # --- recovery layers ---------------------------------------------------------

@@ -453,6 +453,7 @@ def fused_two_phase_apply(
     beta_gbps: float,
     prescale_factor: float = 1.0,
     postscale_factor: float = 1.0,
+    schedule=None,
 ) -> List[jax.Array]:
     """Schedule-aware fused allreduce: buckets whose payload clears the
     α–β crossover decompose into reduce-scatter → all-gather, emitted in
@@ -462,6 +463,13 @@ def fused_two_phase_apply(
     the wire).  Latency-bound buckets stay single-launch allreduces.
     Must run inside an SPMD region over ``axis``; numerically equivalent
     to the single-phase path (same reduction, same compression wire).
+
+    ``schedule`` (a ``topo.schedule.ScheduleCompiler``) replaces the
+    flat α–β phase decision with the two-tier compiler's per-bucket
+    choice: ``two_phase`` buckets keep the pipelined RS/AG emission,
+    ``hierarchical`` buckets ride the compiled RS-intra → cross-pod →
+    AG-intra lowering as single composite entries in the emission
+    order, and ``flat`` buckets stay monolithic allreduces.
     """
     # Fault site "fusion": fires at trace time — the failure surfaces
     # while the fused two-phase program is being built, the moment a
@@ -496,7 +504,21 @@ def fused_two_phase_apply(
                 "bytes": sum(sizes[j] for j in bucket),
             })
 
-    if n is None or n <= 1:
+    scheds: dict = {}
+    if schedule is not None and groups is None and n is not None \
+            and n > 1 and schedule.topo.size == n:
+        # Topo schedules are defined on the global axis: a process-set
+        # sub-reduction (groups) or a compiler built for a different
+        # mesh width must fall back to the flat planner — executing a
+        # whole-axis schedule there would sum across group boundaries.
+        for bi, b in enumerate(packed):
+            scheds[bi] = schedule.compile(b["bytes"])
+        # Hierarchical buckets are single composite entries in the
+        # emission order (kind "ar"); only the compiler's two_phase
+        # buckets join the pipelined RS/AG interleave.
+        flags = [scheds[bi].algo == "two_phase"
+                 for bi in range(len(packed))]
+    elif n is None or n <= 1:
         flags = [False] * len(packed)
     else:
         flags = _dispatch_two_phase_flags([b["bytes"] for b in packed], n,
@@ -515,14 +537,29 @@ def fused_two_phase_apply(
             est_cost_us=estimate_schedule_cost_us(
                 [b["bytes"] for b in packed], flags, n or 1, alpha_us,
                 beta_gbps))
+    if scheds:
+        from ..topo import schedule as _topo_sched_mod
+
+        _topo_sched_mod.record_plans(
+            scheds.values(), compression,
+            jnp.asarray(leaves[0]).dtype.itemsize if leaves else 4,
+            params=schedule.params)
 
     shards: dict = {}
     reduced: dict = {}
     for kind, bi in order:
         b = packed[bi]
         if kind == "ar":
-            reduced[bi] = compression.spmd_allreduce(
-                b["fused"], op=op, axis=axis, groups=groups)
+            sched = scheds.get(bi)
+            if sched is not None:
+                from ..topo import schedule as _topo_sched
+
+                reduced[bi] = _topo_sched.execute_schedule(
+                    b["fused"], sched, axis=axis, op=op,
+                    compression=compression)
+            else:
+                reduced[bi] = compression.spmd_allreduce(
+                    b["fused"], op=op, axis=axis, groups=groups)
         elif kind == "rs":
             x = b["fused"]
             pad = (-x.size) % n
@@ -622,15 +659,36 @@ def zero_overlap_shards(plan: OverlapBucketPlan) -> Tuple[jax.Array, ...]:
                  for e, dt in zip(plan.shard_elems, plan.dtypes))
 
 
+def _overlap_bucket_schedule(plan: OverlapBucketPlan, bi: int, topo):
+    """Compiled schedule for one overlap bucket, or None for the flat
+    wire.  The compile keys off the bucket's exact payload bytes — the
+    same coordinate the fused paths use — so the per-bucket choice is
+    identical everywhere a bucket's bytes appear."""
+    if topo is None:
+        return None
+    if topo.topo.size != plan.n:
+        return None   # topology describes a different mesh than this wire
+    nbytes = plan.payload[bi] * np.dtype(plan.dtypes[bi]).itemsize
+    sched = topo.compile(int(nbytes))
+    return sched if sched.algo == "hierarchical" else None
+
+
 def overlap_reduce_scatter(leaves: Sequence[jax.Array],
                            plan: OverlapBucketPlan, *, axis: str, op: str,
-                           groups, compression) -> Tuple[jax.Array, ...]:
+                           groups, compression,
+                           topo=None) -> Tuple[jax.Array, ...]:
     """One bucketed reduce-scatter pass over ``leaves`` (one
     microbatch's gradients): each bucket is flattened, padded to the
     group width and reduce-scattered on the compressor's wire, emitted
     in ``plan.order`` so the most expensive collectives are issued
     first.  Returns per-bucket shards in bucket-index order.  Must run
-    inside an SPMD region over ``axis``."""
+    inside an SPMD region over ``axis``.
+
+    ``topo`` (a ``topo.schedule.ScheduleCompiler``) lowers buckets the
+    two-tier compiler marks hierarchical through RS-intra (ICI) →
+    cross-pod RS (DCN): shards come back pod-major-permuted but the
+    same size, and :func:`overlap_all_gather` with the same compiler
+    inverts the permutation — flat-equivalent end to end."""
     shards: List[jax.Array] = [None] * len(plan.members)  # type: ignore
     for bi in plan.order:
         flats = [leaves[i].reshape(-1) for i in plan.members[bi]]
@@ -638,22 +696,40 @@ def overlap_reduce_scatter(leaves: Sequence[jax.Array],
         if plan.pad[bi]:
             fused = jnp.concatenate(
                 [fused, jnp.zeros((plan.pad[bi],), fused.dtype)])
-        shards[bi] = compression.spmd_reducescatter(
-            fused, op=op, axis=axis, groups=groups)
+        sched = _overlap_bucket_schedule(plan, bi, topo)
+        if sched is not None:
+            from ..topo import schedule as _topo_sched_mod
+
+            shards[bi] = _topo_sched_mod.hierarchical_reduce_scatter(
+                fused, sched, axis=axis, op=op, compression=compression)
+        else:
+            shards[bi] = compression.spmd_reducescatter(
+                fused, op=op, axis=axis, groups=groups)
     return tuple(shards)
 
 
 def overlap_all_gather(shards: Sequence[jax.Array],
                        plan: OverlapBucketPlan,
                        leaves_like: Sequence[jax.Array], *, axis: str,
-                       groups, compression) -> List[jax.Array]:
+                       groups, compression, topo=None) -> List[jax.Array]:
     """The deferred all-gather phase at the optimizer-update boundary:
     gather each bucket's accumulated shard on the compressor's wire,
     drop the padding and unpack to the leaf shapes of ``leaves_like``.
-    Must run inside an SPMD region over ``axis``."""
+    Must run inside an SPMD region over ``axis``.  ``topo`` must match
+    the :func:`overlap_reduce_scatter` call that produced the shards —
+    hierarchical buckets gather cross-pod then intra-pod, inverting the
+    RS permutation."""
     out: List[jax.Array] = [None] * len(leaves_like)  # type: ignore
     for bi, shard in enumerate(shards):
-        full = compression.spmd_allgather(shard, axis=axis, groups=groups)
+        sched = _overlap_bucket_schedule(plan, bi, topo)
+        if sched is not None:
+            from ..topo import schedule as _topo_sched_mod
+
+            full = _topo_sched_mod.hierarchical_all_gather(
+                shard, sched, axis=axis, compression=compression)
+        else:
+            full = compression.spmd_allgather(shard, axis=axis,
+                                              groups=groups)
         full = full[: plan.payload[bi]]
         offset = 0
         for i, ncols in zip(plan.members[bi], plan.cols[bi]):
@@ -676,6 +752,7 @@ def fused_allreduce_pytree(
     postscale_factor: float = 1.0,
     two_phase: Optional[bool] = None,
     pipeline_depth: Optional[int] = None,
+    topo_schedule=None,
 ) -> Any:
     """Fused allreduce of every leaf of a pytree — the gradient hot path
     (reference: fused ``ncclAllReduce`` over the fusion buffer).
@@ -687,6 +764,14 @@ def fused_allreduce_pytree(
     trace time, so the autotuner can flip them at a re-jit boundary.
     When on, bandwidth-bound buckets ride the pipelined reduce-scatter +
     all-gather schedule of :func:`fused_two_phase_apply`.
+
+    ``topo_schedule`` (a ``topo.schedule.ScheduleCompiler``, or None to
+    resolve ``HVD_TPU_TOPO_SCHEDULE`` at trace time — the autotuner's
+    topo application point) lowers each bucket through the two-tier
+    schedule compiler instead of the flat α–β planner: per bucket, flat
+    allreduce, global RS+AG, or hierarchical RS-intra → cross-pod
+    exchange → AG-intra, chosen by the per-tier cost model
+    (docs/topology.md).
     """
     from .compression import Compression
 
@@ -706,13 +791,21 @@ def fused_allreduce_pytree(
     two_phase = bool(two_phase) if two_phase is not None else False
     pipeline_depth = int(pipeline_depth) if pipeline_depth else 2
 
-    if two_phase:
+    compiler = topo_schedule
+    if compiler is None and op in ("sum", "average") and leaves:
+        from ..topo import schedule as _topo_sched_mod
+
+        n = _uniform_group_width(axis, groups)
+        if n is not None:
+            compiler = _topo_sched_mod.maybe_compiler(n, groups=groups)
+
+    if two_phase or compiler is not None:
         reduced = fused_two_phase_apply(
             leaves, axis=axis, op=op, groups=groups,
             compression=compression, threshold=threshold,
             pipeline_depth=pipeline_depth, alpha_us=alpha_us,
             beta_gbps=beta_gbps, prescale_factor=prescale_factor,
-            postscale_factor=postscale_factor)
+            postscale_factor=postscale_factor, schedule=compiler)
         return jax.tree.unflatten(treedef, reduced)
 
     if _obs.enabled() and leaves:

@@ -208,6 +208,29 @@ def _microbatch_grads(grad_fn, params, batch, mb, *, has_aux=False,
             leaves0, threshold, world_size=n, alpha_us=alpha_us,
             beta_gbps=beta_gbps)
         comp = compression or Compression.none
+        # Topology-aware lowering of the overlap wire: buckets the
+        # two-tier compiler marks hierarchical reduce-scatter within
+        # the pod and cross pods on the fragment (docs/topology.md);
+        # None = flat wire, the single-tier default.
+        from ..topo import schedule as _topo_sched_mod
+
+        topo_compiler = _topo_sched_mod.maybe_compiler(n, groups=groups)
+        if topo_compiler is not None:
+            # Record ONLY the buckets the wire will actually lower
+            # hierarchically (the _overlap_bucket_schedule gate below):
+            # flat/two-phase buckets ride the plain whole-axis RS+AG
+            # and are already covered by the overlap plan record.
+            executed = [
+                s for s in (fusion._overlap_bucket_schedule(
+                    plan, bi, topo_compiler)
+                    for bi in range(len(plan.members)))
+                if s is not None]
+            if executed:
+                _topo_sched_mod.record_plans(
+                    executed, comp,
+                    np.dtype(plan.dtypes[0]).itemsize
+                    if plan.dtypes else 4,
+                    params=topo_compiler.params)
         if _obs.enabled() and plan.members:
             # Trace-time plan record for the overlap wire: mb RS passes
             # plus ONE deferred AG ride this plan per step.
@@ -223,7 +246,7 @@ def _microbatch_grads(grad_fn, params, batch, mb, *, has_aux=False,
         def rs(leaves):
             return fusion.overlap_reduce_scatter(
                 leaves, plan, axis=axis, op=spmd_op, groups=groups,
-                compression=comp)
+                compression=comp, topo=topo_compiler)
 
         def body(carry, mb_i):
             pending, shard_acc, loss_acc = carry
@@ -246,7 +269,7 @@ def _microbatch_grads(grad_fn, params, batch, mb, *, has_aux=False,
         shard_acc = tuple(a + s for a, s in zip(shard_acc, rs(pending)))
         full = fusion.overlap_all_gather(
             shard_acc, plan, leaves0, axis=axis, groups=groups,
-            compression=comp)
+            compression=comp, topo=topo_compiler)
         grads = jax.tree.unflatten(treedef, [l / mb for l in full])
     else:
         def body(carry, mb_i):

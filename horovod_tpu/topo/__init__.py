@@ -1,0 +1,47 @@
+"""Topology-aware collective scheduling.
+
+The flat α–β planner in :mod:`horovod_tpu.ops.fusion` models the wire
+as one link; the moment a job spans pods the wire is two — fast
+intra-pod ICI and inter-pod DCN an order of magnitude slower in both
+latency and bandwidth.  This subsystem owns everything the flat planner
+cannot express (the GC3 "collective schedules as compiler output" and
+"Collective Communication for 100k+ GPUs" directions in PAPERS.md):
+
+* :mod:`.topology` — a declarative two-tier mesh description
+  (pods × chips-per-pod, from ``HVD_TPU_TOPO_SPEC`` or inferred from
+  ``jax.devices()``) with intra-/inter-tier process-set factories.
+* :mod:`.costmodel` — per-tier α/β parameters with an online EWMA
+  estimator fed by the ``obs/`` wire-byte and step-time signals
+  (frozen under ``HVD_TPU_TOPO_COST_FREEZE``).
+* :mod:`.schedule` — the compiler: per bucket, lower to flat allreduce,
+  two-phase RS+AG, or hierarchical RS-intra → cross-pod exchange →
+  AG-intra, chosen by modeled cost, emitted as a deterministic
+  rank-invariant :class:`~horovod_tpu.topo.schedule.CollectiveSchedule`
+  IR that ``ops/fusion.py`` executes (native twin:
+  ``hvd_tpu_plan_hierarchical`` in ``native/src/planner.cc``).
+* :mod:`.simulate` — a CPU multi-host mesh simulator (N simulated pods
+  on one host via sub-axis process sets) so the equivalence and cost
+  oracles run in tier-1.
+
+See ``docs/topology.md`` for the mesh-spec grammar, the schedule IR,
+the estimator, and the simulation recipe.
+"""
+
+from .topology import (MeshTopology, infer_topology, resolve_topology,
+                       register_tier_process_sets)
+from .costmodel import (TierParams, TopoCostParams, OnlineEstimator,
+                        flat_cost_us, hierarchical_cost_us,
+                        hierarchical_crossover_bytes, estimator)
+from .schedule import (CollectiveSchedule, ScheduleStep, ScheduleCompiler,
+                       choose_algo, compile_bucket_schedule,
+                       execute_schedule, maybe_compiler)
+
+__all__ = [
+    "MeshTopology", "infer_topology", "resolve_topology",
+    "register_tier_process_sets",
+    "TierParams", "TopoCostParams", "OnlineEstimator", "flat_cost_us",
+    "hierarchical_cost_us", "hierarchical_crossover_bytes", "estimator",
+    "CollectiveSchedule", "ScheduleStep", "ScheduleCompiler",
+    "choose_algo", "compile_bucket_schedule", "execute_schedule",
+    "maybe_compiler",
+]

@@ -1,0 +1,345 @@
+"""Per-tier α–β cost model for two-tier ICI×DCN meshes.
+
+Extends the flat α–β model of :mod:`horovod_tpu.ops.fusion` (per-hop
+launch latency α, per-hop bandwidth β) to two tiers with separate
+parameters.  The structural claims, with ``n = P·C`` slots in ``P``
+pods of ``C`` chips:
+
+* **Flat allreduce** is ONE compiled collective whose ring steps
+  pipeline neighbor-to-neighbor: per-hop launch stays at the fast
+  tier's α, but every ring step moves payload through the pod-boundary
+  links, so the transfer term runs at the DCN β —
+  ``2(n−1)·(α_ici + (b/n)/β_dcn)`` (single-pod meshes degrade to the
+  familiar all-ICI form).
+* **Hierarchical** (RS-intra → cross-pod exchange on the sharded
+  fragment → AG-intra) pays two ICI phases on the full payload plus a
+  DCN allreduce on only the ``b/C`` fragment — but its cross-pod stage
+  is a separate collective whose every hop spans DCN, so each of its
+  ``2(P−1)`` hops costs the full α_dcn.
+
+Small buckets are therefore latency-bound and stay flat whenever
+``C·α_ici < α_dcn`` (the extra DCN launches outweigh the saved ICI
+hops); large buckets go hierarchical because DCN moves ``C×`` fewer
+bytes.  The crossover is closed-form
+(:func:`hierarchical_crossover_bytes`) and oracle-tested.
+
+The **online estimator** refines the per-tier β from the signals the
+``obs/`` layer already publishes: each compiled schedule notes its
+per-tier planned wire bytes (trace time), each finished step
+contributes ``bytes/µs`` per tier, EWMA'd into an achieved-bandwidth
+floor.  ``HVD_TPU_TOPO_COST_FREEZE=1`` pins the parameters (a tuned
+fleet must not drift mid-run).  Refined parameters feed the compiler
+only on single-controller worlds — per-process estimators see
+different wall clocks, and divergent parameters would compile
+divergent collective programs (the deadlock hvdlint exists to catch);
+multi-controller refinement publishes gauges for operators but the
+compiler stays on the declared priors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Optional
+
+from ..config import DEFAULT_COST_ALPHA_US, DEFAULT_COST_BETA_GBPS
+from .topology import MeshTopology
+
+TIERS = ("ici", "dcn")
+
+
+@dataclasses.dataclass(frozen=True)
+class TierParams:
+    """One tier's α–β point: per-hop launch latency (µs) and per-hop
+    bandwidth (GB/s)."""
+
+    alpha_us: float
+    beta_gbps: float
+
+    @property
+    def beta_bytes_per_us(self) -> float:
+        return self.beta_gbps * 1e3  # GB/s == 10^3 B/µs
+
+
+@dataclasses.dataclass(frozen=True)
+class TopoCostParams:
+    """The model: one :class:`TierParams` per tier."""
+
+    ici: TierParams
+    dcn: TierParams
+
+    def tier(self, name: str) -> TierParams:
+        if name == "ici":
+            return self.ici
+        if name == "dcn":
+            return self.dcn
+        raise ValueError(f"unknown tier {name!r}; expected one of {TIERS}")
+
+
+def default_params() -> TopoCostParams:
+    """Priors from the live config: the ICI tier reuses the flat
+    model's ``HVD_TPU_COST_ALPHA_US``/``COST_BETA_GBPS`` (they were
+    always intra-slice numbers), the DCN tier gets its own
+    ``HVD_TPU_TOPO_ALPHA_DCN_US``/``TOPO_BETA_DCN_GBPS`` — an order of
+    magnitude worse by default, matching the ICI/DCN gap."""
+    from .. import basics
+
+    if basics.is_initialized():
+        cfg = basics.config()
+        return TopoCostParams(
+            ici=TierParams(cfg.cost_alpha_us, cfg.cost_beta_gbps),
+            dcn=TierParams(cfg.topo_alpha_dcn_us, cfg.topo_beta_dcn_gbps))
+    return TopoCostParams(
+        ici=TierParams(DEFAULT_COST_ALPHA_US, DEFAULT_COST_BETA_GBPS),
+        dcn=TierParams(DEFAULT_COST_ALPHA_US * 10.0,
+                       DEFAULT_COST_BETA_GBPS / 10.0))
+
+
+def tier_phase_cost_us(nbytes: float, n: int, p: TierParams) -> float:
+    """One RS/AG phase of a ring collective over ``n`` participants on
+    one tier — the per-tier form of ``fusion.phase_cost_us``.  Written
+    to be bit-reproducible against the native twin
+    (``hvd_tpu_plan_hierarchical``): same operation order, double
+    arithmetic throughout."""
+    if n <= 1:
+        return 0.0
+    return (n - 1) * (p.alpha_us + (nbytes / n) / (p.beta_gbps * 1e3))
+
+
+def flat_cost_us(nbytes: float, topo: MeshTopology,
+                 params: TopoCostParams) -> float:
+    """Modeled makespan of one flat allreduce over the whole mesh (see
+    module docstring for the launch-vs-transfer split)."""
+    n = topo.size
+    if n <= 1:
+        return 0.0
+    if topo.pods > 1:
+        return 2.0 * (n - 1) * (
+            params.ici.alpha_us
+            + (nbytes / n) / (params.dcn.beta_gbps * 1e3))
+    return 2.0 * tier_phase_cost_us(nbytes, n, params.ici)
+
+
+def hierarchical_cost_us(nbytes: float, topo: MeshTopology,
+                         params: TopoCostParams) -> float:
+    """Modeled makespan of the hierarchical schedule: RS-intra +
+    AG-intra on the full payload over ICI, one allreduce on the ``b/C``
+    fragment over DCN."""
+    if not topo.two_tier:
+        return flat_cost_us(nbytes, topo, params)
+    intra = 2.0 * tier_phase_cost_us(nbytes, topo.chips_per_pod,
+                                     params.ici)
+    frag = nbytes / topo.chips_per_pod
+    cross = 2.0 * tier_phase_cost_us(frag, topo.pods, params.dcn)
+    return intra + cross
+
+
+def hierarchical_phase_costs_us(nbytes: float, topo: MeshTopology,
+                                params: TopoCostParams
+                                ) -> Dict[str, float]:
+    """Per-phase breakdown ``{rs_intra, xpod, ag_intra}`` — the numbers
+    the obs layer publishes per tier and the bench rows carry."""
+    if not topo.two_tier:
+        return {"rs_intra": 0.0,
+                "xpod": flat_cost_us(nbytes, topo, params),
+                "ag_intra": 0.0}
+    intra = tier_phase_cost_us(nbytes, topo.chips_per_pod, params.ici)
+    frag = nbytes / topo.chips_per_pod
+    return {"rs_intra": intra,
+            "xpod": 2.0 * tier_phase_cost_us(frag, topo.pods, params.dcn),
+            "ag_intra": intra}
+
+
+def hierarchical_crossover_bytes(topo: MeshTopology,
+                                 params: TopoCostParams) -> int:
+    """Bucket payload above which the hierarchical schedule beats flat,
+    in closed form.  Setting ``flat(b) = hier(b)`` and solving:
+
+    * latency gap at b→0: ``2(P−1)·(C·α_ici − α_dcn)`` (flat − hier)
+    * slope gap: ``2·(C−1)/C · (1/β'_dcn − 1/β'_ici)`` per byte
+
+    The contract is "the payload at and above which hierarchical wins":
+    0 when it wins at every size (``C·α_ici ≥ α_dcn`` with DCN the
+    per-byte bottleneck), ``1 << 62`` when no such payload exists —
+    including the inverted-tier corner (``β_dcn ≥ β_ici``) where
+    hierarchy can only win *below* a boundary; ``choose_algo`` compares
+    the costs directly and stays correct there, this closed form just
+    declines to report a threshold that isn't one."""
+    if not topo.two_tier:
+        return 1 << 62
+    P, C = topo.pods, topo.chips_per_pod
+    lat_gap = 2.0 * (P - 1) * (C * params.ici.alpha_us
+                               - params.dcn.alpha_us)
+    slope_gap = 2.0 * ((C - 1) / C) * (
+        1.0 / params.dcn.beta_bytes_per_us
+        - 1.0 / params.ici.beta_bytes_per_us)
+    if slope_gap <= 0:
+        # DCN not the per-byte bottleneck: flat wins (or ties) ever
+        # more as payload grows, so there is no "above" threshold.
+        return 1 << 62
+    if lat_gap >= 0:
+        return 0            # hier already wins on latency alone
+    return int(-lat_gap / slope_gap) + 1
+
+
+# --- online estimator --------------------------------------------------------
+
+class OnlineEstimator:
+    """EWMA refinement of the per-tier β from observed bytes/µs.
+
+    ``note_plan`` records a compiled schedule's per-tier planned wire
+    bytes (called at trace time by the schedule executor);
+    ``refine_from_step`` converts each finished step's wall time into
+    per-tier achieved bytes/µs samples and EWMAs them into the β
+    estimate.  Step time includes compute, so the sample is a *floor*
+    on achievable bandwidth — the estimate converges from below and is
+    exact on pure-wire workloads (the convergence oracle in
+    tests/test_topo.py feeds synthetic pure-wire signals).  α samples
+    arrive via :meth:`observe_alpha` from latency-dominated probes.
+    """
+
+    def __init__(self, prior: Optional[TopoCostParams] = None,
+                 decay: float = 0.2) -> None:
+        self._lock = threading.Lock()
+        self.prior = prior or default_params()
+        self.decay = float(decay)
+        self._beta: Dict[str, float] = {}     # bytes/µs EWMA; guarded-by: _lock
+        self._alpha: Dict[str, float] = {}    # µs EWMA; guarded-by: _lock
+        self._plan_bytes: Dict[str, float] = {}  # guarded-by: _lock
+        self._samples = 0                     # guarded-by: _lock
+        self._frozen: Optional[bool] = None   # guarded-by: _lock
+
+    def frozen(self) -> bool:
+        with self._lock:
+            if self._frozen is not None:
+                return self._frozen
+        from .. import basics
+
+        return (basics.config().topo_cost_freeze
+                if basics.is_initialized() else False)
+
+    def freeze(self, frozen: bool = True) -> None:
+        with self._lock:
+            self._frozen = bool(frozen)
+
+    @property
+    def samples(self) -> int:
+        with self._lock:
+            return self._samples
+
+    def note_plan(self, tier_bytes: Dict[str, float]) -> None:
+        """Latest compiled schedule's per-tier wire bytes per step."""
+        with self._lock:
+            self._plan_bytes = {t: float(b) for t, b in tier_bytes.items()
+                                if b > 0}
+
+    def observe(self, tier: str, nbytes: float, elapsed_us: float) -> None:
+        """One achieved-bandwidth sample for a tier."""
+        if self.frozen() or nbytes <= 0 or elapsed_us <= 0:
+            return
+        rate = float(nbytes) / float(elapsed_us)
+        with self._lock:
+            prev = self._beta.get(tier)
+            self._beta[tier] = (rate if prev is None
+                                else (1 - self.decay) * prev
+                                + self.decay * rate)
+            self._samples += 1
+        self._publish()
+
+    def observe_alpha(self, tier: str, elapsed_us: float,
+                      hops: int) -> None:
+        """One latency-dominated sample (near-zero payload): per-hop
+        launch latency."""
+        if self.frozen() or hops <= 0 or elapsed_us <= 0:
+            return
+        a = float(elapsed_us) / float(hops)
+        with self._lock:
+            prev = self._alpha.get(tier)
+            self._alpha[tier] = (a if prev is None
+                                 else (1 - self.decay) * prev
+                                 + self.decay * a)
+            self._samples += 1
+        self._publish()
+
+    def refine_from_step(self, step_time_s: float) -> None:
+        """Feed one finished step: the per-tier bytes of the latest
+        compiled plan rode the wire inside this wall time.  Called from
+        ``obs/instrument.wrap_step``; cheap no-op when no plan was
+        noted or the estimator is frozen."""
+        with self._lock:
+            plan = dict(self._plan_bytes)
+        if not plan or step_time_s <= 0:
+            return
+        for tier, nbytes in plan.items():
+            self.observe(tier, nbytes, step_time_s * 1e6)
+
+    def params(self) -> TopoCostParams:
+        """Current estimate: prior with EWMA'd tiers swapped in."""
+        with self._lock:
+            beta = dict(self._beta)
+            alpha = dict(self._alpha)
+
+        def tier(name: str, prior: TierParams) -> TierParams:
+            return TierParams(
+                alpha_us=alpha.get(name, prior.alpha_us),
+                beta_gbps=(beta[name] / 1e3) if name in beta
+                else prior.beta_gbps)
+
+        return TopoCostParams(ici=tier("ici", self.prior.ici),
+                              dcn=tier("dcn", self.prior.dcn))
+
+    def effective_params(self) -> TopoCostParams:
+        """What the schedule compiler should use: refined values on a
+        single-controller world, declared priors everywhere else (see
+        module docstring — per-process refinement must not diverge the
+        compiled collective programs across ranks).
+
+        Refinement feeds the compiler only once EVERY tier has a β
+        sample: the flat-vs-hierarchical decision rides the cross-tier
+        ratio, and a one-sided floor (e.g. a flat plan notes bytes only
+        on the DCN tier, so step time collapses β_dcn while β_ici keeps
+        its fast prior) would distort that ratio and flip schedules for
+        reasons that have nothing to do with link speeds.  Shared-step
+        samples refine both tiers against the same denominator, which
+        keeps the decision stable under the floor's pessimism."""
+        with self._lock:
+            refined_tiers = set(self._beta)
+        if not refined_tiers.issuperset(TIERS):
+            return self.prior
+        import jax
+
+        if jax.process_count() > 1:
+            return self.prior
+        return self.params()
+
+    def _publish(self) -> None:
+        from ..obs import instrument as _obs
+
+        if not _obs.enabled():
+            return
+        p = self.params()
+        for name in TIERS:
+            t = p.tier(name)
+            _obs.on_topo_estimator(name, t.alpha_us, t.beta_gbps)
+
+
+_estimator: Optional[OnlineEstimator] = None   # guarded-by: _est_lock
+_est_lock = threading.Lock()
+
+
+def estimator() -> OnlineEstimator:
+    """The process-wide estimator (lazy; priors resolve from the live
+    config at first use).  Never reset across elastic re-inits — like
+    the metrics registry, learned bandwidth spans recoveries."""
+    global _estimator
+    with _est_lock:
+        if _estimator is None:
+            _estimator = OnlineEstimator()
+        return _estimator
+
+
+def reset_estimator() -> None:
+    """Drop the process estimator (tests only)."""
+    global _estimator
+    with _est_lock:
+        _estimator = None

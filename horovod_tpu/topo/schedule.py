@@ -1,0 +1,361 @@
+"""The schedule compiler: per-bucket lowering to a deterministic,
+rank-invariant :class:`CollectiveSchedule` IR.
+
+GC3 (PAPERS.md) argues collective schedules should be *compiler
+output* — explicit, verifiable, chosen by a cost model — rather than
+special cases inside the transport.  This module is that compiler for
+the two-tier mesh: given a bucket's payload bytes, a
+:class:`~horovod_tpu.topo.topology.MeshTopology` and per-tier α/β
+(:mod:`~horovod_tpu.topo.costmodel`), :func:`compile_bucket_schedule`
+emits one of
+
+* ``flat`` — one allreduce over the whole mesh,
+* ``two_phase`` — reduce-scatter → all-gather over the whole mesh (the
+  PR-1 pipelined wire; picked for bandwidth-bound buckets on meshes
+  where hierarchy doesn't pay),
+* ``hierarchical`` — RS-intra (ICI) → cross-pod allreduce on only the
+  sharded ``b/C`` fragment (DCN) → AG-intra (ICI),
+
+as a tuple of ``(op, tier, groups, payload)`` :class:`ScheduleStep`\\ s.
+The IR is pure bookkeeping over static values — every rank compiles the
+identical schedule (asserted by hvdlint's jaxpr rank-invariance pass),
+and the native twin ``hvd_tpu_plan_hierarchical`` mirrors the choice
+bit-for-bit.
+
+:func:`execute_schedule` runs a compiled schedule inside an SPMD region
+on a compressor's wire; :func:`hierarchical_reduce_scatter` /
+:func:`hierarchical_all_gather` are the RS/AG halves the overlap
+microbatch wire composes (shards come back pod-major-permuted, and the
+matching AG inverts the permutation — flat-equivalent end to end).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .costmodel import (TopoCostParams, default_params, estimator,
+                        flat_cost_us, hierarchical_cost_us,
+                        hierarchical_phase_costs_us)
+from .topology import MeshTopology, config_topology
+
+Groups = Optional[Tuple[Tuple[int, ...], ...]]
+
+ALGO_FLAT, ALGO_TWO_PHASE, ALGO_HIERARCHICAL = "flat", "two_phase", \
+    "hierarchical"
+
+
+@dataclasses.dataclass(frozen=True)
+class ScheduleStep:
+    """One step of the IR: ``op`` ∈ {rs, ar, ag}, the tier whose wire
+    it rides, the ``axis_index_groups`` partition it reduces over
+    (None = whole axis), and the payload bytes it moves."""
+
+    op: str
+    tier: str
+    groups: Groups
+    payload_bytes: int
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveSchedule:
+    """A compiled per-bucket schedule: the algorithm, its steps, the
+    modeled cost, and the topology it was compiled for.  Frozen and
+    built from static values only — rank-invariant by construction."""
+
+    algo: str
+    steps: Tuple[ScheduleStep, ...]
+    nbytes: int
+    est_cost_us: float
+    topo: MeshTopology
+
+    def tier_bytes(self) -> Dict[str, int]:
+        """Wire bytes per tier (exact dtype bytes; the executor scales
+        by the compressor's wire ratio when recording)."""
+        out: Dict[str, int] = {}
+        for s in self.steps:
+            out[s.tier] = out.get(s.tier, 0) + s.payload_bytes
+        return out
+
+
+def choose_algo(nbytes: int, topo: MeshTopology,
+                params: TopoCostParams) -> str:
+    """The modeled-cost decision, mirrored exactly by the native
+    ``hvd_tpu_plan_hierarchical`` (equivalence property-tested in
+    tests/test_topo.py): hierarchical when its modeled makespan beats
+    flat's on a genuinely two-tier mesh; otherwise the flat family,
+    decomposed into RS+AG when the bucket clears the two-phase
+    crossover at the flat wire's effective parameters (α_ici paired
+    with the bottleneck β — DCN on multi-pod meshes)."""
+    n = topo.size
+    if n <= 1:
+        return ALGO_FLAT
+    if topo.two_tier and hierarchical_cost_us(nbytes, topo, params) \
+            < flat_cost_us(nbytes, topo, params):
+        return ALGO_HIERARCHICAL
+    beta_eff = (params.dcn.beta_gbps if topo.pods > 1
+                else params.ici.beta_gbps)
+    crossover_d = params.ici.alpha_us * beta_eff * 1e3 * n
+    if crossover_d < 9.2e18 and nbytes >= int(crossover_d):
+        return ALGO_TWO_PHASE
+    return ALGO_FLAT
+
+
+def _dispatch_algo(nbytes: int, topo: MeshTopology,
+                   params: TopoCostParams) -> str:
+    """Native-planner dispatch for :func:`choose_algo` (same contract;
+    mirrors ``ops.fusion.plan_buckets``' dispatch discipline)."""
+    use_native = True
+    from .. import basics
+
+    if basics.is_initialized():
+        use_native = basics.config().use_native_planner
+    if use_native:
+        try:
+            from ..native import planner as _native
+
+            if _native.available():
+                return _native.plan_hierarchical(
+                    [int(nbytes)], topo.pods, topo.chips_per_pod,
+                    params.ici.alpha_us, params.ici.beta_gbps,
+                    params.dcn.alpha_us, params.dcn.beta_gbps)[0]
+        except ImportError:
+            pass
+    return choose_algo(nbytes, topo, params)
+
+
+def compile_bucket_schedule(nbytes: int, topo: MeshTopology,
+                            params: Optional[TopoCostParams] = None, *,
+                            force: Optional[str] = None,
+                            ) -> CollectiveSchedule:
+    """Compile one bucket's schedule.  ``force`` pins the algorithm
+    (the autotuner's and the bench's explicit lattice points); None
+    lets the cost model choose (``auto``)."""
+    params = params or default_params()
+    algo = force if force in (ALGO_FLAT, ALGO_TWO_PHASE,
+                              ALGO_HIERARCHICAL) else \
+        _dispatch_algo(nbytes, topo, params)
+    if algo == ALGO_HIERARCHICAL and not topo.two_tier:
+        algo = ALGO_FLAT   # nothing to hierarchize on a one-tier mesh
+    n = topo.size
+    flat_tier = "dcn" if topo.pods > 1 else "ici"
+    nbytes = int(nbytes)
+    if algo == ALGO_HIERARCHICAL:
+        intra = tuple(tuple(g) for g in topo.intra_pod_groups())
+        cross = tuple(tuple(g) for g in topo.cross_pod_groups())
+        frag = nbytes // topo.chips_per_pod
+        steps = (
+            ScheduleStep("rs", "ici", intra, nbytes),
+            ScheduleStep("ar", "dcn", cross, frag),
+            ScheduleStep("ag", "ici", intra, nbytes),
+        )
+        cost = hierarchical_cost_us(nbytes, topo, params)
+    elif algo == ALGO_TWO_PHASE:
+        steps = (ScheduleStep("rs", flat_tier, None, nbytes),
+                 ScheduleStep("ag", flat_tier, None, nbytes))
+        cost = flat_cost_us(nbytes, topo, params)
+    else:
+        steps = (ScheduleStep("ar", flat_tier, None, nbytes),)
+        cost = flat_cost_us(nbytes, topo, params)
+    return CollectiveSchedule(algo=algo, steps=steps, nbytes=nbytes,
+                              est_cost_us=cost, topo=topo)
+
+
+class ScheduleCompiler:
+    """A compile cache bound to one (topology, params, force) point —
+    what ``fused_apply``/``fused_two_phase_apply``/the overlap wire
+    accept.  Compilation happens at trace time; the cache keeps
+    re-traces cheap and deterministic."""
+
+    def __init__(self, topo: MeshTopology,
+                 params: Optional[TopoCostParams] = None,
+                 force: Optional[str] = None) -> None:
+        self.topo = topo
+        self.params = params or default_params()
+        self.force = force
+        self._cache: Dict[int, CollectiveSchedule] = {}
+
+    def compile(self, nbytes: int) -> CollectiveSchedule:
+        nbytes = int(nbytes)
+        sched = self._cache.get(nbytes)
+        if sched is None:
+            sched = self._cache[nbytes] = compile_bucket_schedule(
+                nbytes, self.topo, self.params, force=self.force)
+        return sched
+
+
+def maybe_compiler(world_size: int, groups=None,
+                   mode: Optional[str] = None) -> Optional[ScheduleCompiler]:
+    """Trace-time resolution of the topo scheduling gate: a compiler
+    when ``HVD_TPU_TOPO_SCHEDULE`` (or an explicit ``mode``) turns it
+    on AND the reduction runs over the whole mesh (process-set
+    sub-reductions keep the flat wire — tier groups are defined on the
+    global axis) AND the resolved topology matches the group width.
+    Returns None otherwise — callers fall back to the flat planner."""
+    if mode is None:
+        from .. import basics
+
+        mode = (basics.config().topo_schedule
+                if basics.is_initialized() else "off")
+    if mode == "off" or groups is not None or world_size <= 1:
+        return None
+    topo = config_topology(world_size)
+    if topo.size != world_size:
+        return None
+    force = None if mode == "auto" else mode
+    return ScheduleCompiler(topo, estimator().effective_params(),
+                            force=force)
+
+
+# --- execution ---------------------------------------------------------------
+# Everything below runs at trace time inside an SPMD region: the spans
+# wrap schedule *emission* (like the `fusion` fault site, a failure
+# here surfaces while the program is being built), and the compiled
+# program replays the emitted collectives every step.
+
+def _groups_list(groups: Groups):
+    return [list(g) for g in groups] if groups is not None else None
+
+
+def record_plans(scheds: Sequence[CollectiveSchedule], compression,
+                 itemsize: int,
+                 params: Optional[TopoCostParams] = None) -> None:
+    """Trace-time plan record for a set of compiled per-bucket
+    schedules: per-tier wire bytes and per-tier modeled cost into the
+    obs registry (``hvd_tpu_topo_*``; docs/metrics.md), plus the
+    per-tier byte note the online estimator refines β from.  Bytes are
+    scaled by the compressor's wire ratio, like every fusion-tier
+    record.  ``params`` must be the point the schedules were compiled
+    with (the caller's ``ScheduleCompiler.params``) so the published
+    per-tier costs stay consistent with each schedule's own
+    ``est_cost_us`` once the estimator has refined."""
+    from ..obs import instrument as _obs
+    from ..ops.fusion import wire_ratio
+
+    scheds = list(scheds)
+    if not scheds:
+        return
+    ratio = wire_ratio(compression, max(itemsize, 1))
+    params = params or default_params()
+    tier_bytes: Dict[str, int] = {}
+    tier_cost: Dict[str, float] = {}
+    by_algo: Dict[str, int] = {}
+    for sched in scheds:
+        by_algo[sched.algo] = by_algo.get(sched.algo, 0) + 1
+        for t, b in sched.tier_bytes().items():
+            tier_bytes[t] = tier_bytes.get(t, 0) + int(b * ratio)
+        if sched.algo == ALGO_HIERARCHICAL:
+            phase = hierarchical_phase_costs_us(sched.nbytes, sched.topo,
+                                                params)
+            tier_cost["ici"] = tier_cost.get("ici", 0.0) \
+                + phase["rs_intra"] + phase["ag_intra"]
+            tier_cost["dcn"] = tier_cost.get("dcn", 0.0) + phase["xpod"]
+        else:
+            t = "dcn" if sched.topo.pods > 1 else "ici"
+            tier_cost[t] = tier_cost.get(t, 0.0) + sched.est_cost_us
+    if _obs.enabled():
+        _obs.on_topo_plan(by_algo, tier_bytes=tier_bytes,
+                          est_cost_us=tier_cost)
+    estimator().note_plan(tier_bytes)
+
+
+def _on_dcn_step(stage: str) -> None:
+    from .. import faults as _faults
+
+    if _faults._active is not None:
+        _faults.on_dcn(stage)
+
+
+def execute_schedule(x, sched: CollectiveSchedule, *, axis: str, op: str,
+                     compression) -> "jax.Array":
+    """Run one compiled schedule over a flat 1-D buffer inside an SPMD
+    region: allreduce semantics (every slot returns the full reduction
+    over the whole mesh), on the compressor's wire.  ``op`` is
+    sum/average."""
+    import jax.numpy as jnp
+
+    from ..obs import trace as _trace
+
+    if op not in ("sum", "average"):
+        raise ValueError(
+            f"topo schedules support op=sum/average, got {op!r}")
+    n = sched.topo.size
+    if n <= 1 or sched.algo == ALGO_FLAT:
+        return compression.spmd_allreduce(x, op=op, axis=axis, groups=None)
+    if sched.algo == ALGO_TWO_PHASE:
+        pad = (-x.size) % n
+        xp = jnp.concatenate([x, jnp.zeros((pad,), x.dtype)]) if pad else x
+        shard = compression.spmd_reducescatter(xp, op=op, axis=axis,
+                                               groups=None)
+        full = compression.spmd_allgather(shard, axis=axis, groups=None)
+        return full[: x.size]
+    # hierarchical: RS-intra (ICI) -> cross-pod exchange on the sharded
+    # fragment (DCN) -> AG-intra (ICI).  Internal reductions run op=sum;
+    # one exact division by the full mesh width lands at the end so the
+    # result matches the flat wire's average bit-for-bit on exact data.
+    intra = _groups_list(sched.steps[0].groups)
+    cross = _groups_list(sched.steps[1].groups)
+    pad = (-x.size) % n
+    xp = jnp.concatenate([x, jnp.zeros((pad,), x.dtype)]) if pad else x
+    with _trace.span("hvd_tpu_topo_rs_intra",
+                     args={"bytes": sched.steps[0].payload_bytes}):
+        frag = compression.spmd_reducescatter(xp, op="sum", axis=axis,
+                                              groups=intra)
+    _on_dcn_step("xpod")
+    with _trace.span("hvd_tpu_topo_xpod",
+                     args={"bytes": sched.steps[1].payload_bytes}):
+        frag = compression.spmd_allreduce(frag, op="sum", axis=axis,
+                                          groups=cross)
+    with _trace.span("hvd_tpu_topo_ag_intra",
+                     args={"bytes": sched.steps[2].payload_bytes}):
+        full = compression.spmd_allgather(frag, axis=axis, groups=intra)
+    out = full[: x.size]
+    if op == "average":
+        out = out / n
+    return out
+
+
+def hierarchical_reduce_scatter(x, sched: CollectiveSchedule, *,
+                                axis: str, op: str, compression):
+    """The RS half for the overlap microbatch wire: RS-intra (ICI) then
+    RS across pods (DCN) on the fragment.  ``x`` must already be padded
+    to the mesh width; returns this slot's ``x.size/n`` shard.  Shards
+    come back in (chip, pod)-major order — a fixed permutation of the
+    flat RS layout that :func:`hierarchical_all_gather` inverts, so
+    accumulate-then-gather is flat-equivalent."""
+    from ..obs import trace as _trace
+
+    n = sched.topo.size
+    intra = _groups_list(sched.steps[0].groups)
+    cross = _groups_list(sched.steps[1].groups)
+    with _trace.span("hvd_tpu_topo_rs_intra",
+                     args={"bytes": sched.steps[0].payload_bytes}):
+        frag = compression.spmd_reducescatter(x, op="sum", axis=axis,
+                                              groups=intra)
+    _on_dcn_step("xpod_rs")
+    with _trace.span("hvd_tpu_topo_xpod",
+                     args={"bytes": sched.steps[1].payload_bytes}):
+        shard = compression.spmd_reducescatter(frag, op="sum", axis=axis,
+                                               groups=cross)
+    if op == "average":
+        shard = shard / n
+    return shard
+
+
+def hierarchical_all_gather(shard, sched: CollectiveSchedule, *,
+                            axis: str, compression):
+    """The AG half: gather across pods (DCN) to rebuild the fragment,
+    then AG-intra (ICI) to rebuild the full padded buffer — the exact
+    inverse of :func:`hierarchical_reduce_scatter`'s permutation."""
+    from ..obs import trace as _trace
+
+    intra = _groups_list(sched.steps[0].groups)
+    cross = _groups_list(sched.steps[1].groups)
+    _on_dcn_step("xpod_ag")
+    with _trace.span("hvd_tpu_topo_xpod",
+                     args={"bytes": sched.steps[1].payload_bytes}):
+        frag = compression.spmd_allgather(shard, axis=axis, groups=cross)
+    with _trace.span("hvd_tpu_topo_ag_intra",
+                     args={"bytes": sched.steps[2].payload_bytes}):
+        full = compression.spmd_allgather(frag, axis=axis, groups=intra)
+    return full

@@ -1,0 +1,162 @@
+"""CPU multi-host mesh simulator: N simulated pods on one host.
+
+Multi-pod hardware is exactly what CI doesn't have, so the equivalence
+and cost oracles must run on the tier-1 CPU mesh (the 8 virtual devices
+``tests/conftest.py`` forces).  A :class:`SimulatedMesh` overlays a
+declared ``pods × chips`` topology on the real single-host mesh via the
+sub-axis process-set partitions of
+:meth:`~horovod_tpu.topo.topology.MeshTopology.intra_pod_groups` /
+``cross_pod_groups`` — the collectives are the *same HLO group
+partitions* a real two-tier deployment would trace, only the physical
+links under them are loopback.  What the simulation therefore proves:
+schedule correctness (bit-level equivalence against the flat wire,
+rank-invariance, permutation inverses), never bandwidth — the cost
+side is covered by the closed-form oracles of
+:mod:`~horovod_tpu.topo.costmodel`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .costmodel import TopoCostParams, default_params
+from .schedule import (ALGO_HIERARCHICAL, choose_algo,
+                       compile_bucket_schedule, execute_schedule,
+                       hierarchical_all_gather, hierarchical_reduce_scatter)
+from .topology import MeshTopology
+
+
+@dataclasses.dataclass(frozen=True)
+class SimulatedMesh:
+    """A two-tier topology overlaid on the live (single-host) global
+    mesh; ``axis`` is the mesh axis every schedule executes over."""
+
+    topo: MeshTopology
+    axis: str
+
+
+def simulated_mesh(pods: Optional[int] = None,
+                   chips: Optional[int] = None) -> SimulatedMesh:
+    """Build the simulation topology over the live world: ``pods ×
+    chips`` must factor the world size (default: 2 pods of world/2
+    chips — the smallest genuinely two-tier split)."""
+    from .. import basics
+
+    n = basics.size()
+    if pods is None and chips is None:
+        pods = 2 if n % 2 == 0 and n >= 4 else 1
+    if pods is None:
+        pods = n // int(chips)
+    if chips is None:
+        chips = n // int(pods)
+    topo = MeshTopology(pods=int(pods), chips_per_pod=int(chips))
+    if topo.size != n:
+        raise ValueError(
+            f"simulated topology {topo.describe()} does not factor the "
+            f"{n}-slot mesh")
+    return SimulatedMesh(topo=topo,
+                         axis=basics.config().mesh_axis_name)
+
+
+def run_allreduce(sim: SimulatedMesh, stack: np.ndarray, *,
+                  algo: str = ALGO_HIERARCHICAL, op: str = "sum",
+                  compression=None,
+                  params: Optional[TopoCostParams] = None) -> np.ndarray:
+    """Execute one compiled schedule over a per-slot data stack
+    (``[size, elems]`` — slot *i* contributes row *i*) and return every
+    slot's result stacked back ``[size, elems]``.  The vehicle the
+    equivalence oracle and the bench share: the fused SPMD gradient
+    wire (schedule execution inside ``shard_map``)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .. import basics
+    from .._compat import shard_map
+    from ..ops.compression import Compression
+
+    compression = compression or Compression.none
+    gm = basics.global_mesh()
+    n = sim.topo.size
+    stack = np.asarray(stack)
+    if stack.shape[0] != n:
+        raise ValueError(
+            f"stack rows {stack.shape[0]} != mesh size {n}")
+    sched = compile_bucket_schedule(
+        int(stack.shape[-1] * stack.dtype.itemsize), sim.topo,
+        params or default_params(), force=algo)
+
+    def per_slot(xb):  # [1, elems] — this slot's contribution
+        red = execute_schedule(xb[0], sched, axis=sim.axis, op=op,
+                               compression=compression)
+        return red[None].astype(xb.dtype)
+
+    sharded = jax.device_put(
+        stack, NamedSharding(gm.mesh, P(gm.axis_name)))
+    out = jax.jit(shard_map(per_slot, mesh=gm.mesh,
+                            in_specs=P(gm.axis_name),
+                            out_specs=P(gm.axis_name)))(sharded)
+    return np.asarray(out)
+
+
+def run_rs_ag_roundtrip(sim: SimulatedMesh, stack: np.ndarray, *,
+                        compression=None, op: str = "sum") -> np.ndarray:
+    """The overlap wire's hierarchical RS → AG composition (shard
+    permutation and its inverse): must equal the plain allreduce."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .. import basics
+    from .._compat import shard_map
+    from ..ops.compression import Compression
+
+    compression = compression or Compression.none
+    gm = basics.global_mesh()
+    n = sim.topo.size
+    stack = np.asarray(stack)
+    elems = stack.shape[-1]
+    sched = compile_bucket_schedule(int(elems * stack.dtype.itemsize),
+                                    sim.topo, force=ALGO_HIERARCHICAL)
+
+    def per_slot(xb):
+        x = xb[0]
+        pad = (-x.size) % n
+        xp = (jnp.concatenate([x, jnp.zeros((pad,), x.dtype)])
+              if pad else x)
+        shard = hierarchical_reduce_scatter(
+            xp, sched, axis=sim.axis, op=op, compression=compression)
+        full = hierarchical_all_gather(
+            shard, sched, axis=sim.axis, compression=compression)
+        return full[: x.size][None].astype(xb.dtype)
+
+    sharded = jax.device_put(
+        stack, NamedSharding(gm.mesh, P(gm.axis_name)))
+    out = jax.jit(shard_map(per_slot, mesh=gm.mesh,
+                            in_specs=P(gm.axis_name),
+                            out_specs=P(gm.axis_name)))(sharded)
+    return np.asarray(out)
+
+
+def cost_oracle_rows(sizes_bytes: Sequence[int], topo: MeshTopology,
+                     params: Optional[TopoCostParams] = None
+                     ) -> List[Dict]:
+    """Modeled cost of every algorithm at every size plus the
+    compiler's choice — the modeled-vs-chosen agreement surface the
+    acceptance test and the ``--topology`` bench rows share."""
+    from .costmodel import flat_cost_us, hierarchical_cost_us
+
+    params = params or default_params()
+    rows: List[Dict] = []
+    for b in sizes_bytes:
+        flat = flat_cost_us(b, topo, params)
+        hier = hierarchical_cost_us(b, topo, params)
+        rows.append({
+            "bytes": int(b),
+            "modeled_flat_us": flat,
+            "modeled_hierarchical_us": hier,
+            "chosen": choose_algo(int(b), topo, params),
+        })
+    return rows

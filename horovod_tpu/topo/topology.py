@@ -1,0 +1,169 @@
+"""Declarative two-tier mesh description (pods × chips-per-pod).
+
+The reference stack discovers topology implicitly — NCCL rings within a
+node, MPI across nodes, glued by ``HOROVOD_HIERARCHICAL_ALLREDUCE``.
+Here the topology is a *value*: a :class:`MeshTopology` either declared
+via ``HVD_TPU_TOPO_SPEC=PODSxCHIPS`` or inferred from the slice/process
+structure of ``jax.devices()``, consumed by the cost model and the
+schedule compiler.  Pods are contiguous ranges of the 1-D mesh axis
+(slot ``r`` lives in pod ``r // chips_per_pod`` at chip ``r %
+chips_per_pod``) — the layout :mod:`horovod_tpu.mesh` builds, where
+devices enumerate process-major.
+
+The tier *process sets* are plain ``axis_index_groups`` partitions
+(the same mechanism :mod:`horovod_tpu.process_sets` uses): the
+intra-pod tier partitions the axis into ``pods`` groups of
+``chips_per_pod`` slots (ICI-local reductions), the cross-pod tier into
+``chips_per_pod`` groups of ``pods`` slots — one group per chip index,
+so each group's collective moves only the fragment that chip owns
+across DCN.  Both are full partitions, so XLA accepts them directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+from ..config import parse_topo_spec
+from ..utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshTopology:
+    """A two-tier mesh: ``pods`` × ``chips_per_pod`` slots, pods laid
+    out contiguously along the 1-D mesh axis.  ``pods == 1`` is the
+    flat (single-tier) degenerate every single-pod job resolves to."""
+
+    pods: int
+    chips_per_pod: int
+
+    def __post_init__(self) -> None:
+        if self.pods < 1 or self.chips_per_pod < 1:
+            raise ValueError(
+                f"MeshTopology factors must be >= 1, got "
+                f"{self.pods}x{self.chips_per_pod}")
+
+    @property
+    def size(self) -> int:
+        return self.pods * self.chips_per_pod
+
+    @property
+    def two_tier(self) -> bool:
+        """Does a hierarchical schedule even exist on this mesh?  Needs
+        both tiers to be non-trivial."""
+        return self.pods > 1 and self.chips_per_pod > 1
+
+    def pod_of(self, rank: int) -> int:
+        return rank // self.chips_per_pod
+
+    def chip_of(self, rank: int) -> int:
+        return rank % self.chips_per_pod
+
+    def intra_pod_groups(self) -> List[List[int]]:
+        """ICI tier: one group per pod — a full partition of the axis,
+        directly usable as ``axis_index_groups``."""
+        c = self.chips_per_pod
+        return [list(range(p * c, (p + 1) * c)) for p in range(self.pods)]
+
+    def cross_pod_groups(self) -> List[List[int]]:
+        """DCN tier: one group per chip index — slot ``p·C + c`` talks
+        to its peers at the same chip index ``c`` in every other pod,
+        so each group's collective carries only that chip's fragment."""
+        c = self.chips_per_pod
+        return [[p * c + i for p in range(self.pods)] for i in range(c)]
+
+    def describe(self) -> str:
+        return f"{self.pods}x{self.chips_per_pod}"
+
+
+def infer_topology(devices=None) -> MeshTopology:
+    """Infer the two-tier structure from the device list: group devices
+    (in mesh order) by their slice — ``slice_index`` where the backend
+    exposes it (multi-slice TPU), else ``process_index`` (one pod per
+    host process, the DCN boundary in multi-controller worlds).  Groups
+    must be contiguous and uniform to be a topology; anything else
+    falls back to the flat 1×N degenerate."""
+    import jax
+
+    devices = list(devices) if devices is not None else list(jax.devices())
+    n = len(devices)
+    if n <= 1:
+        return MeshTopology(pods=1, chips_per_pod=max(1, n))
+
+    def slice_of(d) -> int:
+        s = getattr(d, "slice_index", None)
+        if s is None:
+            s = getattr(d, "process_index", 0)
+        return int(s)
+
+    # Contiguous runs of equal slice id, in mesh (device-list) order.
+    runs: List[Tuple[int, int]] = []   # (slice_id, run_length)
+    for d in devices:
+        s = slice_of(d)
+        if runs and runs[-1][0] == s:
+            runs[-1] = (s, runs[-1][1] + 1)
+        else:
+            runs.append((s, 1))
+    lengths = {length for _, length in runs}
+    ids = [s for s, _ in runs]
+    if (len(runs) > 1 and len(lengths) == 1 and len(set(ids)) == len(ids)
+            and next(iter(lengths)) > 1):
+        return MeshTopology(pods=len(runs), chips_per_pod=runs[0][1])
+    return MeshTopology(pods=1, chips_per_pod=n)
+
+
+def resolve_topology(world_size: int,
+                     spec: Optional[str] = None) -> MeshTopology:
+    """The topology for a ``world_size``-slot mesh: a declared spec wins
+    (validated against the world — a spec that doesn't factor the mesh
+    is a deployment error, not something to guess around), otherwise
+    inference, otherwise flat."""
+    if spec:
+        pods, chips = parse_topo_spec(spec)
+        if pods * chips != world_size:
+            raise ValueError(
+                f"topo spec {spec!r} declares {pods * chips} slots but "
+                f"the mesh has {world_size}")
+        return MeshTopology(pods=pods, chips_per_pod=chips)
+    topo = infer_topology()
+    if topo.size != world_size:
+        # The device list the inference saw is not this reduction's
+        # group (e.g. a process-set sub-world): stay flat.
+        return MeshTopology(pods=1, chips_per_pod=world_size)
+    return topo
+
+
+def config_topology(world_size: int) -> MeshTopology:
+    """Trace-time resolution from the live config (``HVD_TPU_TOPO_SPEC``),
+    falling back to flat on a spec/world mismatch with a warning —
+    a bad spec must not crash a training step that can run flat."""
+    from .. import basics
+
+    spec = basics.config().topo_spec if basics.is_initialized() else None
+    try:
+        return resolve_topology(world_size, spec)
+    except ValueError as e:
+        logger.warning("ignoring HVD_TPU_TOPO_SPEC (%s); running flat", e)
+        return MeshTopology(pods=1, chips_per_pod=world_size)
+
+
+def register_tier_process_sets(topo: MeshTopology):
+    """Register (or find — idempotent) one :class:`ProcessSet` per
+    intra-pod group and per cross-pod group on the live table, layered
+    on :mod:`horovod_tpu.process_sets`.  Returns ``(intra_sets,
+    cross_sets)``.  The schedule executor itself passes raw
+    ``axis_index_groups`` (no registration needed inside jit); these
+    sets are for callers that want the reference-parity API surface —
+    ``ps.rank()``/``ps.size()``/host-tier collectives over one tier."""
+    from ..process_sets import ProcessSet, add_process_set, _table
+
+    def _ensure(ranks) -> ProcessSet:
+        existing = _table().find(ranks)
+        return existing if existing is not None \
+            else add_process_set(ProcessSet(ranks))
+
+    intra = [_ensure(g) for g in topo.intra_pod_groups()]
+    cross = [_ensure(g) for g in topo.cross_pod_groups()]
+    return intra, cross

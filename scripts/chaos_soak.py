@@ -18,6 +18,10 @@ tier-1 suite runs); ``--mp`` switches to the multi-process world test
 or real accelerators).  ``--mode serve`` soaks the serving router
 instead: randomized ``serve:step=N,mode=kill`` injection points against
 the replica-failover tests (the training-path loop stays the default).
+``--mode dcn`` soaks the topology-aware wire: randomized ``dcn:step=N``
+specs fire at the hierarchical schedule's cross-pod exchange
+(``topo/schedule.py``) and the drill asserts rollback + convergence on
+the simulated two-tier mesh.
 
 Usage::
 
@@ -42,11 +46,15 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 TARGETS = {
     # (mode, mp) -> pytest target; every target's chaos tests read
-    # HVD_TPU_CHAOS_STEP/_SEED, so one knob pair drives all four.
+    # HVD_TPU_CHAOS_STEP/_SEED, so one knob pair drives all of them.
     ("train", False): "tests/test_faults.py",
     ("train", True): "tests/multiproc/test_chaos_recovery_mp.py",
     ("serve", False): "tests/test_serving.py",
     ("serve", True): "tests/multiproc/test_serving_mp.py",
+    # dcn: randomized ``dcn:step=N`` specs against the hierarchical
+    # schedule's cross-pod exchange (topo/schedule.py) — the
+    # simulated-mesh recovery drill runs single-controller only.
+    ("dcn", False): "tests/test_topo.py",
 }
 
 
@@ -97,10 +105,14 @@ def main(argv=None) -> int:
     ap.add_argument("--mp", action="store_true",
                     help="soak the multi-process world test instead of "
                          "the single-controller one")
-    ap.add_argument("--mode", choices=("train", "serve"), default="train",
+    ap.add_argument("--mode", choices=("train", "serve", "dcn"),
+                    default="train",
                     help="'train' loops the elastic-recovery chaos "
                          "tests; 'serve' soaks the serving router under "
-                         "randomized serve:kill fault specs")
+                         "randomized serve:kill fault specs; 'dcn' "
+                         "soaks the hierarchical schedule's cross-pod "
+                         "exchange under randomized dcn:* fault specs "
+                         "(single-controller only)")
     ap.add_argument("--master-seed", type=int, default=None,
                     help="seed for the (step, seed) draw itself — a "
                          "seeded soak is replayable end to end")
@@ -118,6 +130,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     rng = random.Random(args.master_seed)
+    if (args.mode, args.mp) not in TARGETS:
+        ap.error(f"--mode {args.mode} has no --mp target")
     target = TARGETS[(args.mode, args.mp)]
     flight_root = os.path.abspath(args.flight_root or args.out + ".flight")
     runs = []

@@ -190,6 +190,46 @@ def test_gpt_bench_overlap_contract():
     assert row["hidden_comm_basis"] in ("modeled_peak", "measured_wall")
 
 
+@pytest.mark.slow
+def test_allreduce_bench_topology_contract(tmp_path):
+    """ISSUE 8 acceptance: `allreduce_bench.py --topology PODSxCHIPS`
+    sweeps flat vs two-phase vs hierarchical on the simulated two-tier
+    mesh, every row carries the per-size modeled costs + the compiler's
+    `chosen` pick, the summary asserts modeled-vs-chosen agreement, and
+    the artifact diffs cleanly through bench_regress."""
+    art = tmp_path / "topo.json"
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "benchmarks", "allreduce_bench.py"),
+         "--topology", "2x4", "--cpu-mesh", "--min-elems", "4096",
+         "--max-elems", "65536", "--iters", "1", "--warmup", "0",
+         "--out", str(art)],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "XLA_FLAGS": "", "JAX_PLATFORMS": ""},
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    doc = json.loads(art.read_text())
+    summary, rows = doc["summary"], doc["rows"]
+    assert summary["vehicle"] == "topo_schedule_wire"
+    assert summary["topology"] == "2x4"
+    assert summary["modeled_vs_chosen_agree"] is True
+    assert summary["crossover_bytes"] > 0
+    assert summary["metric"] == "allreduce_topo_hierarchical_busbw_peak"
+    assert summary["value"] > 0
+    paths = {r["path"] for r in rows}
+    assert paths == {"flat", "two_phase", "hierarchical"}
+    for r in rows:
+        assert r["chosen"] in ("flat", "two_phase", "hierarchical")
+        assert r["modeled_flat_us"] > 0
+        assert r["modeled_hierarchical_us"] > 0
+    # bench_regress reads the {"summary", "rows"} artifact shape.
+    regress = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "bench_regress.py"),
+         str(art), str(art)],
+        capture_output=True, text=True, timeout=60)
+    assert regress.returncode == 0, regress.stderr
+
+
 # --- scripts/bench_regress.py (tier-1-safe: pure-Python JSON diffing) --------
 
 def _regress(tmp_path, old, new, *flags):

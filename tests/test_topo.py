@@ -1,0 +1,1143 @@
+"""Topology-aware collective scheduling (ISSUE 8 tentpole): the
+``horovod_tpu/topo/`` subsystem — mesh model, per-tier α–β cost model
+with its online estimator, the schedule compiler + native twin, and the
+CPU mesh simulator.
+
+Four contracts:
+
+* **Closed-form cost oracles** — per-tier ``phase_cost_us``, the
+  flat/hierarchical makespans and the crossover byte count match the
+  hand-derived formulas; the compiler's choice flips exactly at the
+  crossover (tiny bucket → flat, huge bucket → hierarchical), and the
+  native ``hvd_tpu_plan_hierarchical`` twin agrees bit-for-bit.
+* **Equivalence oracle** — on the CPU-simulated two-tier mesh the
+  compiled hierarchical schedule is bit-identical to flat allreduce on
+  exact-arithmetic data for every compressor tier (int8 on its
+  ``127·2^k`` grid), tolerance-equivalent on random data, and the
+  overlap wire's RS→AG composition inverts its shard permutation.
+* **Online estimator** — converges on synthetic pure-wire signals,
+  refines from the obs step-time loop, freezes under
+  ``HVD_TPU_TOPO_COST_FREEZE``.
+* **Fault site ``dcn``** — fires only at the cross-pod exchange step;
+  the seeded recovery drill (``scripts/chaos_soak.py --mode dcn`` loops
+  it) rolls back and converges.
+"""
+
+import contextlib
+import dataclasses
+import os
+import time
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import basics, faults
+from horovod_tpu.config import Config, parse_fault_spec, parse_topo_spec
+from horovod_tpu.elastic import HorovodInternalError
+from horovod_tpu.obs import metrics as obs_metrics
+from horovod_tpu.ops.compression import Compression
+from horovod_tpu.optim import make_train_step
+from horovod_tpu.topo import simulate
+from horovod_tpu.topo.costmodel import (OnlineEstimator, TierParams,
+                                        TopoCostParams, default_params,
+                                        flat_cost_us,
+                                        hierarchical_cost_us,
+                                        hierarchical_crossover_bytes,
+                                        hierarchical_phase_costs_us,
+                                        reset_estimator,
+                                        tier_phase_cost_us)
+from horovod_tpu.topo.costmodel import estimator as process_estimator
+from horovod_tpu.topo.schedule import (ALGO_FLAT, ALGO_HIERARCHICAL,
+                                       ALGO_TWO_PHASE, ScheduleCompiler,
+                                       choose_algo,
+                                       compile_bucket_schedule,
+                                       maybe_compiler, record_plans)
+from horovod_tpu.topo.topology import (MeshTopology, infer_topology,
+                                       resolve_topology)
+
+# Per-tier parameters pinned so the oracles don't move with config
+# defaults: ICI an order of magnitude better on both axes.
+PARAMS = TopoCostParams(ici=TierParams(alpha_us=10.0, beta_gbps=100.0),
+                        dcn=TierParams(alpha_us=100.0, beta_gbps=10.0))
+TOPO24 = MeshTopology(pods=2, chips_per_pod=4)
+
+
+@contextlib.contextmanager
+def _config(**kw):
+    """Swap fields into the live config for the duration (trace-time
+    reads resolve the override; single-threaded test harness, restored
+    in finally like analysis/jaxpr_check.py does)."""
+    old = basics._state.config
+    basics._state.config = dataclasses.replace(old, **kw)
+    try:
+        yield basics._state.config
+    finally:
+        basics._state.config = old
+
+
+def _metric(name, **labels):
+    """Current value of one process-registry series (0.0 when absent;
+    the delta convention of tests/test_obs.py)."""
+    for series in obs_metrics.registry().snapshot().get(name, []):
+        if series.get("labels", {}) == {str(k): str(v)
+                                        for k, v in labels.items()}:
+            return series.get("value", series.get("count"))
+    return 0.0
+
+
+# --- topology model ----------------------------------------------------------
+
+class TestTopoSpec:
+    @pytest.mark.parametrize("spec,want", [
+        ("4x8", (4, 8)),
+        ("2x4", (2, 4)),
+        (" 2 x 4 ", (2, 4)),
+        ("2X4", (2, 4)),
+        ("1x8", (1, 8)),
+    ])
+    def test_parses(self, spec, want):
+        assert parse_topo_spec(spec) == want
+
+    @pytest.mark.parametrize("bad", [
+        "", "8", "x8", "4x", "0x4", "4x0", "-1x4", "ax8", "4x8x2",
+        "4*8",
+    ])
+    def test_rejects_malformed(self, bad):
+        with pytest.raises(ValueError, match="topo spec"):
+            parse_topo_spec(bad)
+
+    def test_from_env_roundtrip(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_TOPO_SPEC", "2x4")
+        monkeypatch.setenv("HVD_TPU_TOPO_SCHEDULE", "hierarchical")
+        monkeypatch.setenv("HVD_TPU_TOPO_COST_FREEZE", "1")
+        monkeypatch.setenv("HVD_TPU_TOPO_ALPHA_DCN_US", "55.5")
+        monkeypatch.setenv("HVD_TPU_TOPO_BETA_DCN_GBPS", "2.5")
+        cfg = Config.from_env()
+        assert cfg.topo_spec == "2x4"
+        assert cfg.topo_schedule == "hierarchical"
+        assert cfg.topo_cost_freeze is True
+        assert cfg.topo_alpha_dcn_us == 55.5
+        assert cfg.topo_beta_dcn_gbps == 2.5
+
+    def test_from_env_defaults(self):
+        cfg = Config.from_env()
+        assert cfg.topo_spec is None
+        assert cfg.topo_schedule == "off"
+        assert cfg.topo_cost_freeze is False
+
+    def test_from_env_rejects_malformed_spec(self, monkeypatch):
+        """A typo'd topology must fail at init, not silently run flat."""
+        monkeypatch.setenv("HVD_TPU_TOPO_SPEC", "4by8")
+        with pytest.raises(ValueError, match="topo spec"):
+            Config.from_env()
+
+
+class TestMeshTopology:
+    def test_tier_groups_2x4(self):
+        topo = MeshTopology(pods=2, chips_per_pod=4)
+        assert topo.intra_pod_groups() == [[0, 1, 2, 3], [4, 5, 6, 7]]
+        assert topo.cross_pod_groups() == [[0, 4], [1, 5], [2, 6], [3, 7]]
+
+    @pytest.mark.parametrize("pods,chips", [(2, 4), (4, 2), (1, 8),
+                                            (8, 1)])
+    def test_groups_are_full_partitions(self, pods, chips):
+        """Both tiers must be full partitions of the axis — the XLA
+        ``axis_index_groups`` contract."""
+        topo = MeshTopology(pods=pods, chips_per_pod=chips)
+        for groups in (topo.intra_pod_groups(), topo.cross_pod_groups()):
+            flat = [r for g in groups for r in g]
+            assert sorted(flat) == list(range(topo.size))
+
+    def test_rank_coordinates(self):
+        topo = MeshTopology(pods=2, chips_per_pod=4)
+        assert [topo.pod_of(r) for r in range(8)] == [0] * 4 + [1] * 4
+        assert [topo.chip_of(r) for r in range(8)] == [0, 1, 2, 3] * 2
+
+    def test_two_tier_predicate(self):
+        assert MeshTopology(2, 4).two_tier
+        assert not MeshTopology(1, 8).two_tier
+        assert not MeshTopology(8, 1).two_tier
+
+    @pytest.mark.parametrize("pods,chips", [(0, 4), (4, 0), (-1, 2)])
+    def test_rejects_degenerate_factors(self, pods, chips):
+        with pytest.raises(ValueError, match=">= 1"):
+            MeshTopology(pods=pods, chips_per_pod=chips)
+
+
+class TestInferTopology:
+    def _devices(self, slice_ids, attr="process_index"):
+        return [SimpleNamespace(**{attr: s}) for s in slice_ids]
+
+    def test_uniform_contiguous_runs_become_pods(self):
+        devs = self._devices([0, 0, 0, 0, 1, 1, 1, 1])
+        topo = infer_topology(devs)
+        assert (topo.pods, topo.chips_per_pod) == (2, 4)
+
+    def test_slice_index_preferred_over_process_index(self):
+        devs = [SimpleNamespace(slice_index=i // 2, process_index=0)
+                for i in range(8)]
+        topo = infer_topology(devs)
+        assert (topo.pods, topo.chips_per_pod) == (4, 2)
+
+    def test_irregular_runs_fall_back_flat(self):
+        devs = self._devices([0, 0, 0, 1, 1, 1, 1, 1])  # 3 + 5
+        topo = infer_topology(devs)
+        assert (topo.pods, topo.chips_per_pod) == (1, 8)
+
+    def test_noncontiguous_slices_fall_back_flat(self):
+        devs = self._devices([0, 0, 1, 1, 0, 0, 1, 1])  # slice 0 reappears
+        topo = infer_topology(devs)
+        assert (topo.pods, topo.chips_per_pod) == (1, 8)
+
+    def test_single_chip_pods_fall_back_flat(self):
+        """Runs of length 1 carry no intra-tier to hierarchize over."""
+        devs = self._devices(list(range(8)))
+        topo = infer_topology(devs)
+        assert (topo.pods, topo.chips_per_pod) == (1, 8)
+
+    def test_single_device(self):
+        topo = infer_topology(self._devices([0]))
+        assert topo.size == 1
+
+
+class TestTierProcessSets:
+    def test_registers_both_tiers_and_is_idempotent(self, world_size):
+        from horovod_tpu.process_sets import remove_process_set
+        from horovod_tpu.topo.topology import register_tier_process_sets
+
+        topo = MeshTopology(2, 4)
+        intra, cross = register_tier_process_sets(topo)
+        try:
+            assert [list(ps.ranks) for ps in intra] \
+                == topo.intra_pod_groups()
+            assert [list(ps.ranks) for ps in cross] \
+                == topo.cross_pod_groups()
+            # Idempotent: a second registration finds, never duplicates.
+            intra2, cross2 = register_tier_process_sets(topo)
+            assert all(a is b for a, b in zip(intra, intra2))
+            assert all(a is b for a, b in zip(cross, cross2))
+        finally:
+            for ps in intra + cross:
+                remove_process_set(ps)
+
+
+class TestResolveTopology:
+    def test_declared_spec_wins(self):
+        topo = resolve_topology(8, "2x4")
+        assert (topo.pods, topo.chips_per_pod) == (2, 4)
+
+    def test_spec_must_factor_world(self):
+        with pytest.raises(ValueError, match="8 slots"):
+            resolve_topology(6, "2x4")
+
+    def test_subworld_without_spec_stays_flat(self):
+        """Inference sees the global device list; a reduction over a
+        different width must not inherit its pods."""
+        topo = resolve_topology(4)
+        assert (topo.pods, topo.chips_per_pod) == (1, 4)
+
+    def test_config_topology_bad_spec_falls_back_flat(self):
+        """A config-driven trace must run flat on a spec/world mismatch,
+        not crash the step."""
+        from horovod_tpu.topo.topology import config_topology
+
+        with _config(topo_spec="3x3"):  # 9 != 8
+            topo = config_topology(8)
+        assert (topo.pods, topo.chips_per_pod) == (1, 8)
+
+
+# --- cost model oracles ------------------------------------------------------
+
+class TestCostModelOracles:
+    def test_phase_cost_closed_form(self):
+        # 3 hops, each 10µs launch + (1e6/4 B) / (1e5 B/µs) transfer.
+        got = tier_phase_cost_us(1e6, 4, TierParams(10.0, 100.0))
+        assert got == pytest.approx(3 * (10.0 + 2.5))
+
+    def test_phase_cost_single_participant_is_free(self):
+        assert tier_phase_cost_us(1e9, 1, TierParams(10.0, 100.0)) == 0.0
+
+    def test_flat_cost_single_pod(self):
+        topo = MeshTopology(1, 8)
+        want = 2.0 * tier_phase_cost_us(1e6, 8, PARAMS.ici)
+        assert flat_cost_us(1e6, topo, PARAMS) == pytest.approx(want)
+
+    def test_flat_cost_multi_pod_uses_dcn_bandwidth(self):
+        # One collective: hop launches at ICI α, transfer paced by the
+        # DCN bottleneck β — 2(n−1)·(α_ici + (b/n)/β'_dcn).
+        b, n = 8e6, TOPO24.size
+        want = 2.0 * (n - 1) * (10.0 + (b / n) / 1e4)
+        assert flat_cost_us(b, TOPO24, PARAMS) == pytest.approx(want)
+
+    def test_hierarchical_cost_is_sum_of_phases(self):
+        b = 8e6
+        want = (2.0 * tier_phase_cost_us(b, 4, PARAMS.ici)
+                + 2.0 * tier_phase_cost_us(b / 4, 2, PARAMS.dcn))
+        assert hierarchical_cost_us(b, TOPO24, PARAMS) \
+            == pytest.approx(want)
+        phases = hierarchical_phase_costs_us(b, TOPO24, PARAMS)
+        assert phases["rs_intra"] + phases["xpod"] + phases["ag_intra"] \
+            == pytest.approx(want)
+        assert phases["rs_intra"] == phases["ag_intra"]
+
+    def test_one_tier_mesh_has_no_hierarchy(self):
+        topo = MeshTopology(1, 8)
+        assert hierarchical_cost_us(1e6, topo, PARAMS) \
+            == flat_cost_us(1e6, topo, PARAMS)
+        assert hierarchical_crossover_bytes(topo, PARAMS) == 1 << 62
+
+    def test_crossover_is_the_exact_decision_boundary(self):
+        """choose_algo flips to hierarchical at exactly the closed-form
+        crossover byte count, not one byte earlier."""
+        xb = hierarchical_crossover_bytes(TOPO24, PARAMS)
+        assert 0 < xb < 1 << 62
+        assert choose_algo(xb, TOPO24, PARAMS) == ALGO_HIERARCHICAL
+        assert choose_algo(xb - 1, TOPO24, PARAMS) != ALGO_HIERARCHICAL
+        # And the model itself agrees on both sides of the boundary.
+        assert hierarchical_cost_us(xb, TOPO24, PARAMS) \
+            < flat_cost_us(xb, TOPO24, PARAMS)
+        assert hierarchical_cost_us(xb - 1, TOPO24, PARAMS) \
+            >= flat_cost_us(xb - 1, TOPO24, PARAMS)
+
+    def test_tiny_bucket_stays_flat_huge_goes_hierarchical(self):
+        assert choose_algo(1 << 10, TOPO24, PARAMS) == ALGO_FLAT
+        assert choose_algo(64 << 20, TOPO24, PARAMS) == ALGO_HIERARCHICAL
+
+    def test_crossover_zero_when_hierarchy_wins_on_latency(self):
+        # C·α_ici ≥ α_dcn: the saved ICI hops already pay for the DCN
+        # launches — hierarchical at every size.
+        params = TopoCostParams(ici=TierParams(10.0, 100.0),
+                                dcn=TierParams(5.0, 10.0))
+        assert hierarchical_crossover_bytes(TOPO24, params) == 0
+        assert choose_algo(1, TOPO24, params) == ALGO_HIERARCHICAL
+
+    def test_crossover_unreachable_when_dcn_not_bottleneck(self):
+        # β_dcn == β_ici: no transfer to save, and the DCN launches
+        # always cost more — hierarchy never wins.
+        params = TopoCostParams(ici=TierParams(10.0, 100.0),
+                                dcn=TierParams(100.0, 100.0))
+        assert hierarchical_crossover_bytes(TOPO24, params) == 1 << 62
+        assert choose_algo(1 << 30, TOPO24, params) != ALGO_HIERARCHICAL
+
+    def test_crossover_declines_inverted_tiers(self):
+        """β_dcn > β_ici with cheap DCN launches: hierarchy wins only
+        *below* a boundary, so there is no 'above which it wins'
+        threshold to report — the closed form must say unreachable
+        while choose_algo (direct cost comparison) stays correct."""
+        params = TopoCostParams(ici=TierParams(10.0, 10.0),
+                                dcn=TierParams(5.0, 100.0))
+        assert hierarchical_crossover_bytes(TOPO24, params) == 1 << 62
+        assert choose_algo(1, TOPO24, params) == ALGO_HIERARCHICAL
+        assert choose_algo(1 << 30, TOPO24, params) != ALGO_HIERARCHICAL
+
+    def test_two_phase_on_single_pod_mesh(self):
+        # The flat-family crossover α·β·n: 10µs · 1e5 B/µs · 8 = 8 MB.
+        topo = MeshTopology(1, 8)
+        assert choose_algo(16 << 20, topo, PARAMS) == ALGO_TWO_PHASE
+        assert choose_algo(1 << 20, topo, PARAMS) == ALGO_FLAT
+
+    def test_default_params_come_from_live_config(self):
+        with _config(cost_alpha_us=7.0, cost_beta_gbps=70.0,
+                     topo_alpha_dcn_us=77.0, topo_beta_dcn_gbps=7.7):
+            p = default_params()
+        assert (p.ici.alpha_us, p.ici.beta_gbps) == (7.0, 70.0)
+        assert (p.dcn.alpha_us, p.dcn.beta_gbps) == (77.0, 7.7)
+
+
+class TestNativeTwin:
+    """``hvd_tpu_plan_hierarchical`` (native/src/planner.cc) must agree
+    with ``choose_algo`` bit-for-bit — divergent planners would compile
+    divergent collective programs across build flavors."""
+
+    PARAM_GRID = [
+        PARAMS,
+        TopoCostParams(ici=TierParams(10.0, 100.0),
+                       dcn=TierParams(5.0, 10.0)),       # crossover 0
+        TopoCostParams(ici=TierParams(10.0, 100.0),
+                       dcn=TierParams(100.0, 100.0)),    # never wins
+        TopoCostParams(ici=TierParams(0.0, 50.0),
+                       dcn=TierParams(1.0, 5.0)),
+    ]
+    TOPOS = [(2, 4), (4, 2), (1, 8), (8, 1), (2, 2)]
+
+    def test_matches_python_choice_everywhere(self):
+        from horovod_tpu.native import planner as nplanner
+
+        if not nplanner.available():
+            pytest.skip("native planner not built")
+        for pods, chips in self.TOPOS:
+            topo = MeshTopology(pods, chips)
+            for params in self.PARAM_GRID:
+                xb = hierarchical_crossover_bytes(topo, params)
+                sizes = [0, 1, 1 << 10, 1 << 20, 1 << 26, 1 << 30]
+                if 0 < xb < 1 << 62:
+                    sizes += [xb - 1, xb, xb + 1]
+                want = [choose_algo(b, topo, params) for b in sizes]
+                got = nplanner.plan_hierarchical(
+                    sizes, pods, chips, params.ici.alpha_us,
+                    params.ici.beta_gbps, params.dcn.alpha_us,
+                    params.dcn.beta_gbps)
+                assert got == want, (pods, chips, params, sizes)
+
+    def test_rejects_invalid_input(self):
+        from horovod_tpu.native import planner as nplanner
+
+        if not nplanner.available():
+            pytest.skip("native planner not built")
+        with pytest.raises(ValueError, match="Invalid"):
+            nplanner.plan_hierarchical([1024], 0, 4, 10.0, 100.0,
+                                       100.0, 10.0)
+
+
+# --- schedule compiler -------------------------------------------------------
+
+class TestScheduleCompiler:
+    def test_hierarchical_ir_structure(self):
+        b = 64 << 20
+        sched = compile_bucket_schedule(b, TOPO24, PARAMS)
+        assert sched.algo == ALGO_HIERARCHICAL
+        assert [s.op for s in sched.steps] == ["rs", "ar", "ag"]
+        assert [s.tier for s in sched.steps] == ["ici", "dcn", "ici"]
+        intra = tuple(tuple(g) for g in TOPO24.intra_pod_groups())
+        cross = tuple(tuple(g) for g in TOPO24.cross_pod_groups())
+        assert sched.steps[0].groups == intra
+        assert sched.steps[1].groups == cross
+        assert sched.steps[2].groups == intra
+        assert [s.payload_bytes for s in sched.steps] == [b, b // 4, b]
+        assert sched.est_cost_us \
+            == pytest.approx(hierarchical_cost_us(b, TOPO24, PARAMS))
+        assert sched.tier_bytes() == {"ici": 2 * b, "dcn": b // 4}
+
+    def test_flat_ir_structure(self):
+        sched = compile_bucket_schedule(1 << 10, TOPO24, PARAMS)
+        assert sched.algo == ALGO_FLAT
+        assert len(sched.steps) == 1
+        # On a multi-pod mesh the flat wire's bottleneck is DCN.
+        assert sched.steps[0] .tier == "dcn"
+        assert sched.steps[0].groups is None
+        one_pod = compile_bucket_schedule(1 << 10, MeshTopology(1, 8),
+                                          PARAMS)
+        assert one_pod.steps[0].tier == "ici"
+
+    def test_two_phase_ir_structure(self):
+        sched = compile_bucket_schedule(16 << 20, MeshTopology(1, 8),
+                                        PARAMS)
+        assert sched.algo == ALGO_TWO_PHASE
+        assert [s.op for s in sched.steps] == ["rs", "ag"]
+
+    def test_force_pins_algorithm(self):
+        sched = compile_bucket_schedule(1 << 10, TOPO24, PARAMS,
+                                        force=ALGO_HIERARCHICAL)
+        assert sched.algo == ALGO_HIERARCHICAL
+
+    def test_force_hierarchical_demotes_on_one_tier_mesh(self):
+        sched = compile_bucket_schedule(64 << 20, MeshTopology(1, 8),
+                                        PARAMS, force=ALGO_HIERARCHICAL)
+        assert sched.algo == ALGO_FLAT
+
+    def test_compiler_caches_by_payload(self):
+        comp = ScheduleCompiler(TOPO24, PARAMS)
+        assert comp.compile(1 << 20) is comp.compile(1 << 20)
+        assert comp.compile(1 << 20) is not comp.compile(1 << 21)
+
+    def test_schedule_is_rank_invariant(self):
+        """The GC3 'verifiable compiler output' property: static bytes
+        in, the identical frozen IR out on every simulated rank."""
+        from horovod_tpu.analysis.jaxpr_check import simulate_rank_env
+
+        scheds = []
+        for r in (0, 3, 7):
+            with simulate_rank_env(r):
+                scheds.append(compile_bucket_schedule(64 << 20, TOPO24,
+                                                      PARAMS))
+        assert scheds[0] == scheds[1] == scheds[2]
+
+    def test_maybe_compiler_gating(self):
+        # off → None regardless of topology.
+        with _config(topo_schedule="off", topo_spec="2x4"):
+            assert maybe_compiler(8) is None
+        # process-set sub-reductions keep the flat wire.
+        with _config(topo_schedule="auto", topo_spec="2x4"):
+            assert maybe_compiler(8, groups=[[0, 1], [2, 3]]) is None
+            assert maybe_compiler(1) is None
+            comp = maybe_compiler(8)
+        assert comp is not None
+        assert (comp.topo.pods, comp.topo.chips_per_pod) == (2, 4)
+        assert comp.force is None   # auto = the cost model decides
+
+    def test_maybe_compiler_explicit_mode_pins(self):
+        with _config(topo_spec="2x4"):
+            comp = maybe_compiler(8, mode="hierarchical")
+        assert comp is not None and comp.force == ALGO_HIERARCHICAL
+
+    def test_explicit_schedule_with_groups_falls_back_flat(self,
+                                                           world_size):
+        """Topo schedules are defined on the global axis: handing an
+        explicit compiler to a process-set sub-reduction must fall back
+        to the grouped flat wire, not sum across group boundaries."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from horovod_tpu._compat import shard_map
+        from horovod_tpu.ops.fusion import fused_two_phase_apply
+
+        gm = hvd.global_mesh()
+        groups = [[0, 1, 2, 3], [4, 5, 6, 7]]
+        comp = ScheduleCompiler(TOPO24, PARAMS,
+                                force=ALGO_HIERARCHICAL)
+        stack = np.arange(8, dtype=np.float32)[:, None] \
+            * np.ones((8, 64), np.float32)
+
+        def per_slot(xb):
+            red = fused_two_phase_apply(
+                [xb[0]], axis=gm.axis_name, op="sum", groups=groups,
+                compression=Compression.none, threshold=1 << 20,
+                pipeline_depth=2, alpha_us=10.0, beta_gbps=100.0,
+                schedule=comp)
+            return red[0][None]
+
+        out = jax.jit(shard_map(
+            per_slot, mesh=gm.mesh, in_specs=P(gm.axis_name),
+            out_specs=P(gm.axis_name)))(
+                jax.device_put(stack,
+                               NamedSharding(gm.mesh, P(gm.axis_name))))
+        out = np.asarray(out)
+        # Per-group sums (0+1+2+3, 4+5+6+7), NOT the global 28.
+        assert np.allclose(out[:4], 6.0)
+        assert np.allclose(out[4:], 22.0)
+
+    def test_explicit_schedule_width_mismatch_falls_back(self,
+                                                         world_size):
+        """A compiler built for a different mesh width than the live
+        reduction must be ignored, not executed."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from horovod_tpu._compat import shard_map
+        from horovod_tpu.ops.fusion import fused_two_phase_apply
+
+        gm = hvd.global_mesh()
+        comp = ScheduleCompiler(MeshTopology(2, 2), PARAMS,
+                                force=ALGO_HIERARCHICAL)  # 4 != 8
+        stack = np.ones((8, 64), np.float32)
+
+        def per_slot(xb):
+            red = fused_two_phase_apply(
+                [xb[0]], axis=gm.axis_name, op="sum", groups=None,
+                compression=Compression.none, threshold=1 << 20,
+                pipeline_depth=2, alpha_us=10.0, beta_gbps=100.0,
+                schedule=comp)
+            return red[0][None]
+
+        out = jax.jit(shard_map(
+            per_slot, mesh=gm.mesh, in_specs=P(gm.axis_name),
+            out_specs=P(gm.axis_name)))(
+                jax.device_put(stack,
+                               NamedSharding(gm.mesh, P(gm.axis_name))))
+        assert np.allclose(np.asarray(out), 8.0)
+
+    def test_maybe_compiler_spec_world_mismatch_degrades_flat(self):
+        """A reduction narrower than the declared mesh must not inherit
+        its pods — the mismatch warns and the compiler degrades to the
+        flat one-tier degenerate (no hierarchical schedule possible)."""
+        with _config(topo_schedule="auto", topo_spec="2x4"):
+            comp = maybe_compiler(4)
+        assert comp is not None and not comp.topo.two_tier
+        assert comp.compile(64 << 20).algo != ALGO_HIERARCHICAL
+
+
+# --- online estimator --------------------------------------------------------
+
+class TestOnlineEstimator:
+    def _fresh(self, decay=0.5):
+        est = OnlineEstimator(prior=PARAMS, decay=decay)
+        est.freeze(False)   # pin: never consult the live config
+        return est
+
+    def test_first_sample_sets_then_ewma(self):
+        est = self._fresh()
+        est.observe("dcn", nbytes=1e6, elapsed_us=1e3)   # 1000 B/µs
+        assert est.params().dcn.beta_gbps == pytest.approx(1.0)
+        est.observe("dcn", nbytes=3e6, elapsed_us=1e3)   # 3000 B/µs
+        assert est.params().dcn.beta_gbps == pytest.approx(2.0)  # EWMA
+
+    def test_converges_on_synthetic_pure_wire_signal(self):
+        """Feed a constant achieved bandwidth: the EWMA's error against
+        the true rate shrinks geometrically from any starting point."""
+        est = self._fresh(decay=0.3)
+        est.observe("dcn", nbytes=1e6, elapsed_us=1e3)   # start at 1 GB/s
+        target = 5.0   # GB/s == 5000 B/µs
+        errors = []
+        for _ in range(30):
+            est.observe("dcn", nbytes=5e6, elapsed_us=1e3)
+            errors.append(abs(est.params().dcn.beta_gbps - target))
+        assert errors[-1] < 1e-3
+        assert all(b < a + 1e-12 for a, b in zip(errors, errors[1:]))
+
+    def test_untouched_tier_keeps_prior(self):
+        est = self._fresh()
+        est.observe("dcn", nbytes=1e6, elapsed_us=1e3)
+        p = est.params()
+        assert p.ici == PARAMS.ici
+        assert p.dcn.alpha_us == PARAMS.dcn.alpha_us  # β-only sample
+
+    def test_observe_alpha(self):
+        est = self._fresh()
+        est.observe_alpha("ici", elapsed_us=30.0, hops=3)
+        assert est.params().ici.alpha_us == pytest.approx(10.0)
+
+    def test_refine_from_step_uses_noted_plan(self):
+        # 8 MB ICI + 2 MB DCN rode the wire inside a 1 ms step:
+        # 8000/2000 B/µs floors → 8.0/2.0 GB/s.
+        est = self._fresh()
+        est.note_plan({"ici": 8e6, "dcn": 2e6})
+        est.refine_from_step(1e-3)
+        p = est.params()
+        assert p.ici.beta_gbps == pytest.approx(8.0)
+        assert p.dcn.beta_gbps == pytest.approx(2.0)
+
+    def test_refine_without_plan_is_noop(self):
+        est = self._fresh()
+        est.refine_from_step(1e-3)
+        assert est.samples == 0
+
+    def test_freeze_stops_refinement(self):
+        est = self._fresh()
+        est.freeze()
+        est.observe("dcn", nbytes=1e6, elapsed_us=1e3)
+        assert est.samples == 0
+        assert est.params().dcn == PARAMS.dcn
+
+    def test_config_freeze_knob(self):
+        est = OnlineEstimator(prior=PARAMS)   # frozen unset → config
+        with _config(topo_cost_freeze=True):
+            assert est.frozen()
+            est.observe("dcn", nbytes=1e6, elapsed_us=1e3)
+        assert est.samples == 0
+
+    def test_effective_params_prior_until_every_tier_sampled(self):
+        """One-sided refinement must not feed the compiler: the
+        flat-vs-hierarchical decision rides the cross-tier ratio, and a
+        β floor on one tier alone would distort it."""
+        est = self._fresh()
+        assert est.effective_params() is est.prior
+        est.observe("dcn", nbytes=5e6, elapsed_us=1e3)
+        assert est.effective_params() is est.prior   # ici unsampled
+        est.observe("ici", nbytes=5e7, elapsed_us=1e3)
+        # Single-controller world (the CI harness): refined values flow
+        # once both tiers sampled against a shared denominator.
+        eff = est.effective_params()
+        assert eff.dcn.beta_gbps == pytest.approx(5.0)
+        assert eff.ici.beta_gbps == pytest.approx(50.0)
+
+    def test_process_estimator_singleton_and_reset(self):
+        reset_estimator()
+        try:
+            assert process_estimator() is process_estimator()
+        finally:
+            reset_estimator()
+
+    def test_estimator_publishes_gauges(self):
+        reset_estimator()
+        try:
+            est = process_estimator()
+            est.freeze(False)
+            est.observe("dcn", nbytes=6e6, elapsed_us=1e3)  # 6 GB/s
+            assert _metric("hvd_tpu_topo_cost_beta_gbps", tier="dcn") \
+                == pytest.approx(6.0)
+            assert _metric("hvd_tpu_topo_cost_alpha_us", tier="ici") \
+                > 0.0
+        finally:
+            reset_estimator()
+
+
+class TestRecordPlans:
+    def test_records_tiers_algos_and_estimator_note(self):
+        reset_estimator()
+        try:
+            b = 64 << 20
+            hier = compile_bucket_schedule(b, TOPO24, PARAMS,
+                                           force=ALGO_HIERARCHICAL)
+            flat = compile_bucket_schedule(1 << 10, TOPO24, PARAMS,
+                                           force=ALGO_FLAT)
+            before_h = _metric("hvd_tpu_topo_schedules_total",
+                               algo="hierarchical")
+            before_wire = _metric("hvd_tpu_topo_wire_bytes_total",
+                                  tier="dcn")
+            record_plans([hier, flat], Compression.none, 4)
+            assert _metric("hvd_tpu_topo_schedules_total",
+                           algo="hierarchical") == before_h + 1
+            # hier puts b//4 on DCN; the flat bucket's whole payload
+            # also rides the (bottleneck) DCN tier on a multi-pod mesh.
+            assert _metric("hvd_tpu_topo_wire_bytes_total", tier="dcn") \
+                == before_wire + b // 4 + (1 << 10)
+            assert _metric("hvd_tpu_topo_est_cost_us", tier="ici") > 0.0
+            # The estimator saw the plan: one step refines from it.
+            est = process_estimator()
+            est.freeze(False)
+            est.refine_from_step(1e-3)
+            assert est.samples > 0
+        finally:
+            reset_estimator()
+
+    def test_compressed_wire_scales_bytes(self):
+        reset_estimator()
+        try:
+            b = 1 << 20
+            hier = compile_bucket_schedule(b, TOPO24, PARAMS,
+                                           force=ALGO_HIERARCHICAL)
+            before = _metric("hvd_tpu_topo_wire_bytes_total", tier="dcn")
+            record_plans([hier], Compression.fp16, 4)  # fp32→fp16: ½
+            assert _metric("hvd_tpu_topo_wire_bytes_total", tier="dcn") \
+                == before + (b // 4) // 2
+        finally:
+            reset_estimator()
+
+
+# --- equivalence oracle on the simulated mesh --------------------------------
+
+def _int_stack(rng, elems=257, lo=-8, hi=9):
+    """Exact-arithmetic data: small-integer fp32 whose partial sums are
+    exactly representable in every association order."""
+    return rng.integers(lo, hi, size=(8, elems)).astype(np.float32)
+
+
+def _int8_grid_stack(rng, elems=256):
+    """Per-row-constant rows on the ``127·2^k`` grid: the int8 wire's
+    block quantization is exact at every stage of both paths (the
+    partial sums stay on the grid)."""
+    k = rng.integers(0, 3, size=(8, 1)).astype(np.float32)
+    return np.broadcast_to(127.0 * (2.0 ** k), (8, elems)) \
+        .astype(np.float32).copy()
+
+
+class TestSimulatedMesh:
+    def test_default_factoring_is_two_tier(self, world_size):
+        sim = simulate.simulated_mesh()
+        assert sim.topo.pods == 2
+        assert sim.topo.size == world_size
+
+    def test_partial_factoring(self, world_size):
+        assert simulate.simulated_mesh(chips=2).topo.pods \
+            == world_size // 2
+
+    def test_rejects_nonfactoring(self):
+        with pytest.raises(ValueError, match="factor"):
+            simulate.simulated_mesh(3, 3)
+
+    def test_rejects_wrong_stack_width(self):
+        sim = simulate.simulated_mesh(2, 4)
+        with pytest.raises(ValueError, match="rows"):
+            simulate.run_allreduce(sim, np.ones((4, 8), np.float32))
+
+
+class TestEquivalenceOracle:
+    """Acceptance criterion: on the CPU-simulated two-tier mesh the
+    compiled hierarchical schedule is bit-identical to flat allreduce
+    for every compressor tier."""
+
+    @pytest.mark.parametrize("comp", ["none", "fp16", "bf16"])
+    def test_bit_identical_on_exact_data(self, comp, world_size):
+        compression = getattr(Compression, comp)
+        sim = simulate.simulated_mesh(2, 4)
+        stack = _int_stack(np.random.default_rng(7))
+        flat = simulate.run_allreduce(sim, stack, algo=ALGO_FLAT,
+                                      compression=compression)
+        for algo in (ALGO_HIERARCHICAL, ALGO_TWO_PHASE):
+            got = simulate.run_allreduce(sim, stack, algo=algo,
+                                         compression=compression)
+            assert np.array_equal(flat, got), (comp, algo)
+
+    def test_bit_identical_int8_on_grid(self, world_size):
+        sim = simulate.simulated_mesh(2, 4)
+        stack = _int8_grid_stack(np.random.default_rng(3))
+        flat = simulate.run_allreduce(sim, stack, algo=ALGO_FLAT,
+                                      compression=Compression.int8)
+        hier = simulate.run_allreduce(sim, stack,
+                                      algo=ALGO_HIERARCHICAL,
+                                      compression=Compression.int8)
+        assert np.array_equal(flat, hier)
+
+    def test_int8_error_feedback_wire_exact_on_grid(self, world_size):
+        """The EF wire = int8 wire + locally-carried residual; on the
+        exact grid the residual is identically zero on every rank, so
+        hierarchical stays bit-identical with error feedback active."""
+        from horovod_tpu.ops.quantization import quant_dequant
+
+        stack = _int8_grid_stack(np.random.default_rng(5))
+        # Residual at the per-slot tensor granularity the EF machinery
+        # uses (each slot's leaf is its row).
+        residual = np.stack([
+            np.asarray(jnp.asarray(row) - quant_dequant(jnp.asarray(row)))
+            for row in stack])
+        assert np.array_equal(residual, np.zeros_like(stack))
+        sim = simulate.simulated_mesh(2, 4)
+        flat = simulate.run_allreduce(sim, stack, algo=ALGO_FLAT,
+                                      compression=Compression.int8)
+        hier = simulate.run_allreduce(sim, stack,
+                                      algo=ALGO_HIERARCHICAL,
+                                      compression=Compression.int8)
+        assert np.array_equal(flat, hier)
+
+    def test_random_data_tolerance(self, world_size):
+        """Random fp32 differs only by summation association order."""
+        sim = simulate.simulated_mesh(2, 4)
+        stack = np.random.default_rng(0).standard_normal(
+            (8, 257)).astype(np.float32)
+        flat = simulate.run_allreduce(sim, stack, algo=ALGO_FLAT)
+        hier = simulate.run_allreduce(sim, stack,
+                                      algo=ALGO_HIERARCHICAL)
+        np.testing.assert_allclose(flat, hier, rtol=1e-5, atol=1e-6)
+
+    def test_average_matches_flat(self, world_size):
+        sim = simulate.simulated_mesh(2, 4)
+        stack = _int_stack(np.random.default_rng(11))
+        flat = simulate.run_allreduce(sim, stack, algo=ALGO_FLAT,
+                                      op="average")
+        hier = simulate.run_allreduce(sim, stack,
+                                      algo=ALGO_HIERARCHICAL,
+                                      op="average")
+        assert np.array_equal(flat, hier)
+
+    def test_other_factorings(self, world_size):
+        stack = _int_stack(np.random.default_rng(13), elems=64)
+        for pods, chips in ((4, 2), (2, 4)):
+            sim = simulate.simulated_mesh(pods, chips)
+            flat = simulate.run_allreduce(sim, stack, algo=ALGO_FLAT)
+            hier = simulate.run_allreduce(sim, stack,
+                                          algo=ALGO_HIERARCHICAL)
+            assert np.array_equal(flat, hier), (pods, chips)
+
+    def test_overlap_rs_ag_roundtrip_inverts_permutation(self,
+                                                         world_size):
+        """The overlap wire's hierarchical RS → AG composition: shards
+        come back pod-major-permuted and the AG must invert it — the
+        roundtrip equals the flat allreduce bit-for-bit."""
+        sim = simulate.simulated_mesh(2, 4)
+        stack = _int_stack(np.random.default_rng(17))
+        flat = simulate.run_allreduce(sim, stack, algo=ALGO_FLAT)
+        rt = simulate.run_rs_ag_roundtrip(sim, stack)
+        assert np.array_equal(flat, rt)
+
+    def test_roundtrip_int8_on_grid(self, world_size):
+        sim = simulate.simulated_mesh(2, 4)
+        stack = _int8_grid_stack(np.random.default_rng(19))
+        flat = simulate.run_allreduce(sim, stack, algo=ALGO_FLAT,
+                                      compression=Compression.int8)
+        rt = simulate.run_rs_ag_roundtrip(sim, stack,
+                                          compression=Compression.int8)
+        assert np.array_equal(flat, rt)
+
+
+# --- modeled-vs-chosen agreement (acceptance) --------------------------------
+
+class TestModeledVsChosenAgreement:
+    def test_compiler_picks_hierarchical_exactly_where_model_wins(self):
+        sizes = [1 << s for s in range(10, 27)]
+        rows = simulate.cost_oracle_rows(sizes, TOPO24, PARAMS)
+        for row in rows:
+            model_says_hier = (row["modeled_hierarchical_us"]
+                               < row["modeled_flat_us"])
+            assert (row["chosen"] == ALGO_HIERARCHICAL) \
+                == model_says_hier, row
+        chosen = [r["chosen"] for r in rows]
+        # The sweep straddles the crossover: both regimes appear, and
+        # the flip happens at the closed-form boundary.
+        assert ALGO_HIERARCHICAL in chosen and chosen[0] != \
+            ALGO_HIERARCHICAL
+        xb = hierarchical_crossover_bytes(TOPO24, PARAMS)
+        for row in rows:
+            assert (row["chosen"] == ALGO_HIERARCHICAL) \
+                == (row["bytes"] >= xb), (row, xb)
+
+    def test_hierarchical_modeled_busbw_beats_flat_above_crossover(self):
+        """Where the compiler picks hierarchical, its modeled effective
+        busbw (bytes moved / makespan) must beat the flat wire's on the
+        same payload — the cross-pod fragment is the win."""
+        xb = hierarchical_crossover_bytes(TOPO24, PARAMS)
+        for b in (xb, 2 * xb, 16 * xb):
+            flat = flat_cost_us(b, TOPO24, PARAMS)
+            hier = hierarchical_cost_us(b, TOPO24, PARAMS)
+            assert b / hier > b / flat
+
+
+# --- train-step integration --------------------------------------------------
+
+def _data(n=64, d=5, seed=0):
+    rng = np.random.RandomState(seed)
+    w_true = rng.randn(d).astype(np.float32)
+    x = rng.randn(n, d).astype(np.float32)
+    y = x @ w_true + 0.01 * rng.randn(n).astype(np.float32)
+    return x, y
+
+
+def loss_fn(params, batch):
+    x, y = batch
+    pred = x @ params["w"] + params["b"]
+    return jnp.mean((pred - y) ** 2)
+
+
+def _init_params(d=5):
+    return {"w": jnp.zeros((d,), jnp.float32),
+            "b": jnp.zeros((), jnp.float32)}
+
+
+def _run(step, params, opt_state, batch, steps=3):
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, batch)
+        jax.block_until_ready(loss)
+    return params, opt_state, loss
+
+
+def _assert_trees_close(a, b, **tol):
+    leaves_a, leaves_b = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(leaves_a) == len(leaves_b)
+    for la, lb in zip(leaves_a, leaves_b):
+        np.testing.assert_allclose(np.asarray(la, np.float64),
+                                   np.asarray(lb, np.float64), **tol)
+
+
+class TestTrainStepIntegration:
+    """`HVD_TPU_TOPO_SCHEDULE` routes the fused gradient wire through
+    the schedule compiler at trace time — results must match the flat
+    wire and the hierarchical lowering must actually engage."""
+
+    def test_hierarchical_step_matches_flat(self, world_size):
+        x, y = _data()
+        params = _init_params()
+        tx = optax.adam(0.05)
+        baseline = make_train_step(loss_fn, tx, donate=False)
+        p1, s1, _ = _run(baseline, params, tx.init(params), (x, y))
+        before = _metric("hvd_tpu_topo_schedules_total",
+                         algo="hierarchical")
+        with _config(topo_spec="2x4", topo_schedule="hierarchical"):
+            topo_step = make_train_step(loss_fn, tx, donate=False)
+            p2, s2, _ = _run(topo_step, params, tx.init(params), (x, y))
+        _assert_trees_close(p1, p2, rtol=2e-5, atol=1e-6)
+        _assert_trees_close(s1, s2, rtol=2e-5, atol=1e-6)
+        assert _metric("hvd_tpu_topo_schedules_total",
+                       algo="hierarchical") > before
+
+    def test_auto_mode_runs_and_matches(self, world_size):
+        x, y = _data()
+        params = _init_params()
+        tx = optax.sgd(0.1)
+        baseline = make_train_step(loss_fn, tx, donate=False)
+        p1, _, _ = _run(baseline, params, tx.init(params), (x, y))
+        with _config(topo_spec="2x4", topo_schedule="auto"):
+            auto_step = make_train_step(loss_fn, tx, donate=False)
+            p2, _, _ = _run(auto_step, params, tx.init(params), (x, y))
+        _assert_trees_close(p1, p2, rtol=2e-5, atol=1e-6)
+
+    def test_overlap_microbatch_wire_hierarchical(self, world_size):
+        """The overlap wire's per-bucket hierarchical RS + deferred AG
+        (permutation + inverse inside the scan) stays equivalent to the
+        sequential single-batch step."""
+        x, y = _data()
+        params = _init_params()
+        tx = optax.adam(0.05)
+        baseline = make_train_step(loss_fn, tx, donate=False)
+        p1, s1, _ = _run(baseline, params, tx.init(params), (x, y))
+        before = _metric("hvd_tpu_topo_schedules_total",
+                         algo="hierarchical")
+        with _config(topo_spec="2x4", topo_schedule="hierarchical"):
+            topo_step = make_train_step(loss_fn, tx, donate=False,
+                                        microbatches=4, overlap=True)
+            p2, s2, _ = _run(topo_step, params, tx.init(params), (x, y))
+        _assert_trees_close(p1, p2, rtol=2e-5, atol=1e-6)
+        _assert_trees_close(s1, s2, rtol=2e-5, atol=1e-6)
+        assert _metric("hvd_tpu_topo_schedules_total",
+                       algo="hierarchical") > before
+
+    def test_int8_error_feedback_wire_hierarchical(self, world_size):
+        """int8 + EF on the hierarchical overlap wire: quantization
+        noise stays bounded against the exact step (the tolerance of
+        the flat-wire EF test in tests/test_microbatch.py)."""
+        from horovod_tpu.optim import DistributedOptimizer
+
+        x, y = _data()
+        params = _init_params()
+        tx = optax.sgd(0.1)
+        exact = make_train_step(loss_fn, tx, donate=False)
+        p1, _, _ = _run(exact, params, tx.init(params), (x, y), steps=1)
+        dopt = DistributedOptimizer(optax.sgd(0.1),
+                                    compression=Compression.int8,
+                                    error_feedback=True)
+        with _config(topo_spec="2x4", topo_schedule="hierarchical"):
+            lossy = make_train_step(loss_fn, dopt, donate=False,
+                                    microbatches=4, overlap=True,
+                                    compression=Compression.int8)
+            p2, _, _ = _run(lossy, params, dopt.init(params), (x, y),
+                            steps=1)
+        _assert_trees_close(p1, p2, rtol=5e-2, atol=5e-2)
+
+
+class TestAutotuneTopoKnob:
+    def test_apply_maps_lattice_to_config(self):
+        old = basics._state.config
+        try:
+            applied = basics._apply_autotuned_knobs({"topo_schedule": 3.2})
+            assert applied["topo_schedule"] == 3
+            assert hvd.config().topo_schedule == "hierarchical"
+            applied = basics._apply_autotuned_knobs({"topo_schedule": 1.0})
+            assert hvd.config().topo_schedule == "flat"
+        finally:
+            with basics._state.lock:
+                basics._state.config = old  # hvdlint: disable=unguarded-mutation -- holds _state.lock
+
+    def test_knob_joins_search_on_two_tier_mesh(self):
+        hvd.shutdown()
+        try:
+            hvd.init(Config(autotune=True, topo_schedule="auto",
+                            topo_spec="2x4"))
+            assert "topo_schedule" in hvd.parameter_manager().knob_names
+        finally:
+            hvd.shutdown()
+            hvd.init()
+
+    def test_knob_stays_out_on_flat_mesh(self):
+        hvd.shutdown()
+        try:
+            hvd.init(Config(autotune=True, topo_schedule="auto"))
+            # No spec and a single-process CPU world → 1×N inference:
+            # nothing to hierarchize, the axis must not join.
+            assert "topo_schedule" not in \
+                hvd.parameter_manager().knob_names
+        finally:
+            hvd.shutdown()
+            hvd.init()
+
+
+# --- fault site `dcn` --------------------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+class TestDcnFaultSite:
+    def test_grammar_accepts_dcn(self):
+        c = parse_fault_spec("dcn:step=2,mode=partition")["dcn"]
+        assert (c.site, c.step, c.mode) == ("dcn", 2, "partition")
+
+    def test_grammar_rejects_bad_mode(self):
+        with pytest.raises(ValueError, match="mode"):
+            parse_fault_spec("dcn:mode=kill")
+
+    def test_unit_drop(self):
+        with faults.inject("dcn:step=0"):
+            with pytest.raises(HorovodInternalError, match="dcn drop"):
+                faults.on_dcn("xpod")
+
+    def test_unit_partition_message(self):
+        with faults.inject("dcn:step=0,mode=partition"):
+            with pytest.raises(HorovodInternalError,
+                               match="unreachable"):
+                faults.on_dcn("xpod")
+
+    def test_unit_delay(self):
+        with faults.inject("dcn:step=0,mode=delay,delay_ms=150"):
+            t0 = time.monotonic()
+            faults.on_dcn("xpod")
+            assert time.monotonic() - t0 >= 0.15
+
+    def test_fires_at_cross_pod_exchange_only(self, world_size):
+        """The whole point of the site: a hierarchical schedule's xpod
+        step trips it; the flat and two-phase wires (no DCN exchange)
+        sail through untouched."""
+        sim = simulate.simulated_mesh(2, 4)
+        stack = _int_stack(np.random.default_rng(23), elems=64)
+        with faults.inject("dcn:step=0,mode=partition"):
+            with pytest.raises(HorovodInternalError,
+                               match="unreachable"):
+                simulate.run_allreduce(sim, stack,
+                                       algo=ALGO_HIERARCHICAL)
+        with faults.inject("dcn:step=0"):
+            for algo in (ALGO_FLAT, ALGO_TWO_PHASE):
+                simulate.run_allreduce(sim, stack, algo=algo)
+            assert not [h for h in faults.history() if h[0] == "dcn"]
+
+    def test_overlap_rs_half_hits_the_site(self, world_size):
+        """The overlap wire's composable RS half crosses DCN too — its
+        ``xpod_rs`` stage trips the same site."""
+        sim = simulate.simulated_mesh(2, 4)
+        stack = _int_stack(np.random.default_rng(29), elems=64)
+        with faults.inject("dcn:step=0"):
+            with pytest.raises(HorovodInternalError, match="xpod_rs"):
+                simulate.run_rs_ag_roundtrip(sim, stack)
+
+    def test_deterministic_across_runs(self, world_size):
+        sim = simulate.simulated_mesh(2, 4)
+        stack = _int_stack(np.random.default_rng(31), elems=64)
+
+        def firing_sequence():
+            fired = []
+            with faults.inject("dcn:p=0.5,seed=42,times=3"):
+                for i in range(8):
+                    try:
+                        simulate.run_allreduce(sim, stack,
+                                               algo=ALGO_HIERARCHICAL)
+                    except HorovodInternalError:
+                        fired.append(i)
+            return fired
+
+        first = firing_sequence()
+        assert first, "seeded plan never fired"
+        assert firing_sequence() == first
+
+
+@pytest.mark.chaos
+class TestChaosDcnRecovery:
+    """Seeded recovery drill for `scripts/chaos_soak.py --mode dcn`:
+    a dcn fault at a randomized cross-pod exchange rolls the elastic
+    state back and the loop converges to the exact flat-wire total."""
+
+    def test_dcn_fault_rolls_back_and_converges(self, monkeypatch,
+                                                world_size):
+        from horovod_tpu.elastic import TpuState, run
+        from horovod_tpu.elastic import state as state_mod
+
+        monkeypatch.setattr(state_mod.time, "sleep", lambda s: None)
+        fault_step = int(os.environ.get("HVD_TPU_CHAOS_STEP", "5"))
+        seed = int(os.environ.get("HVD_TPU_CHAOS_SEED", "0"))
+        TOTAL = max(8, fault_step + 2)
+
+        sim = simulate.simulated_mesh(2, 4)
+        state = TpuState(params={"w": jax.numpy.zeros((2,))},
+                         step=0, accum=0.0)
+        meta = {"tries": 0}
+
+        @run
+        def train(state):
+            meta["tries"] += 1
+            if meta["tries"] == 2:
+                expect = sum(hvd.size() * t for t in range(int(state.step)))
+                assert abs(float(state.accum) - expect) < 1e-6
+            while int(state.step) < TOTAL:
+                s = int(state.step)
+                stack = np.full((hvd.size(), 2), float(s), np.float32)
+                # Each loop iteration re-traces the schedule (fresh jit
+                # in run_allreduce), so exchange #s belongs to step s —
+                # the injected step index maps 1:1 onto train steps.
+                out = simulate.run_allreduce(sim, stack,
+                                             algo=ALGO_HIERARCHICAL)
+                state.accum = float(state.accum) + float(out[0, 0])
+                state.params = jax.tree.map(lambda p: p + 1.0,
+                                            state.params)
+                state.step = s + 1
+                state.commit()
+            return state
+
+        with faults.inject(f"dcn:step={fault_step},seed={seed}"):
+            train(state)
+            fired = [h for h in faults.history() if h[0] == "dcn"]
+        assert len(fired) == 1 and fired[0][1] == fault_step, fired
+        assert meta["tries"] == 2, meta
+        want = sum(hvd.size() * t for t in range(TOTAL))
+        assert abs(float(state.accum) - want) < 1e-6, (state.accum, want)
+        assert float(np.asarray(state.params["w"])[0]) == float(TOTAL)
