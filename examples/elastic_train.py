@@ -28,7 +28,7 @@ import numpy as np
 import optax
 
 import horovod_tpu as hvd
-from horovod_tpu.checkpoint import Checkpointer
+from horovod_tpu.ckpt import AsyncCheckpointer
 from horovod_tpu.models import MLP
 
 
@@ -61,7 +61,12 @@ def main():
 
     @hvd.elastic.run
     def train(state):
-        with Checkpointer(ckpt_dir, async_save=False) as ckpt:
+        # Async durable tier (horovod_tpu/ckpt/): every state.commit()
+        # also snapshots to the background writer (costing the loop one
+        # host copy), and the per-step journal lets a resume land on
+        # the exact step instead of the last commit.
+        with AsyncCheckpointer(ckpt_dir) as ckpt:
+            state.attach_durable(ckpt, step_attr="epoch")
             if ckpt.latest_step() is not None and state.epoch == 0:
                 state.load_from(ckpt)          # durable resume
                 print(f"resumed from epoch {state.epoch}")
@@ -71,9 +76,10 @@ def main():
                     p, s, loss = step(p, s, (x[i:i + 64], y[i:i + 64]))
                 state.params, state.opt_state = p, s
                 state.epoch += 1
-                state.commit()                 # in-memory rollback point
-                state.save_to(ckpt, state.epoch)   # durable tier
+                state.commit()   # in-memory rollback point + async save
+                state.journal_step(state.epoch, loss=float(loss))
                 print(f"epoch {state.epoch}: loss={float(loss):.4f}")
+            ckpt.wait_until_finished()         # barrier before exit
 
     train(state)
     print("elastic training finished at epoch", state.epoch)

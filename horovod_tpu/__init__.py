@@ -51,6 +51,7 @@ from . import ops  # noqa: F401
 from . import elastic  # noqa: F401
 from . import data  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import ckpt  # noqa: F401
 from . import faults  # noqa: F401
 from . import obs  # noqa: F401
 from .version import __version__  # noqa: F401

@@ -1,385 +1,27 @@
-"""Durable checkpoint/resume (orbax-backed) with integrity verification.
+"""Durable checkpoint/resume — compatibility shim.
 
-Reference context (SURVEY.md §5, checkpoint/resume row; mount empty,
-unverified): the reference keeps elastic commit/rollback **in memory**
-(``horovod/common/elastic.py``) and delegates durable checkpoints to
-the framework — its examples save rank-0 checkpoints, and the Spark
-estimators write model stores.  The TPU-native equivalent is an async
-orbax checkpointer over the same pytrees the elastic ``TpuState``
-holds, so a training job gets both tiers: in-memory rollback for
-membership changes, durable save/restore for preemption (TPU slices are
-preemptible — durable checkpoints matter *more* here than in the
-reference's GPU fleets).
+The implementation moved to :mod:`horovod_tpu.ckpt` (ISSUE 9): this
+module keeps the original public API — :class:`Checkpointer` (the
+orbax-backed whole-tree tier, now with snapshot-offloaded digesting),
+the one-shot ``save``/``restore``/``latest_step`` helpers, and the
+digest utilities — so existing callers and checkpoints keep working
+unchanged.
 
-Integrity tier (beyond the reference): a pytree digest (sha256 over
-leaf bytes + key paths) is written as a sidecar next to each save and
-verified on restore — a half-written or bit-flipped latest step must
-degrade to "restore the newest intact step", never to a bricked job or
-silently-wrong parameters.  Orbax-level restore errors get the same
-treatment: the newest step that both restores and verifies wins.
-
-Rank semantics: with a multi-controller world every process must enter
-``save``/``restore`` (orbax coordinates the distributed write); the
-``should_save_on_this_host`` helper mirrors the reference examples'
-rank-0 gating for purely host-local artifacts.
+New code should use :class:`horovod_tpu.ckpt.AsyncCheckpointer`: the
+sharded store with per-step manifests, the step-metadata journal, and
+the bounded async writer whose save stall is one device→host copy.
+See docs/checkpointing.md for the model and the recovery matrix.
 """
 
 from __future__ import annotations
 
-import hashlib
-import json
-import os
-from typing import Any, List, Optional
-
-import jax
-import numpy as np
-
-from . import faults as faults_mod
-from ._compat import sanitize_checkpoint_tree
-from .obs import trace as trace_mod
-from .utils.logging import get_logger
-from .utils.retry import RetryPolicy, retry_call
-
-logger = get_logger(__name__)
+from .ckpt.compat import (  # noqa: F401
+    Checkpointer, CheckpointCorruptionError, _damage_step_dir,
+    _digestable, _key_token, latest_step, pytree_digest, restore, save,
+    should_save_on_this_host,
+)
 
 __all__ = [
     "Checkpointer", "CheckpointCorruptionError", "pytree_digest",
     "save", "restore", "latest_step", "should_save_on_this_host",
 ]
-
-
-class CheckpointCorruptionError(RuntimeError):
-    """No step restored AND verified (raised only after the fallback
-    scan exhausted every retained step)."""
-
-
-def should_save_on_this_host() -> bool:
-    """True on the process that should write host-local artifacts
-    (reference examples: ``if hvd.rank() == 0: save_checkpoint()``)."""
-    return jax.process_index() == 0
-
-
-def _key_token(entry) -> str:
-    """One path entry as a container-agnostic token: a save/restore
-    round trip normalizes containers (namedtuples/custom nodes → dicts,
-    tuples → lists), which swaps GetAttrKey('x') for DictKey('x') — the
-    *name* is the stable coordinate, not the keystr formatting."""
-    for attr in ("key", "name", "idx"):
-        if hasattr(entry, attr):
-            return repr(getattr(entry, attr))
-    return repr(entry)
-
-
-def _digestable(tree: Any) -> bool:
-    """Digesting needs every leaf's bytes on this host; arrays spanning
-    non-addressable devices (multi-host shardings) can't be pulled —
-    the integrity tier degrades to off for such trees rather than
-    crashing the save."""
-    for leaf in jax.tree_util.tree_leaves(tree):
-        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
-            return False
-    return True
-
-
-def pytree_digest(tree: Any) -> str:
-    """Content digest of a pytree: sha256 over per-leaf records of
-    (key path, dtype, shape, raw bytes), combined order-insensitively.
-    Key paths (not treedef identity, not flatten order) are the stable
-    coordinate across the container-type normalization a save/restore
-    round trip applies: tuples → lists and namedtuples/custom nodes →
-    dicts change both the key *kind* (:func:`_key_token`) and the leaf
-    *order* (namedtuples flatten in field order, dicts in sorted-key
-    order), neither of which is a content change."""
-    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
-    records = []
-    for path, leaf in flat:
-        arr = np.asarray(jax.device_get(leaf))
-        r = hashlib.sha256()
-        r.update("/".join(_key_token(e) for e in path).encode())
-        r.update(arr.dtype.str.encode())
-        r.update(repr(arr.shape).encode())
-        r.update(np.ascontiguousarray(arr).tobytes())
-        records.append(r.digest())
-    h = hashlib.sha256()
-    for record in sorted(records):
-        h.update(record)
-    return h.hexdigest()
-
-
-class Checkpointer:
-    """Async, step-numbered pytree checkpoints in ``directory``.
-
-    Wraps ``orbax.checkpoint.CheckpointManager`` with the framework's
-    defaults: async writes (training continues while the previous step
-    flushes), bounded retention, optional ``keep_period`` for
-    long-horizon runs, and (``verify=True``) the digest-sidecar
-    integrity tier.  The managed pytree is whatever the caller
-    passes — canonically ``{"params": ..., "opt_state": ..., "step": N}``
-    or an elastic ``TpuState``'s trees.
-    """
-
-    def __init__(self, directory: str, *, max_to_keep: int = 3,
-                 keep_period: Optional[int] = None,
-                 async_save: bool = True,
-                 verify: Optional[bool] = None,
-                 restore_retries: int = 2):
-        import orbax.checkpoint as ocp
-
-        self._dir = os.path.abspath(directory)
-        options = ocp.CheckpointManagerOptions(
-            max_to_keep=max_to_keep,
-            keep_period=keep_period,
-            enable_async_checkpointing=async_save,
-        )
-        self._mgr = ocp.CheckpointManager(self._dir, options=options)
-        if verify is None:
-            from . import basics
-
-            verify = (basics.config().checkpoint_digest
-                      if basics.is_initialized() else True)
-        self._verify = bool(verify)
-        self._restore_policy = RetryPolicy(attempts=max(1, restore_retries),
-                                           base_delay_s=0.5, max_delay_s=5.0)
-
-    @property
-    def directory(self) -> str:
-        return self._dir
-
-    # --- digest sidecars ----------------------------------------------------
-
-    def _digest_dir(self) -> str:
-        return os.path.join(self._dir, "digests")
-
-    def _digest_path(self, step: int) -> str:
-        return os.path.join(self._digest_dir(), f"{int(step)}.json")
-
-    def _write_digest(self, step: int, digest: str, nleaves: int) -> None:
-        # Tiny host-local JSON: the writer is the rank-0 controller (the
-        # same host that gates every other host-local artifact).
-        if not should_save_on_this_host():
-            return
-        os.makedirs(self._digest_dir(), exist_ok=True)
-        tmp = self._digest_path(step) + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"step": int(step), "digest": digest,
-                       "nleaves": int(nleaves)}, f)
-        os.replace(tmp, self._digest_path(step))
-
-    def _read_digest(self, step: int) -> Optional[str]:
-        try:
-            with open(self._digest_path(step)) as f:
-                return json.load(f)["digest"]
-        except (OSError, ValueError, KeyError):
-            return None
-
-    def _prune_digests(self) -> None:
-        """Drop sidecars for steps retention already deleted."""
-        if not should_save_on_this_host():
-            return
-        keep = {int(s) for s in self.all_steps()}
-        try:
-            names = os.listdir(self._digest_dir())
-        except OSError:
-            return
-        for name in names:
-            stem = name.partition(".")[0]
-            if stem.isdigit() and int(stem) not in keep:
-                try:
-                    os.unlink(os.path.join(self._digest_dir(), name))
-                except OSError:
-                    pass
-
-    # --- save / restore -----------------------------------------------------
-
-    def save(self, step: int, tree: Any, *, force: bool = False) -> bool:
-        """Write ``tree`` as checkpoint ``step`` (async by default) plus
-        its digest sidecar.  Returns False if the manager's save policy
-        skipped it."""
-        with trace_mod.span("hvd_tpu_ckpt_save", args={"step": int(step)}):
-            return self._traced_save(step, tree, force=force)
-
-    def _traced_save(self, step: int, tree: Any, *, force: bool) -> bool:
-        import orbax.checkpoint as ocp
-
-        tree = sanitize_checkpoint_tree(tree)
-        saved = self._mgr.save(step, args=ocp.args.StandardSave(tree),
-                               force=force)
-        # Digest only on the sidecar-writing host (computing the hash on
-        # every controller would be O(model bytes) of wasted device->host
-        # traffic per save) and only for host-addressable trees.
-        if saved and self._verify and should_save_on_this_host():
-            if _digestable(tree):
-                nleaves = len(jax.tree_util.tree_leaves(tree))
-                self._write_digest(step, pytree_digest(tree), nleaves)
-            else:
-                logger.debug("checkpoint step %d: digest skipped (tree "
-                             "spans non-addressable devices)", step)
-            self._prune_digests()
-        if saved and faults_mod._active is not None:
-            # Every rank ticks its plan (site counters stay in lockstep)
-            # but only ONE applies the damage: two ranks XOR-flipping
-            # the same bytes would cancel out (a false-green chaos run),
-            # and two unlinks of the same victim would crash the second.
-            mode = faults_mod.on_checkpoint_save(int(step))
-            if mode is not None and should_save_on_this_host():
-                # The injected damage targets the *stored* artifact, so
-                # the async write must land before we vandalize it.
-                self._mgr.wait_until_finished()
-                _damage_step_dir(self._dir, int(step), mode)
-        return saved
-
-    def _restore_step(self, step: int, template: Optional[Any]) -> Any:
-        import orbax.checkpoint as ocp
-
-        # StandardRestore (with or without template) — a bare
-        # ``mgr.restore(step)`` needs a handler registry on orbax >= 0.7
-        # when the manager didn't perform the save itself (the
-        # fresh-process resume path).
-        return retry_call(
-            lambda: self._mgr.restore(
-                step, args=ocp.args.StandardRestore(template)),
-            policy=self._restore_policy,
-            retry_on=(OSError,),
-            # A missing file (torn/partial write) is deterministic —
-            # retrying it just delays the fallback scan.
-            give_up_on=(FileNotFoundError,),
-            describe=f"checkpoint restore step {step}",
-        )
-
-    def _verified_restore(self, step: int, template: Optional[Any]) -> Any:
-        with trace_mod.span("hvd_tpu_ckpt_restore",
-                            args={"step": int(step)}):
-            got = self._restore_step(step, template)
-            # Digest verification is byte-exact, so it only applies to
-            # as-saved restores: a template legitimately *transforms* the
-            # content (dtype casts, shardings — orbax restores into the
-            # template's spec), which is not corruption.
-            if self._verify and template is None:
-                want = self._read_digest(step)
-                if want is not None and _digestable(got) \
-                        and pytree_digest(got) != want:
-                    raise CheckpointCorruptionError(
-                        f"checkpoint step {step} failed digest "
-                        f"verification under {self._dir}")
-            return got
-
-    def restore(self, step: Optional[int] = None,
-                template: Optional[Any] = None,
-                fallback: Optional[bool] = None) -> Any:
-        """Restore checkpoint ``step`` (default: latest).  ``template``
-        (a matching pytree of arrays/shape-dtype structs) restores with
-        the template's shardings — pass it in multi-chip runs so params
-        land sharded instead of replicated on host.
-
-        With ``fallback`` (default: on when ``step`` is None), a step
-        that fails to restore or fails digest verification degrades to
-        the newest older step that passes — a corrupted latest save must
-        not brick the job.  An explicitly-requested step never falls
-        back: the caller asked for *that* state.
-        """
-        if fallback is None:
-            fallback = step is None
-        if step is not None:
-            return self._verified_restore(step, template)
-        candidates = sorted((int(s) for s in self.all_steps()), reverse=True)
-        if not candidates:
-            raise FileNotFoundError(f"no checkpoint found under {self._dir}")
-        if not fallback:
-            return self._verified_restore(candidates[0], template)
-        # What counts as "this step is damaged, try an older one": digest
-        # mismatch, I/O errors, and the decode/structure errors orbax
-        # raises on torn files.  With a template, a ValueError is most
-        # likely a template/checkpoint mismatch — a caller bug that would
-        # fail identically on every step — so it propagates as itself.
-        damage = (CheckpointCorruptionError, OSError, UnicodeDecodeError,
-                  KeyError)
-        if template is None:
-            damage = damage + (ValueError,)
-        errors: List[str] = []
-        for s in candidates:
-            try:
-                got = self._verified_restore(s, template)
-                if errors:
-                    logger.warning(
-                        "restored checkpoint step %d after newer step(s) "
-                        "failed: %s", s, "; ".join(errors))
-                return got
-            except damage as e:
-                errors.append(f"step {s}: {type(e).__name__}: {e}")
-                logger.warning("checkpoint step %d unusable (%s); trying "
-                               "older step", s, e)
-        raise CheckpointCorruptionError(
-            f"no intact checkpoint under {self._dir}: {'; '.join(errors)}")
-
-    def latest_step(self) -> Optional[int]:
-        return self._mgr.latest_step()
-
-    def all_steps(self):
-        return self._mgr.all_steps()
-
-    def wait_until_finished(self) -> None:
-        """Block until pending async saves hit storage (call before
-        exiting, or before deleting the job's scratch space)."""
-        self._mgr.wait_until_finished()
-
-    def close(self) -> None:
-        self._mgr.close()
-
-    def __enter__(self) -> "Checkpointer":
-        return self
-
-    def __exit__(self, *exc) -> None:
-        self.wait_until_finished()
-        self.close()
-
-
-def _damage_step_dir(directory: str, step: int, mode: str) -> None:
-    """Apply the fault plan's checkpoint damage (site ``checkpoint``):
-    ``corrupt`` bit-flips the largest data file of the step; ``partial``
-    deletes it (a write that never finished)."""
-    step_dir = os.path.join(directory, str(step))
-    victims: List[str] = []
-    for root, _, files in os.walk(step_dir):
-        for name in files:
-            path = os.path.join(root, name)
-            try:
-                if os.path.getsize(path) > 0:
-                    victims.append(path)
-            except OSError:
-                pass
-    if not victims:
-        logger.warning("fault: no files to damage under %s", step_dir)
-        return
-    victim = max(victims, key=os.path.getsize)
-    if mode == "partial":
-        try:
-            os.unlink(victim)
-        except FileNotFoundError:
-            pass  # already damaged (e.g. a prior run of the plan)
-        logger.warning("fault: deleted %s (partial write)", victim)
-        return
-    size = os.path.getsize(victim)
-    with open(victim, "r+b") as f:
-        f.seek(size // 2)
-        chunk = f.read(64) or b"\0"
-        f.seek(size // 2)
-        f.write(bytes(b ^ 0xFF for b in chunk))
-    logger.warning("fault: corrupted %d bytes of %s", len(chunk), victim)
-
-
-def save(directory: str, step: int, tree: Any) -> None:
-    """One-shot synchronous save (convenience for scripts/tests)."""
-    with Checkpointer(directory, async_save=False) as ckpt:
-        ckpt.save(step, tree)
-
-
-def restore(directory: str, step: Optional[int] = None,
-            template: Optional[Any] = None) -> Any:
-    """One-shot restore (convenience for scripts/tests)."""
-    with Checkpointer(directory, async_save=False) as ckpt:
-        return ckpt.restore(step, template)
-
-
-def latest_step(directory: str) -> Optional[int]:
-    with Checkpointer(directory, async_save=False) as ckpt:
-        return ckpt.latest_step()

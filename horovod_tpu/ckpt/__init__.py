@@ -1,0 +1,44 @@
+"""Async sharded durable state (ROADMAP item 5 / ISSUE 9).
+
+The checkpoint subsystem, in four pieces:
+
+* :mod:`.snapshot` — snapshot-and-offload: durability costs the step
+  loop ONE device→host copy into pooled host buffers; digests are
+  computed from those buffers, never from the device again.
+* :mod:`.store` + :mod:`.manifest` — per-step shard files with a JSON
+  manifest mapping key-path → {shard file, owner ranks, digest,
+  nbytes}, committed by one atomic rename; an elastic resize N→N′
+  restores exactly the bytes each new rank owns, and damage is
+  detected at manifest granularity.
+* :mod:`.journal` — an append-only fsync'd JSONL of per-step replay
+  metadata (rng key, sampler cursor, knobs), so recovery restores the
+  last snapshot and replays to the EXACT failed step.
+* :mod:`.writer` + :mod:`.checkpointer` — the bounded background
+  writer (``HVD_TPU_CKPT_ASYNC``/``HVD_TPU_CKPT_INFLIGHT``) and the
+  :class:`AsyncCheckpointer` facade.
+
+:mod:`.compat` keeps the pre-existing orbax whole-tree tier; the
+``horovod_tpu.checkpoint`` module is a thin shim over it.  See
+docs/checkpointing.md.
+"""
+
+from .checkpointer import AsyncCheckpointer, ResumeInfo  # noqa: F401
+from .errors import CheckpointCorruptionError  # noqa: F401
+from .journal import StepJournal  # noqa: F401
+from .manifest import (  # noqa: F401
+    Manifest, ManifestError, RestorePlan, assign_owners, plan_restore,
+    shard_filename,
+)
+from .snapshot import (  # noqa: F401
+    BufferPool, Snapshot, is_snapshotable, pytree_digest, take_snapshot,
+)
+from .store import ShardStore  # noqa: F401
+from .writer import AsyncWriter  # noqa: F401
+
+__all__ = [
+    "AsyncCheckpointer", "ResumeInfo", "CheckpointCorruptionError",
+    "StepJournal", "Manifest", "ManifestError", "RestorePlan",
+    "assign_owners", "plan_restore", "shard_filename", "BufferPool",
+    "Snapshot", "is_snapshotable", "pytree_digest", "take_snapshot",
+    "ShardStore", "AsyncWriter",
+]

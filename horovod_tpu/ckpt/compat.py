@@ -1,0 +1,469 @@
+"""Monolithic orbax tier (the ``horovod_tpu.checkpoint`` compat API).
+
+This is the pre-``ckpt/`` checkpointer — orbax ``CheckpointManager``
+whole-tree saves with digest sidecars and fallback-to-newest-intact —
+kept as the compatibility surface (``horovod_tpu.checkpoint`` re-exports
+it) and as the tier for trees the sharded store cannot hold (arrays
+spanning non-addressable devices: orbax coordinates the distributed
+write itself).
+
+What changed from the monolithic era (ROADMAP item 5 / ISSUE 9):
+
+* **Digesting never bills the step loop.**  ``save`` takes ONE host
+  snapshot (:mod:`.snapshot`) and computes the sha256 sidecar from
+  those buffers on a background digest thread — previously the digest
+  re-pulled the full tree on the caller between steps.
+* The ``hvd_tpu_ckpt_save`` span gains ``offload``/``write`` children,
+  and save stall/bytes land in the obs registry.
+* The ``checkpoint`` fault site's new modes map onto this layout:
+  ``stall`` sleeps in the hook (a slow filesystem), ``crash-before-
+  rename`` removes the step directory (a commit that never happened),
+  ``partial-manifest`` deletes the step's smallest file (metadata/data
+  split damage).  New-code paths should prefer
+  :class:`horovod_tpu.ckpt.AsyncCheckpointer` (sharded manifests, step
+  journal, bounded async writer).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional
+
+import jax
+
+from . import snapshot as snapshot_mod
+from .errors import CheckpointCorruptionError
+from .snapshot import pytree_digest
+from .writer import AsyncWriter
+from .. import faults as faults_mod
+from .._compat import sanitize_checkpoint_tree
+from ..obs import trace as trace_mod
+from ..utils.logging import get_logger
+from ..utils.retry import RetryPolicy, retry_call
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "Checkpointer", "CheckpointCorruptionError", "pytree_digest",
+    "save", "restore", "latest_step", "should_save_on_this_host",
+]
+
+
+def should_save_on_this_host() -> bool:
+    """True on the process that should write host-local artifacts
+    (reference examples: ``if hvd.rank() == 0: save_checkpoint()``)."""
+    return jax.process_index() == 0
+
+
+def _key_token(entry) -> str:
+    return snapshot_mod._key_token(entry)
+
+
+def _digestable(tree: Any) -> bool:
+    """Digesting needs every leaf's bytes on this host — degrade to off
+    for multi-host trees rather than crashing the save."""
+    return snapshot_mod.is_snapshotable(tree)
+
+
+class Checkpointer:
+    """Async, step-numbered whole-tree pytree checkpoints in
+    ``directory``.
+
+    Wraps ``orbax.checkpoint.CheckpointManager`` with the framework's
+    defaults: async writes (training continues while the previous step
+    flushes), bounded retention, optional ``keep_period`` for
+    long-horizon runs, and (``verify=True``) the digest-sidecar
+    integrity tier — the digest computed ONCE from an offloaded host
+    snapshot, on a background thread.  The managed pytree is whatever
+    the caller passes — canonically ``{"params": ..., "opt_state": ...,
+    "step": N}`` or an elastic ``TpuState``'s trees.
+    """
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3,
+                 keep_period: Optional[int] = None,
+                 async_save: bool = True,
+                 verify: Optional[bool] = None,
+                 restore_retries: int = 2):
+        import orbax.checkpoint as ocp
+
+        self._dir = os.path.abspath(directory)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            keep_period=keep_period,
+            enable_async_checkpointing=async_save,
+        )
+        self._mgr = ocp.CheckpointManager(self._dir, options=options)
+        if verify is None:
+            from .. import basics
+
+            verify = (basics.config().checkpoint_digest
+                      if basics.is_initialized() else True)
+        self._verify = bool(verify)
+        self._restore_policy = RetryPolicy(attempts=max(1, restore_retries),
+                                           base_delay_s=0.5, max_delay_s=5.0)
+        self._digest_writer: Optional[AsyncWriter] = None
+        # Pooled snapshot buffers for the digest path: without a pool,
+        # hashing lagging the save cadence would hold one fresh
+        # model-sized host copy per queued job.
+        self._digest_pool = snapshot_mod.BufferPool(3)
+
+    @property
+    def directory(self) -> str:
+        return self._dir
+
+    # --- digest sidecars ----------------------------------------------------
+
+    def _digest_dir(self) -> str:
+        return os.path.join(self._dir, "digests")
+
+    def _digest_path(self, step: int) -> str:
+        return os.path.join(self._digest_dir(), f"{int(step)}.json")
+
+    def _write_digest(self, step: int, digest: str, nleaves: int) -> None:
+        # Tiny host-local JSON: the writer is the rank-0 controller (the
+        # same host that gates every other host-local artifact).
+        if not should_save_on_this_host():
+            return
+        import json
+
+        os.makedirs(self._digest_dir(), exist_ok=True)
+        tmp = self._digest_path(step) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"step": int(step), "digest": digest,
+                       "nleaves": int(nleaves)}, f)
+        os.replace(tmp, self._digest_path(step))
+
+    # Sentinel returned by _read_digest for a sidecar whose real hash
+    # never landed (the digest thread died with the process).
+    _PENDING = "__pending__"
+
+    def _write_pending_digest(self, step: int) -> None:
+        """Synchronous, tiny marker written BEFORE the digest job is
+        queued: if the process dies in the gap, restore sees "pending"
+        and treats the step as unverifiable (falls back) instead of
+        silently skipping verification for exactly the crash-recovery
+        case the integrity tier exists for."""
+        if not should_save_on_this_host():
+            return
+        import json
+
+        os.makedirs(self._digest_dir(), exist_ok=True)
+        tmp = self._digest_path(step) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"step": int(step), "pending": True}, f)
+        os.replace(tmp, self._digest_path(step))
+
+    def _read_digest(self, step: int) -> Optional[str]:
+        import json
+
+        try:
+            with open(self._digest_path(step)) as f:
+                doc = json.load(f)
+            if doc.get("pending"):
+                return self._PENDING
+            return doc["digest"]
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def _prune_digests(self) -> None:
+        """Drop sidecars for steps retention already deleted."""
+        if not should_save_on_this_host():
+            return
+        keep = {int(s) for s in self.all_steps()}
+        try:
+            names = os.listdir(self._digest_dir())
+        except OSError:
+            return
+        for name in names:
+            stem = name.partition(".")[0]
+            if stem.isdigit() and int(stem) not in keep:
+                try:
+                    os.unlink(os.path.join(self._digest_dir(), name))
+                except OSError:
+                    pass
+
+    def _digest_one(self, item) -> None:
+        """Digest worker: sha256 from the snapshot's host buffers —
+        the step loop never pays for hashing (ISSUE 9 satellite)."""
+        step, snap = item
+        try:
+            self._write_digest(step, snap.digest(), len(snap.leaves))
+            self._prune_digests()
+        finally:
+            snap.release()
+
+    def _submit_digest(self, step: int, snap) -> None:
+        if self._digest_writer is None:
+            # coalesce=False: unlike checkpoint saves (newest wins), a
+            # dropped digest job would silently skip verification for
+            # its step — under load the queue backpressures instead.
+            self._digest_writer = AsyncWriter(
+                self._digest_one, inflight=2, coalesce=False,
+                on_drop=lambda item: item[1].release(),
+                name="hvd-tpu-ckpt-digest")
+        self._digest_writer.submit((int(step), snap))
+
+    # --- save / restore -----------------------------------------------------
+
+    def save(self, step: int, tree: Any, *, force: bool = False) -> bool:
+        """Write ``tree`` as checkpoint ``step`` (async by default) plus
+        its digest sidecar.  Returns False if the manager's save policy
+        skipped it."""
+        with trace_mod.span("hvd_tpu_ckpt_save", args={"step": int(step)}):
+            return self._traced_save(step, tree, force=force)
+
+    def _traced_save(self, step: int, tree: Any, *, force: bool) -> bool:
+        import time
+
+        import orbax.checkpoint as ocp
+
+        from ..obs import instrument as _obs
+
+        t0 = time.perf_counter()
+        tree = sanitize_checkpoint_tree(tree)
+        # One host snapshot, taken only when a digest will be written
+        # (every controller hashing would be O(model bytes) of wasted
+        # device->host traffic per save) and only for host-addressable
+        # trees.  The digest itself runs on the background worker.
+        try:
+            # Don't pay the O(model bytes) host copy for a save the
+            # manager's policy will skip anyway (already-saved step,
+            # save-interval miss); force bypasses the policy.
+            will_save = force or bool(self._mgr.should_save(step))
+        except Exception:
+            will_save = True   # orbax API drift: fail open
+        snap = None
+        if will_save and self._verify and should_save_on_this_host():
+            if _digestable(tree):
+                with trace_mod.span("hvd_tpu_ckpt_offload",
+                                    args={"step": int(step)}):
+                    snap = snapshot_mod.take_snapshot(
+                        tree, step=int(step), pool=self._digest_pool)
+            else:
+                logger.debug("checkpoint step %d: digest skipped (tree "
+                             "spans non-addressable devices)", step)
+        with trace_mod.span("hvd_tpu_ckpt_write",
+                            args={"step": int(step)}):
+            saved = self._mgr.save(step, args=ocp.args.StandardSave(tree),
+                                   force=force)
+        if saved and snap is not None:
+            self._write_pending_digest(int(step))
+            self._submit_digest(step, snap)
+        elif snap is not None:
+            snap.release()
+        if saved and faults_mod._active is not None:
+            # Every rank ticks its plan (site counters stay in lockstep)
+            # but only ONE applies the damage: two ranks XOR-flipping
+            # the same bytes would cancel out (a false-green chaos run),
+            # and two unlinks of the same victim would crash the second.
+            mode = faults_mod.on_checkpoint_save(int(step))
+            if mode is not None and should_save_on_this_host():
+                # The injected damage targets the *stored* artifact, so
+                # the async write must land before we vandalize it.
+                self._mgr.wait_until_finished()
+                _damage_step_dir(self._dir, int(step), mode)
+        _obs.on_ckpt_save((time.perf_counter() - t0) * 1e6,
+                          snap.nbytes if snap is not None else 0,
+                          self._digest_writer.depth()
+                          if self._digest_writer is not None else 0)
+        return saved
+
+    def _restore_step(self, step: int, template: Optional[Any]) -> Any:
+        import orbax.checkpoint as ocp
+
+        # StandardRestore (with or without template) — a bare
+        # ``mgr.restore(step)`` needs a handler registry on orbax >= 0.7
+        # when the manager didn't perform the save itself (the
+        # fresh-process resume path).
+        return retry_call(
+            lambda: self._mgr.restore(
+                step, args=ocp.args.StandardRestore(template)),
+            policy=self._restore_policy,
+            retry_on=(OSError,),
+            # A missing file (torn/partial write) is deterministic —
+            # retrying it just delays the fallback scan.
+            give_up_on=(FileNotFoundError,),
+            describe=f"checkpoint restore step {step}",
+        )
+
+    def _verified_restore(self, step: int, template: Optional[Any]) -> Any:
+        with trace_mod.span("hvd_tpu_ckpt_restore",
+                            args={"step": int(step)}):
+            got = self._restore_step(step, template)
+            # Digest verification is byte-exact, so it only applies to
+            # as-saved restores: a template legitimately *transforms* the
+            # content (dtype casts, shardings — orbax restores into the
+            # template's spec), which is not corruption.
+            if self._verify and template is None:
+                want = self._read_digest(step)
+                if want == self._PENDING:
+                    raise CheckpointCorruptionError(
+                        f"checkpoint step {step} has a pending digest "
+                        f"sidecar (a crash cut the digest write) — it "
+                        f"cannot be verified; restore an older "
+                        f"verified step or pass verify=False")
+                if want is not None and _digestable(got) \
+                        and pytree_digest(got) != want:
+                    raise CheckpointCorruptionError(
+                        f"checkpoint step {step} failed digest "
+                        f"verification under {self._dir}")
+            return got
+
+    def restore(self, step: Optional[int] = None,
+                template: Optional[Any] = None,
+                fallback: Optional[bool] = None) -> Any:
+        """Restore checkpoint ``step`` (default: latest).  ``template``
+        (a matching pytree of arrays/shape-dtype structs) restores with
+        the template's shardings — pass it in multi-chip runs so params
+        land sharded instead of replicated on host.
+
+        With ``fallback`` (default: on when ``step`` is None), a step
+        that fails to restore or fails digest verification degrades to
+        the newest older step that passes — a corrupted latest save must
+        not brick the job.  An explicitly-requested step never falls
+        back: the caller asked for *that* state.
+        """
+        # Land pending writes first, but never let a stored digest-
+        # worker failure (disk full — plausibly the same incident
+        # forcing this restore) brick the recovery path: record it and
+        # read what is intact on disk.
+        try:
+            self.wait_until_finished()
+        except BaseException as e:
+            from ..obs import flight as _flight
+
+            _flight.record("ckpt_async_save_failed", error=str(e)[:300])
+            logger.warning("pending digest/save work failed (%s); "
+                           "restoring from what is on disk", e)
+        if fallback is None:
+            fallback = step is None
+        if step is not None:
+            return self._verified_restore(step, template)
+        candidates = sorted((int(s) for s in self.all_steps()), reverse=True)
+        if not candidates:
+            raise FileNotFoundError(f"no checkpoint found under {self._dir}")
+        if not fallback:
+            return self._verified_restore(candidates[0], template)
+        # What counts as "this step is damaged, try an older one": digest
+        # mismatch, I/O errors, and the decode/structure errors orbax
+        # raises on torn files.  With a template, a ValueError is most
+        # likely a template/checkpoint mismatch — a caller bug that would
+        # fail identically on every step — so it propagates as itself.
+        damage = (CheckpointCorruptionError, OSError, UnicodeDecodeError,
+                  KeyError)
+        if template is None:
+            damage = damage + (ValueError,)
+        errors: List[str] = []
+        for s in candidates:
+            try:
+                got = self._verified_restore(s, template)
+                if errors:
+                    logger.warning(
+                        "restored checkpoint step %d after newer step(s) "
+                        "failed: %s", s, "; ".join(errors))
+                return got
+            except damage as e:
+                errors.append(f"step {s}: {type(e).__name__}: {e}")
+                from ..obs import flight as _flight
+
+                _flight.record("ckpt_step_damaged", step=int(s),
+                               error=f"{type(e).__name__}: {str(e)[:200]}")
+                logger.warning("checkpoint step %d unusable (%s); trying "
+                               "older step", s, e)
+        raise CheckpointCorruptionError(
+            f"no intact checkpoint under {self._dir}: {'; '.join(errors)}")
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return self._mgr.all_steps()
+
+    def wait_until_finished(self) -> None:
+        """Block until pending async saves AND digest sidecars hit
+        storage (call before exiting, or before deleting the job's
+        scratch space)."""
+        self._mgr.wait_until_finished()
+        if self._digest_writer is not None:
+            self._digest_writer.wait_until_finished()
+
+    def close(self) -> None:
+        if self._digest_writer is not None:
+            self._digest_writer.close(drain=True)
+            self._digest_writer = None
+        self._mgr.close()
+
+    def __enter__(self) -> "Checkpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.wait_until_finished()
+        self.close()
+
+
+def _damage_step_dir(directory: str, step: int, mode: str) -> None:
+    """Apply the fault plan's checkpoint damage (site ``checkpoint``) to
+    the orbax layout: ``corrupt`` bit-flips the largest data file of the
+    step; ``partial`` deletes it (a write that never finished);
+    ``partial-manifest`` deletes the smallest file (the metadata/data
+    split — orbax's per-step metadata dangling); ``crash-before-rename``
+    removes the whole step directory (the atomic commit that never
+    happened).  ``stall`` never reaches here — the fault hook sleeps."""
+    import shutil
+
+    step_dir = os.path.join(directory, str(step))
+    if mode == "crash-before-rename":
+        shutil.rmtree(step_dir, ignore_errors=True)
+        logger.warning("fault: removed %s (commit never happened)",
+                       step_dir)
+        return
+    victims: List[str] = []
+    for root, _, files in os.walk(step_dir):
+        for name in files:
+            path = os.path.join(root, name)
+            try:
+                if os.path.getsize(path) > 0:
+                    victims.append(path)
+            except OSError:
+                pass
+    if not victims:
+        logger.warning("fault: no files to damage under %s", step_dir)
+        return
+    if mode == "partial-manifest":
+        victim = min(victims, key=os.path.getsize)
+        try:
+            os.unlink(victim)
+        except FileNotFoundError:
+            pass
+        logger.warning("fault: deleted %s (metadata dangling)", victim)
+        return
+    victim = max(victims, key=os.path.getsize)
+    if mode == "partial":
+        try:
+            os.unlink(victim)
+        except FileNotFoundError:
+            pass  # already damaged (e.g. a prior run of the plan)
+        logger.warning("fault: deleted %s (partial write)", victim)
+        return
+    from .store import bitflip_middle
+
+    flipped = bitflip_middle(victim)
+    logger.warning("fault: corrupted %d bytes of %s", flipped, victim)
+
+
+def save(directory: str, step: int, tree: Any) -> None:
+    """One-shot synchronous save (convenience for scripts/tests)."""
+    with Checkpointer(directory, async_save=False) as ckpt:
+        ckpt.save(step, tree)
+
+
+def restore(directory: str, step: Optional[int] = None,
+            template: Optional[Any] = None) -> Any:
+    """One-shot restore (convenience for scripts/tests)."""
+    with Checkpointer(directory, async_save=False) as ckpt:
+        return ckpt.restore(step, template)
+
+
+def latest_step(directory: str) -> Optional[int]:
+    with Checkpointer(directory, async_save=False) as ckpt:
+        return ckpt.latest_step()

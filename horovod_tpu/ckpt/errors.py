@@ -1,0 +1,10 @@
+"""Shared checkpoint error types (one home, no import cycles)."""
+
+from __future__ import annotations
+
+__all__ = ["CheckpointCorruptionError"]
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """No step restored AND verified (raised only after the fallback
+    scan exhausted every retained step)."""

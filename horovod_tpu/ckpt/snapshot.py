@@ -1,0 +1,232 @@
+"""Snapshot-and-offload: the one device→host copy durability costs.
+
+The synchronous checkpoint path bills the step loop for everything —
+device→host copy, serialization, digesting, the filesystem round trip.
+The async design ("Check-N-Run" line in PAPERS.md) splits it: at the
+step boundary the caller pays exactly ONE ``jax.device_get`` into
+host-owned buffers (a :class:`Snapshot`), and everything downstream —
+the orbax/shard write, the sha256 digest, the fsync — happens on a
+background writer thread against those frozen buffers.
+
+Two properties matter:
+
+* **Ownership.** On CPU backends ``np.asarray(jax.Array)`` can alias
+  the live device buffer, which the next step mutates (donation).  A
+  snapshot therefore always COPIES into buffers it owns.
+* **Bounded allocation.**  Re-allocating model-sized host buffers per
+  save fragments the host heap exactly when the allocator is busiest.
+  :class:`BufferPool` keeps one reusable buffer set per in-flight
+  snapshot (``HVD_TPU_CKPT_INFLIGHT`` + 1), so steady-state saving
+  allocates nothing.
+
+Digest compatibility: :meth:`Snapshot.digest` reproduces
+:func:`pytree_digest` bit-for-bit from the snapshot buffers — the
+sidecar a sync save wrote yesterday verifies a snapshot-offloaded save
+written today, and vice versa.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "Snapshot", "SnapshotLeaf", "BufferPool", "take_snapshot",
+    "is_snapshotable", "pytree_digest", "leaf_record_digest",
+]
+
+
+def _key_token(entry) -> str:
+    """One path entry as a container-agnostic token: a save/restore
+    round trip normalizes containers (namedtuples/custom nodes → dicts,
+    tuples → lists), which swaps GetAttrKey('x') for DictKey('x') — the
+    *name* is the stable coordinate, not the keystr formatting."""
+    for attr in ("key", "name", "idx"):
+        if hasattr(entry, attr):
+            return repr(getattr(entry, attr))
+    return repr(entry)
+
+
+def path_string(path: Tuple[Any, ...]) -> str:
+    return "/".join(_key_token(e) for e in path)
+
+
+def leaf_record_digest(path_str: str, arr: np.ndarray) -> bytes:
+    """The per-leaf record the tree digest is built from: sha256 over
+    (key path, dtype, shape, raw bytes).  Per-leaf digests also land in
+    the shard manifest, so restore can verify exactly the leaves it
+    moves instead of the whole tree."""
+    r = hashlib.sha256()
+    r.update(path_str.encode())
+    r.update(arr.dtype.str.encode())
+    r.update(repr(arr.shape).encode())
+    r.update(np.ascontiguousarray(arr).tobytes())
+    return r.digest()
+
+
+def combine_leaf_digests(records: List[bytes]) -> str:
+    """Order-insensitive combination (sorted), matching the original
+    ``checkpoint.pytree_digest`` contract: container normalization
+    reorders leaves, which is not a content change."""
+    h = hashlib.sha256()
+    for record in sorted(records):
+        h.update(record)
+    return h.hexdigest()
+
+
+def pytree_digest(tree: Any) -> str:
+    """Content digest of a pytree: sha256 over per-leaf records of
+    (key path, dtype, shape, raw bytes), combined order-insensitively.
+    Key paths (not treedef identity, not flatten order) are the stable
+    coordinate across the container-type normalization a save/restore
+    round trip applies."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    records = []
+    for path, leaf in flat:
+        arr = np.asarray(jax.device_get(leaf))
+        records.append(leaf_record_digest(path_string(path), arr))
+    return combine_leaf_digests(records)
+
+
+def is_snapshotable(tree: Any) -> bool:
+    """A snapshot needs every leaf's bytes on this host; arrays spanning
+    non-addressable devices (multi-host shardings) can't be pulled —
+    callers degrade to the direct orbax path (which coordinates the
+    distributed write itself) for such trees."""
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            return False
+    return True
+
+
+class SnapshotLeaf:
+    """One offloaded leaf: its typed key path (skeleton reconstruction),
+    the stable path string (digests/manifests), and the host buffer."""
+
+    __slots__ = ("path", "path_str", "array")
+
+    def __init__(self, path: Tuple[Any, ...], path_str: str,
+                 array: np.ndarray) -> None:
+        self.path = path
+        self.path_str = path_str
+        self.array = array
+
+
+class Snapshot:
+    """A frozen host copy of one pytree at one step.  The writer thread
+    reads it; nothing mutates it after :func:`take_snapshot` returns."""
+
+    def __init__(self, step: int, leaves: List[SnapshotLeaf],
+                 treedef, buffers: Optional[Dict[str, np.ndarray]],
+                 pool: Optional["BufferPool"]) -> None:
+        self.step = int(step)
+        self.leaves = leaves
+        self.treedef = treedef
+        self._buffers = buffers
+        self._pool = pool
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(leaf.array.nbytes) for leaf in self.leaves)
+
+    def tree(self) -> Any:
+        """Rebuild the (numpy) pytree with the original container
+        structure — what the compat tier hands to orbax."""
+        import jax
+
+        return jax.tree_util.tree_unflatten(
+            self.treedef, [leaf.array for leaf in self.leaves])
+
+    def digest(self) -> str:
+        """Tree digest from the snapshot buffers — identical to
+        ``pytree_digest(tree)``, computed without touching the device
+        again (the whole point: digesting never bills the step loop)."""
+        return combine_leaf_digests(
+            [leaf_record_digest(leaf.path_str, leaf.array)
+             for leaf in self.leaves])
+
+    def leaf_digests(self) -> Dict[str, str]:
+        """Per-leaf hex digests keyed by path string (manifest rows)."""
+        return {
+            leaf.path_str: leaf_record_digest(leaf.path_str,
+                                              leaf.array).hex()
+            for leaf in self.leaves
+        }
+
+    def release(self) -> None:
+        """Return pooled buffers (write finished, or the snapshot was
+        coalesced away).  Idempotent."""
+        if self._pool is not None and self._buffers is not None:
+            self._pool.release(self._buffers)
+        self._buffers = None
+        self._pool = None
+
+
+class BufferPool:
+    """Reusable host buffer sets — one per concurrently-live snapshot.
+
+    ``acquire`` hands out a dict keyed by leaf path; ``take_snapshot``
+    copies into matching (dtype, shape) buffers and replaces mismatched
+    ones (a resize/new-leaf re-trace is rare).  An exhausted pool falls
+    back to fresh allocation rather than blocking the step loop —
+    memory pressure is the writer's problem, latency is the caller's.
+    """
+
+    def __init__(self, depth: int) -> None:
+        self._lock = threading.Lock()
+        self._free: List[Dict[str, np.ndarray]] = [
+            {} for _ in range(max(1, int(depth)))]
+
+    def acquire(self) -> Optional[Dict[str, np.ndarray]]:
+        with self._lock:
+            if self._free:
+                return self._free.pop()
+        return None
+
+    def release(self, buffers: Dict[str, np.ndarray]) -> None:
+        with self._lock:
+            self._free.append(buffers)
+
+
+def take_snapshot(tree: Any, *, step: int = 0,
+                  pool: Optional[BufferPool] = None) -> Snapshot:
+    """Device→host copy ``tree`` into owned (pooled when possible)
+    buffers.  This is the entirety of what a save costs the step loop.
+    Raises ``ValueError`` for trees spanning non-addressable devices —
+    gate on :func:`is_snapshotable` first."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    buffers = pool.acquire() if pool is not None else None
+    if buffers:
+        # Evict buffers for leaves that no longer exist (an elastic
+        # re-trace restructuring opt_state) — stale entries would pin
+        # old-model-sized host memory for the rest of the run.
+        live = {path_string(p) for p, _ in flat}
+        for key in [k for k in buffers if k not in live]:
+            del buffers[key]
+    leaves: List[SnapshotLeaf] = []
+    host = jax.device_get([leaf for _, leaf in flat])
+    for (path, _), got in zip(flat, host):
+        arr = np.asarray(got)
+        pstr = path_string(path)
+        buf = buffers.get(pstr) if buffers is not None else None
+        if buf is not None and buf.dtype == arr.dtype \
+                and buf.shape == arr.shape:
+            np.copyto(buf, arr)
+            arr = buf
+        else:
+            # np.asarray may alias the live device buffer on CPU
+            # backends — the snapshot must own its bytes.
+            arr = np.array(arr, copy=True)
+            if buffers is not None:
+                buffers[pstr] = arr
+        leaves.append(SnapshotLeaf(path, pstr, arr))
+    return Snapshot(step, leaves, treedef, buffers, pool)

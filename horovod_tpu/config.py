@@ -74,7 +74,14 @@ _FAULT_MODES = {
     "accumulate": ("raise",),
     "discovery": ("flap", "timeout", "error"),
     "rpc": ("drop", "delay"),
-    "checkpoint": ("corrupt", "partial"),
+    # checkpoint: corrupt/partial damage the committed step's largest
+    # data file; stall sleeps delay_ms at the write (a slow filesystem
+    # — stalls the writer thread on the async tier, the caller on the
+    # sync tier); partial-manifest deletes a shard file the manifest
+    # still references (metadata/data split); crash-before-rename cuts
+    # the save between the last fsync and the atomic commit rename.
+    "checkpoint": ("corrupt", "partial", "stall", "partial-manifest",
+                   "crash-before-rename"),
     # serve: drop/delay fire at the serving endpoint's request handler;
     # kill fires at the continuous batcher's decode dispatch (replica
     # death mid-decode — the router-failover drill).
@@ -426,6 +433,9 @@ class Config:
     agent_ping_interval_seconds: float = 15.0  # HVD_TPU_AGENT_PING_INTERVAL
     agent_max_missed_pings: int = 4           # HVD_TPU_AGENT_MAX_MISSED
     checkpoint_digest: bool = True            # HVD_TPU_CHECKPOINT_DIGEST (integrity sidecar)
+    # Async sharded durable state (horovod_tpu/ckpt/; docs/checkpointing.md)
+    ckpt_async: bool = True                   # HVD_TPU_CKPT_ASYNC (snapshot-and-offload saves)
+    ckpt_inflight: int = 2                    # HVD_TPU_CKPT_INFLIGHT (bounded writer queue; beyond it, oldest unwritten save is coalesced away)
 
     # --- inference serving (horovod_tpu/serve/; no reference analogue —
     #     the reference is training-only) ---
@@ -508,6 +518,8 @@ class Config:
             agent_ping_interval_seconds=_env_float("AGENT_PING_INTERVAL", 15.0),
             agent_max_missed_pings=_env_int("AGENT_MAX_MISSED", 4),
             checkpoint_digest=_env_bool("CHECKPOINT_DIGEST", True),
+            ckpt_async=_env_bool("CKPT_ASYNC", True),
+            ckpt_inflight=_env_pos_int("CKPT_INFLIGHT", 2),
             serve_max_batch=_env_int("SERVE_MAX_BATCH", 8),
             serve_queue_depth=_env_int("SERVE_QUEUE_DEPTH", 64),
             serve_prefill_buckets=_env_int_tuple("SERVE_PREFILL_BUCKETS",

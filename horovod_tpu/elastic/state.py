@@ -121,12 +121,18 @@ class TpuState(ObjectState):
             if k not in self._tree_saved
         }
         self._saved = copy.deepcopy(saved)
+        self._durable_save()
 
     def restore(self) -> None:
         for k, v in copy.deepcopy(self._saved).items():
             setattr(self, k, v)
         for k, v in self._tree_saved.items():
             setattr(self, k, jax.tree.map(jax.numpy.asarray, v))
+        # Queued async saves hold pre-rollback state, and a writer
+        # error from the incident must not resurface mid-recovery.
+        ck = getattr(self, "_durable", None)
+        if ck is not None and hasattr(ck, "discard_pending"):
+            ck.discard_pending()
 
     def sync(self) -> None:
         from ..functions import broadcast_parameters, broadcast_object
@@ -142,8 +148,63 @@ class TpuState(ObjectState):
             setattr(self, k, v)
         self.commit()
 
-    # --- durable tier (orbax; reference delegates this to the framework,
-    # --- see horovod_tpu.checkpoint module docstring) -----------------------
+    # --- durable tier (horovod_tpu.ckpt / horovod_tpu.checkpoint; the
+    # --- reference delegates this to the framework) -------------------------
+
+    def attach_durable(self, checkpointer, *, step_attr: str = "step",
+                       every: int = 1) -> None:
+        """Make every ``commit`` durable: the in-memory rollback point
+        is also handed to ``checkpointer`` (canonically an
+        :class:`horovod_tpu.ckpt.AsyncCheckpointer`, whose save costs
+        one host copy — which ``commit`` just made anyway).  ``every``
+        thins the durable cadence when even that is too often; the
+        step number comes from ``getattr(self, step_attr)`` (falling
+        back to the commit count).  On rollback (:meth:`restore`) the
+        checkpointer's queued-but-unwritten saves are discarded: they
+        hold pre-rollback state."""
+        self._durable = checkpointer
+        self._durable_step_attr = step_attr
+        self._durable_every = max(1, int(every))
+        self._durable_commits = 0
+
+    def _durable_save(self) -> None:
+        ck = getattr(self, "_durable", None)
+        if ck is None:
+            return
+        self._durable_commits += 1
+        if self._durable_commits % self._durable_every:
+            return
+        step = getattr(self, self._durable_step_attr, None)
+        step = int(step) if step is not None else self._durable_commits
+        # Stateful helpers (the elastic sampler) ride along as their
+        # state_dict, packed into ONE json leaf — objects aren't
+        # storable, and a cursor with thousands of processed indices
+        # must not explode into thousands of manifest rows.
+        import json as _json
+
+        plain = {}
+        for k, v in self._saved.items():
+            state_dict = getattr(v, "state_dict", None)
+            if callable(state_dict):
+                plain[k] = {"__state_json__": _json.dumps(
+                    state_dict(), default=str)}
+            else:
+                plain[k] = v
+        ck.save(step, {"trees": self._tree_saved, "plain": plain})
+
+    def journal_step(self, step: Optional[int] = None, **meta) -> None:
+        """Journal one step's replay metadata through the attached
+        async checkpointer (no-op without one): the state's ``rng`` and
+        ``sampler`` attributes (when present) ride along automatically
+        — see ``AsyncCheckpointer.journal_step``."""
+        ck = getattr(self, "_durable", None)
+        if ck is None or not hasattr(ck, "journal_step"):
+            return
+        if step is None:
+            step = int(getattr(self, self._durable_step_attr, 0))
+        meta.setdefault("rng", getattr(self, "rng", None))
+        meta.setdefault("sampler", getattr(self, "sampler", None))
+        ck.journal_step(int(step), **meta)
 
     def save_to(self, checkpointer, step: int) -> None:
         """Persist the committed state durably (preemption-proof tier on
@@ -154,10 +215,35 @@ class TpuState(ObjectState):
                                  "plain": self._saved})
 
     def load_from(self, checkpointer, step=None) -> None:
-        """Load a durable checkpoint into this state and restore it."""
+        """Load a durable checkpoint into this state and restore it.
+        A value that was saved as a ``state_dict`` (the elastic
+        sampler's cursor) is re-applied onto the live attribute via its
+        ``load_state_dict`` instead of replacing the object."""
+        import json as _json
+
+        import numpy as np
+
         payload = checkpointer.restore(step)
         self._tree_saved = payload["trees"]
-        self._saved = payload["plain"]
+        merged = {}
+        for k, v in dict(payload["plain"]).items():
+            live = getattr(self, k, None)
+            if isinstance(v, dict) and "__state_json__" in v:
+                if not hasattr(live, "load_state_dict"):
+                    # Installing the raw marker dict would silently
+                    # lose the cursor and fail far from the cause.
+                    raise ValueError(
+                        f"checkpoint attribute {k!r} was saved as a "
+                        f"state_dict, but the live attribute "
+                        f"({type(live).__name__}) cannot re-apply it "
+                        f"— construct the state with its stateful "
+                        f"helper (e.g. the sampler) before load_from")
+                blob = np.asarray(v["__state_json__"]).item()
+                live.load_state_dict(_json.loads(blob))
+                merged[k] = live
+            else:
+                merged[k] = v
+        self._saved = merged
         self.restore()
 
 

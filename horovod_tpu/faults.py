@@ -16,7 +16,8 @@ accumulate microbatch-loop boundary of the overlap-scheduled     ``raise``
            train steps (trace time; one event per microbatch)
 discovery  ``elastic/driver.py`` ScriptDiscovery + poll          ``flap``/``timeout``/``error``
 rpc        ``runner/common/network.py`` BasicClient calls        ``drop``/``delay``
-checkpoint ``checkpoint.py`` Checkpointer.save                   ``corrupt``/``partial``
+checkpoint ``ckpt/store.py`` write + ``checkpoint.py`` save      ``corrupt``/``partial``/``stall``/
+                                                                 ``partial-manifest``/``crash-before-rename``
 serve      ``serve/server.py`` request handler (drop/delay);     ``drop``/``delay``/``kill``
            ``serve/batcher.py`` decode dispatch (kill)
 dcn        ``topo/schedule.py`` cross-pod exchange step only     ``drop``/``delay``/``partition``
@@ -396,10 +397,14 @@ def on_serve_decode() -> bool:
 
 
 def on_checkpoint_save(step: int) -> Optional[str]:
-    """Site ``checkpoint`` — returns ``"corrupt"``/``"partial"`` when the
-    plan fires for this checkpoint ``step`` (the domain step, so
-    ``checkpoint:step=2`` targets checkpoint 2 regardless of how many
-    saves preceded it), else None.  The checkpointer applies the damage."""
+    """Site ``checkpoint`` — fires for this checkpoint ``step`` (the
+    domain step, so ``checkpoint:step=2`` targets checkpoint 2
+    regardless of how many saves preceded it).  ``stall`` sleeps
+    ``delay_ms`` here (a slow filesystem — on the async tier this runs
+    on the writer thread, so the step loop must NOT feel it) and
+    returns None; the damage modes (``corrupt``/``partial``/
+    ``partial-manifest``/``crash-before-rename``) are returned for the
+    store to apply at the right point of its write protocol."""
     plan = _active
     if plan is None:
         return None
@@ -409,6 +414,9 @@ def on_checkpoint_save(step: int) -> Optional[str]:
     if st.should_fire(domain_step=step):
         mode = st.clause.mode or "corrupt"
         plan.fire("checkpoint", mode, step)
+        if mode == "stall":
+            time.sleep(st.clause.delay_ms / 1000.0)
+            return None
         return mode
     return None
 

@@ -33,7 +33,9 @@ __all__ = [
     "on_fault", "on_elastic_reset", "on_blacklist", "on_membership_loss",
     "on_stall", "on_autotune_window", "on_autotune_apply", "autotune_log",
     "set_mfu", "set_hidden_comm_estimate", "on_topo_plan",
-    "on_topo_estimator",
+    "on_topo_estimator", "on_ckpt_save", "on_ckpt_write",
+    "on_ckpt_restore", "on_ckpt_journal", "on_ckpt_coalesced",
+    "on_ckpt_inflight",
 ]
 
 
@@ -301,6 +303,79 @@ def on_topo_estimator(tier: str, alpha_us: float,
     reg.gauge("hvd_tpu_topo_cost_beta_gbps",
               "estimated per-hop bandwidth, by tier").labels(
                   tier=tier).set(beta_gbps)
+
+
+# --- durable state (horovod_tpu/ckpt/; docs/checkpointing.md) ----------------
+
+def on_ckpt_save(stall_us: float, nbytes: int, inflight: int) -> None:
+    """One save's caller-visible cost: the stall the step loop paid
+    (async tier: the device→host snapshot; sync tier: the whole write),
+    the snapshot bytes offloaded, and the writer queue depth after
+    enqueue."""
+    if not _m.enabled():
+        return
+    reg = _reg()
+    reg.histogram("hvd_tpu_ckpt_save_stall_us",
+                  "wall time a checkpoint save billed the caller "
+                  "(async: one device->host snapshot)").observe(stall_us)
+    if nbytes > 0:
+        reg.counter("hvd_tpu_ckpt_bytes_total",
+                    "checkpoint bytes moved, by kind (snapshot = "
+                    "device->host offload, write = shard files to "
+                    "disk, restore = shard bytes read, journal = "
+                    "step-metadata appends)").labels(
+                        kind="snapshot").inc(nbytes)
+    reg.gauge("hvd_tpu_ckpt_inflight",
+              "checkpoint writer queue depth (queued + writing)").set(
+                  inflight)
+
+
+def on_ckpt_write(write_us: float, nbytes: int) -> None:
+    """One background write's wall time + bytes (writer thread)."""
+    if not _m.enabled():
+        return
+    reg = _reg()
+    reg.histogram("hvd_tpu_ckpt_write_us",
+                  "background checkpoint write wall time (shard files "
+                  "+ manifest + fsync)").observe(write_us)
+    if nbytes > 0:
+        reg.counter("hvd_tpu_ckpt_bytes_total", "").labels(
+            kind="write").inc(nbytes)
+
+
+def on_ckpt_restore(nbytes: int) -> None:
+    """Bytes one restore actually moved (a sharded N→N′ restore moves
+    only the leaves the rank owns — this is the number that proves it)."""
+    if not _m.enabled():
+        return
+    if nbytes > 0:
+        _reg().counter("hvd_tpu_ckpt_bytes_total", "").labels(
+            kind="restore").inc(nbytes)
+
+
+def on_ckpt_journal(nbytes: int) -> None:
+    """One fsync'd journal append."""
+    if not _m.enabled():
+        return
+    _reg().counter("hvd_tpu_ckpt_bytes_total", "").labels(
+        kind="journal").inc(nbytes)
+
+
+def on_ckpt_coalesced() -> None:
+    """A queued save was dropped to admit a newer one (the disk is
+    slower than the save cadence; newest state wins)."""
+    if not _m.enabled():
+        return
+    _reg().counter("hvd_tpu_ckpt_coalesced_total",
+                   "queued checkpoint saves coalesced away "
+                   "(drop-oldest-unwritten)").inc()
+
+
+def on_ckpt_inflight(depth: int) -> None:
+    """Writer queue depth after a write retired."""
+    if not _m.enabled():
+        return
+    _reg().gauge("hvd_tpu_ckpt_inflight", "").set(depth)
 
 
 # --- recovery layers ---------------------------------------------------------

@@ -21,7 +21,12 @@ the replica-failover tests (the training-path loop stays the default).
 ``--mode dcn`` soaks the topology-aware wire: randomized ``dcn:step=N``
 specs fire at the hierarchical schedule's cross-pod exchange
 (``topo/schedule.py``) and the drill asserts rollback + convergence on
-the simulated two-tier mesh.
+the simulated two-tier mesh.  ``--mode ckpt`` soaks the async
+checkpointer: randomized ``checkpoint:*`` specs (the seed draws the
+mode — corrupt, partial, stall, partial-manifest, crash-before-rename —
+and the step picks the save they hit) against the resize-and-replay
+drill in ``tests/test_ckpt.py``, which must resume at the exact
+journaled step, byte-identical to an uninterrupted reference.
 
 Usage::
 
@@ -55,6 +60,12 @@ TARGETS = {
     # schedule's cross-pod exchange (topo/schedule.py) — the
     # simulated-mesh recovery drill runs single-controller only.
     ("dcn", False): "tests/test_topo.py",
+    # ckpt: randomized ``checkpoint:*`` specs (the seed picks the mode
+    # from corrupt/partial/stall/partial-manifest/crash-before-rename,
+    # the step picks which save it hits) against the resize-and-replay
+    # drill in tests/test_ckpt.py — resume must land on the exact
+    # journaled step, byte-identical to the uninterrupted reference.
+    ("ckpt", False): "tests/test_ckpt.py",
 }
 
 
@@ -105,14 +116,18 @@ def main(argv=None) -> int:
     ap.add_argument("--mp", action="store_true",
                     help="soak the multi-process world test instead of "
                          "the single-controller one")
-    ap.add_argument("--mode", choices=("train", "serve", "dcn"),
+    ap.add_argument("--mode", choices=("train", "serve", "dcn", "ckpt"),
                     default="train",
                     help="'train' loops the elastic-recovery chaos "
                          "tests; 'serve' soaks the serving router under "
                          "randomized serve:kill fault specs; 'dcn' "
                          "soaks the hierarchical schedule's cross-pod "
                          "exchange under randomized dcn:* fault specs "
-                         "(single-controller only)")
+                         "(single-controller only); 'ckpt' soaks the "
+                         "async checkpointer's kill-and-replay drill "
+                         "under randomized checkpoint:* fault specs "
+                         "(all five modes, incl. stall/partial-"
+                         "manifest/crash-before-rename)")
     ap.add_argument("--master-seed", type=int, default=None,
                     help="seed for the (step, seed) draw itself — a "
                          "seeded soak is replayable end to end")

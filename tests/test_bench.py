@@ -119,6 +119,49 @@ def test_serving_bench_trace_artifact(tmp_path):
 
 
 @pytest.mark.slow
+def test_checkpoint_bench_json_contract(tmp_path):
+    """ISSUE 9 satellite: the checkpoint bench reports sync vs async
+    save stall, the N→N′ restore rows, bytes moved per rank, and a
+    bench_regress-compatible artifact — and the measured async stall
+    clears the <10% acceptance ratio on the CPU tier."""
+    out_path = str(tmp_path / "ckpt_bench.json")
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmarks",
+                                      "checkpoint_bench.py"),
+         "--mb", "32", "--iters", "3", "--world", "4",
+         "--out", out_path],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "XLA_FLAGS": "", "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "ckpt_async_save_stall_ms"
+    assert row["unit"] == "ms"
+    assert row["value"] > 0 and row["sync_save_ms"] > 0
+    # THE acceptance ratio: async save stall < 10% of the sync wall.
+    assert row["stall_time_frac"] < 0.10, row
+    with open(out_path) as f:
+        artifact = json.load(f)
+    assert "metrics" in artifact and "rows" in artifact
+    worlds = {r["world_to"] for r in artifact["rows"]}
+    assert worlds == {2, 4, 8}             # N/2, N, 2N
+    for r in artifact["rows"]:
+        assert r["bytes_per_rank_max"] <= r["bytes_total"]
+        assert r["value"] > 0
+    # Doubling the world must shrink what any one rank moves.
+    by_world = {r["world_to"]: r for r in artifact["rows"]}
+    assert by_world[8]["bytes_per_rank_max"] < \
+        by_world[2]["bytes_per_rank_max"]
+    # bench_regress accepts the artifact against itself (exit 0).
+    rc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts",
+                                      "bench_regress.py"),
+         out_path, out_path],
+        capture_output=True, text=True, timeout=120).returncode
+    assert rc == 0
+
+
+@pytest.mark.slow
 def test_bench_rejects_nonpositive_batch_size():
     out = subprocess.run(
         [sys.executable, os.path.join(ROOT, "bench.py"), "--preset", "tiny",

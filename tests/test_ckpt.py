@@ -1,0 +1,1211 @@
+"""Async sharded durable state (ISSUE 9, horovod_tpu/ckpt/).
+
+The oracles this file pins:
+
+* **Equivalence + exactness**: an async save produces a byte-identical
+  restorable tree to the sync path (and to the live tree's digest).
+* **Kill-mid-save chaos drill**: a train loop with an injected
+  checkpoint fault resumes from the journal at the EXACT failed step
+  with zero lost steps, across an N→N′ (2-pod → 4-rank) elastic
+  resize — final params byte-identical to an uninterrupted reference.
+* **Stall acceptance**: with a deliberately slow filesystem (stall
+  fault), the async save stall is <10% of the synchronous save wall.
+* **Restore precedence**: journal ahead of the newest intact snapshot,
+  journal missing, journal corrupt mid-line, and a manifest referencing
+  a missing shard each fall back deterministically and leave a
+  flight-recorder event.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu import faults
+from horovod_tpu.ckpt import (
+    AsyncCheckpointer, AsyncWriter, BufferPool, CheckpointCorruptionError,
+    Manifest, ManifestError, ShardStore, StepJournal, assign_owners,
+    plan_restore, pytree_digest, take_snapshot,
+)
+from horovod_tpu.ckpt.manifest import build_skeleton, skeleton_fill
+from horovod_tpu.config import Config, parse_fault_spec
+from horovod_tpu.elastic import ElasticSampler, TpuState
+from horovod_tpu.elastic.state import HorovodInternalError
+from horovod_tpu.obs import flight
+
+
+def _tree(scale=1.0):
+    return {
+        "params": {"w": jnp.arange(24.0).reshape(4, 6) * scale,
+                   "b": jnp.ones((6,)) * scale},
+        "opt": [jnp.zeros((4,)), jnp.full((3, 3), 7.0) * scale],
+        "step": 5,
+    }
+
+
+def _leaves_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        xa, ya = np.asarray(x), np.asarray(y)
+        assert xa.dtype == ya.dtype
+        np.testing.assert_array_equal(xa, ya)
+
+
+def _flight_kinds():
+    return [e["kind"] for e in flight.events()]
+
+
+# --- snapshot ----------------------------------------------------------------
+
+class TestSnapshot:
+    def test_digest_matches_pytree_digest(self):
+        tree = _tree()
+        snap = take_snapshot(tree)
+        assert snap.digest() == pytree_digest(tree)
+
+    def test_snapshot_owns_its_bytes(self):
+        src = np.arange(8.0)
+        tree = {"w": src}
+        snap = take_snapshot(tree)
+        src[:] = -1.0   # the live buffer moves on; the snapshot must not
+        np.testing.assert_array_equal(
+            snap.leaves[0].array, np.arange(8.0))
+
+    def test_buffer_pool_reuse(self):
+        pool = BufferPool(1)
+        tree = _tree()
+        s1 = take_snapshot(tree, pool=pool)
+        bufs1 = [leaf.array for leaf in s1.leaves]
+        s1.release()
+        s2 = take_snapshot(tree, pool=pool)
+        bufs2 = [leaf.array for leaf in s2.leaves]
+        # Steady state allocates nothing: the same host buffers cycle.
+        assert all(b1 is b2 for b1, b2 in zip(bufs1, bufs2))
+        s2.release()
+
+    def test_pool_exhaustion_falls_back_to_fresh_alloc(self):
+        pool = BufferPool(1)
+        tree = _tree()
+        s1 = take_snapshot(tree, pool=pool)         # holds the one set
+        s2 = take_snapshot(tree, pool=pool)         # must not block
+        assert s2.leaves[0].array is not s1.leaves[0].array
+        _leaves_equal(s1.tree(), s2.tree())
+        s1.release()
+        s2.release()
+
+    def test_nbytes_accounts_every_leaf(self):
+        snap = take_snapshot({"a": np.zeros((4,), np.float32),
+                              "b": np.zeros((2, 2), np.float64)})
+        assert snap.nbytes == 4 * 4 + 4 * 8
+
+
+# --- journal -----------------------------------------------------------------
+
+class TestStepJournal:
+    def test_append_read_roundtrip(self, tmp_path):
+        j = StepJournal(str(tmp_path / "j.jsonl"))
+        j.append(1, rng=[0, 1], cursor=4)
+        j.append(2, rng=[0, 2], cursor=8)
+        entries, intact = j.read()
+        assert intact
+        assert [e["step"] for e in entries] == [1, 2]
+        assert entries[1]["cursor"] == 8
+        assert j.last_step() == 2
+        j.close()
+
+    def test_every_append_is_on_disk(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = StepJournal(path)
+        j.append(7, x=1)
+        # No close, no flush from the caller: the contract is that the
+        # line is durable when append() returns.
+        with open(path) as f:
+            assert json.loads(f.read().splitlines()[0])["step"] == 7
+        j.close()
+
+    def test_duplicate_steps_last_wins(self, tmp_path):
+        j = StepJournal(str(tmp_path / "j.jsonl"))
+        for step, tag in [(1, "a"), (2, "b"), (2, "b2"), (3, "c")]:
+            j.append(step, tag=tag)
+        tail = j.entries_after(1)
+        assert [(e["step"], e["tag"]) for e in tail] == [(2, "b2"),
+                                                         (3, "c")]
+        j.close()
+
+    def test_torn_final_line_tolerated(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = StepJournal(path)
+        j.append(1, x=1)
+        j.append(2, x=2)
+        j.close()
+        with open(path, "ab") as f:
+            f.write(b'{"step": 3, "x"')     # the fsync the crash cut
+        flight.reset_for_tests()
+        entries, intact = StepJournal(path).read()
+        assert not intact
+        assert [e["step"] for e in entries] == [1, 2]
+        assert "ckpt_journal_corrupt" in _flight_kinds()
+
+    def test_corrupt_mid_file_stops_deterministically(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = StepJournal(path)
+        for s in (1, 2, 3, 4):
+            j.append(s)
+        j.close()
+        raw = open(path, "rb").read().splitlines(keepends=True)
+        raw[1] = b"\x00garbage\x00\n"
+        with open(path, "wb") as f:
+            f.writelines(raw)
+        flight.reset_for_tests()
+        entries, intact = StepJournal(path).read()
+        assert not intact
+        assert [e["step"] for e in entries] == [1]   # stops at the cut
+        assert "ckpt_journal_corrupt" in _flight_kinds()
+
+    def test_missing_file_is_fresh_not_damage(self, tmp_path):
+        entries, intact = StepJournal(str(tmp_path / "nope.jsonl")).read()
+        assert entries == [] and intact
+
+    def test_resumed_appends_repair_a_torn_tail(self, tmp_path):
+        # Double-crash scenario: crash 1 tears line 2; the restarted
+        # process appends steps 2-3; crash 2.  Without tail repair the
+        # first post-restart entry concatenates onto the partial record
+        # and EVERY later entry is unreadable.
+        path = str(tmp_path / "j.jsonl")
+        j = StepJournal(path)
+        j.append(1, x=1)
+        j.append(2, x=2)
+        j.close()
+        with open(path, "rb+") as f:
+            raw = f.read()
+            f.truncate(len(raw) - 7)       # tear line 2 mid-record
+        j2 = StepJournal(path)             # the restarted process
+        j2.append(2, x=22)
+        j2.append(3, x=3)
+        j2.close()
+        entries, intact = StepJournal(path).read()
+        assert intact
+        assert [(e["step"], e["x"]) for e in entries] == \
+            [(1, 1), (2, 22), (3, 3)]
+
+
+# --- manifest / ownership ----------------------------------------------------
+
+class TestOwnership:
+    LEAVES = [("a", 400), ("b", 300), ("c", 200), ("d", 100), ("e", 96)]
+
+    def test_dp_is_rank0_only(self):
+        owners = assign_owners(self.LEAVES, world=4, scheme="dp")
+        assert set(owners.values()) == {0}
+
+    def test_zero_balances_bytes(self):
+        owners = assign_owners(self.LEAVES, world=2, scheme="zero")
+        load = {0: 0, 1: 0}
+        sizes = dict(self.LEAVES)
+        for path, rank in owners.items():
+            load[rank] += sizes[path]
+        # Greedy biggest-first: within one max-leaf of balanced.
+        assert abs(load[0] - load[1]) <= 400
+
+    def test_assignment_is_deterministic(self):
+        a = assign_owners(self.LEAVES, world=3, scheme="fsdp")
+        b = assign_owners(list(reversed(self.LEAVES)), world=3,
+                          scheme="fsdp")
+        assert a == b
+
+    def test_unknown_scheme_rejected(self):
+        with pytest.raises(ValueError, match="scheme"):
+            assign_owners(self.LEAVES, world=2, scheme="wat")
+
+    def test_skeleton_roundtrip_normalizes_containers(self):
+        from collections import namedtuple
+
+        Opt = namedtuple("Opt", ["mu", "count"])
+        tree = {"opt": Opt(mu={"w": np.ones(2)}, count=np.zeros(())),
+                "lst": (np.zeros(1), np.ones(1))}
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        ids = [f"l{i:05d}" for i in range(len(flat))]
+        skel = build_skeleton([p for p, _ in flat], ids)
+        lookup = {i: np.asarray(leaf) for i, (_, leaf) in zip(ids, flat)}
+        rebuilt = skeleton_fill(skel, lookup)
+        # namedtuple → dict, tuple → list: the orbax normalization.
+        assert isinstance(rebuilt["opt"], dict)
+        assert isinstance(rebuilt["lst"], list)
+        np.testing.assert_array_equal(rebuilt["opt"]["mu"]["w"],
+                                      np.ones(2))
+        assert pytree_digest(rebuilt) == pytree_digest(tree)
+
+
+class TestRestorePlanning:
+    def _manifest(self, tmp_path, world=4):
+        with AsyncCheckpointer(str(tmp_path / "z"), async_save=False,
+                               world=world, rank=0,
+                               scheme="zero") as ck:
+            ck.save(1, _tree())
+            return ck, ck._store.read_manifest(1)
+
+    def test_resize_plans_cover_disjointly(self, tmp_path):
+        _, m = self._manifest(tmp_path)
+        for new_world in (2, 4, 8):
+            seen = []
+            total = 0
+            for r in range(new_world):
+                plan = plan_restore(m, rank=r, world=new_world)
+                seen.extend(plan.leaf_ids)
+                total += plan.nbytes
+            assert sorted(seen) == sorted(m.entries)   # exactly once
+            assert total == m.nbytes                   # no byte twice
+
+    def test_bytes_move_only_to_owners(self, tmp_path):
+        ck, m = self._manifest(tmp_path)
+        plan, payload = ck.restore_shard(rank=1, world=2)
+        assert plan.nbytes < m.nbytes       # a shard, not the tree
+        assert plan.nbytes == sum(np.asarray(v).nbytes
+                                  for v in payload.values())
+
+    def test_resized_shards_reassemble_exactly(self, tmp_path):
+        ck, m = self._manifest(tmp_path)
+        merged = {}
+        for r in range(8):                  # N=4 → N′=8 resize
+            _, payload = ck.restore_shard(rank=r, world=8)
+            merged.update(payload)
+        by_path = {e["path"]: leaf_id
+                   for leaf_id, e in m.entries.items()}
+        full = ck.restore()
+        flat, _ = jax.tree_util.tree_flatten_with_path(full)
+        from horovod_tpu.ckpt.snapshot import path_string
+
+        for path, leaf in flat:
+            np.testing.assert_array_equal(merged[path_string(path)],
+                                          np.asarray(leaf))
+        assert len(merged) == len(by_path)
+
+    def test_dp_restore_is_rank0_only(self, tmp_path):
+        with AsyncCheckpointer(str(tmp_path / "dp"), async_save=False,
+                               world=4, rank=0, scheme="dp") as ck:
+            ck.save(1, _tree())
+            p0, payload = ck.restore_shard(rank=0, world=4)
+            p1, empty = ck.restore_shard(rank=1, world=4)
+        assert p0.nbytes > 0 and payload
+        assert p1.nbytes == 0 and empty == {}
+
+
+# --- async writer ------------------------------------------------------------
+
+class TestAsyncWriter:
+    def test_writes_in_order(self):
+        got = []
+        w = AsyncWriter(got.append, inflight=8)
+        for i in range(5):
+            w.submit(i)
+        w.wait_until_finished()
+        w.close()
+        assert got == [0, 1, 2, 3, 4]
+
+    def test_bounded_queue_coalesces_oldest(self):
+        gate = threading.Event()
+        done, dropped = [], []
+
+        def slow(item):
+            gate.wait(5.0)
+            done.append(item)
+
+        w = AsyncWriter(slow, inflight=2, on_drop=dropped.append)
+        w.submit("a")                     # starts writing, blocks
+        time.sleep(0.05)
+        w.submit("b")
+        w.submit("c")
+        w.submit("d")                     # queue full: b coalesced away
+        gate.set()
+        w.wait_until_finished()
+        w.close()
+        assert dropped == ["b"]
+        assert done == ["a", "c", "d"]    # newest state survived
+        assert w.dropped() == 1
+
+    def test_error_surfaces_on_caller(self):
+        def boom(item):
+            raise RuntimeError(f"disk on fire: {item}")
+
+        w = AsyncWriter(boom, inflight=2)
+        w.submit("x")
+        time.sleep(0.1)
+        with pytest.raises(RuntimeError, match="disk on fire"):
+            w.submit("y")
+        w.close()
+
+    def test_error_surfaces_on_wait_and_close(self):
+        w = AsyncWriter(lambda item: 1 / 0, inflight=2)
+        w.submit("x")
+        with pytest.raises(ZeroDivisionError):
+            w.wait_until_finished()
+        w.submit("y")
+        with pytest.raises(ZeroDivisionError):
+            w.close()
+
+    def test_wait_timeout_raises_rather_than_lying(self):
+        gate = threading.Event()
+        w = AsyncWriter(lambda item: gate.wait(10.0), inflight=2)
+        w.submit("x")
+        with pytest.raises(TimeoutError, match="NOT yet durable"):
+            w.wait_until_finished(timeout=0.2)
+        gate.set()
+        w.wait_until_finished()
+        w.close()
+
+    def test_no_coalesce_mode_backpressures_instead_of_dropping(self):
+        gate = threading.Event()
+        done, dropped = [], []
+
+        def slow(item):
+            gate.wait(5.0)
+            done.append(item)
+
+        w = AsyncWriter(slow, inflight=1, coalesce=False,
+                        on_drop=dropped.append)
+        w.submit("a")
+        time.sleep(0.05)
+        w.submit("b")                     # fills the queue
+
+        t = threading.Thread(target=lambda: w.submit("c"))
+        t.start()
+        time.sleep(0.1)
+        assert t.is_alive()               # blocked, not dropping
+        gate.set()
+        t.join(5.0)
+        w.wait_until_finished()
+        w.close()
+        assert done == ["a", "b", "c"]    # every item written
+        assert dropped == [] and w.dropped() == 0
+
+    def test_close_without_drain_releases_queued_items(self):
+        gate = threading.Event()
+        dropped = []
+        w = AsyncWriter(lambda item: gate.wait(5.0), inflight=4,
+                        on_drop=dropped.append)
+        w.submit("a")
+        time.sleep(0.05)
+        w.submit("q1")
+        w.submit("q2")
+        gate.set()
+        w.close(drain=False)
+        # Queued items must be RELEASED (buffer-pool return), not
+        # silently leaked.
+        assert dropped == ["q1", "q2"]
+
+    def test_discard_pending_clears_queue_and_error(self):
+        gate = threading.Event()
+        done = []
+
+        def slow(item):
+            if item == "bad":
+                raise RuntimeError("bad item")
+            gate.wait(5.0)
+            done.append(item)
+
+        w = AsyncWriter(slow, inflight=4)
+        w.submit("bad")
+        time.sleep(0.1)                   # error stored
+        dropped = []
+        w2 = AsyncWriter(slow, inflight=4, on_drop=dropped.append)
+        w2.submit("a")
+        time.sleep(0.05)
+        w2.submit("queued1")
+        w2.submit("queued2")
+        assert w2.discard_pending() == 2
+        assert dropped == ["queued1", "queued2"]
+        gate.set()
+        w2.wait_until_finished()
+        w2.close()
+        assert done == ["a"]
+        # The failed writer's stored error is cleared by discard too.
+        assert w.discard_pending() == 0
+        w.submit("ok-now-it-raises-nothing")  # no stored error
+        gate.set()
+        w.close()
+
+
+# --- the checkpointer --------------------------------------------------------
+
+class TestAsyncCheckpointer:
+    def test_async_byte_identical_to_sync(self, tmp_path):
+        """THE equivalence oracle: async and sync saves restore
+        byte-identical trees, and both match the live tree's digest."""
+        tree = _tree(scale=3.0)
+        with AsyncCheckpointer(str(tmp_path / "s"),
+                               async_save=False) as sync_ck:
+            sync_ck.save(1, tree)
+            got_sync = sync_ck.restore()
+        with AsyncCheckpointer(str(tmp_path / "a"),
+                               async_save=True) as async_ck:
+            async_ck.save(1, tree)
+            async_ck.wait_until_finished()
+            got_async = async_ck.restore()
+        _leaves_equal(got_sync, got_async)
+        assert pytree_digest(got_sync) == pytree_digest(got_async) \
+            == pytree_digest(tree)
+        m_sync = ShardStore(str(tmp_path / "s")).read_manifest(1)
+        m_async = ShardStore(str(tmp_path / "a")).read_manifest(1)
+        assert m_sync.tree_digest == m_async.tree_digest
+
+    def test_duplicate_step_skipped_force_overwrites(self, tmp_path):
+        with AsyncCheckpointer(str(tmp_path / "d"),
+                               async_save=False) as ck:
+            assert ck.save(1, _tree())
+            assert not ck.save(1, _tree(scale=9.0))
+            got = ck.restore(1, fallback=False)
+            np.testing.assert_array_equal(
+                np.asarray(got["params"]["b"]), np.ones(6))
+            assert ck.save(1, _tree(scale=9.0), force=True)
+            got = ck.restore(1, fallback=False)
+            np.testing.assert_array_equal(
+                np.asarray(got["params"]["b"]), np.ones(6) * 9.0)
+
+    def test_retention_prunes_oldest(self, tmp_path):
+        with AsyncCheckpointer(str(tmp_path / "r"), async_save=False,
+                               max_to_keep=2) as ck:
+            for s in (1, 2, 3, 4):
+                ck.save(s, _tree(scale=float(s)))
+            assert ck.all_steps() == [3, 4]
+            assert ck.latest_step() == 4
+
+    def test_save_stall_excludes_write(self, tmp_path):
+        """The headline contract: save() returns after the snapshot;
+        the (deliberately slow) write happens behind it."""
+        gate = threading.Event()
+        ck = AsyncCheckpointer(str(tmp_path / "q"), async_save=True)
+        orig = ck._store.write_step
+
+        def slow_write(*a, **kw):
+            gate.wait(5.0)
+            return orig(*a, **kw)
+
+        ck._store.write_step = slow_write
+        t0 = time.perf_counter()
+        assert ck.save(1, _tree())
+        stall = time.perf_counter() - t0
+        assert stall < 1.0                 # did not wait for the write
+        assert ck._inflight() >= 1
+        gate.set()
+        ck.wait_until_finished()
+        assert ck.all_steps() == [1]
+        ck.close()
+
+    def test_non_primary_process_never_writes(self, tmp_path,
+                                              monkeypatch):
+        # The single-rename commit protocol and the shared journal file
+        # have exactly one writer: a non-primary controller's save()
+        # and journal_step() are no-ops (it may still restore).
+        import jax
+
+        monkeypatch.setattr(jax, "process_index", lambda: 1)
+        ck = AsyncCheckpointer(str(tmp_path / "np"), async_save=False)
+        assert ck.save(1, _tree()) is False
+        ck.journal_step(1, cursor=4)
+        assert ck.all_steps() == []
+        assert not os.path.exists(ck.journal.path)
+        ck.close()
+
+    def test_duplicate_step_queued_but_uncommitted_returns_false(
+            self, tmp_path):
+        # The duplicate check must see steps still in the writer queue:
+        # otherwise save() returns True for a tree the store will later
+        # silently skip (the first queued save wins the commit).
+        gate = threading.Event()
+        ck = AsyncCheckpointer(str(tmp_path / "dq"), async_save=True)
+        orig = ck._store.write_step
+
+        def slow_write(*a, **kw):
+            gate.wait(5.0)
+            return orig(*a, **kw)
+
+        ck._store.write_step = slow_write
+        assert ck.save(1, _tree(scale=1.0))
+        assert not ck.save(1, _tree(scale=9.0))   # queued, not on disk
+        gate.set()
+        ck.wait_until_finished()
+        got = ck.restore(1, fallback=False)
+        np.testing.assert_array_equal(np.asarray(got["params"]["b"]),
+                                      np.ones(6))
+        assert ck.save(2, _tree(scale=2.0))       # step set was cleaned
+        ck.close()
+
+    def test_pool_evicts_stale_leaves(self, tmp_path):
+        pool = BufferPool(1)
+        s1 = take_snapshot({"old": np.zeros(1024, np.float32)},
+                           pool=pool)
+        s1.release()
+        s2 = take_snapshot({"new": np.zeros(8, np.float32)}, pool=pool)
+        # The 'old' leaf's buffer must be evicted, not pinned forever.
+        assert set(s2._buffers) == {"'new'"}
+        s2.release()
+
+    def test_writer_error_surfaces_on_next_save(self, tmp_path):
+        ck = AsyncCheckpointer(str(tmp_path / "e"), async_save=True)
+        ck._store.write_step = lambda *a, **kw: 1 / 0
+        ck.save(1, _tree())
+        time.sleep(0.2)
+        with pytest.raises(ZeroDivisionError):
+            ck.save(2, _tree())
+
+    def test_template_casts_dtypes(self, tmp_path):
+        with AsyncCheckpointer(str(tmp_path / "t"),
+                               async_save=False) as ck:
+            ck.save(1, {"x": jnp.ones((4,), jnp.float32)})
+            template = {"x": np.zeros((4,), np.float16)}
+            got = ck.restore(template=template)
+        assert np.asarray(got["x"]).dtype == np.float16
+
+    def test_template_matches_by_key_path_not_position(self, tmp_path):
+        # Restored trees are dict-normalized (sorted-key flatten order)
+        # while a namedtuple template flattens in FIELD order —
+        # positional pairing would silently swap weight and bias.
+        from collections import namedtuple
+
+        P = namedtuple("P", ["weight", "bias"])   # w before b: unsorted
+        tree = {"params": P(weight=jnp.arange(4.0),
+                            bias=jnp.ones((2,)) * 5.0)}
+        with AsyncCheckpointer(str(tmp_path / "nt"),
+                               async_save=False) as ck:
+            ck.save(1, tree)
+            template = {"params": P(weight=np.zeros((4,), np.float32),
+                                    bias=np.zeros((2,), np.float32))}
+            got = ck.restore(template=template)
+        np.testing.assert_array_equal(np.asarray(got["params"].weight),
+                                      np.arange(4.0, dtype=np.float32))
+        np.testing.assert_array_equal(np.asarray(got["params"].bias),
+                                      np.full((2,), 5.0, np.float32))
+
+    def test_metrics_land_in_registry(self, tmp_path):
+        from horovod_tpu.obs import metrics as obs_metrics
+
+        with AsyncCheckpointer(str(tmp_path / "m"),
+                               async_save=True) as ck:
+            ck.save(1, _tree())
+            ck.wait_until_finished()
+            ck.restore()
+            ck.journal_step(1, rng=[0, 1])
+        snap = obs_metrics.registry().snapshot()
+        assert "hvd_tpu_ckpt_save_stall_us" in snap
+        assert "hvd_tpu_ckpt_write_us" in snap
+        assert "hvd_tpu_ckpt_inflight" in snap
+        kinds = {dict(s["labels"]).get("kind")
+                 for s in snap["hvd_tpu_ckpt_bytes_total"]}
+        assert {"snapshot", "write", "restore", "journal"} <= kinds
+
+    def test_save_restore_spans_recorded(self, tmp_path):
+        from horovod_tpu.obs import trace as trace_mod
+
+        trace_mod.clear()
+        with AsyncCheckpointer(str(tmp_path / "sp"),
+                               async_save=True) as ck:
+            ck.save(1, _tree())
+            ck.wait_until_finished()
+            ck.restore()
+        names = {s["name"] for s in trace_mod.snapshot()}
+        assert {"hvd_tpu_ckpt_save", "hvd_tpu_ckpt_offload",
+                "hvd_tpu_ckpt_write",
+                "hvd_tpu_ckpt_restore"} <= names
+
+
+# --- restore precedence (satellite) ------------------------------------------
+
+class TestRestorePrecedence:
+    def _seed(self, tmp_path, *, journal_to=None, snap_steps=(2, 4)):
+        ck = AsyncCheckpointer(str(tmp_path / "p"), async_save=False)
+        for s in snap_steps:
+            ck.save(s, _tree(scale=float(s)))
+        if journal_to is not None:
+            for s in range(1, journal_to + 1):
+                ck.journal_step(s, rng=[0, s], cursor=s * 4)
+        return ck
+
+    def test_journal_ahead_of_snapshot_replays_to_exact(self, tmp_path):
+        flight.reset_for_tests()
+        ck = self._seed(tmp_path, journal_to=7)
+        info = ck.resume()
+        assert info.snapshot_step == 4
+        assert [e["step"] for e in info.replay] == [5, 6, 7]
+        assert info.exact_step == 7
+        assert info.journal_intact
+        assert "ckpt_resume" in _flight_kinds()
+        ck.close()
+
+    def test_journal_missing_resumes_at_snapshot(self, tmp_path):
+        flight.reset_for_tests()
+        ck = self._seed(tmp_path, journal_to=None)
+        info = ck.resume()
+        assert info.snapshot_step == 4 and info.exact_step == 4
+        assert info.replay == []
+        assert "ckpt_resume" in _flight_kinds()
+        ck.close()
+
+    def test_journal_corrupt_midline_uses_intact_prefix(self, tmp_path):
+        ck = self._seed(tmp_path, journal_to=8)
+        path = ck.journal.path
+        ck.close()
+        raw = open(path, "rb").read().splitlines(keepends=True)
+        raw[6] = b"}{ not json\n"          # corrupt step 7's line
+        with open(path, "wb") as f:
+            f.writelines(raw)
+        flight.reset_for_tests()
+        ck2 = AsyncCheckpointer(str(tmp_path / "p"), async_save=False)
+        info = ck2.resume()
+        assert info.snapshot_step == 4
+        assert [e["step"] for e in info.replay] == [5, 6]
+        assert info.exact_step == 6        # deterministic: intact prefix
+        assert not info.journal_intact
+        kinds = _flight_kinds()
+        assert "ckpt_journal_corrupt" in kinds
+        assert "ckpt_resume" in kinds
+        ck2.close()
+
+    def test_manifest_missing_shard_falls_back(self, tmp_path):
+        ck = self._seed(tmp_path, journal_to=5)
+        step_dir = ck._store.step_dir(4)
+        m = ck._store.read_manifest(4)
+        os.unlink(os.path.join(step_dir, m.files()[0]))
+        flight.reset_for_tests()
+        info = ck.resume()
+        assert info.snapshot_step == 2     # newest INTACT step
+        assert [e["step"] for e in info.replay] == [3, 4, 5]
+        assert info.exact_step == 5
+        kinds = _flight_kinds()
+        assert "ckpt_step_damaged" in kinds
+        assert "ckpt_resume" in kinds
+        ck.close()
+
+    def test_parseable_but_mangled_manifest_falls_back(self, tmp_path):
+        # A torn write can leave JSON that parses but is structurally
+        # wrong (entry missing 'file', nbytes garbage): that must feed
+        # the fallback scan, never escape as a raw KeyError/TypeError.
+        ck = self._seed(tmp_path, journal_to=5)
+        mpath = os.path.join(ck._store.step_dir(4), Manifest.FILENAME)
+        with open(mpath) as f:
+            doc = json.load(f)
+        first = sorted(doc["entries"])[0]
+        del doc["entries"][first]["file"]
+        doc["entries"][sorted(doc["entries"])[1]]["nbytes"] = "garbage"
+        with open(mpath, "w") as f:
+            json.dump(doc, f)
+        got = ck.restore()                 # falls back to step 2
+        np.testing.assert_array_equal(np.asarray(got["params"]["b"]),
+                                      np.ones(6) * 2.0)
+        info = ck.resume()
+        assert info.snapshot_step == 2 and info.exact_step == 5
+        ck.close()
+
+    def test_explicit_step_never_falls_back(self, tmp_path):
+        ck = self._seed(tmp_path)
+        step_dir = ck._store.step_dir(4)
+        m = ck._store.read_manifest(4)
+        os.unlink(os.path.join(step_dir, m.files()[0]))
+        with pytest.raises(ManifestError):
+            ck.restore(4, fallback=False)
+        got = ck.restore(2, fallback=False)
+        np.testing.assert_array_equal(np.asarray(got["params"]["b"]),
+                                      np.ones(6) * 2.0)
+        ck.close()
+
+    def test_latest_with_fallback_disabled_fails_fast(self, tmp_path):
+        # restore(fallback=False) without a step must honor the
+        # caller's choice (fail fast and alert), not silently degrade
+        # to stale state.
+        ck = self._seed(tmp_path)
+        m = ck._store.read_manifest(4)
+        os.unlink(os.path.join(ck._store.step_dir(4), m.files()[0]))
+        with pytest.raises(ManifestError):
+            ck.restore(fallback=False)
+        ck.close()
+
+    def test_digest_mismatch_detected_and_skipped(self, tmp_path):
+        # Tamper a manifest digest (the content/metadata disagreement a
+        # flipped block that still CRCs would produce): the per-leaf
+        # digest check must reject step 4 and fall back to step 2.
+        ck = self._seed(tmp_path)
+        mpath = os.path.join(ck._store.step_dir(4), Manifest.FILENAME)
+        with open(mpath) as f:
+            doc = json.load(f)
+        first = sorted(doc["entries"])[0]
+        doc["entries"][first]["digest"] = "0" * 64
+        with open(mpath, "w") as f:
+            json.dump(doc, f)
+        got = ck.restore()                 # falls back to step 2
+        np.testing.assert_array_equal(np.asarray(got["params"]["b"]),
+                                      np.ones(6) * 2.0)
+        with pytest.raises(CheckpointCorruptionError):
+            ck.restore(4, fallback=False)
+        ck.close()
+
+    def test_bitflipped_shard_detected_and_skipped(self, tmp_path):
+        # A flipped disk block breaks the zip CRC — same verdict, same
+        # fallback, via CheckpointCorruptionError.
+        ck = self._seed(tmp_path)
+        m = ck._store.read_manifest(4)
+        victim = os.path.join(ck._store.step_dir(4), m.files()[0])
+        size = os.path.getsize(victim)
+        with open(victim, "r+b") as f:
+            f.seek(size // 2)
+            chunk = f.read(64)
+            f.seek(size // 2)
+            f.write(bytes(b ^ 0xFF for b in chunk))
+        got = ck.restore()
+        np.testing.assert_array_equal(np.asarray(got["params"]["b"]),
+                                      np.ones(6) * 2.0)
+        ck.close()
+
+    def test_all_steps_damaged_raises_corruption_error(self, tmp_path):
+        ck = self._seed(tmp_path)
+        for s in (2, 4):
+            m = ck._store.read_manifest(s)
+            os.unlink(os.path.join(ck._store.step_dir(s), m.files()[0]))
+        with pytest.raises(CheckpointCorruptionError):
+            ck.restore()
+        with pytest.raises(FileNotFoundError):
+            ck.resume()
+        ck.close()
+
+
+# --- fault modes -------------------------------------------------------------
+
+class TestCheckpointFaultModes:
+    def test_new_modes_parse(self):
+        for mode in ("stall", "partial-manifest", "crash-before-rename"):
+            clauses = parse_fault_spec(f"checkpoint:step=2,mode={mode}")
+            assert clauses["checkpoint"].mode == mode
+
+    def test_unknown_mode_rejected(self):
+        with pytest.raises(ValueError, match="unknown mode"):
+            parse_fault_spec("checkpoint:step=2,mode=wat")
+
+    def test_crash_before_rename_never_commits(self, tmp_path):
+        d = str(tmp_path / "c")
+        with faults.inject("checkpoint:step=2,mode=crash-before-rename"):
+            ck = AsyncCheckpointer(d, async_save=False)
+            ck.save(1, _tree())
+            with pytest.raises(HorovodInternalError,
+                               match="crash-before-rename"):
+                ck.save(2, _tree())
+            assert ck.all_steps() == [1]
+            assert [h[:2] for h in faults.history()] == [("checkpoint",
+                                                          2)]
+            ck.close()
+        # The tmp dir a real crash would leave is invisible to restore.
+        ck2 = AsyncCheckpointer(d, async_save=False)
+        assert ck2.latest_step() == 1
+        ck2.close()
+
+    def test_crash_mid_async_save_surfaces_on_barrier(self, tmp_path):
+        with faults.inject("checkpoint:step=2,mode=crash-before-rename"):
+            ck = AsyncCheckpointer(str(tmp_path / "a"), async_save=True)
+            ck.save(1, _tree())
+            ck.save(2, _tree())            # returns: stall is a snapshot
+            with pytest.raises(HorovodInternalError):
+                ck.wait_until_finished()
+            assert ck.all_steps() == [1]
+            ck.discard_pending()
+            ck.close()
+
+    def test_partial_manifest_damages_exactly_one_shard(self, tmp_path):
+        with faults.inject("checkpoint:step=1,mode=partial-manifest"):
+            ck = AsyncCheckpointer(str(tmp_path / "pm"),
+                                   async_save=False, world=2,
+                                   scheme="zero")
+            ck.save(1, _tree())
+            m = ck._store.read_manifest(1)
+            present = [f for f in m.files() if os.path.exists(
+                os.path.join(ck._store.step_dir(1), f))]
+            assert len(present) == len(m.files()) - 1
+            with pytest.raises(ManifestError):
+                ck._store.validate_step(1)
+            ck.close()
+
+    def test_corrupt_and_partial_still_work_on_shard_store(self, tmp_path):
+        for mode in ("corrupt", "partial"):
+            d = str(tmp_path / mode)
+            with faults.inject(f"checkpoint:step=2,mode={mode}"):
+                ck = AsyncCheckpointer(d, async_save=False)
+                ck.save(1, _tree(scale=1.0))
+                ck.save(2, _tree(scale=2.0))
+                got = ck.restore()         # falls back to step 1
+                np.testing.assert_array_equal(
+                    np.asarray(got["params"]["b"]), np.ones(6))
+                ck.close()
+
+    def test_stall_acceptance_async_under_10pct_of_sync(self, tmp_path):
+        """Acceptance: with a deliberately slow filesystem (stall
+        fault, 250 ms per save), the async save stall is <10% of the
+        synchronous save wall — deterministic, no disk-speed luck."""
+        tree = _tree()
+        with faults.inject("checkpoint:p=1.0,mode=stall,delay_ms=250"):
+            ck = AsyncCheckpointer(str(tmp_path / "sync"),
+                                   async_save=False)
+            t0 = time.perf_counter()
+            ck.save(1, tree)
+            sync_wall = time.perf_counter() - t0
+            ck.close()
+        with faults.inject("checkpoint:p=1.0,mode=stall,delay_ms=250"):
+            ck = AsyncCheckpointer(str(tmp_path / "async"),
+                                   async_save=True)
+            t0 = time.perf_counter()
+            ck.save(1, tree)
+            async_stall = time.perf_counter() - t0
+            ck.wait_until_finished()
+            ck.close()
+        assert sync_wall >= 0.25
+        assert async_stall < 0.1 * sync_wall, (async_stall, sync_wall)
+
+
+# --- elastic integration -----------------------------------------------------
+
+class TestElasticDurable:
+    def test_attach_durable_saves_on_commit(self, tmp_path):
+        with AsyncCheckpointer(str(tmp_path / "el"),
+                               async_save=True) as ck:
+            state = TpuState(params={"w": jnp.ones((2, 2))}, step=0)
+            state.attach_durable(ck, step_attr="step")
+            state.step = 3
+            state.params = {"w": jnp.full((2, 2), 3.0)}
+            state.commit()
+            ck.wait_until_finished()
+            assert ck.latest_step() == 3
+            resumed = TpuState(params={"w": jnp.zeros((2, 2))}, step=0)
+            resumed.load_from(ck)
+        np.testing.assert_array_equal(np.asarray(resumed.params["w"]),
+                                      np.full((2, 2), 3.0))
+        assert int(resumed.step) == 3
+
+    def test_sampler_cursor_rides_the_journal_and_save(self, tmp_path):
+        with AsyncCheckpointer(str(tmp_path / "sm"),
+                               async_save=False) as ck:
+            sampler = ElasticSampler(num_samples=16, batch_size=2,
+                                     shuffle=True, seed=3)
+            state = TpuState(params={"w": jnp.zeros((2,))}, step=0,
+                             sampler=sampler)
+            state.attach_durable(ck, step_attr="step")
+            for batch in sampler:
+                sampler.record_batch(batch)
+                state.step += 1
+                state.journal_step()
+                if state.step == 3:
+                    break
+            state.commit()
+            entries, intact = ck.journal.read()
+            assert intact and len(entries) == 3
+            # The journal carries the COMPACT cursor (the full index
+            # list would grow the fsync'd line every step); the durable
+            # save below carries the complete state_dict.
+            assert entries[-1]["sampler"]["num_processed"] == 6
+            assert "processed_indices" not in entries[-1]["sampler"]
+            # The durable save stored the sampler's STATE, the restore
+            # re-applies it onto the live object.
+            resumed = TpuState(
+                params={"w": jnp.zeros((2,))}, step=0,
+                sampler=ElasticSampler(num_samples=16, batch_size=2,
+                                       shuffle=True, seed=3))
+            resumed.load_from(ck)
+            assert isinstance(resumed.sampler, ElasticSampler)
+            assert len(resumed.sampler.processed_indices) == 6
+            assert int(resumed.step) == 3
+
+    def test_load_from_without_live_helper_fails_loudly(self, tmp_path):
+        # A state_dict-saved attribute restored into a state that lacks
+        # the live helper must raise, not silently install the marker
+        # dict as the "sampler".
+        with AsyncCheckpointer(str(tmp_path / "lf"),
+                               async_save=False) as ck:
+            sampler = ElasticSampler(num_samples=8, batch_size=2)
+            state = TpuState(params={"w": jnp.zeros((2,))}, step=1,
+                             sampler=sampler)
+            state.attach_durable(ck)
+            state.commit()
+            bare = TpuState(params={"w": jnp.zeros((2,))}, step=0)
+            with pytest.raises(ValueError, match="sampler"):
+                bare.load_from(ck)
+
+    def test_rollback_discards_pending_and_clears_error(self, tmp_path):
+        ck = AsyncCheckpointer(str(tmp_path / "rb"), async_save=True)
+        state = TpuState(params={"w": jnp.ones((2,))}, step=0)
+        state.attach_durable(ck)
+        state.commit()
+        ck.wait_until_finished()
+        ck._store.write_step = lambda *a, **kw: 1 / 0   # disk dies
+        state.step = 1
+        state.commit()
+        time.sleep(0.2)
+        state.restore()     # the elastic rollback path
+        # Recovery is not poisoned: the next commit does not re-raise
+        # the dead write's error from before the rollback.
+        ck._store.write_step = lambda *a, **kw: None
+        state.step = 2
+        state.commit()
+        ck.wait_until_finished()
+        ck.close()
+
+
+# --- THE chaos drill ---------------------------------------------------------
+# A deterministic train loop over an ElasticSampler-style cursor, saved
+# through the async checkpointer on a 2-simulated-pod (world=2, zero)
+# partition, killed mid-run by an injected checkpoint fault, resumed
+# via the journal, resized to world=4, and compared byte-for-byte
+# against an uninterrupted reference run.
+
+TOTAL_STEPS = 12
+RESIZE_AT = 8          # world 2 → 4 (N → 2N)
+SAVE_EVERY = 2
+N_SAMPLES = 64
+BATCH = 4
+LR = np.float32(0.05)
+
+
+def _data_order(seed=11):
+    return np.random.RandomState(seed).permutation(N_SAMPLES)
+
+
+def _samples():
+    return (np.arange(N_SAMPLES, dtype=np.float32)[:, None]
+            * np.linspace(0.5, 1.5, 8, dtype=np.float32)[None, :])
+
+
+def _apply_step(params, order, cursor):
+    batch = _samples()[order[cursor:cursor + BATCH]]
+    return {"w": params["w"] + LR * batch.mean(axis=0)}, cursor + BATCH
+
+
+def _drill(ckpt_dir, fault_spec=None, kill_after=None):
+    """Run the loop (phase A), optionally dying on an injected fault or
+    at ``kill_after``; then resume in a 'fresh process' (phase B) at
+    the doubled world size and run to completion.  Returns (params,
+    executed_step_list)."""
+    order = _data_order()
+    params = {"w": np.zeros(8, np.float32)}
+    cursor = 0
+    executed = []
+    died_at = None
+
+    def run_phase(ck, start_step, stop_after=None):
+        nonlocal params, cursor
+        for step in range(start_step, TOTAL_STEPS + 1):
+            params, cursor = _apply_step(params, order, cursor)
+            executed.append(step)
+            ck.journal_step(step, cursor=cursor, rng=[0, step])
+            if step % SAVE_EVERY == 0:
+                ck.save(step, params)
+            if stop_after is not None and step >= stop_after:
+                return step
+        return TOTAL_STEPS
+
+    ctx = faults.inject(fault_spec) if fault_spec else None
+    if ctx:
+        ctx.__enter__()
+    try:
+        ck = AsyncCheckpointer(ckpt_dir, async_save=True, world=2,
+                               scheme="zero", max_to_keep=10)
+        try:
+            last = run_phase(ck, 1, stop_after=kill_after)
+            if kill_after is None:
+                ck.wait_until_finished()
+        except HorovodInternalError:
+            died_at = executed[-1]
+        else:
+            if kill_after is not None and kill_after < TOTAL_STEPS:
+                died_at = last
+        # Simulated process death: no close(), no barrier — the writer
+        # thread is abandoned exactly as a SIGKILL would abandon it.
+    finally:
+        if ctx:
+            ctx.__exit__(None, None, None)
+
+    if died_at is None:
+        return params, executed
+
+    # ---- "fresh process": resume from disk + journal ----
+    ck2 = AsyncCheckpointer(ckpt_dir, async_save=True, world=4,
+                            scheme="zero", max_to_keep=10)
+    info = ck2.resume()
+    assert info.exact_step == died_at, (info.exact_step, died_at)
+    if info.tree is None:
+        # Every snapshot was damaged/uncommitted: journal-only recovery
+        # replays the whole run from scratch — still exact.
+        params = {"w": np.zeros(8, np.float32)}
+        cursor = 0
+    else:
+        params = {"w": np.asarray(info.tree["w"], np.float32).copy()}
+        # Rewind the data cursor to the snapshot's position (the
+        # journal entry AT the snapshot step holds it; step*BATCH is
+        # its closed form here), then replay to the exact step.
+        cursor = info.snapshot_step * BATCH
+    for entry in info.replay:
+        step = int(entry["step"])
+        params, cursor = _apply_step(params, order, cursor)
+        executed.append(step)
+        assert cursor == int(entry["cursor"])   # journal agrees
+    assert executed[-1] == died_at              # zero lost steps
+    # ---- continue (resized world) to completion ----
+    run_phase(ck2, died_at + 1)
+    ck2.wait_until_finished()
+    ck2.close()
+    return params, executed
+
+
+@pytest.mark.chaos
+class TestKillMidSaveDrill:
+    def _chaos_knobs(self):
+        step = int(os.environ.get("HVD_TPU_CHAOS_STEP", "6"))
+        seed = int(os.environ.get("HVD_TPU_CHAOS_SEED", "0"))
+        import random
+
+        rng = random.Random(seed)
+        mode = rng.choice(("crash-before-rename", "partial-manifest",
+                           "corrupt", "partial", "stall"))
+        # Clamp onto a step the loop actually saves.
+        save_steps = list(range(SAVE_EVERY, TOTAL_STEPS + 1, SAVE_EVERY))
+        fault_step = save_steps[step % len(save_steps)]
+        return fault_step, mode
+
+    def test_kill_mid_async_save_resumes_exact(self, tmp_path):
+        """THE acceptance e2e: kill mid-async-save (crash-before-rename
+        at step 6's save), resume from the journal at the exact step,
+        finish across the 2→4 resize, byte-identical to the reference."""
+        ref_params, ref_steps = _drill(str(tmp_path / "ref"))
+        assert ref_steps == list(range(1, TOTAL_STEPS + 1))
+
+        params, executed = _drill(
+            str(tmp_path / "chaos"),
+            fault_spec="checkpoint:step=6,mode=crash-before-rename")
+        np.testing.assert_array_equal(params["w"], ref_params["w"])
+        # Every step 1..TOTAL ran; the replayed tail ran exactly the
+        # steps the kill threw away, none twice after the resume point.
+        assert sorted(set(executed)) == list(range(1, TOTAL_STEPS + 1))
+
+    def test_randomized_fault_mode_drill(self, tmp_path):
+        """chaos_soak --mode ckpt entry point: HVD_TPU_CHAOS_STEP/_SEED
+        pick the injected save step and the fault mode; every mode must
+        resume exact and match the reference."""
+        fault_step, mode = self._chaos_knobs()
+        ref_params, _ = _drill(str(tmp_path / "ref"))
+        params, executed = _drill(
+            str(tmp_path / "chaos"),
+            fault_spec=f"checkpoint:step={fault_step},mode={mode},"
+                       f"delay_ms=50",
+            # Damage modes don't raise — the run "dies" two steps later.
+            kill_after=min(TOTAL_STEPS - 1, fault_step + 2))
+        np.testing.assert_array_equal(params["w"], ref_params["w"])
+        assert sorted(set(executed)) == list(range(1, TOTAL_STEPS + 1))
+
+
+# --- knobs -------------------------------------------------------------------
+
+class TestCkptKnobs:
+    def test_async_knob_parses(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_CKPT_ASYNC", "0")
+        assert Config.from_env().ckpt_async is False
+        monkeypatch.setenv("HVD_TPU_CKPT_ASYNC", "1")
+        assert Config.from_env().ckpt_async is True
+
+    def test_inflight_knob_validated(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_CKPT_INFLIGHT", "3")
+        assert Config.from_env().ckpt_inflight == 3
+        monkeypatch.setenv("HVD_TPU_CKPT_INFLIGHT", "0")
+        with pytest.raises(ValueError, match="CKPT_INFLIGHT"):
+            Config.from_env()
+
+    def test_checkpointer_defaults_from_config(self, tmp_path,
+                                               monkeypatch):
+        monkeypatch.setenv("HVD_TPU_CKPT_ASYNC", "0")
+        import horovod_tpu.basics as basics
+
+        monkeypatch.setattr(basics, "is_initialized", lambda: False)
+        ck = AsyncCheckpointer(str(tmp_path / "k"))
+        assert ck.async_save is False
+        ck.close()
+
+
+# --- compat tier (the digest-offload satellite) ------------------------------
+
+class TestCompatDigestOffload:
+    def test_digest_computed_off_the_caller_thread(self, tmp_path,
+                                                   monkeypatch):
+        """ISSUE 9 satellite: the sha256 sidecar is computed from the
+        offloaded snapshot buffers on the writer thread — a slow digest
+        must not bill the step loop."""
+        from horovod_tpu.checkpoint import Checkpointer
+        from horovod_tpu.ckpt.snapshot import Snapshot
+
+        seen_threads = []
+        orig = Snapshot.digest
+        DIGEST_S = 3.0
+
+        def spying_digest(self):
+            seen_threads.append(threading.current_thread().name)
+            time.sleep(DIGEST_S)
+            return orig(self)
+
+        monkeypatch.setattr(Snapshot, "digest", spying_digest)
+        tree = _tree()
+        # Baseline: the same save with digesting off.  The orbax write
+        # itself costs ~1 s of jitter in this container, so the bound
+        # must be RELATIVE — a billed 3 s digest clears it, an
+        # offloaded one cannot.
+        with Checkpointer(str(tmp_path / "base"), async_save=False,
+                          verify=False) as ck:
+            t0 = time.perf_counter()
+            ck.save(1, tree)
+            base_wall = time.perf_counter() - t0
+        d = str(tmp_path / "ck")
+        with Checkpointer(d, async_save=False, verify=True) as ck:
+            t0 = time.perf_counter()
+            ck.save(1, tree)
+            save_wall = time.perf_counter() - t0
+            ck.wait_until_finished()
+        assert save_wall < base_wall + DIGEST_S - 1.0, \
+            (save_wall, base_wall)         # the 3 s digest not billed
+        assert seen_threads and all("digest" in t for t in seen_threads)
+        assert os.path.exists(os.path.join(d, "digests", "1.json"))
+
+    def test_pending_sidecar_blocks_silent_unverified_restore(
+            self, tmp_path):
+        """A crash between the data commit and the digest write must
+        not let restore silently skip verification: the synchronous
+        'pending' marker makes the step unverifiable → fallback."""
+        from horovod_tpu.checkpoint import Checkpointer
+
+        d = str(tmp_path / "ck")
+        with Checkpointer(d, async_save=False) as ck:
+            ck.save(1, _tree(scale=1.0))
+            ck.save(2, _tree(scale=2.0))
+            ck.wait_until_finished()
+        # Simulate the crash window: step 2's sidecar back to pending.
+        with open(os.path.join(d, "digests", "2.json"), "w") as f:
+            json.dump({"step": 2, "pending": True}, f)
+        with Checkpointer(d, async_save=False) as ck:
+            got = ck.restore()             # falls back to verified 1
+            np.testing.assert_array_equal(
+                np.asarray(got["params"]["b"]), np.ones(6))
+            with pytest.raises(CheckpointCorruptionError,
+                               match="pending"):
+                ck.restore(2)
+        # verify=False deliberately accepts the unverifiable step.
+        with Checkpointer(d, async_save=False, verify=False) as ck:
+            got = ck.restore(2)
+            np.testing.assert_array_equal(
+                np.asarray(got["params"]["b"]), np.ones(6) * 2.0)
+
+    def test_sidecar_digest_matches_snapshot_and_tree(self, tmp_path):
+        from horovod_tpu.checkpoint import Checkpointer
+
+        tree = _tree()
+        d = str(tmp_path / "ck")
+        with Checkpointer(d, async_save=False) as ck:
+            ck.save(1, tree)
+            ck.wait_until_finished()
+        with open(os.path.join(d, "digests", "1.json")) as f:
+            sidecar = json.load(f)["digest"]
+        assert sidecar == pytree_digest(tree)
