@@ -16,10 +16,23 @@ user feels), TPOT as the post-first-token cadence.  Runnable on CPU
 (default tiny model; ``--cpu-mesh`` forces the virtual CPU mesh) —
 a functional datapoint there, a perf datapoint on TPU.
 
+**Prefix-heavy workload** (``--prefix-shared N``): every request
+carries the same N-token system prompt plus a short unique tail — the
+paged KV pool (serve/kv/) serves the shared prefix from resident
+blocks, so the summary splits TTFT into ``ttft_miss_ms`` (first
+request: full prefill) vs ``ttft_hit_ms`` (prefix served from cache)
+and reports ``prefix_hit_ratio`` + KV pool occupancy.  Requests run
+closed-loop-sequential in this mode so the hit/miss split measures
+prefill work, not queue luck.  ``--spec-k K`` adds speculative
+decoding (``--drafter self`` verifies against the target itself — the
+perfect-drafter harness bound; deployments pass a distilled model) and
+reports the accepted-token rate per verify step.
+
 Usage::
 
     python benchmarks/serving_bench.py                     # tiny, CPU-safe
     python benchmarks/serving_bench.py --requests 128 --slots 16
+    python benchmarks/serving_bench.py --prefix-shared 48 --spec-k 4
     python benchmarks/serving_bench.py --out SERVING_r01.json
 """
 
@@ -56,6 +69,19 @@ def main() -> None:
     parser.add_argument("--temperature", type=float, default=0.0)
     parser.add_argument("--top-k", type=int, default=0)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--prefix-shared", type=int, default=0,
+                        help="prefix-heavy workload: every request "
+                             "shares this many leading prompt tokens "
+                             "(a system prompt); 0 = off")
+    parser.add_argument("--kv-cache", choices=("paged", "dense"),
+                        default=None,
+                        help="override HVD_TPU_SERVE_KV for the engine")
+    parser.add_argument("--spec-k", type=int, default=0,
+                        help="speculative decoding draft length; 0 = off")
+    parser.add_argument("--drafter", choices=("none", "self"),
+                        default=None,
+                        help="drafter model for --spec-k (default: "
+                             "'self' when --spec-k > 0)")
     # Tiny-but-real decoder; flags let a TPU run scale it up.
     parser.add_argument("--layers", type=int, default=2)
     parser.add_argument("--d-model", type=int, default=64)
@@ -75,9 +101,13 @@ def main() -> None:
     if args.prompt_min < 1 or args.prompt_max < args.prompt_min:
         parser.error("--prompt-min/--prompt-max must satisfy "
                      "1 <= min <= max")
-    if args.prompt_max + args.max_new_tokens >= args.max_seq_len:
-        parser.error("--prompt-max + --max-new-tokens must fit below "
+    prompt_cap = (args.prefix_shared + 8 if args.prefix_shared > 0
+                  else args.prompt_max)
+    if prompt_cap + args.max_new_tokens >= args.max_seq_len:
+        parser.error("longest prompt + --max-new-tokens must fit below "
                      "--max-seq-len (the KV-cache length)")
+    if args.spec_k > 0 and args.drafter is None:
+        args.drafter = "self"
 
     if args.cpu_mesh:
         from horovod_tpu.utils.platform import force_cpu_mesh
@@ -104,23 +134,34 @@ def main() -> None:
     model = GPT(cfg)
     rng = jax.random.PRNGKey(args.seed)
     params = model.init(rng, jnp.zeros((1, 8), jnp.int32))["params"]
+    drafter = (model, params) if args.drafter == "self" else None
     engine = InferenceEngine(model, params, max_slots=args.slots,
                              prefill_buckets=buckets,
                              max_seq_len=args.max_seq_len,
+                             kv_cache=args.kv_cache,
+                             drafter=drafter,
+                             spec_k=args.spec_k or None,
                              seed=args.seed)
     batcher = ContinuousBatcher(engine, max_queue=args.queue_depth,
                                 default_deadline_s=0)
 
     py_rng = random.Random(args.seed)
+    shared_prefix = [py_rng.randrange(args.vocab)
+                     for _ in range(max(0, args.prefix_shared))]
 
     def mk_prompt():
+        if args.prefix_shared > 0:
+            tail = py_rng.randint(2, 8)
+            return shared_prefix + [py_rng.randrange(args.vocab)
+                                    for _ in range(tail)]
         n = py_rng.randint(args.prompt_min,
                            min(args.prompt_max, engine.prefill_buckets[-1]))
         return [py_rng.randrange(args.vocab) for _ in range(n)]
 
     sampling = SamplingParams(max_new_tokens=args.max_new_tokens,
                               temperature=args.temperature,
-                              top_k=args.top_k)
+                              top_k=args.top_k,
+                              spec=args.spec_k > 0)
 
     def submit_one(prompt):
         if not args.trace:
@@ -134,17 +175,27 @@ def main() -> None:
         with obs_trace.use_context(obs_trace.new_context()):
             return batcher.submit(prompt, sampling)
 
-    def drive(prompts):
-        pending = collections.deque(prompts)
+    def drive(prompts, one_at_a_time=False):
         live = []
-        while pending or any(not r.done.is_set() for r in live):
-            while pending:
-                try:
-                    live.append(submit_one(pending[0]))
-                    pending.popleft()
-                except QueueFullError:
-                    break
-            batcher.step()
+        if one_at_a_time:
+            # Prefix-heavy mode: one request in flight at a time, so
+            # the hit/miss TTFT split measures prefill work (resident
+            # prefix vs full recompute), not queue scheduling luck.
+            for p in prompts:
+                req = submit_one(p)
+                live.append(req)
+                while not req.done.is_set():
+                    batcher.step()
+        else:
+            pending = collections.deque(prompts)
+            while pending or any(not r.done.is_set() for r in live):
+                while pending:
+                    try:
+                        live.append(submit_one(pending[0]))
+                        pending.popleft()
+                    except QueueFullError:
+                        break
+                batcher.step()
         if args.trace:
             # Deferred roots: each request's span covers its full
             # submit->finish latency (monotonic, re-anchored onto the
@@ -173,7 +224,8 @@ def main() -> None:
     if args.trace:
         obs_trace.clear()   # the artifact covers the measured window only
     t0 = time.perf_counter()
-    done = drive([mk_prompt() for _ in range(args.requests)])
+    done = drive([mk_prompt() for _ in range(args.requests)],
+                 one_at_a_time=args.prefix_shared > 0)
     elapsed = time.perf_counter() - t0
 
     rows = []
@@ -181,6 +233,7 @@ def main() -> None:
         row = {
             "request": r.request_id, "prompt_len": len(r.prompt),
             "tokens": len(r.tokens), "error": r.error,
+            "prefix_hit": r.prefix_hit_tokens,
             "ttft_ms": (round((r.first_token_at - r.submitted_at) * 1e3, 3)
                         if r.first_token_at else None),
             "total_ms": (round((r.finished_at - r.submitted_at) * 1e3, 3)
@@ -208,6 +261,35 @@ def main() -> None:
         "model": {"layers": args.layers, "d_model": args.d_model,
                   "heads": args.heads, "vocab": args.vocab},
     }
+    if args.prefix_shared > 0:
+        from horovod_tpu.serve.metrics import percentile as _pct
+
+        def _mean_ttft(reqs):
+            # Median, not mean: the miss class is often a single
+            # sample and a host-scheduling spike inside one hit would
+            # otherwise swamp the structural prefill gap.
+            vals = [(r.first_token_at - r.submitted_at) * 1e3
+                    for r in reqs
+                    if r.error is None and r.first_token_at is not None]
+            v = _pct(vals, 50)
+            return round(v, 3) if v is not None else None
+
+        hits = [r for r in done if r.prefix_hit_tokens > 0]
+        misses = [r for r in done if r.prefix_hit_tokens == 0]
+        summary.update({
+            "prefix_shared": args.prefix_shared,
+            "ttft_hit_ms": _mean_ttft(hits),       # cache-hit TTFT
+            "ttft_miss_ms": _mean_ttft(misses),    # full-prefill TTFT
+            "prefix_hit_ratio": snap.get("prefix_hit_ratio"),
+            "kv_blocks_cached": snap.get("kv_blocks_cached"),
+            "kv_blocks_in_use": snap.get("kv_blocks_in_use"),
+            "kv_evictions": snap.get("kv_evictions_total"),
+            "kv_cow_copies": snap.get("kv_cow_copies_total"),
+        })
+    if args.spec_k > 0:
+        summary["spec_k"] = args.spec_k
+        summary["spec_accept_per_verify"] = snap.get(
+            "spec_accept_per_verify")
     trace_block = None
     if args.trace:
         # Merged per-run trace artifact (single-process merge) — a

@@ -84,8 +84,11 @@ _FAULT_MODES = {
                    "crash-before-rename"),
     # serve: drop/delay fire at the serving endpoint's request handler;
     # kill fires at the continuous batcher's decode dispatch (replica
-    # death mid-decode — the router-failover drill).
-    "serve": ("drop", "delay", "kill"),
+    # death mid-decode — the router-failover drill); evict fires at the
+    # paged KV pool's block-allocation events (serve/kv/) and force-
+    # evicts every unreferenced cached block — seeded page-eviction
+    # pressure, the stale-prefix drill.
+    "serve": ("drop", "delay", "kill", "evict"),
     # dcn: fires ONLY at the cross-pod exchange step of a hierarchical
     # collective schedule (topo/schedule.py) — the slow-tier link is
     # the one that actually fails in multi-pod fleets.  drop/partition
@@ -446,6 +449,12 @@ class Config:
     serve_deadline_seconds: float = 30.0      # HVD_TPU_SERVE_DEADLINE_S (default per-request deadline; 0 = none)
     serve_replica_strikes: int = 2            # HVD_TPU_SERVE_REPLICA_STRIKES (failures before a replica is benched)
     serve_probation_seconds: float = 10.0     # HVD_TPU_SERVE_PROBATION_S (bench time before a half-open retry)
+    # Paged KV cache + speculative decoding (horovod_tpu/serve/kv/;
+    # the vLLM block-pool direction of ROADMAP item 3)
+    serve_kv: str = "paged"                   # HVD_TPU_SERVE_KV (paged|dense: cache layout under the engine API)
+    serve_kv_block: int = 16                  # HVD_TPU_SERVE_KV_BLOCK (tokens per KV block)
+    serve_kv_blocks: int = 0                  # HVD_TPU_SERVE_KV_BLOCKS (pool budget in blocks; 0 = auto)
+    serve_spec_k: int = 4                     # HVD_TPU_SERVE_SPEC_K (draft tokens per speculative verify step)
 
     # --- fault injection (horovod_tpu/faults.py; no reference analogue) ---
     fault_spec: Optional[str] = None          # HVD_TPU_FAULT_SPEC
@@ -528,6 +537,11 @@ class Config:
             serve_deadline_seconds=_env_float("SERVE_DEADLINE_S", 30.0),
             serve_replica_strikes=_env_int("SERVE_REPLICA_STRIKES", 2),
             serve_probation_seconds=_env_float("SERVE_PROBATION_S", 10.0),
+            serve_kv=_env_choice("SERVE_KV", "paged",
+                                 ("paged", "dense")) or "paged",
+            serve_kv_block=_env_pos_int("SERVE_KV_BLOCK", 16),
+            serve_kv_blocks=_env_int("SERVE_KV_BLOCKS", 0),
+            serve_spec_k=_env_pos_int("SERVE_SPEC_K", 4),
             fault_spec=_validated_fault_spec(_env("FAULT_SPEC")),
             cache_capacity=_env_opt_int("CACHE_CAPACITY"),
             mesh_axis_name=_env("MESH_AXIS_NAME", "hvd") or "hvd",

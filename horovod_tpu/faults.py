@@ -18,8 +18,9 @@ discovery  ``elastic/driver.py`` ScriptDiscovery + poll          ``flap``/``time
 rpc        ``runner/common/network.py`` BasicClient calls        ``drop``/``delay``
 checkpoint ``ckpt/store.py`` write + ``checkpoint.py`` save      ``corrupt``/``partial``/``stall``/
                                                                  ``partial-manifest``/``crash-before-rename``
-serve      ``serve/server.py`` request handler (drop/delay);     ``drop``/``delay``/``kill``
-           ``serve/batcher.py`` decode dispatch (kill)
+serve      ``serve/server.py`` request handler (drop/delay);     ``drop``/``delay``/``kill``/
+           ``serve/batcher.py`` decode dispatch (kill);          ``evict``
+           ``serve/kv/pool.py`` block allocation (evict)
 dcn        ``topo/schedule.py`` cross-pod exchange step only     ``drop``/``delay``/``partition``
            (trace time; intra-pod phases never fire)
 ========== ===================================================== =====================
@@ -56,7 +57,7 @@ __all__ = [
     "configure", "clear", "inject", "active_spec", "history",
     "on_collective", "on_fusion", "on_accumulate", "on_discovery_script",
     "on_discovery_hosts", "on_rpc", "on_checkpoint_save",
-    "on_serve_request", "on_serve_decode", "on_dcn",
+    "on_serve_request", "on_serve_decode", "on_serve_evict", "on_dcn",
 ]
 
 
@@ -357,13 +358,14 @@ def on_serve_request(op: str = "") -> Optional[str]:
     slow replica) and returns None; ``drop`` returns ``"drop"`` — the
     server closes the connection without a response, so the router sees
     a mid-frame peer death, exactly what a crashed replica looks like
-    on the wire.  ``kill`` clauses never fire here (their event
-    coordinate is the decode dispatch, :func:`on_serve_decode`)."""
+    on the wire.  ``kill``/``evict`` clauses never fire here (their
+    event coordinates are the decode dispatch, :func:`on_serve_decode`,
+    and the KV block allocation, :func:`on_serve_evict`)."""
     plan = _active
     if plan is None:
         return None
     st = plan.site("serve")
-    if st is None or st.clause.mode == "kill":
+    if st is None or st.clause.mode in ("kill", "evict"):
         return None
     at = st.counter
     if st.should_fire():
@@ -392,6 +394,28 @@ def on_serve_decode() -> bool:
     at = st.counter
     if st.should_fire():
         plan.fire("serve", "kill", at)
+        return True
+    return False
+
+
+def on_serve_evict() -> bool:
+    """Site ``serve`` (mode ``evict``) — fires at the paged KV pool's
+    block-allocation events (``serve/kv/pool.py``): each event is one
+    real block allocation, so ``serve:step=N,mode=evict`` reproducibly
+    applies forced page-eviction pressure at the N-th allocation in the
+    process.  Returns True when the pool must evict every unreferenced
+    cached block before allocating — the stale-prefix drill: an evicted
+    prefix that is readmitted later must recompute, never serve stale
+    blocks."""
+    plan = _active
+    if plan is None:
+        return False
+    st = plan.site("serve")
+    if st is None or st.clause.mode != "evict":
+        return False
+    at = st.counter
+    if st.should_fire():
+        plan.fire("serve", "evict", at)
         return True
     return False
 

@@ -52,7 +52,12 @@ def init_kv_cache(config: GPTConfig, batch_size: int, max_len: int):
     (serve/engine.py): one ``{"k", "v"}`` pair of ``[B, max_len, H, D]``
     arrays per block.  Allocated once per serving slot-batch so the
     decode hot path never reallocates; the engine's length buckets keep
-    the set of compiled shapes small."""
+    the set of compiled shapes small.
+
+    The paged alternative (``horovod_tpu/serve/kv``) replaces the dense
+    per-slot rows with one ``[num_blocks, block, H, D]`` pool per layer
+    plus a per-slot block table; :class:`Attention` accepts either
+    layout (``{"k", "v"}`` vs ``{"k_pool", "v_pool", "table"}``)."""
     head_dim = config.d_model // config.n_head
     shape = (batch_size, max_len, config.n_head, head_dim)
     return [{"k": jnp.zeros(shape, config.dtype),
@@ -90,9 +95,29 @@ class Attention(nn.Module):
             # indices beyond a row's position are stale/padding and the
             # ``<= position`` mask excludes them — padding correctness
             # needs no separate key mask.
+            #
+            # Two cache layouts share the math:
+            # * dense ``{"k", "v"}`` — per-slot ``[B, S, H, D]`` rows;
+            #   the updated rows ARE the new cache and are returned.
+            # * paged ``{"k_pool", "v_pool", "table"}`` — one
+            #   ``[num_blocks, block, H, D]`` pool per layer plus a
+            #   per-row block table (``serve/kv/``): the view is
+            #   gathered block-indexed (view row ``i`` is the token at
+            #   absolute position ``i`` of that row's chain), the chunk
+            #   is written into the view for intra-chunk causality, and
+            #   the raw chunk K/V is returned for the engine to scatter
+            #   into the pool through the same block table (invalid
+            #   positions route to the reserved trash block there).
+            paged = "k_pool" in cache
+            if paged:
+                table = cache["table"]           # [B, n_cols] block ids
+                k_base = cache["k_pool"][table].reshape(B, -1, H, D)
+                v_base = cache["v_pool"][table].reshape(B, -1, H, D)
+            else:
+                k_base, v_base = cache["k"], cache["v"]
             row = jnp.arange(B)[:, None]
-            k_all = cache["k"].at[row, positions].set(k.astype(cache["k"].dtype))
-            v_all = cache["v"].at[row, positions].set(v.astype(cache["v"].dtype))
+            k_all = k_base.at[row, positions].set(k.astype(k_base.dtype))
+            v_all = v_base.at[row, positions].set(v.astype(v_base.dtype))
             S = k_all.shape[1]
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_all)
             scores = scores.astype(jnp.float32) * (D ** -0.5)
@@ -100,6 +125,8 @@ class Attention(nn.Module):
             scores = jnp.where(visible[:, None], scores, _NEG_INF)
             probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
             out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_all)
+            if paged:
+                return proj(out.reshape(B, T, C)), {"k": k, "v": v}
             return proj(out.reshape(B, T, C)), {"k": k_all, "v": v_all}
         if cfg.attention == "ring":
             if self.mesh is None:

@@ -440,6 +440,53 @@ def on_stall(kind: str) -> None:
                    "stall-inspector escalations").labels(kind=kind).inc()
 
 
+# --- paged KV serving (serve/kv/; docs/serving.md) ---------------------------
+
+def on_kv_blocks_in_use(n: int) -> None:
+    """Referenced-block count after any pool mutation (the serving
+    occupancy signal the "add replicas" decision reads)."""
+    if not _m.enabled():
+        return
+    _reg().gauge("hvd_tpu_serve_kv_blocks_in_use",
+                 "KV pool blocks referenced by active requests").set(n)
+
+
+def on_kv_evictions(n: int = 1) -> None:
+    """``n`` cached prefix blocks evicted under allocation pressure
+    (or the ``serve:mode=evict`` fault)."""
+    if not _m.enabled():
+        return
+    _reg().counter("hvd_tpu_serve_kv_evictions_total",
+                   "KV blocks evicted from the prefix cache").inc(n)
+
+
+def on_kv_prefix_hit() -> None:
+    """One admission whose prompt prefix was resident (skipped
+    prefill compute)."""
+    if not _m.enabled():
+        return
+    _reg().counter("hvd_tpu_serve_kv_prefix_hits_total",
+                   "admissions that hit a resident prompt prefix").inc()
+
+
+def on_kv_cow_copy() -> None:
+    """One copy-on-write block copy (first divergent write into a
+    shared block)."""
+    if not _m.enabled():
+        return
+    _reg().counter("hvd_tpu_serve_kv_cow_copies_total",
+                   "copy-on-write KV block copies").inc()
+
+
+def on_spec_accept_ratio(ratio: float) -> None:
+    """Speculative decoding's rolling accepted-tokens-per-verify-step
+    ratio (1.0 = drafts never accepted = plain decode cadence)."""
+    if not _m.enabled():
+        return
+    _reg().gauge("hvd_tpu_serve_spec_accepted_ratio",
+                 "emitted tokens per speculative verify step").set(ratio)
+
+
 # --- autotune decision log ---------------------------------------------------
 
 # Bounded decision log: the JSON snapshot carries it verbatim (the

@@ -18,6 +18,11 @@ training framework's existing layers:
   strike/probation health, and drains a dead replica's in-flight
   requests back through :class:`~horovod_tpu.utils.retry.RetryPolicy`
 * :mod:`~horovod_tpu.serve.metrics` — TTFT/TPOT/occupancy snapshots
+* :mod:`~horovod_tpu.serve.kv` — paged block-pool KV cache: refcounted
+  fixed-size token blocks with copy-on-write prefix sharing (radix
+  trie over token IDs), LRU eviction, and speculative decoding
+  (drafter + one-forward batched verification, token-identical to
+  plain greedy decode)
 
 Chaos: the ``serve`` fault site (``HVD_TPU_FAULT_SPEC``) drops/delays
 requests at the endpoint and kills a replica mid-decode
@@ -29,6 +34,9 @@ from .batcher import (  # noqa: F401
 )
 from .engine import (  # noqa: F401
     InferenceEngine, PromptTooLongError, SamplingParams,
+)
+from .kv import (  # noqa: F401
+    BlockPool, KVPoolExhaustedError, PrefixIndex,
 )
 from .metrics import ServingStats, percentile  # noqa: F401
 from .router import (  # noqa: F401

@@ -64,6 +64,10 @@ class ServeRequest:
     finished_at: Optional[float] = None
     tokens: List[int] = dataclasses.field(default_factory=list)
     error: Optional[str] = None
+    # Resident-prefix tokens (admission-time probe, refined to the
+    # actual binding at prefill) — the cache-hit/miss signal the bench
+    # and the router's affinity layer read.
+    prefix_hit_tokens: int = 0
     done: threading.Event = dataclasses.field(
         default_factory=threading.Event)
     # Trace context captured at submit (the server handler's span): the
@@ -127,7 +131,14 @@ class ContinuousBatcher:
         if sampling.max_new_tokens > self.max_new_tokens_cap:
             sampling = dataclasses.replace(
                 sampling, max_new_tokens=self.max_new_tokens_cap)
-        self.engine.check_prompt(len(prompt))   # PromptTooLongError early
+        # PromptTooLongError / out-of-vocab ValueError early — a poison
+        # prompt must never reach the shared KV pool (engine docstring).
+        self.engine.check_prompt_tokens(prompt)
+        # Admission-time prefix lookup: how much of this prompt's K/V
+        # is already resident (serve/kv/).  Recorded before queueing so
+        # backpressure decisions and the bench see the signal even for
+        # requests that later expire; the binding at prefill refines it.
+        hit = self.engine.prefix_probe(prompt)
         limit = (deadline_s if deadline_s is not None
                  else self.default_deadline_s)
         req = ServeRequest(
@@ -136,6 +147,7 @@ class ContinuousBatcher:
             deadline=(time.monotonic() + limit) if limit and limit > 0
             else None,
             submitted_at=time.monotonic(),
+            prefix_hit_tokens=hit,
             trace_ctx=trace_mod.current())
         with self._lock:
             if self._killed is not None:
@@ -224,16 +236,20 @@ class ContinuousBatcher:
             total_s=req.finished_at - req.submitted_at)
 
     def _emit(self, slot: int, req: ServeRequest, token: int,
-              now: float) -> None:
+              now: float, check_full: bool = True) -> None:
         if req.done.is_set():
             return   # cancelled/expired concurrently: drop the token
         if req.first_token_at is None:
             req.first_token_at = now
         req.tokens.append(token)
         stop = req.sampling.stop_token
+        # ``check_full`` is False for all but the last token of a
+        # speculative burst: the engine advanced the slot position past
+        # the whole burst, but every emitted token except the last had
+        # cache room by construction (acceptance is capped there).
         if (len(req.tokens) >= req.sampling.max_new_tokens
                 or (stop is not None and token == stop)
-                or self.engine.slot_full(slot)):
+                or (check_full and self.engine.slot_full(slot))):
             self._finish_slot(slot, req)
 
     def step(self) -> int:
@@ -264,11 +280,14 @@ class ContinuousBatcher:
                 self.stats.record_failed()
                 req.finish(error=f"prefill_failed: {e}")
                 continue
+            req.prefix_hit_tokens = self.engine.prefix_hit_tokens(slot)
+            self.stats.record_prefix(req.prefix_hit_tokens > 0)
             self._record_phase(req, "hvd_tpu_serve_queued",
                                req.submitted_at, prefill_t0)
             self._record_phase(req, "hvd_tpu_serve_prefill", prefill_t0,
                                time.monotonic(),
-                               prompt_len=len(req.prompt), slot=slot)
+                               prompt_len=len(req.prompt), slot=slot,
+                               prefix_hit=req.prefix_hit_tokens)
             if req.done.is_set():
                 # Cancelled/expired between admission and prefill
                 # completion: cancel() found no active slot to release
@@ -291,11 +310,20 @@ class ContinuousBatcher:
                 raise ReplicaKilledError(self._killed)
             tokens = self.engine.step()
             now = time.monotonic()
-            for slot, token in tokens.items():
+            for slot, toks in tokens.items():
                 req = active.get(slot)
-                if req is not None:
+                if req is None:
+                    continue
+                # A speculative burst emits several tokens; a finish
+                # condition (stop token, max_new_tokens) mid-burst
+                # drops the remainder — exactly what plain greedy
+                # decode would never have produced.
+                for j, token in enumerate(toks):
                     emitted += 1
-                    self._emit(slot, req, token, now)
+                    self._emit(slot, req, token, now,
+                               check_full=(j == len(toks) - 1))
+                    if req.done.is_set():
+                        break
         with self._lock:
             self.stats.record_step(active=len(self._slots),
                                    slots=self.engine.max_slots,
@@ -362,6 +390,7 @@ class ContinuousBatcher:
 
     def snapshot(self) -> Dict:
         snap = self.stats.snapshot()
+        snap.update(self.engine.kv_stats())
         with self._lock:
             snap.update(queue_depth=len(self._queue),
                         active_slots=len(self._slots),

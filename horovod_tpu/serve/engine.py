@@ -3,22 +3,44 @@
 The serving hot path is two compiled programs:
 
 * **prefill** — one program per *length bucket* ``L``: run the prompt
-  (padded to ``L``) through the model with a fresh ``[1, L]`` KV cache,
-  sample the first token, and write the cache into this request's slot
-  of the engine-wide preallocated cache.  Padding prompts to a small
-  set of bucket shapes bounds recompiles: serving traffic has arbitrary
-  prompt lengths, and an unbucketed engine would compile per length.
+  (padded to ``L``) through the model, sample the first token, and
+  write its K/V into this request's cache.  Padding prompts to a small
+  set of bucket shapes bounds recompiles.  With prefix sharing the
+  bucket is chosen for the *suffix*: a prompt whose leading tokens are
+  resident in the KV pool recomputes only what is not cached — the
+  cache-hit TTFT win.
 * **decode** — ONE program for the whole slot batch: every active
-  request advances one token per call, each slot at its own depth
-  (``positions`` is per-row, so a request in its 3rd token and one in
-  its 300th share the dispatch).  This is the continuous-batching
-  property: admission never waits for the batch to drain.
+  request advances per call, each slot at its own depth.  This is the
+  continuous-batching property: admission never waits for the batch to
+  drain.
+
+Two KV layouts live under this one API (``HVD_TPU_SERVE_KV``):
+
+* **paged** (default) — one ``[num_blocks, block, H, D]`` pool per
+  layer plus a host-side block table (``serve/kv/``): requests map
+  onto refcounted fixed-size token blocks, identical prompt prefixes
+  share physical blocks (copy-on-write on first divergent write), and
+  unreferenced prefix blocks are LRU-evicted under pressure.  The
+  jitted programs index the pool *through* a per-slot block-table
+  array, so there is still ONE compiled decode program — the table is
+  data, not shape.  Block 0 is a reserved *trash block*: unmapped
+  table entries point at it and invalid positions (padding, rejected
+  speculative tokens, past-the-cache) clamp into it, which replaces
+  every masking lattice around scatter/gather.
+* **dense** — the original per-slot ``[slots, S, H, D]`` rows; kept as
+  the token-identity oracle the paged path is tested against.
+
+**Speculative decoding** (per-request opt-in via
+``SamplingParams(spec=True)``; greedy requests only): a small drafter
+model proposes ``HVD_TPU_SERVE_SPEC_K`` tokens per step, the target
+model verifies the whole draft in ONE batched forward inside the same
+compiled-program regime, and accepted-prefix semantics guarantee the
+emitted tokens are identical to plain greedy decode — a wrong draft
+costs speed, never correctness (docs/serving.md has the proof sketch).
 
 Neither program contains a cross-replica collective — the per-token hot
-path is replica-local by construction (the fused computation-collective
-literature's guidance: keep collectives off the token critical path);
-replication happens one level up, in ``serve/router.py`` over process
-sets.
+path is replica-local by construction; replication happens one level
+up, in ``serve/router.py`` over process sets.
 
 Sampling is greedy / temperature / top-k, resolved **per slot** inside
 the one decode program (a ``where`` lattice, not a recompile), so mixed
@@ -29,7 +51,8 @@ from __future__ import annotations
 
 import collections
 import dataclasses
-from typing import Dict, List, Optional, Sequence
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -37,6 +60,7 @@ import numpy as np
 
 from ..models.transformer import GPT, init_kv_cache
 from ..utils.logging import get_logger
+from .kv import BlockPool, TRASH_BLOCK
 
 logger = get_logger(__name__)
 
@@ -58,12 +82,16 @@ class PromptTooLongError(ValueError):
 
 @dataclasses.dataclass(frozen=True)
 class SamplingParams:
-    """Per-request sampling knobs (greedy when ``temperature == 0``)."""
+    """Per-request sampling knobs (greedy when ``temperature == 0``).
+    ``spec=True`` opts the request into speculative decoding (engines
+    built with a drafter; greedy requests only — temperature rows in
+    the same batch keep plain single-token semantics)."""
 
     max_new_tokens: int = 16
     temperature: float = 0.0
     top_k: int = 0                 # 0 = full vocab
     stop_token: Optional[int] = None
+    spec: bool = False
 
 
 def _sample(logits, rng, temps, topks):
@@ -84,15 +112,22 @@ class InferenceEngine:
     """Slot-based prefill/decode engine; the batcher owns scheduling.
 
     ``start(slot, prompt, sampling)`` prefixes a request into ``slot``
-    and returns its first token; ``step()`` decodes one token for every
-    active slot.  Per-phase wall time lands on the framework Timeline
-    (phases ``SERVE_PREFILL`` / ``SERVE_DECODE``) when one is active.
+    and returns its first token; ``step()`` decodes for every active
+    slot and returns ``{slot: [tokens]}`` — one token per slot on the
+    plain path, up to ``spec_k + 1`` under speculative decoding.
+    Per-phase wall time lands on the framework Timeline (phases
+    ``SERVE_PREFILL`` / ``SERVE_DECODE``) when one is active.
     """
 
     def __init__(self, model: GPT, params, *,
                  max_slots: Optional[int] = None,
                  prefill_buckets: Optional[Sequence[int]] = None,
                  max_seq_len: Optional[int] = None,
+                 kv_cache: Optional[str] = None,
+                 kv_block: Optional[int] = None,
+                 kv_blocks: Optional[int] = None,
+                 drafter: Optional[Tuple[GPT, dict]] = None,
+                 spec_k: Optional[int] = None,
                  seed: int = 0):
         cfg = resolved_config()
         self._model = model
@@ -109,29 +144,120 @@ class InferenceEngine:
             {min(int(b), self.max_seq_len) for b in buckets if b > 0}))
         if not self.prefill_buckets:
             raise ValueError(f"no usable prefill buckets in {buckets}")
-        self._caches = init_kv_cache(model.config, self.max_slots,
-                                     self.max_seq_len)
-        self._positions = np.zeros(self.max_slots, np.int32)
-        self._active = np.zeros(self.max_slots, bool)
-        self._temps = np.zeros(self.max_slots, np.float32)
-        self._topks = np.zeros(self.max_slots, np.int32)
-        self._last_tokens = np.zeros(self.max_slots, np.int32)
+        self.kv_mode = (kv_cache or cfg.serve_kv).lower()
+        if self.kv_mode not in ("paged", "dense"):
+            raise ValueError(f"unknown kv_cache mode {self.kv_mode!r}; "
+                             f"expected 'paged' or 'dense'")
+        # Slot-state arrays: every mutation goes through the guarded
+        # helpers below (_bind_slot / _advance_slot / _clear_slot) so
+        # the hvdlint lock checker covers them — release() arrives from
+        # RPC handler threads (router cancel) while the batcher thread
+        # is mid-step.
+        self._slot_lock = threading.Lock()
+        self._positions = np.zeros(self.max_slots, np.int32)   # guarded-by: _slot_lock
+        self._active = np.zeros(self.max_slots, bool)          # guarded-by: _slot_lock
+        self._temps = np.zeros(self.max_slots, np.float32)     # guarded-by: _slot_lock
+        self._topks = np.zeros(self.max_slots, np.int32)       # guarded-by: _slot_lock
+        self._last_tokens = np.zeros(self.max_slots, np.int32)  # guarded-by: _slot_lock
+        self._spec = np.zeros(self.max_slots, bool)            # guarded-by: _slot_lock
+        self._prefix_hits = np.zeros(self.max_slots, np.int32)  # guarded-by: _slot_lock
         self._rng = jax.random.PRNGKey(seed)
         # Trace-time counters: the bounded-recompile contract is
         # testable (each jitted program bumps its key once per trace).
         self.trace_counts = collections.Counter()
-        # Donate the engine-wide cache so prefill/decode update it in
-        # place — without donation XLA copies the full [slots, S, H, D]
-        # x 2 x n_layer cache every token, which dominates decode at
-        # real cache sizes.  CPU has no donation support (it would only
-        # warn), so gate on the backend.
+        # Donate the engine-wide cache/pool so prefill/decode update it
+        # in place — without donation XLA copies the full cache every
+        # token, which dominates decode at real cache sizes.  CPU has
+        # no donation support (it would only warn), so gate on backend.
         self._donate = (1,) if jax.default_backend() != "cpu" else ()
-        self._prefill_fns = {L: self._make_prefill(L)
-                             for L in self.prefill_buckets}
-        self._decode_fn = jax.jit(self._decode_impl,
-                                  donate_argnums=self._donate)
+        n_layer = model.config.n_layer
+        head_dim = model.config.d_model // model.config.n_head
+        if self.kv_mode == "paged":
+            self.kv_block = int(kv_block or cfg.serve_kv_block)
+            if self.kv_block < 1:
+                raise ValueError(f"kv_block must be >= 1, got "
+                                 f"{self.kv_block}")
+            self.blocks_per_slot = -(-self.max_seq_len // self.kv_block)
+            floor = 1 + self.max_slots * self.blocks_per_slot
+            budget = int(kv_blocks if kv_blocks is not None
+                         else cfg.serve_kv_blocks)
+            if budget == 0:
+                # Auto: every slot fully servable plus an equal share
+                # of prefix-cache headroom.
+                budget = 1 + 2 * self.max_slots * self.blocks_per_slot
+            if budget < floor:
+                raise ValueError(
+                    f"KV pool budget {budget} below the floor {floor} "
+                    f"(1 trash + slots x blocks_per_slot) — active "
+                    f"requests could deadlock on allocation")
+            self.kv_blocks = budget
+            shape = (budget, self.kv_block, model.config.n_head, head_dim)
+            self._pools = [{"k": jnp.zeros(shape, model.config.dtype),
+                            "v": jnp.zeros(shape, model.config.dtype)}
+                           for _ in range(n_layer)]
+            # Block table: one trailing trash column the jitted
+            # programs clamp invalid positions into (serve/kv/pool.py).
+            self._table = np.full(
+                (self.max_slots, self.blocks_per_slot + 1),
+                TRASH_BLOCK, np.int32)
+            self._copy_fn = jax.jit(
+                self._copy_impl,
+                donate_argnums=(0,) if self._donate else ())
+            self._kv = BlockPool(budget, self.kv_block, self._table,
+                                 self._copy_block)
+            self._caches = None
+            self._decode_fn = jax.jit(self._decode_paged_impl,
+                                      donate_argnums=self._donate)
+            self._prefill_fns = {L: self._make_paged_prefill(L)
+                                 for L in self.prefill_buckets}
+        else:
+            self.kv_block = 0
+            self.kv_blocks = 0
+            self._kv = None
+            self._caches = init_kv_cache(model.config, self.max_slots,
+                                         self.max_seq_len)
+            self._decode_fn = jax.jit(self._decode_impl,
+                                      donate_argnums=self._donate)
+            self._prefill_fns = {L: self._make_prefill(L)
+                                 for L in self.prefill_buckets}
+        # Speculative decoding: drafter = (small GPT, its params).
+        self._drafter = None
+        self._drafter_params = None
+        self._drafter_caches = None
+        self.spec_k = int(spec_k or cfg.serve_spec_k)
+        self.spec_verify_steps = 0
+        self.spec_accepted_tokens = 0
+        if drafter is not None:
+            if self.kv_mode != "paged":
+                raise ValueError("speculative decoding requires the "
+                                 "paged KV cache (HVD_TPU_SERVE_KV=paged)")
+            dmodel, dparams = drafter
+            if dmodel.config.max_seq_len < self.max_seq_len:
+                raise ValueError(
+                    f"drafter positional table "
+                    f"({dmodel.config.max_seq_len}) shorter than the "
+                    f"serving cache ({self.max_seq_len})")
+            self._drafter = dmodel
+            self._drafter_params = dparams
+            self._drafter_caches = init_kv_cache(
+                dmodel.config, self.max_slots, self.max_seq_len)
+            self._draft_prefill_fns = {L: self._make_draft_prefill(L)
+                                       for L in self.prefill_buckets}
+            self._spec_draft_fn = jax.jit(
+                self._spec_draft_impl, donate_argnums=self._donate)
+            self._spec_verify_fn = jax.jit(
+                self._spec_verify_impl, donate_argnums=self._donate)
 
-    # --- compiled programs --------------------------------------------------
+    # --- paged-view geometry ------------------------------------------------
+
+    @property
+    def _view_len(self) -> int:
+        """Gathered per-slot view length: chain blocks + the trash
+        column — always > max_seq_len, so clamped-invalid positions
+        land in trash rows no valid query can see."""
+        return (self.blocks_per_slot + 1) * self.kv_block
+
+    # --- compiled programs: dense tier --------------------------------------
 
     def _make_prefill(self, L: int):
         model, n_layer = self._model, self._model.config.n_layer
@@ -166,6 +292,174 @@ class InferenceEngine:
             positions=positions[:, None])
         nxt = _sample(logits[:, -1].astype(jnp.float32), rng, temps, topks)
         return nxt, new
+
+    # --- compiled programs: paged tier --------------------------------------
+
+    def _paged_caches(self, pools, tables):
+        return [{"k_pool": pools[i]["k"], "v_pool": pools[i]["v"],
+                 "table": tables}
+                for i in range(self._model.config.n_layer)]
+
+    def _scatter_chunk(self, pools, chunk, blk, off):
+        """Write chunk K/V rows into the pools at ``(blk, off)`` (flat
+        index arrays; invalid traffic already routed to the trash
+        block by the callers' position clamping)."""
+        new = []
+        for i in range(self._model.config.n_layer):
+            k_c = chunk[i]["k"].reshape((-1,) + chunk[i]["k"].shape[-2:])
+            v_c = chunk[i]["v"].reshape((-1,) + chunk[i]["v"].shape[-2:])
+            new.append({
+                "k": pools[i]["k"].at[blk, off].set(
+                    k_c.astype(pools[i]["k"].dtype)),
+                "v": pools[i]["v"].at[blk, off].set(
+                    v_c.astype(pools[i]["v"].dtype)),
+            })
+        return new
+
+    def _copy_impl(self, pools, src, dst):
+        self.trace_counts["kv_copy"] += 1  # trace-time only
+        return [{"k": p["k"].at[dst].set(p["k"][src]),
+                 "v": p["v"].at[dst].set(p["v"][src])} for p in pools]
+
+    def _copy_block(self, src: int, dst: int) -> None:
+        """Device block copy (COW / partial-prefix admission) — the
+        callback :class:`BlockPool` drives."""
+        self._pools = self._copy_fn(self._pools, jnp.int32(src),
+                                    jnp.int32(dst))
+
+    def _make_paged_prefill(self, L: int):
+        model = self._model
+        B, S, SV = self.kv_block, self.max_seq_len, self._view_len
+
+        def prefill(params, pools, table_row, tokens, start, length,
+                    rng, temp, topk):
+            # ``start`` = resident-prefix length (the suffix's first
+            # absolute position); ``length`` = real suffix tokens in
+            # the L-padded chunk.  Both are traced values: one compiled
+            # program per bucket regardless of hit depth.
+            self.trace_counts[f"prefill_{L}"] += 1  # trace-time only
+            idx = jnp.arange(L, dtype=jnp.int32)
+            valid = (idx < length) & (start + idx < S)
+            positions = jnp.where(valid, start + idx, SV - 1)
+            caches = self._paged_caches(pools, table_row[None])
+            logits, chunk = model.apply(
+                {"params": params}, tokens, kv_caches=caches,
+                positions=positions[None])
+            last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
+                                                axis=0, keepdims=False)
+            token = _sample(last[None].astype(jnp.float32), rng,
+                            temp[None], topk[None])[0]
+            blk = table_row[positions // B]   # invalid -> trash column
+            new = self._scatter_chunk(pools, chunk, blk, positions % B)
+            return token, new
+
+        return jax.jit(prefill, donate_argnums=self._donate)
+
+    def _decode_paged_impl(self, params, pools, tables, tokens,
+                           positions, temps, topks, rng):
+        self.trace_counts["decode"] += 1  # trace-time only
+        caches = self._paged_caches(pools, tables)
+        logits, chunk = self._model.apply(
+            {"params": params}, tokens[:, None], kv_caches=caches,
+            positions=positions[:, None])
+        nxt = _sample(logits[:, -1].astype(jnp.float32), rng, temps, topks)
+        B = self.kv_block
+        blk = jnp.take_along_axis(tables, (positions // B)[:, None],
+                                  axis=1)[:, 0]
+        new = self._scatter_chunk(pools, chunk, blk, positions % B)
+        return nxt, new
+
+    # --- compiled programs: speculative tier --------------------------------
+
+    def _make_draft_prefill(self, L: int):
+        drafter = self._drafter
+        n_layer = drafter.config.n_layer
+
+        def dprefill(dparams, dcaches, tokens, slot):
+            self.trace_counts[f"draft_prefill_{L}"] += 1  # trace-time
+            positions = jnp.arange(L, dtype=jnp.int32)[None]
+            row = init_kv_cache(drafter.config, 1, L)
+            _, row = drafter.apply({"params": dparams}, tokens,
+                                   kv_caches=row, positions=positions)
+
+            def write(big, chunk):
+                return jax.lax.dynamic_update_slice(
+                    big, chunk.astype(big.dtype), (slot, 0, 0, 0))
+
+            return [{"k": write(dcaches[i]["k"], row[i]["k"]),
+                     "v": write(dcaches[i]["v"], row[i]["v"])}
+                    for i in range(n_layer)]
+
+        return jax.jit(dprefill, donate_argnums=self._donate)
+
+    def _spec_draft_impl(self, dparams, dcaches, tokens, positions):
+        """Greedy-draft ``spec_k`` tokens for every slot in ONE program
+        (a ``lax.scan`` over the drafter's own dense decode).  The scan
+        runs ``K + 1`` iterations: the extra step feeds the last draft
+        token so its K/V lands too — with a fully accepted draft the
+        next step starts at ``p + K + 1``, and a gap at ``p + K`` would
+        silently degrade every later draft (the verify path would still
+        be exact; only acceptance would rot).  Entries past the
+        accepted prefix go stale but are overwritten sequentially
+        before any query can see them (same argument as slot reuse)."""
+        self.trace_counts["spec_draft"] += 1  # trace-time only
+        drafter = self._drafter
+
+        def body(carry, _):
+            caches, toks, pos = carry
+            logits, caches = drafter.apply(
+                {"params": dparams}, toks[:, None], kv_caches=caches,
+                positions=pos[:, None])
+            nxt = jnp.argmax(logits[:, -1].astype(jnp.float32),
+                             axis=-1).astype(jnp.int32)
+            return (caches, nxt, pos + 1), nxt
+
+        (dcaches, _, _), drafts = jax.lax.scan(
+            body, (dcaches, tokens, positions), None,
+            length=self.spec_k + 1)
+        return jnp.moveaxis(drafts[:self.spec_k], 0, 1), dcaches
+
+    def _spec_verify_impl(self, params, pools, tables, tokens, draft,
+                          positions, temps, topks, spec_ok, rng):
+        """Verify the whole draft in one batched target forward.
+
+        Chunk ``[t0, d1..dK]`` runs at positions ``p..p+K``; the
+        accepted prefix is the longest run of drafts matching the
+        target's own greedy chain, so the emitted tokens are exactly
+        what plain greedy decode would produce (docs/serving.md).  Only
+        chunk rows ``<= accepted`` persist their K/V — rejected rows
+        scatter into the trash block and the correct token rewrites
+        that position next step.  Rows with ``spec_ok`` false (no
+        opt-in, or temperature sampling) accept nothing and emit one
+        plain-sampled token."""
+        self.trace_counts["spec_verify"] += 1  # trace-time only
+        K = self.spec_k
+        B, S, SV = self.kv_block, self.max_seq_len, self._view_len
+        chunk_toks = jnp.concatenate([tokens[:, None], draft], axis=1)
+        idx = jnp.arange(K + 1, dtype=jnp.int32)[None]
+        pos = positions[:, None] + idx
+        pos_safe = jnp.where(pos < S, pos, SV - 1)
+        caches = self._paged_caches(pools, tables)
+        logits, chunk = self._model.apply(
+            {"params": params}, chunk_toks, kv_caches=caches,
+            positions=pos_safe)
+        logits = logits.astype(jnp.float32)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        matches = (draft == greedy[:, :K]).astype(jnp.int32)
+        accepted = jnp.cumprod(matches, axis=1).sum(axis=1)
+        accepted = jnp.where(spec_ok, accepted, 0)
+        # The last emitted token needs no K/V write, but every ACCEPTED
+        # draft does — cap acceptance at the cache's remaining rows.
+        accepted = jnp.minimum(accepted,
+                               jnp.maximum(S - 1 - positions, 0))
+        first = _sample(logits[:, 0], rng, temps, topks)
+        out = greedy.at[:, 0].set(first)   # argmax already, unless temp>0
+        keep = (idx <= accepted[:, None]) & (pos < S)
+        pos_w = jnp.where(keep, pos, SV - 1)
+        blk = jnp.take_along_axis(tables, pos_w // B, axis=1)
+        new = self._scatter_chunk(pools, chunk, blk.reshape(-1),
+                                  (pos_w % B).reshape(-1))
+        return out, accepted, new
 
     # --- host-side slot API -------------------------------------------------
 
@@ -205,6 +499,23 @@ class InferenceEngine:
                 f"generate (cache length {self.max_seq_len})")
         return self.bucket_for(prompt_len)
 
+    def check_prompt_tokens(self, prompt: Sequence[int]) -> int:
+        """:meth:`check_prompt` plus token-ID range validation.  An
+        out-of-vocab id embeds as NaN (``jnp.take`` fill semantics),
+        and the paged pool is a SHARED structure: one poison request's
+        NaN rows would outlive it in the trash/prefix blocks and
+        contaminate every later batchmate through the ``0 x NaN``
+        attention sum — so the poison must die at admission, not in
+        the pool."""
+        bucket = self.check_prompt(len(prompt))
+        vocab = self._model.config.vocab_size
+        lo, hi = min(prompt), max(prompt)   # C-speed single pass
+        if lo < 0 or hi >= vocab:
+            raise ValueError(
+                f"prompt token id {lo if lo < 0 else hi} outside the "
+                f"model vocabulary [0, {vocab})")
+        return bucket
+
     def free_slots(self) -> List[int]:
         return [int(s) for s in np.nonzero(~self._active)[0]]
 
@@ -217,57 +528,218 @@ class InferenceEngine:
         while it is ``< max_seq_len``)."""
         return int(self._positions[slot]) >= self.max_seq_len
 
+    # --- guarded slot-state mutation ----------------------------------------
+    # The ONE place slot state changes (the hvdlint lock checker holds
+    # every annotated mutation to a lexical ``with _slot_lock`` block):
+    # prefill used to write these fields inline next to the cache-chunk
+    # write, which left router-thread release() racing the batcher.
+
+    def _bind_slot(self, slot: int, n_prompt: int, token: int,
+                   sampling: SamplingParams, prefix_hit: int) -> None:
+        with self._slot_lock:
+            self._active[slot] = True
+            self._positions[slot] = n_prompt   # first generated index
+            self._temps[slot] = sampling.temperature
+            self._topks[slot] = sampling.top_k
+            self._last_tokens[slot] = token    # first decode consumes it
+            self._spec[slot] = bool(sampling.spec)
+            self._prefix_hits[slot] = prefix_hit
+
+    def _advance_slot(self, slot: int, tokens: List[int]) -> None:
+        with self._slot_lock:
+            if not self._active[slot]:
+                return   # released concurrently (cancel): drop
+            self._last_tokens[slot] = tokens[-1]
+            self._positions[slot] += len(tokens)
+
+    def _clear_slot(self, slot: int) -> None:
+        with self._slot_lock:
+            self._active[slot] = False
+            self._positions[slot] = 0
+            self._temps[slot] = 0.0
+            self._topks[slot] = 0
+            self._spec[slot] = False
+            self._prefix_hits[slot] = 0
+
+    # --- prefix sharing -----------------------------------------------------
+
+    def prefix_probe(self, prompt: Sequence[int]) -> int:
+        """Resident-prefix length for ``prompt`` right now (no side
+        effects) — the batcher's admission-time lookup; 0 on the dense
+        tier."""
+        if self._kv is None:
+            return 0
+        return self._kv.probe(list(prompt))
+
+    def prefix_hit_tokens(self, slot: int) -> int:
+        """Prefix tokens the last ``start()`` on ``slot`` reused."""
+        return int(self._prefix_hits[slot])
+
+    # --- request lifecycle --------------------------------------------------
+
     def start(self, slot: int, prompt: Sequence[int],
               sampling: SamplingParams) -> int:
         """Prefill ``prompt`` into ``slot``; returns the first sampled
-        token.  One compiled program per (bucket, slot-batch) shape."""
+        token.  One compiled program per (bucket, slot-batch) shape —
+        on the paged tier the bucket covers only the non-resident
+        suffix."""
         if self._active[slot]:
             raise RuntimeError(f"slot {slot} is already active")
+        prompt = [int(t) for t in prompt]
         n = len(prompt)
-        L = self.check_prompt(n)
-        padded = np.zeros((1, L), np.int32)
-        padded[0, :n] = np.asarray(prompt, np.int32)
-        fn = self._prefill_fns[L]
-        with self._activity(f"serve/slot{slot}", "SERVE_PREFILL",
-                            {"bucket": L, "prompt_len": n}):
-            token, self._caches = fn(
-                self._params, self._caches, jnp.asarray(padded),
-                jnp.int32(n), jnp.int32(slot), self._next_rng(),
-                jnp.float32(sampling.temperature),
-                jnp.int32(sampling.top_k))
-            token = int(token)
-        self._active[slot] = True
-        self._positions[slot] = n     # the first generated token's index
-        self._temps[slot] = sampling.temperature
-        self._topks[slot] = sampling.top_k
-        self._last_tokens[slot] = token   # first decode consumes it
+        self.check_prompt_tokens(prompt)
+        if self.kv_mode == "paged":
+            hit = self._kv.begin_request(slot, prompt)
+            ns = n - hit
+            L = self.bucket_for(ns)
+            self._kv.ensure_writable(slot, hit, ns)
+            padded = np.zeros((1, L), np.int32)
+            padded[0, :ns] = np.asarray(prompt[hit:], np.int32)
+            fn = self._prefill_fns[L]
+            with self._activity(f"serve/slot{slot}", "SERVE_PREFILL",
+                                {"bucket": L, "prompt_len": n,
+                                 "prefix_hit": hit}):
+                token, self._pools = fn(
+                    self._params, self._pools,
+                    jnp.asarray(self._table[slot]), jnp.asarray(padded),
+                    jnp.int32(hit), jnp.int32(ns), self._next_rng(),
+                    jnp.float32(sampling.temperature),
+                    jnp.int32(sampling.top_k))
+                token = int(token)
+            self._kv.index_prompt(slot, prompt)
+        else:
+            hit = 0
+            L = self.bucket_for(n)
+            padded = np.zeros((1, L), np.int32)
+            padded[0, :n] = np.asarray(prompt, np.int32)
+            fn = self._prefill_fns[L]
+            with self._activity(f"serve/slot{slot}", "SERVE_PREFILL",
+                                {"bucket": L, "prompt_len": n}):
+                token, self._caches = fn(
+                    self._params, self._caches, jnp.asarray(padded),
+                    jnp.int32(n), jnp.int32(slot), self._next_rng(),
+                    jnp.float32(sampling.temperature),
+                    jnp.int32(sampling.top_k))
+                token = int(token)
+        if self._drafter is not None:
+            # The drafter recomputes the full prompt (its dense cache
+            # shares nothing) — it is the small model by construction.
+            Lf = self.bucket_for(n)
+            dp = np.zeros((1, Lf), np.int32)
+            dp[0, :n] = np.asarray(prompt, np.int32)
+            self._drafter_caches = self._draft_prefill_fns[Lf](
+                self._drafter_params, self._drafter_caches,
+                jnp.asarray(dp), jnp.int32(slot))
+        self._bind_slot(slot, n, token, sampling, hit)
         return token
 
-    def step(self) -> Dict[int, int]:
-        """One decode step for every active slot → ``{slot: token}``.
-        Inactive rows ride along masked (position 0) and are ignored."""
+    def step(self) -> Dict[int, List[int]]:
+        """One decode step for every active slot → ``{slot: [tokens]}``
+        (one token per slot on the plain path; up to ``spec_k + 1``
+        under speculative decoding).  Inactive rows ride along masked
+        and write into the trash block."""
         active = self.active_slots()
         if not active:
             return {}
+        if self._drafter is not None and any(
+                self._spec[s] and self._temps[s] <= 0 for s in active):
+            return self._step_spec(active)
         positions = np.where(self._active, self._positions, 0).astype(np.int32)
-        with self._activity("serve/decode", "SERVE_DECODE",
-                            {"batch": len(active)}):
-            nxt, self._caches = self._decode_fn(
-                self._params, self._caches, jnp.asarray(self._last_tokens),
-                jnp.asarray(positions), jnp.asarray(self._temps),
-                jnp.asarray(self._topks), self._next_rng())
-            nxt = np.asarray(nxt)
+        if self.kv_mode == "paged":
+            for s in active:
+                self._kv.ensure_writable(s, int(positions[s]), 1)
+            with self._activity("serve/decode", "SERVE_DECODE",
+                                {"batch": len(active)}):
+                nxt, self._pools = self._decode_fn(
+                    self._params, self._pools, jnp.asarray(self._table),
+                    jnp.asarray(self._last_tokens), jnp.asarray(positions),
+                    jnp.asarray(self._temps), jnp.asarray(self._topks),
+                    self._next_rng())
+                nxt = np.asarray(nxt)
+        else:
+            with self._activity("serve/decode", "SERVE_DECODE",
+                                {"batch": len(active)}):
+                nxt, self._caches = self._decode_fn(
+                    self._params, self._caches,
+                    jnp.asarray(self._last_tokens), jnp.asarray(positions),
+                    jnp.asarray(self._temps), jnp.asarray(self._topks),
+                    self._next_rng())
+                nxt = np.asarray(nxt)
         out = {}
         for s in active:
-            out[s] = int(nxt[s])
-            self._last_tokens[s] = nxt[s]
-            self._positions[s] += 1
+            toks = [int(nxt[s])]
+            out[s] = toks
+            self._advance_slot(s, toks)
         return out
 
+    def _step_spec(self, active: List[int]) -> Dict[int, List[int]]:
+        """Draft-then-verify step: the drafter proposes ``spec_k``
+        tokens per slot, the target verifies the whole draft in one
+        batched forward, and each slot emits its accepted prefix plus
+        the target's next token (1..K+1 tokens, token-identical to
+        plain greedy decode)."""
+        K = self.spec_k
+        positions = np.where(self._active, self._positions, 0).astype(np.int32)
+        for s in active:
+            p = int(positions[s])
+            self._kv.ensure_writable(s, p, min(K + 1, self.max_seq_len - p))
+        spec_ok = self._active & self._spec & (self._temps <= 0)
+        with self._activity("serve/decode", "SERVE_DECODE",
+                            {"batch": len(active), "spec_k": K}):
+            draft, self._drafter_caches = self._spec_draft_fn(
+                self._drafter_params, self._drafter_caches,
+                jnp.asarray(self._last_tokens), jnp.asarray(positions))
+            out, accepted, self._pools = self._spec_verify_fn(
+                self._params, self._pools, jnp.asarray(self._table),
+                jnp.asarray(self._last_tokens), draft,
+                jnp.asarray(positions), jnp.asarray(self._temps),
+                jnp.asarray(self._topks), jnp.asarray(spec_ok),
+                self._next_rng())
+            out = np.asarray(out)
+            accepted = np.asarray(accepted)
+        result: Dict[int, List[int]] = {}
+        spec_emitted = spec_steps = 0
+        for s in active:
+            toks = [int(t) for t in out[s, :int(accepted[s]) + 1]]
+            result[s] = toks
+            self._advance_slot(s, toks)
+            if spec_ok[s]:
+                # Only opted-in greedy slots measure drafter quality —
+                # plain/temperature batchmates always emit exactly one
+                # token and would dilute the ratio toward 1.0.
+                spec_steps += 1
+                spec_emitted += len(toks)
+        self.spec_verify_steps += spec_steps
+        self.spec_accepted_tokens += spec_emitted
+        from ..obs import instrument as _obs
+
+        _obs.on_spec_accept_ratio(
+            self.spec_accepted_tokens / max(1, self.spec_verify_steps))
+        return result
+
     def release(self, slot: int) -> None:
-        """Return ``slot`` to the free pool (cache rows are reused —
-        stale keys are invisible behind the position mask)."""
-        self._active[slot] = False
-        self._positions[slot] = 0
-        self._temps[slot] = 0.0
-        self._topks[slot] = 0
+        """Return ``slot`` to the free pool.  Dense tier: cache rows
+        are reused (stale keys invisible behind the position mask);
+        paged tier: the chain's references drop and unreferenced
+        prompt blocks stay resident for future prefix hits until
+        evicted."""
+        if self._kv is not None:
+            self._kv.release(slot)
+        self._clear_slot(slot)
+
+    # --- observability ------------------------------------------------------
+
+    def kv_stats(self) -> Dict:
+        """JSON-ready paged-KV + speculative counters (merged into the
+        batcher's snapshot and the serving bench artifact)."""
+        out: Dict = {}
+        if self._kv is not None:
+            out.update(self._kv.stats())
+        if self._drafter is not None:
+            steps = self.spec_verify_steps
+            out["spec_verify_steps"] = steps
+            out["spec_accepted_tokens"] = self.spec_accepted_tokens
+            out["spec_accept_per_verify"] = (
+                round(self.spec_accepted_tokens / steps, 4) if steps
+                else None)
+        return out

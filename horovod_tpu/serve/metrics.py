@@ -45,6 +45,8 @@ class ServingStats:
         self.expired = 0                  # guarded-by: _lock
         self.failed = 0                   # guarded-by: _lock
         self.tokens_out = 0               # guarded-by: _lock
+        self.prefix_hits = 0              # guarded-by: _lock
+        self.prefix_misses = 0            # guarded-by: _lock
         self._t0 = time.monotonic()
 
     def record_request(self, ttft_s: float, n_tokens: int,
@@ -61,6 +63,16 @@ class ServingStats:
         with self._lock:
             self._occupancy.append(active / max(1, slots))
             self._queue_depth.append(queued)
+
+    def record_prefix(self, hit: bool) -> None:
+        """One prefill binding: did the prompt's prefix hit resident KV
+        blocks (serve/kv/)?  Ratio lands in the snapshot — the signal
+        that says the fleet's routing keeps prefixes warm."""
+        with self._lock:
+            if hit:
+                self.prefix_hits += 1
+            else:
+                self.prefix_misses += 1
 
     def record_rejected(self) -> None:
         with self._lock:
@@ -83,6 +95,7 @@ class ServingStats:
             occ = self._occupancy.values()
             queued = self._queue_depth.values()
             elapsed = max(1e-9, time.monotonic() - self._t0)
+            bound = self.prefix_hits + self.prefix_misses
             out = {
                 "requests_completed": self.completed,
                 "requests_rejected": self.rejected,
@@ -90,6 +103,9 @@ class ServingStats:
                 "requests_failed": self.failed,
                 "tokens_out": self.tokens_out,
                 "tok_per_s": round(self.tokens_out / elapsed, 3),
+                "prefix_hits": self.prefix_hits,
+                "prefix_hit_ratio": (round(self.prefix_hits / bound, 4)
+                                     if bound else None),
                 "occupancy_mean": (round(sum(occ) / len(occ), 4)
                                    if occ else None),
                 "queue_depth_mean": (round(sum(queued) / len(queued), 2)

@@ -19,6 +19,13 @@ backoff — synchronized retries from a fleet of routers would re-create
 the overload that killed the replica), and a response cache keyed by
 ``request_id`` guarantees at-most-once delivery to the caller even if
 a retry races a late success.
+
+**Prefix affinity** (serve/kv/): requests whose leading prompt block
+matches one recently served on a replica prefer that replica — its
+paged KV pool already holds the prefix's blocks, so admission there is
+a cache hit instead of a full prefill.  Affinity is a preference, not
+a pin: a benched replica falls back to the least-loaded spread, so the
+failure handling above is unchanged.
 """
 
 from __future__ import annotations
@@ -136,6 +143,17 @@ class Router:
         self._rr = itertools.count()
         self._done: "OrderedDict[str, GenerateResponse]" = OrderedDict()  # guarded-by: _lock
         self._dedupe_window = dedupe_window
+        # Prefix affinity: leading-block token key -> replica whose KV
+        # pool last served it (bounded LRU; serve/kv prefix sharing).
+        # The slack is how many MORE in-flight requests than the idlest
+        # peer the resident replica may carry before affinity yields to
+        # the least-loaded spread — without it, one hot system prompt
+        # would pin the whole fleet's traffic to a single replica and
+        # serially bench healthy peers through busy-strikes.
+        self._affinity_block = int(cfg.serve_kv_block)
+        self._affinity_slack = max(1, int(cfg.serve_max_batch))
+        self._prefix_map: "OrderedDict[tuple, _ReplicaState]" = OrderedDict()  # guarded-by: _lock
+        self._prefix_window = 1024
 
     # --- health -------------------------------------------------------------
 
@@ -161,9 +179,29 @@ class Router:
             rep.dead_until = None
             rep.completed += 1
 
-    def _pick(self) -> _ReplicaState:
-        """Round-robin over healthy replicas, preferring the least
-        loaded among the next candidates (spread, not pile-on).
+    def _prefix_key(self, prompt: Sequence[int]) -> Optional[tuple]:
+        """Affinity key: the prompt's leading KV block's token IDs —
+        the same granularity the replica's prefix index shares at, so
+        a key match is (at least) a one-block cache hit there."""
+        b = self._affinity_block
+        if b < 1 or len(prompt) < b:
+            return None
+        return tuple(int(t) for t in prompt[:b])
+
+    def _note_affinity(self, key: Optional[tuple],
+                       rep: _ReplicaState) -> None:
+        if key is None:
+            return
+        with self._lock:
+            self._prefix_map[key] = rep
+            self._prefix_map.move_to_end(key)
+            while len(self._prefix_map) > self._prefix_window:
+                self._prefix_map.popitem(last=False)
+
+    def _pick(self, prefix_key: Optional[tuple] = None) -> _ReplicaState:
+        """Round-robin over healthy replicas, preferring (1) the
+        replica whose KV pool holds this prompt's prefix, then (2) the
+        least loaded among the next candidates (spread, not pile-on).
 
         Expired probation is **half-open**: exactly one request per
         window probes the benched replica (its bench is re-armed under
@@ -189,6 +227,17 @@ class Router:
                     + (f"; next probation in "
                        f"{max(0.0, soonest - now):.1f}s"
                        if soonest else ""))
+            if prefix_key is not None:
+                resident = self._prefix_map.get(prefix_key)
+                if (resident is not None and resident.dead_until is None
+                        and resident.inflight
+                        - min(r.inflight for r in fully)
+                        <= self._affinity_slack):
+                    # Prefer the cache-warm replica while it is not
+                    # drastically more loaded than the idlest peer;
+                    # beyond the slack the request spills to the
+                    # spread (the prefix gets cached there too).
+                    return resident
             start = next(self._rr) % len(fully)
             ordered = fully[start:] + fully[:start]
             return min(ordered, key=lambda r: r.inflight)
@@ -230,13 +279,15 @@ class Router:
                  max_new_tokens: int = 16, temperature: float = 0.0,
                  top_k: int = 0, stop_token: Optional[int] = None,
                  deadline_s: Optional[float] = None,
-                 request_id: Optional[str] = None) -> GenerateResponse:
+                 request_id: Optional[str] = None,
+                 spec: bool = False) -> GenerateResponse:
         """Route one generation; at-most-once per ``request_id``.
 
         Retryable failures (dead/busy/killed replica, wire errors)
         re-enter the queue under the retry policy and land on another
         replica; terminal errors (deadline, oversized prompt) return
-        as-is."""
+        as-is.  ``spec=True`` opts into speculative decoding on
+        replicas that run a drafter."""
         rid = request_id or uuid.uuid4().hex
         with self._lock:
             if rid in self._done:
@@ -245,7 +296,8 @@ class Router:
                               max_new_tokens=max_new_tokens,
                               temperature=temperature, top_k=top_k,
                               stop_token=stop_token,
-                              deadline_s=deadline_s)
+                              deadline_s=deadline_s, spec=spec)
+        prefix_key = self._prefix_key(prompt)
         # Response-read timeout: a generation legitimately runs for the
         # request's whole deadline — reading it under the snappy probe
         # timeout would misclassify every slow answer as a dead replica
@@ -257,8 +309,10 @@ class Router:
                         else 600.0)
 
         def attempt() -> GenerateResponse:
-            rep = self._pick()    # NoHealthyReplicasError is retryable:
-            with self._lock:      # probation may clear under backoff
+            # NoHealthyReplicasError is retryable: probation may clear
+            # under the policy's backoff.
+            rep = self._pick(prefix_key)
+            with self._lock:
                 rep.inflight += 1
             try:
                 client = self._client(rep)
@@ -277,6 +331,9 @@ class Router:
                 raise ReplicaUnavailableError(
                     f"replica {rep.spec.name}: {resp.error}")
             self._mark_ok(rep)
+            # The replica now holds this prompt's prefix blocks: later
+            # requests sharing the leading block prefer it (cache hit).
+            self._note_affinity(prefix_key, rep)
             return resp
 
         # One trace per request, rooted at admission (docs/tracing.md):

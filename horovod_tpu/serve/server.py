@@ -42,7 +42,7 @@ class GenerateRequest:
     def __init__(self, request_id: str, prompt: List[int],
                  max_new_tokens: int = 16, temperature: float = 0.0,
                  top_k: int = 0, stop_token: Optional[int] = None,
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None, spec: bool = False):
         self.request_id = request_id
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -50,6 +50,9 @@ class GenerateRequest:
         self.top_k = top_k
         self.stop_token = stop_token
         self.deadline_s = deadline_s
+        # Per-request speculative-decoding opt-in (greedy only; ignored
+        # by replicas whose engine has no drafter).
+        self.spec = spec
 
 
 class GenerateResponse:
@@ -125,7 +128,8 @@ class InferenceServer(BasicService):
         sampling = SamplingParams(
             max_new_tokens=req.max_new_tokens,
             temperature=req.temperature, top_k=req.top_k,
-            stop_token=req.stop_token)
+            stop_token=req.stop_token,
+            spec=bool(getattr(req, "spec", False)))
         try:
             sr = self._batcher.submit(
                 req.prompt, sampling, request_id=req.request_id,

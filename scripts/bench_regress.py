@@ -17,7 +17,10 @@ Accepted file shapes (everything the in-tree benchmarks emit):
 
 Direction is inferred from the metric name: names containing
 ``ms``/``time``/``latency``/``ttft``/``tpot`` are lower-is-better,
-everything else (throughput, busbw, mfu, fractions) higher-is-better.
+everything else (throughput, busbw, mfu, fractions) higher-is-better —
+EXCEPT ratio/rate/acceptance names (``prefix_hit_ratio``,
+``spec_accept_per_verify``), which stay higher-is-better even when a
+latency token also appears in the name.
 
 Exit codes: 0 ok (improvements included), 1 regression(s), 3 no shared
 metrics (a diff that compares nothing must be loud, not green) — pass
@@ -33,6 +36,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 
 # Numeric fields that are configuration/provenance, not performance —
@@ -47,9 +51,19 @@ _NON_METRIC_KEYS = {
     "n_slots", "sizes_swept", "max_elems", "microbatches", "pipeline_depth",
     "bench_buckets", "per_chip_batch", "probe_attempts", "requests",
     "warmup", "iters", "steps_per_call", "metrics", "trace",
+    "prefix_shared", "spec_k", "prefix_hit",
 }
 
 _LOWER_IS_BETTER_TOKENS = ("_ms", "_us", "time", "latency", "ttft", "tpot")
+
+# Override checked FIRST: ratio/rate/acceptance metrics are
+# higher-is-better even when the name also carries a latency token
+# (``prefix_hit_ratio``, ``spec_accept_per_verify`` — the serving
+# bench's cache/speculation quality signals).  Matching is anchored on
+# ``_``-separated WORDS, so "separate_ms" cannot false-match "rate"
+# and a future "accept_wait_ms" would need its own row here before it
+# could flip direction.
+_HIGHER_IS_BETTER_RE = re.compile(r"(^|_)(ratio|rate|accept\w*)(_|$)")
 
 
 def _rows(path: str):
@@ -109,6 +123,8 @@ def extract_metrics(path: str) -> dict:
 
 def lower_is_better(name: str) -> bool:
     low = name.lower()
+    if _HIGHER_IS_BETTER_RE.search(low):
+        return False
     return any(tok in low for tok in _LOWER_IS_BETTER_TOKENS)
 
 

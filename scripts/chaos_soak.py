@@ -16,8 +16,11 @@ Default target is the single-controller chaos test (runs anywhere the
 tier-1 suite runs); ``--mp`` switches to the multi-process world test
 (needs a jax build whose CPU backend supports multiprocess computations,
 or real accelerators).  ``--mode serve`` soaks the serving router
-instead: randomized ``serve:step=N,mode=kill`` injection points against
-the replica-failover tests (the training-path loop stays the default).
+instead: randomized ``serve:step=N`` injection points against the
+replica-failover drills (kill mid-decode and mid-*speculative*-decode)
+AND the paged-KV eviction drill (``mode=evict`` pressure at a seeded
+block allocation — an evicted-then-readmitted prefix must recompute,
+never serve stale blocks); the training-path loop stays the default.
 ``--mode dcn`` soaks the topology-aware wire: randomized ``dcn:step=N``
 specs fire at the hierarchical schedule's cross-pod exchange
 (``topo/schedule.py``) and the drill asserts rollback + convergence on
@@ -120,7 +123,9 @@ def main(argv=None) -> int:
                     default="train",
                     help="'train' loops the elastic-recovery chaos "
                          "tests; 'serve' soaks the serving router under "
-                         "randomized serve:kill fault specs; 'dcn' "
+                         "randomized serve:kill fault specs (plain + "
+                         "speculative decode) plus the paged-KV "
+                         "serve:evict pressure drill; 'dcn' "
                          "soaks the hierarchical schedule's cross-pod "
                          "exchange under randomized dcn:* fault specs "
                          "(single-controller only); 'ckpt' soaks the "

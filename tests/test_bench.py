@@ -90,6 +90,43 @@ def test_serving_bench_json_contract():
 
 
 @pytest.mark.slow
+def test_serving_bench_prefix_heavy_contract(tmp_path):
+    """ISSUE 10 satellite: the prefix-heavy workload reports cache-hit
+    vs cache-miss TTFT, KV pool occupancy, and the speculative
+    accepted-token rate; hit TTFT beats miss TTFT (resident prefix =
+    suffix-bucket prefill) and self-drafting accepts > 1 token per
+    verify step."""
+    out_path = str(tmp_path / "serving_prefix.json")
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmarks",
+                                      "serving_bench.py"),
+         "--requests", "8", "--warmup", "1", "--max-new-tokens", "6",
+         "--buckets", "16,128", "--slots", "2", "--max-seq-len", "192",
+         "--d-model", "128", "--prefix-shared", "112", "--spec-k", "2",
+         "--out", out_path],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "XLA_FLAGS": "", "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    assert row["failed"] == 0
+    # Cache-hit TTFT strictly below cache-miss TTFT: the miss pays the
+    # 128-bucket prefill, a hit runs only the <=16-token suffix — an 8x
+    # prefill-length gap, so the inequality is structural, not timing
+    # luck.
+    assert row["ttft_hit_ms"] < row["ttft_miss_ms"], row
+    assert row["prefix_hit_ratio"] >= 0.8, row
+    assert row["kv_blocks_cached"] > 0 or row["kv_blocks_in_use"] > 0
+    # Speculative accepted-token rate > 1 token per verify step.
+    assert row["spec_accept_per_verify"] > 1.0, row
+    with open(out_path) as f:
+        artifact = json.load(f)
+    assert artifact["stats"]["kv_prefix_hits_total"] >= 7
+    assert artifact["stats"]["spec_accept_per_verify"] > 1.0
+    assert "metrics" in artifact   # embedded telemetry block
+
+
+@pytest.mark.slow
 def test_serving_bench_trace_artifact(tmp_path):
     """ISSUE 7 satellite: ``--trace DIR`` writes a merged Perfetto
     trace for the measured window and embeds its path + critical-path
@@ -321,6 +358,40 @@ def test_bench_regress_lower_is_better_metrics(tmp_path):
     bad = [r for r in report["rows"] if r["regressed"]]
     assert bad[0]["metric"] == "serving.ttft_ms_p99"
     assert bad[0]["direction"] == "lower_is_better"
+
+
+def test_bench_regress_ratio_and_rate_are_higher_is_better(tmp_path):
+    """ISSUE 10 satellite: the serving bench's cache/speculation
+    quality fields regress when they DROP — direction overrides win
+    over the latency-token inference, while the hit/miss TTFT split
+    stays lower-is-better."""
+    old = {"metric": "serving", "value": 50.0, "prefix_hit_ratio": 0.9,
+           "spec_accept_per_verify": 4.0, "ttft_hit_ms": 5.0}
+    new = {"metric": "serving", "value": 50.0, "prefix_hit_ratio": 0.4,
+           "spec_accept_per_verify": 1.0, "ttft_hit_ms": 4.0}
+    out = _regress(tmp_path, old, new)
+    assert out.returncode == 1
+    report = json.loads(out.stdout)
+    rows = {r["metric"]: r for r in report["rows"]}
+    assert rows["serving.prefix_hit_ratio"]["direction"] == \
+        "higher_is_better"
+    assert rows["serving.prefix_hit_ratio"]["regressed"] is True
+    assert rows["serving.spec_accept_per_verify"]["regressed"] is True
+    assert rows["serving.ttft_hit_ms"]["direction"] == "lower_is_better"
+    assert rows["serving.ttft_hit_ms"]["regressed"] is False
+
+
+def test_bench_regress_direction_overrides_are_word_anchored(tmp_path):
+    """A latency name merely CONTAINING 'rate' ('separate_ms') must not
+    flip to higher-is-better — the override matches _-separated words."""
+    old = {"metric": "m", "value": 1.0, "separate_ms": 10.0}
+    new = {"metric": "m", "value": 1.0, "separate_ms": 20.0}
+    out = _regress(tmp_path, old, new)
+    assert out.returncode == 1
+    report = json.loads(out.stdout)
+    rows = {r["metric"]: r for r in report["rows"]}
+    assert rows["m.separate_ms"]["direction"] == "lower_is_better"
+    assert rows["m.separate_ms"]["regressed"] is True
 
 
 def test_bench_regress_disjoint_is_loud(tmp_path):

@@ -78,9 +78,9 @@ def _run_engine_greedy(engine, slot, prompt, n_tokens):
     toks = [engine.start(slot, prompt, SamplingParams(
         max_new_tokens=n_tokens))]
     while len(toks) < n_tokens:
-        toks.append(engine.step()[slot])
+        toks.extend(engine.step()[slot])   # 1 token/step (spec: more)
     engine.release(slot)
-    return toks
+    return toks[:n_tokens]
 
 
 class TestEngineDecode:
@@ -120,7 +120,7 @@ class TestEngineDecode:
         toks = [engine.start(0, [5, 6, 7], SamplingParams(
             max_new_tokens=6, temperature=1.3, top_k=1))]
         while len(toks) < 6:
-            toks.append(engine.step()[0])
+            toks.extend(engine.step()[0])
         engine.release(0)
         assert toks == greedy
 
@@ -130,7 +130,7 @@ class TestEngineDecode:
             toks = [engine.start(0, [9, 8, 7], SamplingParams(
                 max_new_tokens=8, temperature=0.9, top_k=20))]
             while len(toks) < 8:
-                toks.append(engine.step()[0])
+                toks.extend(engine.step()[0])
             return toks
 
         assert run(7) == run(7)
@@ -153,13 +153,15 @@ class TestEngineDecode:
         engine = _engine(model_and_params)
         p0, p1 = [3, 1, 4, 1, 5], [9, 2, 6]
         t0 = engine.start(0, p0, SamplingParams(max_new_tokens=8))
-        a = [t0] + [engine.step()[0] for _ in range(3)]   # slot 0 is 4 deep
+        a = [t0]
+        for _ in range(3):
+            a.extend(engine.step()[0])   # slot 0 is 4 deep
         t1 = engine.start(1, p1, SamplingParams(max_new_tokens=4))
         b = [t1]
         for _ in range(3):
             toks = engine.step()
-            a.append(toks[0])
-            b.append(toks[1])
+            a.extend(toks[0])
+            b.extend(toks[1])
         assert a[:7] == _greedy_reference(model, params, p0, 7)
         assert b == _greedy_reference(model, params, p1, 4)
 
@@ -172,7 +174,7 @@ class TestEngineDecode:
         toks = [engine.start(0, [1, 2], SamplingParams(
             max_new_tokens=10 ** 6))]
         while not engine.slot_full(0):
-            toks.append(engine.step()[0])
+            toks.extend(engine.step()[0])
         assert len(toks) == engine.max_seq_len - 2 + 1
 
     def test_timeline_records_serving_phases(self, model_and_params,
@@ -187,6 +189,338 @@ class TestEngineDecode:
         text = open(path).read()
         assert "SERVE_PREFILL" in text
         assert "SERVE_DECODE" in text
+
+
+class TestPagedKV:
+    """ISSUE 10 tentpole: block-pool paged KV under the engine API —
+    token-identical to the dense oracle, COW on divergence, LRU
+    eviction under pressure (never stale blocks)."""
+
+    def test_paged_matches_dense_mixed_depth(self, model_and_params):
+        """Mixed-depth batches: the paged path must agree token-for-
+        token with the dense decode oracle at every interleaving."""
+        dense = _engine(model_and_params, kv_cache="dense")
+        paged = _engine(model_and_params, kv_cache="paged", kv_block=4)
+        p0, p1 = [3, 1, 4, 1, 5], [9, 2, 6]
+        out = {}
+        for name, eng in (("dense", dense), ("paged", paged)):
+            a = [eng.start(0, p0, SamplingParams(max_new_tokens=8))]
+            for _ in range(3):
+                a.extend(eng.step()[0])
+            b = [eng.start(1, p1, SamplingParams(max_new_tokens=4))]
+            for _ in range(3):
+                toks = eng.step()
+                a.extend(toks[0])
+                b.extend(toks[1])
+            eng.release(0)
+            eng.release(1)
+            out[name] = (a, b)
+        assert out["paged"] == out["dense"], out
+
+    def test_block_not_aligned_to_seq_len(self, model_and_params):
+        """A block size that does not divide max_seq_len must still be
+        exact (the last chain block is partially used)."""
+        model, params = model_and_params
+        eng = _engine(model_and_params, kv_cache="paged", kv_block=5)
+        got = _run_engine_greedy(eng, 0, [7, 3, 9], 6)
+        assert got == _greedy_reference(model, params, [7, 3, 9], 6)
+
+    def test_cow_when_shared_prefix_diverges(self, model_and_params):
+        """Two requests share a prompt prefix then diverge: the shared
+        tail block is copy-on-write — both decode exactly, and the COW
+        counter proves the copy happened (not a recompute)."""
+        model, params = model_and_params
+        eng = _engine(model_and_params, kv_cache="paged", kv_block=4)
+        pre = [11, 12, 13, 14, 15, 16]          # 1.5 blocks
+        pa, pb = pre + [1], pre + [2]
+        a = _run_engine_greedy(eng, 0, pa, 5)
+        assert a == _greedy_reference(model, params, pa, 5)
+        stats0 = eng.kv_stats()
+        b = _run_engine_greedy(eng, 1, pb, 5)
+        assert b == _greedy_reference(model, params, pb, 5)
+        stats1 = eng.kv_stats()
+        assert stats1["kv_prefix_hits_total"] > stats0["kv_prefix_hits_total"]
+        assert stats1["kv_cow_copies_total"] > stats0["kv_cow_copies_total"]
+
+    def test_cow_between_two_live_requests(self, model_and_params):
+        """A second request shares the first one's partial tail block
+        WHILE the first is still decoding into it — the admission-time
+        copy keeps the streams isolated and both stay exact."""
+        model, params = model_and_params
+        eng = _engine(model_and_params, kv_cache="paged", kv_block=4)
+        pa = [5, 6, 7, 8, 9]          # tail block holds 1 prompt token
+        pb = [5, 6, 7, 8, 9, 3]       # shares it, then diverges inside
+        a = [eng.start(0, pa, SamplingParams(max_new_tokens=8))]
+        a.extend(eng.step()[0])       # slot 0 writes INTO the tail block
+        b = [eng.start(1, pb, SamplingParams(max_new_tokens=6))]
+        assert eng.prefix_hit_tokens(1) == 5   # 1 full block + 1 partial
+        for _ in range(4):
+            toks = eng.step()
+            a.extend(toks[0])
+            b.extend(toks[1])
+        assert a[:6] == _greedy_reference(model, params, pa, 6)
+        assert b[:5] == _greedy_reference(model, params, pb, 5)
+        assert eng.kv_stats()["kv_cow_copies_total"] >= 1
+
+    def test_eviction_under_pressure_recomputes(self, model_and_params):
+        """A floor-sized pool under sustained distinct-prefix traffic
+        must LRU-evict the oldest cached prefix; readmitting it then
+        recomputes (probe misses) and stays exact — never stale."""
+        model, params = model_and_params
+        eng = _engine(model_and_params, kv_cache="paged", kv_block=4,
+                      kv_blocks=1 + 2 * 8)     # floor: slots=2, bps=8
+        first = [40, 41, 42, 43, 44, 45, 46, 47, 48]
+        got = _run_engine_greedy(eng, 0, first, 4)
+        assert got == _greedy_reference(model, params, first, 4)
+        assert eng.prefix_probe(first) > 0     # resident after release
+        for i in range(8):                     # distinct in-vocab prefixes
+            p = [(50 + 9 * i + j) % VOCAB for j in range(9)]
+            _run_engine_greedy(eng, 0, p, 4)
+        stats = eng.kv_stats()
+        assert stats["kv_evictions_total"] > 0, stats
+        assert eng.prefix_probe(first) == 0    # evicted, not stale
+        again = _run_engine_greedy(eng, 0, first, 4)
+        assert again == got                    # recomputed exactly
+
+    def test_pool_budget_floor_validated(self, model_and_params):
+        with pytest.raises(ValueError, match="floor"):
+            _engine(model_and_params, kv_cache="paged", kv_block=4,
+                    kv_blocks=8)   # < 1 + 2 slots * 8 blocks/slot
+
+    def test_out_of_vocab_prompt_rejected_at_admission(
+            self, model_and_params):
+        """An out-of-vocab token embeds as NaN; in a SHARED block pool
+        that NaN would outlive the request (trash/prefix blocks) and
+        poison later batchmates through 0 x NaN attention sums — the
+        engine must kill the poison at admission."""
+        eng = _engine(model_and_params, kv_cache="paged", kv_block=4)
+        with pytest.raises(ValueError, match="vocabulary"):
+            eng.start(0, [1, 2, VOCAB], SamplingParams())
+        with pytest.raises(ValueError, match="vocabulary"):
+            eng.start(0, [-1], SamplingParams())
+        b = _batcher(model_and_params)
+        with pytest.raises(ValueError, match="vocabulary"):
+            b.submit([1, VOCAB + 3], SamplingParams(max_new_tokens=2))
+        assert b.queue_depth() == 0            # rejected before queueing
+
+    def test_batcher_snapshot_carries_kv_and_prefix_stats(
+            self, model_and_params):
+        model, params = model_and_params
+        b = _batcher(model_and_params,
+                     engine_kw={"kv_cache": "paged", "kv_block": 4})
+        pre = [21, 22, 23, 24, 25, 26, 27, 28]
+        r1 = b.submit(pre + [1], SamplingParams(max_new_tokens=3))
+        _pump(b, [r1])
+        r2 = b.submit(pre + [2], SamplingParams(max_new_tokens=3))
+        _pump(b, [r2])
+        assert r2.prefix_hit_tokens >= 8       # two full blocks shared
+        snap = b.snapshot()
+        assert snap["prefix_hits"] == 1
+        assert snap["prefix_hit_ratio"] == 0.5
+        assert snap["kv_prefix_hits_total"] >= 1
+        assert snap["kv_blocks_in_use"] == 0   # both released
+        assert r2.tokens == _greedy_reference(model, params, pre + [2], 3)
+
+
+class TestBlockPoolUnit:
+    """Host-side allocator invariants (no jax involved)."""
+
+    def _pool(self, blocks=10, block_tokens=4, slots=2):
+        import numpy as np
+
+        from horovod_tpu.serve.kv import BlockPool
+
+        table = np.zeros((slots, 4), np.int32)
+        copies = []
+        pool = BlockPool(blocks, block_tokens, table,
+                         lambda s, d: copies.append((s, d)))
+        return pool, table, copies
+
+    def test_full_block_sharing_increfs_partial_cows(self):
+        pool, table, copies = self._pool()
+        p = [1, 2, 3, 4, 5, 6, 7, 8]
+        assert pool.begin_request(0, p + [9]) == 0
+        pool.ensure_writable(0, 0, 9)
+        pool.index_prompt(0, p + [9])
+        # Block-aligned sharing: full blocks increfed, no copy — the
+        # suffix's first write lands in a FRESH block.
+        hit = pool.begin_request(1, p + [9])
+        assert hit == 8                      # both full blocks shared
+        assert copies == []                  # read-only: no COW
+        pool.ensure_writable(1, 8, 1)
+        assert copies == []
+        pool.release(1)
+        # Partial-tail sharing: the shared block's tail rows will be
+        # written, so admission copy-on-writes it exactly once.
+        hit = pool.begin_request(1, p + [9, 7])
+        assert hit == 9                      # 2 full blocks + 1 partial
+        assert len(copies) == 1              # COW fired exactly once
+        pool.ensure_writable(1, 9, 1)        # owned copy: no second COW
+        assert len(copies) == 1
+        assert table[0, 3] == 0 and table[1, 3] == 0   # trash column
+
+    def test_release_parks_indexed_blocks_then_evicts_lru(self):
+        pool, _, _ = self._pool(blocks=5)    # 4 usable
+        pool.begin_request(0, [1, 2, 3, 4, 5])
+        pool.ensure_writable(0, 0, 5)
+        pool.index_prompt(0, [1, 2, 3, 4, 5])
+        pool.release(0)
+        assert pool.blocks_in_use() == 0
+        assert pool.probe([1, 2, 3, 4, 5]) == 4
+        # Demand beyond the free list (3 blocks needed, 2 free) forces
+        # LRU eviction of the cached chain — probe must miss after.
+        pool.begin_request(0, list(range(10, 19)))
+        pool.ensure_writable(0, 0, 9)
+        assert pool.stats()["kv_evictions_total"] > 0
+        assert pool.probe([1, 2, 3, 4, 5]) == 0
+
+    def test_ensure_writable_after_release_is_noop(self):
+        """Router cancel() can release a slot between the batcher's
+        active-snapshot and its ensure_writable call — recreating the
+        chain there would leak blocks forever (nothing releases a
+        ghost chain); the call must no-op instead."""
+        pool, table, _ = self._pool()
+        pool.begin_request(0, [1, 2, 3, 4, 5])
+        pool.ensure_writable(0, 0, 5)
+        pool.release(0)                      # concurrent cancel landed
+        pool.ensure_writable(0, 5, 1)        # batcher's stale dispatch
+        assert pool.blocks_in_use() == 0     # no ghost allocation
+        assert (table[0] == 0).all()         # row stays all-trash
+
+    def test_forced_evict_fault_drops_cache(self):
+        pool, _, _ = self._pool()
+        pool.begin_request(0, [1, 2, 3, 4, 5])
+        pool.ensure_writable(0, 0, 5)
+        pool.index_prompt(0, [1, 2, 3, 4, 5])
+        pool.release(0)
+        assert pool.probe([1, 2, 3, 4, 5]) > 0
+        with faults.inject("serve:step=0,mode=evict"):
+            pool.begin_request(1, [9, 9, 9, 9, 9])
+            pool.ensure_writable(1, 0, 5)    # first alloc fires evict
+        assert pool.probe([1, 2, 3, 4, 5]) == 0
+        assert pool.stats()["kv_evictions_total"] >= 2
+
+    def test_prefix_trie_partial_and_mid_block_divergence(self):
+        from horovod_tpu.serve.kv import PrefixIndex
+
+        idx = PrefixIndex(4)
+        idx.insert([1, 2, 3, 4, 5, 6], [10, 11])   # 1 full + partial(2)
+        blocks, partial = idx.lookup([1, 2, 3, 4, 5, 6, 7])
+        assert blocks == [10] and partial == (11, 2)
+        # Divergence inside the first block: usable as partial source.
+        blocks, partial = idx.lookup([1, 2, 9, 9])
+        assert blocks == [] and partial == (10, 2)
+        freed = idx.remove_subtree(10)
+        assert sorted(freed) == [10, 11]           # subtree pruned
+        assert idx.lookup([1, 2, 3, 4, 5, 6]) == ([], None)
+
+
+class TestSpeculative:
+    """ISSUE 10: speculative decoding — accepted-prefix semantics make
+    spec greedy decode token-identical to plain greedy decode, for any
+    drafter quality."""
+
+    def _spec_engine(self, model_and_params, drafter, k, **kw):
+        model, params = model_and_params
+        kw.setdefault("max_slots", 2)
+        kw.setdefault("prefill_buckets", (8, 16))
+        kw.setdefault("max_seq_len", 32)
+        return InferenceEngine(model, params, kv_cache="paged",
+                               kv_block=4, drafter=drafter, spec_k=k,
+                               **kw)
+
+    def _run_spec(self, engine, slot, prompt, n):
+        toks = [engine.start(slot, prompt, SamplingParams(
+            max_new_tokens=n, spec=True))]
+        while len(toks) < n:
+            toks.extend(engine.step()[slot])
+        engine.release(slot)
+        return toks[:n]
+
+    @pytest.mark.parametrize("k", [1, 2, 4])
+    def test_greedy_identity_self_drafter(self, model_and_params, k):
+        """Perfect drafter (the target itself): every draft accepted,
+        output identical to plain greedy decode for K in {1,2,4}."""
+        model, params = model_and_params
+        eng = self._spec_engine(model_and_params, (model, params), k)
+        for prompt in ([3, 14, 15], [1], list(range(10))):
+            got = self._run_spec(eng, 0, prompt, 7)
+            assert got == _greedy_reference(model, params, prompt, 7), \
+                (k, prompt)
+        stats = eng.kv_stats()
+        # Self-drafting accepts the whole draft: > 1 token per verify.
+        assert stats["spec_accept_per_verify"] == k + 1, stats
+
+    @pytest.mark.parametrize("k", [1, 2, 4])
+    def test_greedy_identity_bad_drafter(self, model_and_params, k):
+        """Adversarial drafter (unrelated random weights): acceptance
+        drops but output identity must hold — a wrong draft costs
+        speed, never correctness."""
+        import jax
+        import jax.numpy as jnp
+
+        model, params = model_and_params
+        dcfg = GPTConfig(vocab_size=VOCAB, n_layer=1, n_head=2,
+                         d_model=16, d_ff=32, max_seq_len=32,
+                         dtype=jnp.float32, param_dtype=jnp.float32)
+        dmodel = GPT(dcfg)
+        dparams = dmodel.init(jax.random.PRNGKey(99),
+                              jnp.zeros((1, 8), jnp.int32))["params"]
+        eng = self._spec_engine(model_and_params, (dmodel, dparams), k)
+        for prompt in ([3, 14, 15], list(range(10))):
+            got = self._run_spec(eng, 0, prompt, 7)
+            assert got == _greedy_reference(model, params, prompt, 7), \
+                (k, prompt)
+        stats = eng.kv_stats()
+        assert stats["spec_accept_per_verify"] >= 1.0
+
+    def test_mixed_spec_and_plain_slots_share_the_batch(
+            self, model_and_params):
+        """A spec-greedy slot and a temperature slot decode in the same
+        dispatch: the spec slot bursts, the sampling slot advances one
+        token per step, both stay correct."""
+        model, params = model_and_params
+        eng = self._spec_engine(model_and_params, (model, params), 3)
+        a = [eng.start(0, [3, 1, 4], SamplingParams(max_new_tokens=9,
+                                                    spec=True))]
+        b = [eng.start(1, [9, 2], SamplingParams(max_new_tokens=9,
+                                                 temperature=0.8,
+                                                 top_k=10))]
+        for _ in range(8):
+            toks = eng.step()
+            a.extend(toks.get(0, []))
+            b.extend(toks.get(1, []))
+            if len(a) >= 9 and len(b) >= 3:
+                break
+        assert a[:9] == _greedy_reference(model, params, [3, 1, 4], 9)
+        assert len(b) >= 3 and all(0 <= t < VOCAB for t in b)
+        # The temperature slot advanced exactly one token per dispatch.
+        assert len(b) < len(a)
+        # The ratio measures the DRAFTER, not the batch mix: the
+        # plain-sampling batchmate must not dilute it toward 1.0.
+        assert eng.kv_stats()["spec_accept_per_verify"] == 4.0
+
+    def test_spec_cap_at_cache_end_is_exact(self, model_and_params):
+        """Acceptance is capped so a burst never writes past the cache:
+        an uncapped spec generation fills exactly the dense contract's
+        ``S - n + 1`` tokens and matches plain greedy throughout."""
+        model, params = model_and_params
+        eng = self._spec_engine(model_and_params, (model, params), 4)
+        prompt = [1, 2]
+        toks = [eng.start(0, prompt, SamplingParams(
+            max_new_tokens=10 ** 6, spec=True))]
+        while not eng.slot_full(0):
+            toks.extend(eng.step()[0])
+        want_n = eng.max_seq_len - len(prompt) + 1
+        assert len(toks) == want_n, (len(toks), want_n)
+        assert toks == _greedy_reference(model, params, prompt, want_n)
+
+    def test_spec_requires_paged(self, model_and_params):
+        model, params = model_and_params
+        with pytest.raises(ValueError, match="paged"):
+            InferenceEngine(model, params, max_slots=2,
+                            prefill_buckets=(8,), max_seq_len=32,
+                            kv_cache="dense", drafter=(model, params))
 
 
 def _batcher(model_and_params, **kw):
@@ -314,6 +648,8 @@ class TestServeFaultSite:
         assert (c.step, c.mode) == (3, "kill")
         c = parse_fault_spec("serve:p=0.2,seed=5,mode=drop")["serve"]
         assert (c.p, c.seed, c.mode) == (0.2, 5, "drop")
+        c = parse_fault_spec("serve:step=2,mode=evict")["serve"]
+        assert (c.step, c.mode) == (2, "evict")
 
     def test_bad_mode_rejected(self):
         with pytest.raises(ValueError, match="mode"):
@@ -332,10 +668,20 @@ class TestServeFaultSite:
     def test_kill_fires_on_decode_only(self):
         with faults.inject("serve:step=1,mode=kill"):
             assert faults.on_serve_request() is None   # wrong hook: no-op
+            assert faults.on_serve_evict() is False    # wrong hook: no-op
             assert faults.on_serve_decode() is False   # event 0
             assert faults.on_serve_decode() is True    # event 1 fires
             assert faults.on_serve_decode() is False   # one-shot
             assert faults.history() == [("serve", 1, "kill")]
+
+    def test_evict_fires_on_allocation_only(self):
+        with faults.inject("serve:step=1,mode=evict"):
+            assert faults.on_serve_request() is None   # wrong hook: no-op
+            assert faults.on_serve_decode() is False   # wrong hook: no-op
+            assert faults.on_serve_evict() is False    # event 0
+            assert faults.on_serve_evict() is True     # event 1 fires
+            assert faults.on_serve_evict() is False    # one-shot
+            assert faults.history() == [("serve", 1, "evict")]
 
 
 class TestReplicaGroups:
@@ -516,6 +862,53 @@ class TestServerRouter:
             router.generate([1], max_new_tokens=2)
 
 
+class TestRouterPrefixAffinity:
+    """ISSUE 10 satellite: requests whose prefix is resident on a
+    replica prefer that replica; benched replicas fall back to the
+    least-loaded spread."""
+
+    def test_pick_prefers_resident_replica(self):
+        router = _fast_router([ReplicaSpec("r0", [("127.0.0.1", 1)]),
+                               ReplicaSpec("r1", [("127.0.0.1", 2)])])
+        key = tuple(range(16))
+        r1 = router._replicas[1]
+        router._note_affinity(key, r1)
+        for _ in range(4):                      # beats round-robin
+            assert router._pick(key) is r1
+        # A benched resident replica falls back to the healthy one.
+        r1.dead_until = time.monotonic() + 60.0
+        assert router._pick(key) is router._replicas[0]
+        r1.dead_until = None
+        # A SATURATED resident spills to the spread — one hot system
+        # prompt must not pin the fleet to a single replica and bench
+        # healthy peers through busy-strikes.
+        r1.inflight = router._affinity_slack + 1
+        assert router._pick(key) is router._replicas[0]
+        r1.inflight = 0
+        assert router._pick(key) is r1          # slack restored: warm wins
+        # Short prompts have no block-aligned key: no affinity.
+        assert router._prefix_key([1, 2, 3]) is None
+
+    def test_same_prefix_requests_land_on_one_replica(self,
+                                                      model_and_params):
+        a = _replica(model_and_params, "aff-a")
+        b = _replica(model_and_params, "aff-b")
+        try:
+            router = _fast_router(
+                [ReplicaSpec("aff-a", [("127.0.0.1", a.port)]),
+                 ReplicaSpec("aff-b", [("127.0.0.1", b.port)])])
+            prompt = list(range(16))           # one full default block
+            for i in range(4):
+                resp = router.generate(prompt, max_new_tokens=2,
+                                       request_id=f"aff-{i}")
+                assert resp.error is None
+            done = sorted(r.completed for r in router._replicas)
+            assert done == [0, 4], done         # all stuck to one
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+
 @pytest.mark.chaos
 class TestChaosServeFailover:
     """ISSUE 3 acceptance: kill a replica mid-decode; every request
@@ -568,3 +961,82 @@ class TestChaosServeFailover:
         finally:
             a.shutdown()
             b.shutdown()
+
+    def test_replica_kill_mid_spec_decode_fails_over(self,
+                                                     model_and_params):
+        """ISSUE 10: a replica killed mid-SPECULATIVE-decode completes
+        on the survivor with greedy-identical output — failover and
+        accepted-prefix semantics compose."""
+        seed = int(os.environ.get("HVD_TPU_CHAOS_SEED", "0"))
+        # Spec bursts shrink the decode-dispatch count (~2/request
+        # here), so fold the soak's step into the in-range window.
+        fault_step = int(os.environ.get("HVD_TPU_CHAOS_STEP", "3")) % 10
+        model, params = model_and_params
+        spec_kw = {"engine_kw": {"kv_cache": "paged", "kv_block": 4,
+                                 "drafter": (model, params),
+                                 "spec_k": 2}}
+        a = _replica(model_and_params, "spec-a", **spec_kw)
+        b = _replica(model_and_params, "spec-b", **spec_kw)
+        try:
+            router = _fast_router(
+                [ReplicaSpec("spec-a", [("127.0.0.1", a.port)]),
+                 ReplicaSpec("spec-b", [("127.0.0.1", b.port)])],
+                retry_policy=RetryPolicy(attempts=10, base_delay_s=0.02,
+                                         max_delay_s=0.2))
+            with faults.inject(f"serve:step={fault_step},seed={seed},"
+                               f"mode=kill"):
+                for i in range(6):
+                    resp = router.generate([i + 1, i + 2, i + 3],
+                                           max_new_tokens=6, spec=True)
+                    assert resp.error is None, (i, resp.error)
+                    assert resp.tokens == _greedy_reference(
+                        model, params, [i + 1, i + 2, i + 3], 6), i
+                kills = [h for h in faults.history() if h[0] == "serve"]
+            assert kills == [("serve", fault_step, "kill")], kills
+            assert sorted([a.dead, b.dead]) == [False, True]
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+
+@pytest.mark.chaos
+class TestChaosServeEvict:
+    """ISSUE 10 satellite: seeded page-eviction pressure
+    (``serve:mode=evict``) — an evicted-then-readmitted prefix must
+    recompute, never serve stale blocks.  ``scripts/chaos_soak.py
+    --mode serve`` loops this with randomized injection points."""
+
+    def test_evict_pressure_never_serves_stale_blocks(self,
+                                                      model_and_params):
+        seed = int(os.environ.get("HVD_TPU_CHAOS_SEED", "0"))
+        # Fold the soak's step into the run's allocation-event window
+        # (shared prefixes keep the allocation count small).
+        fault_step = int(os.environ.get("HVD_TPU_CHAOS_STEP", "3")) % 8
+        model, params = model_and_params
+        b = _batcher(model_and_params,
+                     engine_kw={"kv_cache": "paged", "kv_block": 4})
+        pre = [31, 32, 33, 34, 35, 36, 37, 38]    # shared system prompt
+        # Prime the cache BEFORE arming: the shared prefix is resident,
+        # so whichever allocation event the fault lands on has cached
+        # blocks to evict (otherwise a step-0 firing legitimately
+        # evicts nothing and the eviction-counter assert below would
+        # misread an empty cache as a broken drill).
+        prime = b.submit(pre + [88], SamplingParams(max_new_tokens=4))
+        _pump(b, [prime])
+        # 8 requests x 1 tail-block allocation each = 8 events, so the
+        # folded fault_step (mod 8) always lands on a real allocation.
+        with faults.inject(f"serve:step={fault_step},seed={seed},"
+                           f"mode=evict"):
+            for i in range(8):
+                prompt = pre + [i + 1]
+                r = b.submit(prompt, SamplingParams(max_new_tokens=4))
+                _pump(b, [r])
+                assert r.error is None, (i, r.error)
+                # THE oracle: eviction may cost a recompute, but the
+                # tokens must be exactly what a cold cache produces.
+                assert r.tokens == _greedy_reference(model, params,
+                                                     prompt, 4), i
+            evicts = [h for h in faults.history()
+                      if h[0] == "serve" and h[2].startswith("evict")]
+        assert evicts == [("serve", fault_step, "evict")], evicts
+        assert b.snapshot()["kv_evictions_total"] > 0
