@@ -91,6 +91,19 @@ def main() -> None:
     parser.add_argument("--cpu-mesh", action="store_true",
                         help="force the virtual CPU mesh (functional "
                              "check, not a perf number)")
+    parser.add_argument("--fleet", default=None, metavar="PREFILLxDECODE",
+                        help="disaggregated fleet mode (serve/fleet/): "
+                             "e.g. 1x2 builds 1 prefill + 2 decode "
+                             "replicas behind the role-aware router, "
+                             "drives an open-loop BURSTY workload, and "
+                             "compares tail TTFT + migration overhead "
+                             "against a unified fleet of the same chip "
+                             "count")
+    parser.add_argument("--burst", type=int, default=0,
+                        help="fleet mode: requests per arrival burst "
+                             "(default 2 x --slots)")
+    parser.add_argument("--burst-interval", type=float, default=0.25,
+                        help="fleet mode: seconds between bursts")
     parser.add_argument("--trace", default=None, metavar="DIR",
                         help="write a merged per-run trace artifact "
                              "(Perfetto JSON + critical-path report; "
@@ -134,6 +147,9 @@ def main() -> None:
     model = GPT(cfg)
     rng = jax.random.PRNGKey(args.seed)
     params = model.init(rng, jnp.zeros((1, 8), jnp.int32))["params"]
+    if args.fleet:
+        run_fleet(args, model, params, buckets)
+        return
     drafter = (model, params) if args.drafter == "self" else None
     engine = InferenceEngine(model, params, max_slots=args.slots,
                              prefill_buckets=buckets,
@@ -311,6 +327,200 @@ def main() -> None:
                        "summary": summary, "stats": snap, "rows": rows,
                        "metrics": obs_export.json_snapshot()["metrics"],
                        **({"trace": trace_block} if trace_block else {})},
+                      f, indent=1)
+
+
+def run_fleet(args, model, params, buckets) -> None:
+    """Disaggregated-fleet bench: PREFILLxDECODE replicas behind the
+    role-aware router vs a UNIFIED fleet of the same chip count, both
+    under the same open-loop bursty arrival schedule.  Open loop means
+    arrivals fire on the clock whether or not earlier requests
+    finished — the regime where tail TTFT actually shows queueing, and
+    the number a closed loop structurally hides."""
+    import threading
+
+    import jax
+
+    from horovod_tpu.serve import (ContinuousBatcher, InferenceEngine,
+                                   InferenceServer, ReplicaSpec, Router)
+    from horovod_tpu.serve.metrics import percentile as _pct
+    from horovod_tpu.utils.retry import RetryPolicy
+
+    key = b"serving-bench-fleet-key-0123456"
+    try:
+        p_n, d_n = (int(x) for x in args.fleet.lower().split("x"))
+        if p_n < 1 or d_n < 1:
+            raise ValueError
+    except ValueError:
+        raise SystemExit(f"--fleet expects PREFILLxDECODE (e.g. 1x2), "
+                         f"got {args.fleet!r}")
+
+    py_rng = random.Random(args.seed)
+
+    def mk_prompt():
+        n = py_rng.randint(args.prompt_min, args.prompt_max)
+        return [py_rng.randrange(args.vocab) for _ in range(n)]
+
+    def build(roles):
+        servers = []
+        for i, role in enumerate(roles):
+            engine = InferenceEngine(
+                model, params, max_slots=args.slots,
+                prefill_buckets=buckets, max_seq_len=args.max_seq_len,
+                kv_cache=args.kv_cache or "paged", seed=args.seed)
+            batcher = ContinuousBatcher(engine, max_queue=args.queue_depth,
+                                        default_deadline_s=0, role=role)
+            servers.append(InferenceServer(batcher, key=key,
+                                           name=f"{role}-{i}",
+                                           host="127.0.0.1"))
+        router = Router(
+            [ReplicaSpec(s.name, [("127.0.0.1", s.port)], role=s.role)
+             for s in servers], key,
+            retry_policy=RetryPolicy(attempts=8, base_delay_s=0.05,
+                                     max_delay_s=0.5))
+        return servers, router
+
+    burst = args.burst or 2 * args.slots
+
+    def drive(router, prompts, tag):
+        """Open-loop bursty arrivals: ``burst`` requests fire together,
+        then the clock (not completion) gates the next burst.  ``tag``
+        namespaces request ids per drive — warmup and measured share a
+        router, and a reused id would dedupe-hit the warmup response
+        instead of running the measured request."""
+        results, lock, threads = [], threading.Lock(), []
+
+        def fire(j, prompt):
+            t0 = time.perf_counter()
+            try:
+                resp = router.generate(prompt,
+                                       max_new_tokens=args.max_new_tokens,
+                                       request_id=f"{tag}-{j}")
+                err, ttft = resp.error, resp.ttft_ms
+                migrated = resp.migrated_to is not None
+                mig_ms = resp.migrate_ms
+                n_tok = len(resp.tokens or ())
+            except Exception as e:   # router gave up: a lost request
+                err, ttft, migrated, mig_ms, n_tok = (str(e), None,
+                                                      False, None, 0)
+            with lock:
+                results.append({
+                    "request": f"{tag}-{j}", "error": err,
+                    "ttft_ms": ttft, "migrated": migrated,
+                    "migrate_ms": mig_ms, "tokens": n_tok,
+                    "latency_ms": round(
+                        (time.perf_counter() - t0) * 1e3, 3)})
+
+        t_start = time.perf_counter()
+        for j, prompt in enumerate(prompts):
+            if j and j % burst == 0:
+                time.sleep(args.burst_interval)
+            th = threading.Thread(target=fire, args=(j, prompt),
+                                  daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=300.0)
+        with lock:
+            done_ids = {r["request"] for r in results}
+            # Abandoned (still-hung) request threads never appended a
+            # row: record them as failed instead of letting a lost
+            # request silently vanish from the summary's failed count.
+            for j in range(len(prompts)):
+                if f"{tag}-{j}" not in done_ids:
+                    results.append({"request": f"{tag}-{j}",
+                                    "error": "hung_past_join_timeout",
+                                    "ttft_ms": None, "migrated": False,
+                                    "migrate_ms": None, "tokens": 0,
+                                    "latency_ms": None})
+        return results, time.perf_counter() - t_start
+
+    # One prompt set, generated ONCE and reused by both phases: the
+    # fleet-vs-unified comparison must differ only in fleet shape, not
+    # in workload (a shared RNG stream across phases would hand the
+    # second phase different prompt lengths and prefix behavior).
+    warm_n = max(args.warmup, 2 * (p_n + d_n))
+    warm_prompts = [mk_prompt() for _ in range(warm_n)]
+    measured_prompts = [mk_prompt() for _ in range(args.requests)]
+
+    def phase(roles):
+        servers, router = build(roles)
+        try:
+            # Warmup compiles every replica's programs (prefill buckets,
+            # decode, import) so compiles don't bill measured TTFT.
+            drive(router, warm_prompts, "warm")
+            rows, elapsed = drive(router, measured_prompts, "fleet-req")
+            stats = router.replica_stats(timeout=5.0)
+            occ = {}
+            for entry in stats.values():
+                if "stats" not in entry:
+                    continue
+                occ.setdefault(entry["role"], []).append(
+                    entry["stats"].get("occupancy_mean") or 0.0)
+            occ = {role: round(sum(v) / len(v), 4)
+                   for role, v in occ.items() if v}
+            return rows, elapsed, occ
+        finally:
+            for s in servers:
+                s.shutdown()
+
+    fleet_rows, fleet_s, fleet_occ = phase(
+        ["prefill"] * p_n + ["decode"] * d_n)
+    unified_rows, unified_s, _ = phase(["unified"] * (p_n + d_n))
+
+    for row in fleet_rows:
+        print(json.dumps(row), flush=True)
+
+    def agg(rows, elapsed):
+        ok = [r for r in rows if r["error"] is None]
+        ttfts = [r["ttft_ms"] for r in ok if r["ttft_ms"] is not None]
+        toks = sum(r["tokens"] for r in ok)
+        return {
+            "failed": len(rows) - len(ok),
+            "tok_per_s": round(toks / elapsed, 3) if elapsed > 0 else 0.0,
+            "ttft_ms_p50": (round(_pct(ttfts, 50), 3) if ttfts else None),
+            "ttft_ms_p99": (round(_pct(ttfts, 99), 3) if ttfts else None),
+        }
+
+    fa, ua = agg(fleet_rows, fleet_s), agg(unified_rows, unified_s)
+    migs = [r["migrate_ms"] for r in fleet_rows
+            if r["migrate_ms"] is not None]
+    summary = {
+        "metric": "serving_fleet_tok_per_s",
+        "value": fa["tok_per_s"],
+        "unit": "tok/s",
+        "fleet": args.fleet,
+        "requests": args.requests,
+        "burst": burst,
+        "failed": fa["failed"],
+        "ttft_ms_p50": fa["ttft_ms_p50"],
+        "ttft_ms_p99": fa["ttft_ms_p99"],
+        "migrations": len(migs),
+        "migrate_ms_mean": (round(sum(migs) / len(migs), 3)
+                            if migs else None),
+        "migrate_ms_p99": (round(_pct(migs, 99), 3) if migs else None),
+        "occupancy_prefill": fleet_occ.get("prefill"),
+        "occupancy_decode": fleet_occ.get("decode"),
+        # Same chip count, same arrival schedule, no disaggregation:
+        # the comparison baseline for the tail-TTFT claim.
+        "unified_failed": ua["failed"],
+        "unified_tok_per_s": ua["tok_per_s"],
+        "unified_ttft_ms_p50": ua["ttft_ms_p50"],
+        "unified_ttft_ms_p99": ua["ttft_ms_p99"],
+        "model": {"layers": args.layers, "d_model": args.d_model,
+                  "heads": args.heads, "vocab": args.vocab},
+    }
+    print(json.dumps(summary))
+    if args.out:
+        from horovod_tpu.obs import export as obs_export
+
+        with open(args.out, "w") as f:
+            json.dump({"platform": jax.default_backend(),
+                       "device_kind": jax.devices()[0].device_kind,
+                       "summary": summary,
+                       "rows": fleet_rows,
+                       "unified_rows": unified_rows,
+                       "metrics": obs_export.json_snapshot()["metrics"]},
                       f, indent=1)
 
 

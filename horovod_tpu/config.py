@@ -83,12 +83,20 @@ _FAULT_MODES = {
     "checkpoint": ("corrupt", "partial", "stall", "partial-manifest",
                    "crash-before-rename"),
     # serve: drop/delay fire at the serving endpoint's request handler;
-    # kill fires at the continuous batcher's decode dispatch (replica
-    # death mid-decode — the router-failover drill); evict fires at the
-    # paged KV pool's block-allocation events (serve/kv/) and force-
-    # evicts every unreferenced cached block — seeded page-eviction
-    # pressure, the stale-prefix drill.
-    "serve": ("drop", "delay", "kill", "evict"),
+    # kill fires at the continuous batcher's step dispatch (decode on
+    # decode/unified replicas, the KV-migration handoff on prefill
+    # replicas — replica death mid-stream, the router-failover drill);
+    # evict fires at the paged KV pool's block-allocation events
+    # (serve/kv/) and force-evicts every unreferenced cached block —
+    # seeded page-eviction pressure, the stale-prefix drill.  The
+    # migrate* modes fire at the KV-transfer boundary of the
+    # disaggregated fleet (serve/fleet/migration.py): `migrate` corrupts
+    # one block AFTER the sender digests it (the receiver's digest check
+    # must reject the transfer and the request must finish on a correct
+    # recompute path — never with wrong tokens); `migrate-drop` fails
+    # the transfer on the wire; `migrate-delay` sleeps delay_ms at it.
+    "serve": ("drop", "delay", "kill", "evict", "migrate",
+              "migrate-drop", "migrate-delay"),
     # dcn: fires ONLY at the cross-pod exchange step of a hierarchical
     # collective schedule (topo/schedule.py) — the slow-tier link is
     # the one that actually fails in multi-pod fleets.  drop/partition
@@ -455,6 +463,15 @@ class Config:
     serve_kv_block: int = 16                  # HVD_TPU_SERVE_KV_BLOCK (tokens per KV block)
     serve_kv_blocks: int = 0                  # HVD_TPU_SERVE_KV_BLOCKS (pool budget in blocks; 0 = auto)
     serve_spec_k: int = 4                     # HVD_TPU_SERVE_SPEC_K (draft tokens per speculative verify step)
+    # Disaggregated prefill/decode fleet (horovod_tpu/serve/fleet/;
+    # the role-heterogeneous fleet organization of the 100k-GPU
+    # collectives line — prefill is compute-bound, decode memory-bound)
+    fleet_role: str = "unified"               # HVD_TPU_FLEET_ROLE (prefill|decode|unified: this replica's class)
+    fleet_migrate_chunk: int = 1 << 20        # HVD_TPU_FLEET_MIGRATE_CHUNK (KV-transfer bytes per wire frame)
+    fleet_scale_out_queue: float = 4.0        # HVD_TPU_FLEET_SCALE_OUT_QUEUE (per-replica queue depth that saturates a role)
+    fleet_scale_out_ttft_ms: float = 0.0      # HVD_TPU_FLEET_SCALE_OUT_TTFT_MS (p99 TTFT that saturates a role; 0 = off)
+    fleet_scale_in_idle_s: float = 30.0       # HVD_TPU_FLEET_SCALE_IN_IDLE_S (role idle window before drain-and-retire)
+    fleet_drain_deadline_s: float = 30.0      # HVD_TPU_FLEET_DRAIN_DEADLINE_S (max drain wait before forced retire)
 
     # --- fault injection (horovod_tpu/faults.py; no reference analogue) ---
     fault_spec: Optional[str] = None          # HVD_TPU_FAULT_SPEC
@@ -542,6 +559,17 @@ class Config:
             serve_kv_block=_env_pos_int("SERVE_KV_BLOCK", 16),
             serve_kv_blocks=_env_int("SERVE_KV_BLOCKS", 0),
             serve_spec_k=_env_pos_int("SERVE_SPEC_K", 4),
+            fleet_role=_env_choice("FLEET_ROLE", "unified",
+                                   ("prefill", "decode", "unified"))
+            or "unified",
+            fleet_migrate_chunk=_env_pos_int("FLEET_MIGRATE_CHUNK",
+                                             1 << 20),
+            fleet_scale_out_queue=_env_float("FLEET_SCALE_OUT_QUEUE", 4.0),
+            fleet_scale_out_ttft_ms=_env_float("FLEET_SCALE_OUT_TTFT_MS",
+                                               0.0),
+            fleet_scale_in_idle_s=_env_float("FLEET_SCALE_IN_IDLE_S", 30.0),
+            fleet_drain_deadline_s=_env_float("FLEET_DRAIN_DEADLINE_S",
+                                              30.0),
             fault_spec=_validated_fault_spec(_env("FAULT_SPEC")),
             cache_capacity=_env_opt_int("CACHE_CAPACITY"),
             mesh_axis_name=_env("MESH_AXIS_NAME", "hvd") or "hvd",

@@ -145,6 +145,7 @@ class ElasticDriver:
         self._hosts: Dict[str, int] = {}         # guarded-by: _lock
         self._failures: Dict[str, int] = {}      # guarded-by: _lock
         self._blacklist: Dict[str, float] = {}   # guarded-by: _lock (host -> blacklisted-at)
+        self._reserved: Dict[str, int] = {}      # guarded-by: _lock (host -> placed replicas)
         self._poll_failures = 0                  # guarded-by: _lock (consecutive discovery errors)
         self._callbacks: List[Callable[[Set[str], Set[str]], None]] = []
         self._lock = threading.Lock()
@@ -212,6 +213,38 @@ class ElasticDriver:
         with self._lock:
             return self._blacklisted_locked(host)
 
+    # --- placement (serving-fleet scaling hooks) ----------------------------
+
+    def reserve_slot(self) -> Optional[str]:
+        """Reserve one slot for a new replica on a discovered,
+        non-blacklisted host with free capacity; returns the host, or
+        None when the fleet is out of room.  The serving
+        ``FleetController``'s scale-out placement hook — discovery
+        keeps deciding WHERE capacity exists, the controller decides
+        WHEN to use it."""
+        with self._lock:
+            for host in sorted(self._hosts):
+                if self._blacklisted_locked(host):
+                    continue
+                free = self._hosts[host] - self._reserved.get(host, 0)
+                if free > 0:
+                    self._reserved[host] = self._reserved.get(host, 0) + 1
+                    return host
+        return None
+
+    def release_slot(self, host: str) -> None:
+        """Return a reserved slot (replica retired, or launch failed)."""
+        with self._lock:
+            n = self._reserved.get(host, 0)
+            if n <= 1:
+                self._reserved.pop(host, None)
+            else:
+                self._reserved[host] = n - 1
+
+    def reserved_slots(self) -> int:
+        with self._lock:
+            return sum(self._reserved.values())
+
     # --- polling -----------------------------------------------------------
 
     def poll_once(self) -> bool:
@@ -243,6 +276,12 @@ class ElasticDriver:
             new = set(found)
             changed = found != self._hosts
             self._hosts = found
+            # Reconcile placement reservations with membership: a host
+            # that left took its placed replicas with it, so carrying
+            # its reservation forward would read the host as full
+            # forever when it rejoins — permanently leaked capacity.
+            for gone in [h for h in self._reserved if h not in found]:
+                del self._reserved[gone]
         if changed:
             added, removed = new - old, old - new
             logger.info("Membership change: +%s -%s",

@@ -19,8 +19,11 @@ rpc        ``runner/common/network.py`` BasicClient calls        ``drop``/``dela
 checkpoint ``ckpt/store.py`` write + ``checkpoint.py`` save      ``corrupt``/``partial``/``stall``/
                                                                  ``partial-manifest``/``crash-before-rename``
 serve      ``serve/server.py`` request handler (drop/delay);     ``drop``/``delay``/``kill``/
-           ``serve/batcher.py`` decode dispatch (kill);          ``evict``
-           ``serve/kv/pool.py`` block allocation (evict)
+           ``serve/batcher.py`` step dispatch (kill: decode on   ``evict``/``migrate``/
+           decode replicas, the migration handoff on prefill     ``migrate-drop``/
+           replicas); ``serve/kv/pool.py`` block allocation      ``migrate-delay``
+           (evict); ``serve/fleet/migration.py`` KV-transfer
+           boundary (migrate*)
 dcn        ``topo/schedule.py`` cross-pod exchange step only     ``drop``/``delay``/``partition``
            (trace time; intra-pod phases never fire)
 ========== ===================================================== =====================
@@ -57,7 +60,8 @@ __all__ = [
     "configure", "clear", "inject", "active_spec", "history",
     "on_collective", "on_fusion", "on_accumulate", "on_discovery_script",
     "on_discovery_hosts", "on_rpc", "on_checkpoint_save",
-    "on_serve_request", "on_serve_decode", "on_serve_evict", "on_dcn",
+    "on_serve_request", "on_serve_decode", "on_serve_evict",
+    "on_serve_migrate", "on_dcn",
 ]
 
 
@@ -358,14 +362,17 @@ def on_serve_request(op: str = "") -> Optional[str]:
     slow replica) and returns None; ``drop`` returns ``"drop"`` — the
     server closes the connection without a response, so the router sees
     a mid-frame peer death, exactly what a crashed replica looks like
-    on the wire.  ``kill``/``evict`` clauses never fire here (their
-    event coordinates are the decode dispatch, :func:`on_serve_decode`,
-    and the KV block allocation, :func:`on_serve_evict`)."""
+    on the wire.  ``kill``/``evict``/``migrate*`` clauses never fire
+    here (their event coordinates are the batcher step dispatch,
+    :func:`on_serve_decode`, the KV block allocation,
+    :func:`on_serve_evict`, and the fleet's KV-transfer boundary,
+    :func:`on_serve_migrate`)."""
     plan = _active
     if plan is None:
         return None
     st = plan.site("serve")
-    if st is None or st.clause.mode in ("kill", "evict"):
+    if st is None or st.clause.mode in ("kill", "evict") \
+            or (st.clause.mode or "").startswith("migrate"):
         return None
     at = st.counter
     if st.should_fire():
@@ -380,11 +387,14 @@ def on_serve_request(op: str = "") -> Optional[str]:
 
 def on_serve_decode() -> bool:
     """Site ``serve`` (mode ``kill``) — fires at the continuous
-    batcher's decode dispatch: each event is one real decode step, so
-    ``serve:step=N,mode=kill`` reproducibly kills whichever replica
-    executes the N-th decode in the process.  Returns True when the
-    replica must die mid-decode (the batcher raises ``ReplicaKilled``
-    and fails its in-flight requests — the router-failover drill)."""
+    batcher's step dispatch: each event is one real decode step (or,
+    on a prefill-role fleet replica, one KV-migration handoff — prefill
+    replicas never dispatch decode, so the handoff is their step
+    event), so ``serve:step=N,mode=kill`` reproducibly kills whichever
+    replica executes the N-th dispatch in the process.  Returns True
+    when the replica must die mid-stream (the batcher raises
+    ``ReplicaKilled`` and fails its in-flight requests — the
+    router-failover drill)."""
     plan = _active
     if plan is None:
         return False
@@ -418,6 +428,35 @@ def on_serve_evict() -> bool:
         plan.fire("serve", "evict", at)
         return True
     return False
+
+
+def on_serve_migrate() -> Optional[str]:
+    """Site ``serve`` (modes ``migrate``/``migrate-drop``/
+    ``migrate-delay``) — fires at the disaggregated fleet's KV-transfer
+    boundary (``serve/fleet/migration.py``): each event is one
+    prefill→decode KV migration, so ``serve:step=N,mode=migrate``
+    reproducibly damages the N-th migration in the process.  Returns
+    the mode for the sender to apply: ``migrate`` corrupts one block's
+    payload AFTER the digests were computed (the receiver's per-block
+    digest check must reject the transfer — the wrong-tokens-never
+    drill), ``migrate-drop`` fails the transfer on the wire, and
+    ``migrate-delay`` sleeps ``delay_ms`` here (a congested DCN link
+    under the KV stream) and returns None."""
+    plan = _active
+    if plan is None:
+        return None
+    st = plan.site("serve")
+    if st is None or not (st.clause.mode or "").startswith("migrate"):
+        return None
+    at = st.counter
+    if st.should_fire():
+        mode = st.clause.mode or "migrate"
+        plan.fire("serve", mode, at)
+        if mode == "migrate-delay":
+            time.sleep(st.clause.delay_ms / 1000.0)
+            return None
+        return mode
+    return None
 
 
 def on_checkpoint_save(step: int) -> Optional[str]:

@@ -487,6 +487,59 @@ def on_spec_accept_ratio(ratio: float) -> None:
                  "emitted tokens per speculative verify step").set(ratio)
 
 
+# --- disaggregated serving fleet (serve/fleet/; docs/serving.md) -------------
+
+def on_fleet_migration(nbytes: int, ok: bool, ms: float) -> None:
+    """One prefill→decode KV migration attempt: outcome-labelled count,
+    payload bytes (only successful transfers bill the wire), and the
+    per-migration latency gauge the bench reads."""
+    if not _m.enabled():
+        return
+    reg = _reg()
+    reg.counter("hvd_tpu_fleet_migrations_total",
+                "prefill->decode KV migrations").labels(
+                    outcome="ok" if ok else "failed").inc()
+    if ok:
+        reg.counter("hvd_tpu_fleet_migrated_bytes_total",
+                    "KV bytes moved prefill->decode").inc(nbytes)
+        reg.gauge("hvd_tpu_fleet_migrate_ms",
+                  "last KV migration's wall time").set(ms)
+
+
+def on_fleet_directory_hit() -> None:
+    """One request routed to resident KV by the global prefix
+    directory (a cache hit anywhere in the fleet)."""
+    if not _m.enabled():
+        return
+    _reg().counter("hvd_tpu_fleet_directory_hits_total",
+                   "requests routed by the global prefix "
+                   "directory").inc()
+
+
+def on_fleet_scale_event(direction: str) -> None:
+    """One elastic fleet action: ``direction`` is ``out`` (replica
+    launched) or ``in`` (replica drained and retired)."""
+    if not _m.enabled():
+        return
+    _reg().counter("hvd_tpu_fleet_scale_events_total",
+                   "fleet controller scale actions").labels(
+                       direction=direction).inc()
+
+
+def on_fleet_role_occupancy(role: str, occupancy: float,
+                            replicas: int) -> None:
+    """Per-role fleet load after a controller poll: mean slot
+    occupancy and live replica count for one role class."""
+    if not _m.enabled():
+        return
+    reg = _reg()
+    reg.gauge("hvd_tpu_fleet_role_occupancy",
+              "mean slot occupancy per replica role").labels(
+                  role=role).set(occupancy)
+    reg.gauge("hvd_tpu_fleet_replicas",
+              "live replicas per role").labels(role=role).set(replicas)
+
+
 # --- autotune decision log ---------------------------------------------------
 
 # Bounded decision log: the JSON snapshot carries it verbatim (the

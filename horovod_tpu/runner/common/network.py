@@ -96,6 +96,62 @@ class TraceResponse:
         self.pid = pid
 
 
+class KvMigrateRequest:
+    """One chunk of a live KV migration (disaggregated serving,
+    ``serve/fleet/migration.py``): a prefill replica streams a
+    request's paged KV blocks to a decode replica over this HMAC
+    control plane — the block table is the transfer manifest, so only
+    live, non-trash blocks move.  ``manifest`` rides the first frame
+    (``seq == 0``) and carries per-block sha256 digests the receiver
+    verifies before binding anything into its own pool; ``k_blocks`` /
+    ``v_blocks`` are ``[n_layer, frame_blocks, block, H, D]`` numpy
+    arrays, chunked so each frame stays under
+    ``HVD_TPU_FLEET_MIGRATE_CHUNK`` bytes."""
+
+    def __init__(self, request_id: str, seq: int, total: int,
+                 k_blocks, v_blocks, manifest: Optional[dict] = None):
+        self.request_id = request_id
+        self.seq = seq
+        self.total = total
+        self.k_blocks = k_blocks
+        self.v_blocks = v_blocks
+        self.manifest = manifest
+
+
+class KvMigrateResponse:
+    """Per-frame ack; the FINAL frame's response reports the whole
+    transfer: ``error`` is None once the digests verified and the
+    request was adopted into the decode replica's batcher, else
+    ``digest_mismatch`` / ``busy`` / ``draining`` / ``replica_dead`` —
+    the sender falls back to decoding locally (never wrong tokens)."""
+
+    def __init__(self, request_id: str, error: Optional[str] = None):
+        self.request_id = request_id
+        self.error = error
+
+
+class CollectRequest:
+    """Fetch the finished generation a migrated request produced on
+    this (decode) replica; blocks until the adopted request completes
+    and answers with a ``GenerateResponse``."""
+
+    def __init__(self, request_id: str):
+        self.request_id = request_id
+
+
+class DrainRequest:
+    """Stop admitting new work on this serving replica (drain-and-
+    retire lifecycle, ``serve/fleet/controller.py``): queued and
+    in-flight requests finish, new submissions answer ``draining`` so
+    the router shifts load elsewhere.  ``cancel=True`` reverses an
+    in-progress drain (the abandon path when the retire turns out
+    impossible).  Answered with ``AckResponse``."""
+
+    def __init__(self, reason: str = "", cancel: bool = False):
+        self.reason = reason
+        self.cancel = cancel
+
+
 class DropConnection(Exception):
     """Raised from a ``BasicService._handle`` override to close the
     connection without writing a response — the wire signature of a

@@ -23,17 +23,28 @@ training framework's existing layers:
   trie over token IDs), LRU eviction, and speculative decoding
   (drafter + one-forward batched verification, token-identical to
   plain greedy decode)
+* :mod:`~horovod_tpu.serve.fleet` — the disaggregated prefill/decode
+  tier: role-split replicas with live KV migration over the HMAC wire
+  (per-block digests, token-identical continuation), a router-tier
+  global prefix directory, and a :class:`FleetController` driving
+  per-role elastic scale-out / drain-and-retire from queue-depth and
+  TTFT signals
 
 Chaos: the ``serve`` fault site (``HVD_TPU_FAULT_SPEC``) drops/delays
-requests at the endpoint and kills a replica mid-decode
+requests at the endpoint, kills a replica mid-decode or mid-migration,
+and damages KV transfers at the migration boundary
 (docs/serving.md has recipes).
 """
 
 from .batcher import (  # noqa: F401
-    ContinuousBatcher, QueueFullError, ReplicaKilledError, ServeRequest,
+    ContinuousBatcher, QueueFullError, ReplicaDrainingError,
+    ReplicaKilledError, ServeRequest,
 )
 from .engine import (  # noqa: F401
     InferenceEngine, PromptTooLongError, SamplingParams,
+)
+from .fleet import (  # noqa: F401
+    FleetController, MigrationError, PrefixDirectory, ReplicaLauncher,
 )
 from .kv import (  # noqa: F401
     BlockPool, KVPoolExhaustedError, PrefixIndex,

@@ -50,6 +50,12 @@ class ReplicaKilledError(RuntimeError):
     was stopped with requests in flight)."""
 
 
+class ReplicaDrainingError(RuntimeError):
+    """This replica is draining (drain-and-retire lifecycle): in-flight
+    work finishes, new admissions answer ``draining`` on the wire so
+    the router shifts load elsewhere without striking it."""
+
+
 @dataclasses.dataclass
 class ServeRequest:
     """One in-flight generation; ``done`` fires exactly once, with
@@ -74,6 +80,14 @@ class ServeRequest:
     # batcher thread reconstructs queued/prefill/decode phase spans
     # against it, so the request's trace crosses the thread handoff.
     trace_ctx: Optional[tuple] = None
+    # Disaggregated fleet (serve/fleet/): the decode target the router
+    # asked this (prefill) replica to migrate to, the wire-received KV
+    # payload on the adopting (decode) side, and the migration outcome
+    # the response frame reports.
+    migrate_to: Optional[tuple] = None      # (name, [(ip, port), ...])
+    kv_import: Optional[tuple] = None       # (manifest, k_blocks, v_blocks)
+    migrated: bool = False
+    migrate_ms: Optional[float] = None
 
     def finish(self, error: Optional[str] = None) -> None:
         if self.done.is_set():
@@ -94,7 +108,8 @@ class ContinuousBatcher:
     def __init__(self, engine: InferenceEngine, *,
                  max_queue: Optional[int] = None,
                  max_prefill_per_step: int = 1,
-                 default_deadline_s: Optional[float] = None):
+                 default_deadline_s: Optional[float] = None,
+                 role: Optional[str] = None):
         cfg = resolved_config()
         self.engine = engine
         self.max_queue = int(max_queue if max_queue is not None
@@ -104,11 +119,21 @@ class ContinuousBatcher:
             default_deadline_s if default_deadline_s is not None
             else cfg.serve_deadline_seconds)
         self.max_new_tokens_cap = cfg.serve_max_new_tokens
+        # Fleet role (serve/fleet/): a prefill replica hands each
+        # request's KV to its decode target after the first token; the
+        # role is a scheduling policy, not a capability — every replica
+        # can run a full generation (the recompute fallback path).
+        self.role = (role or cfg.fleet_role).lower()
+        if self.role not in ("prefill", "decode", "unified"):
+            raise ValueError(f"unknown fleet role {self.role!r}; "
+                             f"expected prefill|decode|unified")
+        self._migrator = None    # set by the server on prefill replicas
         self.stats = ServingStats()
         self._lock = threading.Lock()
         self._queue: List[ServeRequest] = []         # guarded-by: _lock
         self._slots: Dict[int, ServeRequest] = {}    # guarded-by: _lock
         self._killed: Optional[str] = None           # guarded-by: _lock
+        self._draining = False                       # guarded-by: _lock
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._wake = threading.Event()
@@ -119,14 +144,50 @@ class ContinuousBatcher:
     def dead(self) -> bool:
         return self._killed is not None
 
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self) -> None:
+        """Enter the drain-and-retire lifecycle: stop admitting, let
+        queued + in-flight work finish (the fleet controller retires
+        the replica once it runs dry)."""
+        with self._lock:
+            if self._draining or self._killed is not None:
+                return
+            self._draining = True
+        logger.info("serving replica draining (no new admissions)")
+
+    def undrain(self) -> None:
+        """Cancel a drain and admit again — the abandon path when a
+        retire turns out impossible (e.g. the fleet's last replica): a
+        replica left draining with no peers would starve the fleet
+        forever."""
+        with self._lock:
+            if not self._draining:
+                return
+            self._draining = False
+        logger.info("serving replica drain cancelled (admitting again)")
+
+    def set_migrator(self, migrator) -> None:
+        """Install the prefill→decode handoff callable
+        (``migrator(engine, slot, req) -> bool``; the server wires
+        ``serve/fleet/migration.migrate_slot`` here on prefill
+        replicas)."""
+        self._migrator = migrator
+
     def submit(self, prompt: Sequence[int],
                sampling: Optional[SamplingParams] = None,
                request_id: Optional[str] = None,
-               deadline_s: Optional[float] = None) -> ServeRequest:
+               deadline_s: Optional[float] = None,
+               migrate_to: Optional[tuple] = None) -> ServeRequest:
         """Enqueue one generation.  Raises :class:`QueueFullError` at
-        capacity and :class:`ReplicaKilledError` on a dead replica;
-        oversized prompts raise :class:`PromptTooLongError` up front
-        (admitting them would waste a slot to fail later)."""
+        capacity, :class:`ReplicaKilledError` on a dead replica and
+        :class:`ReplicaDrainingError` on a draining one; oversized
+        prompts raise :class:`PromptTooLongError` up front (admitting
+        them would waste a slot to fail later).  ``migrate_to`` is the
+        decode target a prefill-role replica hands this request's KV to
+        after the first token."""
         sampling = sampling or SamplingParams()
         if sampling.max_new_tokens > self.max_new_tokens_cap:
             sampling = dataclasses.replace(
@@ -148,17 +209,59 @@ class ContinuousBatcher:
             else None,
             submitted_at=time.monotonic(),
             prefix_hit_tokens=hit,
-            trace_ctx=trace_mod.current())
+            trace_ctx=trace_mod.current(),
+            migrate_to=migrate_to)
+        self._admit(req)
+        return req
+
+    def adopt(self, manifest: dict, k_blocks, v_blocks) -> ServeRequest:
+        """Adopt a migrated request (serve/fleet/migration.py): the
+        digest-verified KV payload is queued like a submission, and the
+        batcher thread binds it into the pool in place of a prefill —
+        generation continues token-identically from the sender's
+        state.  Same admission contract as :meth:`submit` (queue bound,
+        killed/draining refusal, poison-prompt rejection)."""
+        s = manifest["sampling"]
+        sampling = SamplingParams(
+            max_new_tokens=int(s["max_new_tokens"]),
+            temperature=float(s["temperature"]), top_k=int(s["top_k"]),
+            stop_token=s["stop_token"], spec=bool(s["spec"]))
+        prompt = [int(t) for t in manifest["prompt"]]
+        if self.engine.kv_mode != "paged":
+            raise ValueError("KV adoption requires the paged cache "
+                             "(HVD_TPU_SERVE_KV=paged)")
+        # Poison defense on the receiving side too: the sender already
+        # validated, but a pool-poisoning prompt must die at EVERY
+        # admission boundary, not only the first.
+        self.engine.check_prompt_tokens(prompt)
+        if not manifest.get("tokens"):
+            raise ValueError("migration manifest carries no emitted "
+                             "tokens — nothing to continue from")
+        limit = manifest.get("deadline_s")
+        now = time.monotonic()
+        req = ServeRequest(
+            request_id=manifest["request_id"], prompt=prompt,
+            sampling=sampling,
+            deadline=(now + limit) if limit and limit > 0 else None,
+            submitted_at=now,
+            trace_ctx=trace_mod.current(),
+            kv_import=(manifest, k_blocks, v_blocks))
+        self._admit(req)
+        return req
+
+    def _admit(self, req: ServeRequest) -> None:
         with self._lock:
             if self._killed is not None:
                 raise ReplicaKilledError(self._killed)
+            if self._draining:
+                raise ReplicaDrainingError(
+                    "replica draining (no new admissions)")
             if len(self._queue) >= self.max_queue:
                 self.stats.record_rejected()
                 raise QueueFullError(
                     f"admission queue full ({self.max_queue} waiting)")
             self._queue.append(req)
         self._wake.set()
-        return req
 
     def cancel(self, request_id: str) -> bool:
         """Abandon a queued or in-flight request (router failover: the
@@ -271,23 +374,39 @@ class ContinuousBatcher:
                 slot = free[0]
                 self._slots[slot] = req
             prefill_t0 = time.monotonic()
+            imported = req.kv_import is not None
             try:
-                token = self.engine.start(slot, req.prompt, req.sampling)
+                if imported:
+                    # Migrated-in request: bind the wire-received KV in
+                    # place of a prefill; the sender's emitted tokens
+                    # replay below so the token stream is seamless.
+                    manifest, kb, vb = req.kv_import
+                    req.kv_import = None    # payload freed after binding
+                    tokens = [int(t) for t in manifest["tokens"]]
+                    self.engine.import_slot_kv(
+                        slot, req.prompt, kb, vb, tokens[-1],
+                        req.sampling, rng=manifest.get("rng"))
+                else:
+                    tokens = [self.engine.start(slot, req.prompt,
+                                                req.sampling)]
             except Exception as e:   # defensive: engine bug ≠ wedged slot
                 with self._lock:
                     self._slots.pop(slot, None)
                 self.engine.release(slot)
                 self.stats.record_failed()
-                req.finish(error=f"prefill_failed: {e}")
+                req.finish(error=(f"import_failed: {e}" if imported
+                                  else f"prefill_failed: {e}"))
                 continue
-            req.prefix_hit_tokens = self.engine.prefix_hit_tokens(slot)
-            self.stats.record_prefix(req.prefix_hit_tokens > 0)
+            if not imported:
+                req.prefix_hit_tokens = self.engine.prefix_hit_tokens(slot)
+                self.stats.record_prefix(req.prefix_hit_tokens > 0)
             self._record_phase(req, "hvd_tpu_serve_queued",
                                req.submitted_at, prefill_t0)
             self._record_phase(req, "hvd_tpu_serve_prefill", prefill_t0,
                                time.monotonic(),
                                prompt_len=len(req.prompt), slot=slot,
-                               prefix_hit=req.prefix_hit_tokens)
+                               prefix_hit=req.prefix_hit_tokens,
+                               imported=imported)
             if req.done.is_set():
                 # Cancelled/expired between admission and prefill
                 # completion: cancel() found no active slot to release
@@ -297,8 +416,18 @@ class ContinuousBatcher:
                     self._slots.pop(slot, None)
                 self.engine.release(slot)
                 continue
-            emitted += 1
-            self._emit(slot, req, token, time.monotonic())
+            now2 = time.monotonic()
+            for j, token in enumerate(tokens):
+                emitted += 1
+                self._emit(slot, req, token, now2,
+                           check_full=(j == len(tokens) - 1))
+                if req.done.is_set():
+                    break
+            if (not imported and self.role == "prefill"
+                    and self._migrator is not None
+                    and req.migrate_to is not None
+                    and not req.done.is_set()):
+                self._handoff(slot, req)
         # Decode: one token for every active request.  The kill fault's
         # event coordinate is this dispatch — guarded so an unarmed
         # plan costs one attribute read.
@@ -329,6 +458,35 @@ class ContinuousBatcher:
                                    slots=self.engine.max_slots,
                                    queued=len(self._queue))
         return emitted
+
+    def _handoff(self, slot: int, req: ServeRequest) -> None:
+        """Prefill→decode handoff: stream ``slot``'s KV to the
+        request's decode target, then free the slot and answer the
+        router with the migration outcome.  A failed transfer (wire
+        death, digest rejection, busy/draining receiver) falls back to
+        decoding HERE — the local KV is pristine (a corrupt fault only
+        damaged the wire copy), so the request finishes with exactly
+        the right tokens and only the disaggregation economics are
+        lost.
+
+        The ``serve:mode=kill`` fault's step-dispatch coordinate fires
+        at this dispatch too: prefill replicas never dispatch decode,
+        so the handoff is their step event — ``serve:step=N,mode=kill``
+        kills a prefill replica mid-migration (the fleet failover
+        drill)."""
+        if faults_mod._active is not None and faults_mod.on_serve_decode():
+            self._die("injected replica kill mid-migration")
+            raise ReplicaKilledError(self._killed)
+        try:
+            ok = self._migrator(self.engine, slot, req)
+        except Exception as e:
+            logger.warning("KV handoff of %s failed (%s); decoding "
+                           "locally", req.request_id, e)
+            ok = False
+        if not ok:
+            return   # local fallback: the slot keeps decoding here
+        req.migrated = True
+        self._finish_slot(slot, req)
 
     def _die(self, reason: str) -> None:
         """Fail every queued + in-flight request exactly once and
@@ -395,5 +553,7 @@ class ContinuousBatcher:
             snap.update(queue_depth=len(self._queue),
                         active_slots=len(self._slots),
                         max_slots=self.engine.max_slots,
-                        dead=self._killed is not None)
+                        dead=self._killed is not None,
+                        role=self.role,
+                        draining=self._draining)
         return snap

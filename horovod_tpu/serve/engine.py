@@ -203,6 +203,9 @@ class InferenceEngine:
             self._copy_fn = jax.jit(
                 self._copy_impl,
                 donate_argnums=(0,) if self._donate else ())
+            self._import_fn = jax.jit(
+                self._import_impl,
+                donate_argnums=(0,) if self._donate else ())
             self._kv = BlockPool(budget, self.kv_block, self._table,
                                  self._copy_block)
             self._caches = None
@@ -326,6 +329,18 @@ class InferenceEngine:
         callback :class:`BlockPool` drives."""
         self._pools = self._copy_fn(self._pools, jnp.int32(src),
                                     jnp.int32(dst))
+
+    def _import_impl(self, pools, blk, k, v):
+        """Write one wire-received block's K/V (``[n_layer, block, H,
+        D]``) into every layer's pool at block ``blk`` — the binding
+        half of live KV migration (ONE compiled program: the block id
+        is data, not shape)."""
+        self.trace_counts["kv_import"] += 1  # trace-time only
+        return [{"k": pools[i]["k"].at[blk].set(
+                     k[i].astype(pools[i]["k"].dtype)),
+                 "v": pools[i]["v"].at[blk].set(
+                     v[i].astype(pools[i]["v"].dtype))}
+                for i in range(self._model.config.n_layer)]
 
     def _make_paged_prefill(self, L: int):
         model = self._model
@@ -726,6 +741,96 @@ class InferenceEngine:
         if self._kv is not None:
             self._kv.release(slot)
         self._clear_slot(slot)
+
+    # --- live KV migration (serve/fleet/; docs/serving.md) ------------------
+    # Export/import run on the batcher thread only (they read/reassign
+    # the device pools the compiled programs donate), exactly like
+    # start()/step() — the fleet layer routes both through the batcher.
+
+    def export_slot_kv(self, slot: int):
+        """Export ``slot``'s resident KV as ``(chain_len, k, v)`` numpy
+        arrays of shape ``[n_layer, n_blocks, block, H, D]`` — the
+        slot's block table is the transfer manifest: only its live,
+        non-trash chain blocks move.  Called at the prefill→decode
+        boundary, when the chain covers exactly the prompt's positions
+        ``[0, n_prompt)``."""
+        if self.kv_mode != "paged":
+            raise RuntimeError("KV export requires the paged cache "
+                               "(HVD_TPU_SERVE_KV=paged)")
+        chain = self._kv.chain_blocks(slot)
+        if not chain:
+            raise RuntimeError(f"slot {slot} has no KV chain to export")
+        idx = jnp.asarray(chain, jnp.int32)
+        k = np.stack([np.asarray(p["k"][idx]) for p in self._pools])
+        v = np.stack([np.asarray(p["v"][idx]) for p in self._pools])
+        return len(chain), k, v
+
+    def import_slot_kv(self, slot: int, prompt: Sequence[int],
+                       k_blocks, v_blocks, first_token: int,
+                       sampling: SamplingParams,
+                       rng=None) -> None:
+        """Bind wire-received KV blocks into this engine's pool and
+        activate ``slot`` exactly as if prefill had run here: the next
+        ``step()`` consumes ``first_token`` at position ``n_prompt``
+        and generation continues token-identically.  ``rng`` (the
+        sender's post-prefill PRNG key) is adopted only while no other
+        slot is active — temperature sampling is then bit-identical to
+        the single-replica run; with concurrent traffic it stays
+        distributionally correct (greedy/speculative requests are
+        deterministic either way).  Digest verification happens in the
+        migration layer BEFORE this call — corrupt payloads never reach
+        the pool."""
+        if self.kv_mode != "paged":
+            raise RuntimeError("KV import requires the paged cache "
+                               "(HVD_TPU_SERVE_KV=paged)")
+        if self._active[slot]:
+            raise RuntimeError(f"slot {slot} is already active")
+        prompt = [int(t) for t in prompt]
+        n = len(prompt)
+        self.check_prompt_tokens(prompt)
+        nb = int(k_blocks.shape[1])
+        expected = -(-n // self.kv_block)
+        if nb != expected:
+            raise ValueError(
+                f"imported chain of {nb} block(s) does not cover the "
+                f"{n}-token prompt ({expected} expected at block size "
+                f"{self.kv_block})")
+        chain = self._kv.bind_imported(slot, nb)
+        for j, blk in enumerate(chain):
+            self._pools = self._import_fn(
+                self._pools, jnp.int32(blk),
+                jnp.asarray(k_blocks[:, j]), jnp.asarray(v_blocks[:, j]))
+        # The imported prefix is resident here now: index it so later
+        # admissions (and the global prefix directory) hit it — the
+        # "prefix-directory hit landing on a decode replica" path.
+        self._kv.index_prompt(slot, prompt)
+        if rng is not None and not self.active_slots():
+            self._rng = jnp.asarray(np.asarray(rng, np.uint32))
+        if self._drafter is not None:
+            # Mirror start(): the drafter recomputes the prompt (its
+            # dense cache shares nothing) so speculative decode can
+            # draft from position n_prompt immediately.
+            Lf = self.bucket_for(n)
+            dp = np.zeros((1, Lf), np.int32)
+            dp[0, :n] = np.asarray(prompt, np.int32)
+            self._drafter_caches = self._draft_prefill_fns[Lf](
+                self._drafter_params, self._drafter_caches,
+                jnp.asarray(dp), jnp.int32(slot))
+        self._bind_slot(slot, n, int(first_token), sampling, 0)
+
+    def export_rng(self):
+        """This engine's current PRNG key as numpy (migrated with the
+        KV so an idle importer can reproduce the sender's sampling
+        stream bit-exactly)."""
+        return np.asarray(self._rng)
+
+    def drain_evicted_prefixes(self) -> List[tuple]:
+        """Leading-block keys evicted since the last drain (piggybacked
+        on response frames → global prefix directory invalidation);
+        empty on the dense tier."""
+        if self._kv is None:
+            return []
+        return self._kv.drain_evicted_keys()
 
     # --- observability ------------------------------------------------------
 

@@ -1,0 +1,35 @@
+"""Disaggregated prefill/decode serving fleet.
+
+The tier above the single-replica engine (ROADMAP item 3): prefill is
+compute-bound, decode is memory-bound, so the fleet splits them onto
+separate replica classes with different batching and hardware
+economics per phase:
+
+* **roles + live KV migration** (:mod:`.migration`) — replicas declare
+  ``prefill`` / ``decode`` / ``unified``; a prefill replica runs the
+  prompt, then streams the resulting paged KV blocks to a decode
+  replica over the HMAC ``BasicService`` wire, the per-slot block
+  table as the transfer manifest and per-block sha256 digests
+  verifying the transfer — the decode replica binds the blocks into
+  its own pool and continues generation token-identically.
+* **global prefix directory** (:mod:`.directory`) — the router-tier
+  promotion of ``serve/kv/prefix.py``: leading block keys → replicas
+  with resident blocks, so a system-prompt hit *anywhere* in the fleet
+  routes to resident KV; entries invalidate on replica death and on
+  eviction notifications piggybacked on response frames.
+* **elastic autoscaling** (:mod:`.controller`) — per-role replica
+  counts driven by queue-depth/TTFT signals through the ``elastic/``
+  host-discovery machinery: scale out the saturated role,
+  drain-and-retire when idle.
+
+``serve/router.py`` owns the role-aware dispatch
+(admit→prefill→migrate→decode pipeline); this package owns the data
+handoff, the directory, and the control loop.
+"""
+
+from .controller import FleetController, ReplicaLauncher, ROLES  # noqa: F401
+from .directory import PrefixDirectory  # noqa: F401
+from .migration import (  # noqa: F401
+    MigrationBuffer, MigrationError, block_digests, migrate_slot,
+    verify_digests,
+)

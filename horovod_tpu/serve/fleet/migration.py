@@ -1,0 +1,231 @@
+"""Live KV migration: prefill→decode block streaming with digests.
+
+The disaggregated fleet's data handoff: a prefill replica runs the
+prompt, then streams the request's paged KV blocks to a decode replica
+over the existing HMAC ``BasicService`` wire
+(``runner/common/network.py::KvMigrateRequest``).  The slot's block
+table is the transfer manifest — only live, non-trash chain blocks
+move — and every block carries a sha256 digest computed over its
+``[n_layer, block, H, D]`` K and V payload, so the receiver verifies
+the transfer before binding anything into its own pool: a corrupted
+block fails the digest check and the request finishes on a correct
+path (the sender's pristine KV, or a full recompute elsewhere) — never
+with wrong tokens.
+
+Chunking: frames stay under ``HVD_TPU_FLEET_MIGRATE_CHUNK`` bytes
+(block-granular — a block is the atomic unit of both transfer and
+verification), so one migration is a short burst of bounded frames
+instead of one giant allocation on both ends.
+
+Fault site ``serve`` modes ``migrate`` / ``migrate-drop`` /
+``migrate-delay`` fire here, at the KV-transfer boundary: ``migrate``
+corrupts one block AFTER the digests were computed (the
+detect-and-recover drill), ``migrate-drop`` fails the transfer on the
+wire, ``migrate-delay`` stretches it (a congested DCN link).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ... import faults as faults_mod
+from ...obs import instrument as _obs
+from ...obs import trace as trace_mod
+from ...runner.common.network import BasicClient, KvMigrateRequest
+from ...utils.logging import get_logger
+from ...utils.retry import RetryPolicy
+from ..engine import resolved_config
+
+logger = get_logger(__name__)
+
+
+class MigrationError(RuntimeError):
+    """The KV transfer failed (wire death, digest mismatch, receiver
+    refusal) — the sender falls back to decoding locally; the request
+    is never lost and never continues from damaged state."""
+
+
+def block_digests(k: np.ndarray, v: np.ndarray) -> List[str]:
+    """Per-block sha256 over the concatenated K then V bytes of all
+    layers (``k``/``v`` are ``[n_layer, n_blocks, block, H, D]``) —
+    the digest format docs/serving.md documents."""
+    return [hashlib.sha256(np.ascontiguousarray(k[:, j]).tobytes()
+                           + np.ascontiguousarray(v[:, j]).tobytes())
+            .hexdigest()
+            for j in range(k.shape[1])]
+
+
+def verify_digests(manifest: dict, k: np.ndarray, v: np.ndarray) -> None:
+    """Receiver-side transfer verification; raises
+    :class:`MigrationError` on any mismatch — nothing unverified ever
+    reaches the receiving pool."""
+    digests = manifest.get("digests") or []
+    if k.shape[1] != manifest.get("n_blocks") or len(digests) != k.shape[1]:
+        raise MigrationError(
+            f"migration shape mismatch: {k.shape[1]} block(s) received, "
+            f"manifest declares {manifest.get('n_blocks')}")
+    got = block_digests(k, v)
+    for j, (want, have) in enumerate(zip(digests, got)):
+        if want != have:
+            raise MigrationError(f"digest_mismatch: block {j} of "
+                                 f"{len(digests)} failed verification")
+
+
+def plan_frames(n_blocks: int, per_block_bytes: int,
+                chunk_bytes: int) -> List[Tuple[int, int]]:
+    """Split ``n_blocks`` into ``[j0, j1)`` frame ranges so each frame
+    stays under ``chunk_bytes`` (always >= 1 block per frame)."""
+    per = max(1, chunk_bytes // max(1, per_block_bytes))
+    return [(j, min(j + per, n_blocks)) for j in range(0, n_blocks, per)]
+
+
+def migrate_slot(engine, slot: int, req, target, key: bytes, *,
+                 chunk_bytes: Optional[int] = None,
+                 probe_timeout: float = 5.0,
+                 wire_timeout: float = 30.0) -> bool:
+    """Export ``slot``'s KV from ``engine`` and stream it to ``target
+    = (name, addresses)``.  Returns True once the receiver verified the
+    digests and adopted the request; raises :class:`MigrationError` on
+    any failure (after best-effort cancelling a partially-adopted copy
+    on the receiver, so a local fallback cannot double-execute)."""
+    name, addresses = target
+    cfg = resolved_config()
+    chunk = int(chunk_bytes or cfg.fleet_migrate_chunk)
+    t0 = time.monotonic()
+    nb, k, v = engine.export_slot_kv(slot)
+    s = req.sampling
+    manifest = {
+        "request_id": req.request_id,
+        "prompt": list(req.prompt),
+        "tokens": list(req.tokens),
+        "block_tokens": engine.kv_block,
+        "n_blocks": nb,
+        "digests": block_digests(k, v),
+        "sampling": {"max_new_tokens": s.max_new_tokens,
+                     "temperature": s.temperature, "top_k": s.top_k,
+                     "stop_token": s.stop_token, "spec": s.spec},
+        "deadline_s": (max(0.1, req.deadline - time.monotonic())
+                       if req.deadline is not None else None),
+        # Sender's post-prefill PRNG key: an idle importer adopts it so
+        # temperature sampling stays bit-identical across the handoff.
+        "rng": engine.export_rng(),
+    }
+    nbytes = int(k.nbytes + v.nbytes)
+    mode = (faults_mod.on_serve_migrate()
+            if faults_mod._active is not None else None)
+    sent = False
+    try:
+        with trace_mod.span("hvd_tpu_kv_migrate",
+                            args={"request_id": req.request_id,
+                                  "blocks": nb, "bytes": nbytes,
+                                  "target": name}):
+            if mode == "migrate-drop":
+                raise MigrationError(
+                    "injected migrate drop at the KV-transfer boundary")
+            if mode == "migrate":
+                # Corrupt AFTER digesting: the manifest describes the
+                # true content, so the receiver's digest check MUST
+                # reject this payload — the wrong-tokens-never drill.
+                k = k.copy()
+                k.reshape(-1).view(np.uint8)[:16] ^= 0xFF
+            client = BasicClient(name, addresses, key,
+                                 probe_timeout=probe_timeout,
+                                 retry_policy=RetryPolicy(attempts=1))
+            per_block = (int(k[:, :1].nbytes) + int(v[:, :1].nbytes)
+                         if nb else 0)
+            frames = plan_frames(nb, per_block, chunk)
+            for seq, (j0, j1) in enumerate(frames):
+                sent = True
+                resp = client.request(
+                    KvMigrateRequest(
+                        req.request_id, seq, len(frames),
+                        np.ascontiguousarray(k[:, j0:j1]),
+                        np.ascontiguousarray(v[:, j0:j1]),
+                        manifest=manifest if seq == 0 else None),
+                    idempotent=False, timeout=wire_timeout)
+                err = getattr(resp, "error", None)
+                if err:
+                    raise MigrationError(f"decode replica {name}: {err}")
+        ms = (time.monotonic() - t0) * 1e3
+        _obs.on_fleet_migration(nbytes, True, ms)
+        req.migrate_ms = round(ms, 3)
+        return True
+    except (OSError, MigrationError) as e:
+        _obs.on_fleet_migration(nbytes, False, 0.0)
+        if sent:
+            # The receiver may hold a partial (or even fully adopted)
+            # copy; the sender is about to decode locally, so a second
+            # live generation of the same request would only burn the
+            # decode replica's slots producing an answer nobody reads.
+            _cancel_on_target(name, addresses, key, req.request_id)
+        logger.warning("KV migration of %s to %s failed: %s",
+                       req.request_id, name, e)
+        raise MigrationError(str(e)) from e
+
+
+def _cancel_on_target(name, addresses, key, request_id) -> None:
+    from ..server import CancelRequest  # function-level: server imports us
+
+    try:
+        BasicClient(name, addresses, key, probe_timeout=2.0,
+                    retry_policy=RetryPolicy(attempts=1)).request(
+                        CancelRequest(request_id), idempotent=False,
+                        timeout=5.0)
+    except OSError:
+        pass   # receiver truly gone: nothing left to cancel
+
+
+class MigrationBuffer:
+    """Receiver-side frame assembly: one per serving endpoint.
+
+    Frames of one migration arrive in order on one sender connection
+    loop but interleave with other migrations; entries older than
+    ``ttl_s`` are garbage-collected on the next ``add`` (a sender that
+    died mid-stream must not leak buffered blocks forever).
+    """
+
+    def __init__(self, ttl_s: float = 60.0) -> None:
+        self.ttl_s = ttl_s
+        self._lock = threading.Lock()
+        self._pending: Dict[str, dict] = {}   # guarded-by: _lock
+
+    def add(self, frame) -> Optional[Tuple[dict, np.ndarray, np.ndarray]]:
+        """Buffer one frame; returns the digest-verified ``(manifest,
+        k, v)`` when the transfer completed, None while frames are
+        still missing.  Raises :class:`MigrationError` (and drops the
+        buffer) on digest mismatch."""
+        now = time.monotonic()
+        rid = frame.request_id
+        with self._lock:
+            for stale in [r for r, e in self._pending.items()
+                          if now - e["t0"] > self.ttl_s]:
+                del self._pending[stale]
+            ent = self._pending.setdefault(
+                rid, {"frames": {}, "manifest": None, "t0": now,
+                      "total": int(frame.total)})
+            ent["frames"][int(frame.seq)] = (frame.k_blocks,
+                                             frame.v_blocks)
+            if frame.manifest is not None:
+                ent["manifest"] = frame.manifest
+            if (len(ent["frames"]) < ent["total"]
+                    or ent["manifest"] is None):
+                return None
+            del self._pending[rid]
+        if ent["total"] == 1:
+            k, v = ent["frames"][0]
+        else:
+            k = np.concatenate([ent["frames"][s][0]
+                                for s in range(ent["total"])], axis=1)
+            v = np.concatenate([ent["frames"][s][1]
+                                for s in range(ent["total"])], axis=1)
+        verify_digests(ent["manifest"], k, v)
+        return ent["manifest"], k, v
+
+    def discard(self, request_id: str) -> None:
+        with self._lock:
+            self._pending.pop(request_id, None)

@@ -83,6 +83,12 @@ class BlockPool:
         self._evictable: "collections.OrderedDict" = \
             collections.OrderedDict()             # guarded-by: _lock
         self._index = PrefixIndex(block_tokens)   # guarded-by: _lock
+        # Leading-block keys whose depth-0 block was evicted since the
+        # last drain — piggybacked on response frames so the fleet's
+        # global prefix directory can drop the entry (bounded: a missed
+        # key only costs the directory one stale-route retry).
+        self._evicted_keys: "collections.deque" = collections.deque(
+            maxlen=256)                           # guarded-by: _lock
         self.evictions_total = 0                  # guarded-by: _lock
         self.cow_copies_total = 0                 # guarded-by: _lock
         self.prefix_hits_total = 0                # guarded-by: _lock
@@ -114,7 +120,53 @@ class BlockPool:
                 "kv_prefix_tokens_shared": self.prefix_tokens_shared,
             }
 
+    def chain_blocks(self, slot: int) -> List[int]:
+        """Copy of ``slot``'s live block chain (the KV-migration
+        transfer manifest: only these non-trash blocks move)."""
+        with self._lock:
+            return list(self._chains.get(slot, ()))
+
+    def drain_evicted_keys(self) -> List[tuple]:
+        """Leading-block keys evicted since the last drain (consumed:
+        the caller owns notifying the prefix directory)."""
+        with self._lock:
+            out = list(self._evicted_keys)
+            self._evicted_keys.clear()
+            return out
+
     # --- request lifecycle --------------------------------------------------
+
+    def bind_imported(self, slot: int, n_blocks: int) -> List[int]:
+        """Allocate a fresh ``n_blocks``-long chain for ``slot`` whose
+        K/V content arrives over the wire (live KV migration) instead
+        of from local prefill.  No prefix match runs — the sender's
+        blocks are bound verbatim so the decode continues
+        token-identically; ``index_prompt`` afterwards makes the
+        imported prefix shareable here like any locally-computed one."""
+        if n_blocks < 1:
+            raise ValueError(f"n_blocks must be >= 1, got {n_blocks}")
+        with self._lock:
+            if slot in self._chains:
+                raise RuntimeError(f"slot {slot} already has a chain")
+            chain: List[int] = []
+            try:
+                for _ in range(n_blocks):
+                    nb = self._alloc_locked()
+                    self._ref[nb] = 1
+                    chain.append(nb)
+            except Exception:
+                # Mid-chain exhaustion: blocks already allocated are
+                # not yet attached to any chain, so nothing would ever
+                # release them — roll them back before propagating or
+                # every failed adoption under pressure leaks pool.
+                for nb in chain:
+                    self._ref.pop(nb, None)
+                    self._free.append(nb)
+                raise
+            self._chains[slot] = chain
+            self._write_table_locked(slot)
+            self._publish_in_use_locked()
+            return chain
 
     def begin_request(self, slot: int, prompt) -> int:
         """Bind ``slot`` to the longest resident prefix of ``prompt``:
@@ -282,6 +334,9 @@ class BlockPool:
             self._free_subtree_locked(b)
 
     def _free_subtree_locked(self, block: int) -> None:
+        key = self._index.leading_key(block)
+        if key is not None:
+            self._evicted_keys.append(key)
         freed = self._index.remove_subtree(block) or [block]
         n = 0
         for d in freed:

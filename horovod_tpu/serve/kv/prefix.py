@@ -60,6 +60,18 @@ class PrefixIndex:
     def is_indexed(self, block: int) -> bool:
         return block in self._owners
 
+    def leading_key(self, block: int) -> Optional[Tuple[int, ...]]:
+        """The root-level edge key (the first ``block_tokens`` token
+        IDs) when ``block`` is a depth-0 full block, else None — the
+        granularity the fleet's global prefix directory keys on, so an
+        eviction of a depth-0 block is exactly the event that
+        invalidates a directory entry."""
+        info = self._owners.get(block)
+        if info is None:
+            return None
+        kind, parent, key, _ = info
+        return key if kind == "full" and parent is self._root else None
+
     def lookup(self, prompt) -> Tuple[List[int],
                                       Optional[Tuple[int, int]]]:
         """Longest resident match for ``prompt``: a chain of fully

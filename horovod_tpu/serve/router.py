@@ -20,12 +20,26 @@ the overload that killed the replica), and a response cache keyed by
 ``request_id`` guarantees at-most-once delivery to the caller even if
 a retry races a late success.
 
-**Prefix affinity** (serve/kv/): requests whose leading prompt block
-matches one recently served on a replica prefer that replica — its
-paged KV pool already holds the prefix's blocks, so admission there is
-a cache hit instead of a full prefill.  Affinity is a preference, not
-a pin: a benched replica falls back to the least-loaded spread, so the
-failure handling above is unchanged.
+**Global prefix directory** (serve/fleet/directory.py): requests whose
+leading prompt block is resident on some replica's paged KV pool route
+there — admission is a cache hit instead of a full prefill.  The
+directory subsumes the single-replica affinity map: it tracks every
+replica a prefix is resident on (migration leaves it on both ends),
+drops a replica's entries when it is benched, and consumes eviction
+notifications piggybacked on response frames.  Residency is a
+preference, not a pin: a benched or saturated resident falls back to
+the least-loaded spread, so the failure handling above is unchanged.
+
+**Role-aware dispatch** (serve/fleet/): when the fleet carries both
+``prefill`` and ``decode`` replicas, a directory-miss request runs the
+admit→prefill→migrate→decode pipeline — the router sends the request
+to a prefill replica with its decode target attached, the prefill
+replica streams the KV over the wire after the first token, and the
+router collects the finished generation from the decode replica.  Any
+pipeline failure (prefill death mid-migration, digest rejection, lost
+continuation) re-routes to a unified full-generation recompute path on
+whatever healthy replica remains — requests are never lost and tokens
+are never wrong, the disaggregation only ever costs economics.
 """
 
 from __future__ import annotations
@@ -37,11 +51,14 @@ import uuid
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..obs import instrument as _obs
 from ..obs import trace as trace_mod
-from ..runner.common.network import BasicClient
+from ..runner.common.network import (BasicClient, CollectRequest,
+                                     DrainRequest)
 from ..utils.logging import get_logger
 from ..utils.retry import RetryPolicy, retry_call
 from .engine import resolved_config
+from .fleet.directory import PrefixDirectory
 from .server import (CancelRequest, GenerateRequest, GenerateResponse,
                      StatsRequest)
 
@@ -92,13 +109,20 @@ def register_replica_process_sets(n_replicas: int):
 
 
 class ReplicaSpec:
-    """Where one replica answers: candidate addresses + its mesh ranks."""
+    """Where one replica answers: candidate addresses, its mesh ranks,
+    and its fleet role (``prefill`` / ``decode`` / ``unified`` — the
+    replica class the disaggregated dispatch schedules by)."""
 
     def __init__(self, name: str, addresses: List[Tuple[str, int]],
-                 ranks: Optional[Sequence[int]] = None):
+                 ranks: Optional[Sequence[int]] = None,
+                 role: str = "unified"):
         self.name = name
         self.addresses = list(addresses)
         self.ranks = list(ranks) if ranks is not None else None
+        if role not in ("prefill", "decode", "unified"):
+            raise ValueError(f"unknown replica role {role!r}; expected "
+                             f"prefill|decode|unified")
+        self.role = role
 
 
 class _ReplicaState:
@@ -109,6 +133,7 @@ class _ReplicaState:
         self.client: Optional[BasicClient] = None  # guarded-by: Router._lock
         self.strikes = 0                           # guarded-by: Router._lock
         self.dead_until: Optional[float] = None    # guarded-by: Router._lock
+        self.draining = False                      # guarded-by: Router._lock
         self.inflight = 0                          # guarded-by: Router._lock
         self.completed = 0                         # guarded-by: Router._lock
         self.failed = 0                            # guarded-by: Router._lock
@@ -143,17 +168,18 @@ class Router:
         self._rr = itertools.count()
         self._done: "OrderedDict[str, GenerateResponse]" = OrderedDict()  # guarded-by: _lock
         self._dedupe_window = dedupe_window
-        # Prefix affinity: leading-block token key -> replica whose KV
-        # pool last served it (bounded LRU; serve/kv prefix sharing).
-        # The slack is how many MORE in-flight requests than the idlest
-        # peer the resident replica may carry before affinity yields to
+        # Global prefix directory: leading-block token key -> replicas
+        # with resident blocks (serve/fleet/directory.py — the
+        # router-tier promotion of the per-replica radix index).  The
+        # slack is how many MORE in-flight requests than the idlest
+        # peer a resident replica may carry before residency yields to
         # the least-loaded spread — without it, one hot system prompt
         # would pin the whole fleet's traffic to a single replica and
         # serially bench healthy peers through busy-strikes.
         self._affinity_block = int(cfg.serve_kv_block)
         self._affinity_slack = max(1, int(cfg.serve_max_batch))
-        self._prefix_map: "OrderedDict[tuple, _ReplicaState]" = OrderedDict()  # guarded-by: _lock
-        self._prefix_window = 1024
+        self._directory = PrefixDirectory(self._affinity_block,
+                                          max_entries=1024)
 
     # --- health -------------------------------------------------------------
 
@@ -163,15 +189,24 @@ class Router:
         return now >= rep.dead_until    # probation over: half-open try
 
     def _strike(self, rep: _ReplicaState, fatal: bool = False) -> None:
+        benched = False
         with self._lock:
             rep.strikes += 1
             rep.failed += 1
             rep.client = None    # re-probe on next use
             if fatal or rep.strikes >= self._strike_limit:
                 rep.dead_until = time.monotonic() + self._probation_s
+                benched = True
                 logger.warning(
                     "replica %s benched for %.1fs (%d strike(s))",
                     rep.spec.name, self._probation_s, rep.strikes)
+        if benched:
+            # Directory consistency on replica death: a benched replica
+            # may have lost its pool (crash/restart), so its residency
+            # entries are dropped — a stale route would only cost a
+            # cache miss, but a prompt drop here keeps the directory
+            # honest through failover storms.
+            self._directory.invalidate_replica(rep)
 
     def _mark_ok(self, rep: _ReplicaState) -> None:
         with self._lock:
@@ -180,23 +215,23 @@ class Router:
             rep.completed += 1
 
     def _prefix_key(self, prompt: Sequence[int]) -> Optional[tuple]:
-        """Affinity key: the prompt's leading KV block's token IDs —
-        the same granularity the replica's prefix index shares at, so
+        """Directory key: the prompt's leading KV block's token IDs —
+        the same granularity the replicas' prefix indexes share at, so
         a key match is (at least) a one-block cache hit there."""
-        b = self._affinity_block
-        if b < 1 or len(prompt) < b:
-            return None
-        return tuple(int(t) for t in prompt[:b])
+        return self._directory.key_for(prompt)
 
     def _note_affinity(self, key: Optional[tuple],
                        rep: _ReplicaState) -> None:
-        if key is None:
-            return
-        with self._lock:
-            self._prefix_map[key] = rep
-            self._prefix_map.move_to_end(key)
-            while len(self._prefix_map) > self._prefix_window:
-                self._prefix_map.popitem(last=False)
+        """Record residency: ``rep`` now holds this prompt's leading
+        blocks (it served the request, or adopted its migration)."""
+        if key is not None:
+            self._directory.record(key, rep)
+
+    def _ingest_evictions(self, rep: _ReplicaState, resp) -> None:
+        """Apply eviction notifications piggybacked on a response frame
+        to the directory (the replica no longer holds these keys)."""
+        for key in (getattr(resp, "evicted_prefixes", None) or ()):
+            self._directory.discard(tuple(key), rep)
 
     def _pick(self, prefix_key: Optional[tuple] = None) -> _ReplicaState:
         """Round-robin over healthy replicas, preferring (1) the
@@ -212,35 +247,72 @@ class Router:
         with self._lock:
             half_open = [r for r in self._replicas
                          if r.dead_until is not None
-                         and now >= r.dead_until]
+                         and now >= r.dead_until and not r.draining]
             if half_open:
                 probe = min(half_open, key=lambda r: r.dead_until)
                 probe.dead_until = now + self._probation_s
                 return probe
-            fully = [r for r in self._replicas if r.dead_until is None]
+            fully = [r for r in self._replicas
+                     if r.dead_until is None and not r.draining]
             if not fully:
                 soonest = min(
                     (r.dead_until for r in self._replicas
                      if r.dead_until is not None), default=None)
                 raise NoHealthyReplicasError(
-                    f"all {len(self._replicas)} replica(s) benched"
+                    f"all {len(self._replicas)} replica(s) benched or "
+                    f"draining"
                     + (f"; next probation in "
                        f"{max(0.0, soonest - now):.1f}s"
                        if soonest else ""))
-            if prefix_key is not None:
-                resident = self._prefix_map.get(prefix_key)
-                if (resident is not None and resident.dead_until is None
-                        and resident.inflight
-                        - min(r.inflight for r in fully)
-                        <= self._affinity_slack):
-                    # Prefer the cache-warm replica while it is not
-                    # drastically more loaded than the idlest peer;
-                    # beyond the slack the request spills to the
-                    # spread (the prefix gets cached there too).
-                    return resident
+            resident = self._resident_locked(prefix_key, fully)
+            if resident is not None:
+                return resident
             start = next(self._rr) % len(fully)
             ordered = fully[start:] + fully[:start]
             return min(ordered, key=lambda r: r.inflight)
+
+    def _resident_locked(self, prefix_key: Optional[tuple],
+                         fully: List[_ReplicaState]
+                         ) -> Optional[_ReplicaState]:
+        """Caller holds the lock; ``fully`` is its healthy,
+        non-draining pool.  Returns the most recently confirmed
+        resident replica within the load slack, or None.  ONE
+        definition of the residency rule: prefer the cache-warm replica
+        while it is not drastically more loaded than the idlest peer;
+        beyond the slack the request spills to the spread (the prefix
+        gets cached there too)."""
+        if prefix_key is None or not fully:
+            return None
+        floor = min(r.inflight for r in fully)
+        for resident in self._directory.lookup(prefix_key):
+            if (resident in fully and resident.inflight - floor
+                    <= self._affinity_slack):
+                return resident
+        return None
+
+    def _directory_pick(self,
+                        prefix_key: Optional[tuple]
+                        ) -> Optional[_ReplicaState]:
+        """The global-prefix-directory route: a healthy, non-draining
+        replica with this prompt's leading block resident (and within
+        the load slack), or None — the fleet dispatch's first choice
+        before the prefill/decode pipeline."""
+        with self._lock:
+            fully = [r for r in self._replicas
+                     if r.dead_until is None and not r.draining]
+            return self._resident_locked(prefix_key, fully)
+
+    def _pick_role(self, role: str) -> Optional[_ReplicaState]:
+        """Least-loaded healthy, non-draining replica of ``role``
+        (None when the role has no healthy member — the caller falls
+        back to the unified path)."""
+        with self._lock:
+            pool = [r for r in self._replicas
+                    if r.spec.role == role and r.dead_until is None
+                    and not r.draining]
+            if not pool:
+                return None
+            return min(pool, key=lambda r: r.inflight)
 
     def _client(self, rep: _ReplicaState) -> BasicClient:
         with self._lock:
@@ -273,6 +345,72 @@ class Router:
         except OSError:
             pass   # replica truly gone: nothing left to cancel
 
+    # --- fleet membership (serve/fleet/controller.py drives these) ----------
+
+    def _find(self, name: str) -> Optional[_ReplicaState]:
+        with self._lock:
+            return next((r for r in self._replicas
+                         if r.spec.name == name), None)
+
+    def add_replica(self, spec: ReplicaSpec) -> None:
+        """Register a freshly-launched replica (elastic scale-out)."""
+        with self._lock:
+            self._replicas.append(_ReplicaState(spec))
+        logger.info("router: +replica %s (%s)", spec.name, spec.role)
+
+    def remove_replica(self, name: str) -> None:
+        """Deregister ``name`` (drain completed / replica retired) and
+        release its prefix-directory entries.  The router refuses to
+        remove its last replica — an empty fleet serves nothing."""
+        with self._lock:
+            rep = next((r for r in self._replicas
+                        if r.spec.name == name), None)
+            if rep is None:
+                return
+            if len(self._replicas) <= 1:
+                raise ValueError(
+                    "cannot remove the last replica from the router")
+            self._replicas.remove(rep)
+        self._directory.invalidate_replica(rep)
+        logger.info("router: -replica %s", name)
+
+    def drain_replica(self, name: str, timeout: float = 5.0) -> None:
+        """Start drain-and-retire for ``name``: mark it locally (picks
+        skip it immediately) and tell the replica to stop admitting."""
+        rep = self._find(name)
+        if rep is None:
+            return
+        self._mark_draining(rep)
+        try:
+            self._client(rep).request(DrainRequest(), idempotent=False,
+                                      timeout=timeout)
+        except OSError as e:
+            logger.warning("drain request to %s failed (%s); the local "
+                           "draining mark still shields it from new "
+                           "traffic", name, e)
+
+    def undrain_replica(self, name: str, timeout: float = 5.0) -> None:
+        """Reverse a drain (the controller's abandon path): clear the
+        local mark so picks see the replica again and tell it to admit
+        — a replica left draining with no retire coming would starve
+        the fleet."""
+        rep = self._find(name)
+        if rep is None:
+            return
+        with self._lock:
+            rep.draining = False
+        try:
+            self._client(rep).request(DrainRequest(cancel=True),
+                                      idempotent=False, timeout=timeout)
+        except OSError as e:
+            logger.warning("undrain request to %s failed (%s); the "
+                           "replica keeps refusing until reachable",
+                           name, e)
+
+    def _mark_draining(self, rep: _ReplicaState) -> None:
+        with self._lock:
+            rep.draining = True
+
     # --- request path -------------------------------------------------------
 
     def generate(self, prompt: Sequence[int], *,
@@ -292,11 +430,6 @@ class Router:
         with self._lock:
             if rid in self._done:
                 return self._done[rid]
-        req = GenerateRequest(rid, list(prompt),
-                              max_new_tokens=max_new_tokens,
-                              temperature=temperature, top_k=top_k,
-                              stop_token=stop_token,
-                              deadline_s=deadline_s, spec=spec)
         prefix_key = self._prefix_key(prompt)
         # Response-read timeout: a generation legitimately runs for the
         # request's whole deadline — reading it under the snappy probe
@@ -308,15 +441,26 @@ class Router:
                         if effective_deadline and effective_deadline > 0
                         else 600.0)
 
-        def attempt() -> GenerateResponse:
-            # NoHealthyReplicasError is retryable: probation may clear
-            # under the policy's backoff.
-            rep = self._pick(prefix_key)
+        def mk_req(migrate_to=None) -> GenerateRequest:
+            return GenerateRequest(rid, list(prompt),
+                                   max_new_tokens=max_new_tokens,
+                                   temperature=temperature, top_k=top_k,
+                                   stop_token=stop_token,
+                                   deadline_s=deadline_s, spec=spec,
+                                   migrate_to=migrate_to)
+
+        # A collect failure means the decode replica lost the migrated
+        # continuation — later attempts recompute on the unified path
+        # instead of re-entering the pipeline (never wrong tokens, at
+        # worst one redundant prefill).
+        state = {"force_unified": False}
+
+        def run_on(rep: _ReplicaState, wire_req) -> GenerateResponse:
             with self._lock:
                 rep.inflight += 1
             try:
                 client = self._client(rep)
-                resp = client.request(req, idempotent=False,
+                resp = client.request(wire_req, idempotent=False,
                                       timeout=wire_timeout)
             except OSError as e:
                 self._strike(rep)
@@ -326,11 +470,88 @@ class Router:
             finally:
                 with self._lock:
                     rep.inflight -= 1
+            if resp.error == "draining":
+                # Voluntary refusal (drain-and-retire), not a failure:
+                # shield the replica from picks without striking it.
+                self._mark_draining(rep)
+                raise ReplicaUnavailableError(
+                    f"replica {rep.spec.name}: draining")
             if resp.error in _RETRYABLE_ERRORS:
                 self._strike(rep, fatal=resp.error != "busy")
                 raise ReplicaUnavailableError(
                     f"replica {rep.spec.name}: {resp.error}")
             self._mark_ok(rep)
+            self._ingest_evictions(rep, resp)
+            return resp
+
+        def attempt() -> GenerateResponse:
+            # 1. Global prefix directory: a resident prefix anywhere in
+            # the fleet (prefill source, decode target after an earlier
+            # migration, or a unified peer) beats a cold pipeline — the
+            # hit replica runs the whole request against warm KV.
+            rep = self._directory_pick(prefix_key)
+            if rep is not None:
+                resp = run_on(rep, mk_req())
+                # Counted only on success: a failed route is a failover,
+                # not a cache hit, and retries must not recount.
+                _obs.on_fleet_directory_hit()
+                self._note_affinity(prefix_key, rep)
+                return resp
+            # 2. Disaggregated pipeline: admit→prefill→migrate→decode
+            # when both role classes have healthy members.
+            if not state["force_unified"]:
+                pre = self._pick_role("prefill")
+                dec = self._pick_role("decode")
+                if pre is not None and dec is not None:
+                    resp = run_on(pre, mk_req(
+                        migrate_to=(dec.spec.name, dec.spec.addresses)))
+                    if getattr(resp, "migrated_to", None) is None:
+                        # Migration fell back (digest rejection, wire
+                        # drop, busy receiver): the prefill replica
+                        # finished the generation itself.
+                        self._note_affinity(prefix_key, pre)
+                        return resp
+                    self._note_affinity(prefix_key, pre)
+                    try:
+                        final = run_on(dec, CollectRequest(rid))
+                    except ReplicaUnavailableError:
+                        state["force_unified"] = True
+                        raise
+                    if final.error == "unknown_request" or (
+                            final.error or "").startswith("import_failed"):
+                        # The decode replica lost the continuation
+                        # (restart / cancel race) or could not bind the
+                        # adopted KV (pool exhausted at deferred import
+                        # time — adopt() only checks the queue): both
+                        # are recoverable by recomputing elsewhere, and
+                        # returning them to the caller would lose a
+                        # request every replica could still serve.
+                        state["force_unified"] = True
+                        raise ReplicaUnavailableError(
+                            f"replica {dec.spec.name}: {final.error} "
+                            f"for migrated request {rid}")
+                    # The caller-visible response is the collect frame;
+                    # carry the prefill half's migration metadata onto
+                    # it (which replica carried the decode, what the
+                    # transfer cost — the bench's overhead signal) AND
+                    # the prefill-side TTFT: the collect frame's own
+                    # ttft_ms covers only adoption→first-replayed-token
+                    # (~0), while the first token was really produced on
+                    # the prefill replica after its queueing + prefill —
+                    # the same submit→first-token definition the unified
+                    # path reports, so fleet and unified TTFT compare
+                    # like for like.
+                    final.migrated_to = resp.migrated_to
+                    final.migrate_ms = resp.migrate_ms
+                    final.ttft_ms = resp.ttft_ms
+                    self._note_affinity(prefix_key, dec)
+                    return final
+            # 3. Unified spread (also the recompute fallback when the
+            # pipeline cannot run or lost a continuation).
+            # NoHealthyReplicasError is retryable: probation may clear
+            # under the policy's backoff.
+            rep = self._pick(prefix_key)
+            resp = run_on(rep, mk_req())
             # The replica now holds this prompt's prefix blocks: later
             # requests sharing the leading block prefer it (cache hit).
             self._note_affinity(prefix_key, rep)
@@ -358,25 +579,65 @@ class Router:
 
     def replica_stats(self, timeout: float = 5.0) -> Dict[str, dict]:
         """Live ``StatsRequest`` snapshot per reachable replica, plus
-        the router's own health view."""
-        out: Dict[str, dict] = {}
+        the router's own health view.
+
+        Replicas are polled CONCURRENTLY under one overall deadline:
+        an unreachable replica costs the snapshot one ``timeout``, not
+        one timeout EACH — the fleet controller reads this every
+        control round, and with serial polling an N-replica snapshot
+        over dead peers stalled N×timeout (the satellite fix this PR
+        pins with a dead-replica test)."""
+        with self._lock:
+            reps = list(self._replicas)
         now = time.monotonic()
-        for idx, rep in enumerate(self._replicas):
-            entry: Dict[str, object] = {
+        entries: List[Dict[str, object]] = []
+        for rep in reps:
+            entries.append({
+                "name": rep.spec.name,
+                "role": rep.spec.role,
                 "healthy": self._healthy(rep, now),
+                "draining": rep.draining,
                 "strikes": rep.strikes,
                 "inflight": rep.inflight,
                 "completed": rep.completed,
                 "failed": rep.failed,
-            }
+            })
+
+        # Fetch threads write into their own holders, NOT the returned
+        # entries: a thread that outlives the deadline must not mutate
+        # a snapshot the caller is already iterating (the controller
+        # reads these mid-control-round).
+        holders: List[Dict[str, object]] = [{} for _ in reps]
+
+        def fetch(rep: _ReplicaState, holder: Dict[str, object]) -> None:
             try:
                 resp = self._client(rep).request(StatsRequest(),
                                                  idempotent=False,
                                                  timeout=timeout)
-                entry["stats"] = resp.stats
+                holder["stats"] = resp.stats
             except OSError as e:
-                entry["stats_error"] = str(e)
-            key = rep.spec.name
+                holder["stats_error"] = str(e)
+
+        threads = [threading.Thread(target=fetch, args=(rep, holder),
+                                    daemon=True,
+                                    name=f"stats-{rep.spec.name}")
+                   for rep, holder in zip(reps, holders)]
+        for t in threads:
+            t.start()
+        # One overall deadline (timeout + connect grace), not per
+        # replica: the snapshot returns when the fleet answered or the
+        # clock ran out, whichever is first.
+        deadline = time.monotonic() + timeout + 1.0
+        for t in threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+        out: Dict[str, dict] = {}
+        for idx, (entry, holder, t) in enumerate(zip(entries, holders,
+                                                     threads)):
+            if t.is_alive():
+                entry["stats_error"] = f"timeout after {timeout}s"
+            else:
+                entry.update(holder)
+            key = str(entry["name"])
             if key in out:   # duplicate display names stay visible
                 key = f"{key}[{idx}]"
             out[key] = entry

@@ -26,14 +26,20 @@ a replica crashing at the worst moment.
 
 from __future__ import annotations
 
+import threading
+from collections import OrderedDict
 from typing import Any, List, Optional, Tuple
 
 from .. import faults as faults_mod
-from ..runner.common.network import AckResponse, BasicService, DropConnection
+from ..runner.common.network import (AckResponse, BasicService,
+                                     CollectRequest, DrainRequest,
+                                     DropConnection, KvMigrateRequest,
+                                     KvMigrateResponse)
 from ..utils.logging import get_logger
 from .batcher import (ContinuousBatcher, QueueFullError,
-                      ReplicaKilledError)
-from .engine import PromptTooLongError, SamplingParams
+                      ReplicaDrainingError, ReplicaKilledError)
+from .engine import PromptTooLongError, SamplingParams, resolved_config
+from .fleet.migration import MigrationBuffer, MigrationError, migrate_slot
 
 logger = get_logger(__name__)
 
@@ -42,7 +48,8 @@ class GenerateRequest:
     def __init__(self, request_id: str, prompt: List[int],
                  max_new_tokens: int = 16, temperature: float = 0.0,
                  top_k: int = 0, stop_token: Optional[int] = None,
-                 deadline_s: Optional[float] = None, spec: bool = False):
+                 deadline_s: Optional[float] = None, spec: bool = False,
+                 migrate_to: Optional[tuple] = None):
         self.request_id = request_id
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -53,16 +60,34 @@ class GenerateRequest:
         # Per-request speculative-decoding opt-in (greedy only; ignored
         # by replicas whose engine has no drafter).
         self.spec = spec
+        # Disaggregated fleet: the router asks a prefill replica to
+        # hand this request's KV to ``(name, [(ip, port), ...])`` after
+        # the first token; None (or a non-prefill replica) runs the
+        # full generation locally.
+        self.migrate_to = migrate_to
 
 
 class GenerateResponse:
     def __init__(self, request_id: str, tokens: Optional[List[int]],
                  error: Optional[str] = None,
-                 ttft_ms: Optional[float] = None):
+                 ttft_ms: Optional[float] = None,
+                 migrated_to: Optional[str] = None,
+                 migrate_ms: Optional[float] = None,
+                 evicted_prefixes: Optional[list] = None):
         self.request_id = request_id
         self.tokens = tokens
         self.error = error
         self.ttft_ms = ttft_ms
+        # KV migration outcome: the decode replica now carrying the
+        # generation (the router collects the final tokens there) and
+        # the transfer's wall time (the bench's migration-overhead
+        # signal).
+        self.migrated_to = migrated_to
+        self.migrate_ms = migrate_ms
+        # Eviction notifications piggybacked for the router's global
+        # prefix directory: leading-block keys this replica no longer
+        # holds (serve/kv/pool.py::drain_evicted_keys).
+        self.evicted_prefixes = evicted_prefixes
 
 
 class CancelRequest:
@@ -95,10 +120,26 @@ class InferenceServer(BasicService):
                  name: str = "serve", host: str = "0.0.0.0",
                  nics: Optional[List[str]] = None,
                  replica_ranks: Optional[List[int]] = None,
-                 start_batcher: bool = True):
+                 start_batcher: bool = True,
+                 migrate_chunk_bytes: Optional[int] = None):
         super().__init__(name, key, host=host, nics=nics)
         self._batcher = batcher
         self.replica_ranks = list(replica_ranks) if replica_ranks else None
+        # Disaggregated fleet: receiver-side migration assembly (any
+        # role may adopt) and the sender-side handoff on prefill
+        # replicas (serve/fleet/migration.py over this server's key).
+        self._migrations = MigrationBuffer()
+        self._adopt_lock = threading.Lock()
+        self._adopted: "OrderedDict[str, Any]" = OrderedDict()  # guarded-by: _adopt_lock
+        if batcher.role == "prefill":
+            chunk = int(migrate_chunk_bytes
+                        or resolved_config().fleet_migrate_chunk)
+
+            def _migrator(engine, slot, sreq):
+                return migrate_slot(engine, slot, sreq, sreq.migrate_to,
+                                    self._key, chunk_bytes=chunk)
+
+            batcher.set_migrator(_migrator)
         if start_batcher:
             batcher.start()
 
@@ -106,11 +147,26 @@ class InferenceServer(BasicService):
     def dead(self) -> bool:
         return self._batcher.dead
 
+    @property
+    def role(self) -> str:
+        return self._batcher.role
+
     def _handle(self, req: Any, client_address) -> Any:
         if isinstance(req, GenerateRequest):
             return self._generate(req)
         if isinstance(req, CancelRequest):
+            self._migrations.discard(req.request_id)
             self._batcher.cancel(req.request_id)
+            return AckResponse()
+        if isinstance(req, KvMigrateRequest):
+            return self._kv_migrate(req)
+        if isinstance(req, CollectRequest):
+            return self._collect(req)
+        if isinstance(req, DrainRequest):
+            if getattr(req, "cancel", False):
+                self._batcher.undrain()
+            else:
+                self._batcher.drain()
             return AckResponse()
         if isinstance(req, StatsRequest):
             snap = self._batcher.snapshot()
@@ -118,6 +174,64 @@ class InferenceServer(BasicService):
                 snap["replica_ranks"] = self.replica_ranks
             return StatsResponse(snap)
         return super()._handle(req, client_address)
+
+    def _kv_migrate(self, req: KvMigrateRequest) -> KvMigrateResponse:
+        """One migration frame: buffer; on the final frame verify the
+        digests and adopt the request into the batcher.  Every error is
+        a terminal per-transfer answer — the sender falls back to
+        decoding locally, so nothing here may strike this replica."""
+        try:
+            done = self._migrations.add(req)
+        except MigrationError as e:
+            return KvMigrateResponse(req.request_id, error=str(e))
+        if done is None:
+            return KvMigrateResponse(req.request_id)   # frame buffered
+        manifest, k, v = done
+        try:
+            sr = self._batcher.adopt(manifest, k, v)
+        except QueueFullError:
+            return KvMigrateResponse(req.request_id, error="busy")
+        except ReplicaDrainingError:
+            return KvMigrateResponse(req.request_id, error="draining")
+        except ReplicaKilledError:
+            return KvMigrateResponse(req.request_id, error="replica_dead")
+        except (PromptTooLongError, ValueError) as e:
+            return KvMigrateResponse(req.request_id,
+                                     error=f"invalid_migration: {e}")
+        with self._adopt_lock:
+            self._adopted[sr.request_id] = sr
+            while len(self._adopted) > 1024:
+                self._adopted.popitem(last=False)
+        return KvMigrateResponse(req.request_id)
+
+    def _collect(self, creq: CollectRequest) -> GenerateResponse:
+        """Block until the adopted (migrated-in) request finishes and
+        answer with its full token stream — the router's decode half of
+        the admit→prefill→migrate→decode pipeline."""
+        with self._adopt_lock:
+            sr = self._adopted.get(creq.request_id)
+        if sr is None:
+            # Adoption lost (restart, cancel, LRU overflow): the router
+            # re-routes to a recompute path.
+            return GenerateResponse(creq.request_id, None,
+                                    error="unknown_request")
+        while not sr.done.wait(timeout=30.0):
+            if self._batcher.dead:
+                sr.finish(error="replica_dead")   # idempotent
+        with self._adopt_lock:
+            self._adopted.pop(creq.request_id, None)
+        if sr.error is not None:
+            return GenerateResponse(creq.request_id, None, error=sr.error)
+        ttft_ms = None
+        if sr.first_token_at is not None:
+            ttft_ms = round((sr.first_token_at - sr.submitted_at) * 1e3, 3)
+        return GenerateResponse(creq.request_id, sr.tokens,
+                                ttft_ms=ttft_ms,
+                                evicted_prefixes=self._drain_evictions())
+
+    def _drain_evictions(self) -> Optional[list]:
+        keys = self._batcher.engine.drain_evicted_prefixes()
+        return [list(k) for k in keys] or None
 
     def _generate(self, req: GenerateRequest) -> GenerateResponse:
         # Fault site "serve" (drop/delay) — before admission, so a
@@ -133,9 +247,13 @@ class InferenceServer(BasicService):
         try:
             sr = self._batcher.submit(
                 req.prompt, sampling, request_id=req.request_id,
-                deadline_s=req.deadline_s)
+                deadline_s=req.deadline_s,
+                migrate_to=getattr(req, "migrate_to", None))
         except QueueFullError:
             return GenerateResponse(req.request_id, None, error="busy")
+        except ReplicaDrainingError:
+            return GenerateResponse(req.request_id, None,
+                                    error="draining")
         except ReplicaKilledError:
             return GenerateResponse(req.request_id, None,
                                     error="replica_dead")
@@ -164,7 +282,12 @@ class InferenceServer(BasicService):
         ttft_ms = None
         if sr.first_token_at is not None:
             ttft_ms = round((sr.first_token_at - sr.submitted_at) * 1e3, 3)
-        return GenerateResponse(req.request_id, sr.tokens, ttft_ms=ttft_ms)
+        return GenerateResponse(
+            req.request_id, sr.tokens, ttft_ms=ttft_ms,
+            migrated_to=(sr.migrate_to[0]
+                         if sr.migrated and sr.migrate_to else None),
+            migrate_ms=sr.migrate_ms,
+            evicted_prefixes=self._drain_evictions())
 
     def shutdown(self) -> None:
         self._batcher.stop()

@@ -57,8 +57,12 @@ TARGETS = {
     # HVD_TPU_CHAOS_STEP/_SEED, so one knob pair drives all of them.
     ("train", False): "tests/test_faults.py",
     ("train", True): "tests/multiproc/test_chaos_recovery_mp.py",
-    ("serve", False): "tests/test_serving.py",
-    ("serve", True): "tests/multiproc/test_serving_mp.py",
+    # serve: the single-replica drills (kill mid-decode / mid-spec-
+    # decode, evict pressure) plus the fleet drill (kill mid-MIGRATION
+    # with a forced scale-out + drain-and-retire cycle).
+    ("serve", False): "tests/test_serving.py tests/test_fleet.py",
+    ("serve", True): ("tests/multiproc/test_serving_mp.py "
+                      "tests/multiproc/test_fleet_mp.py"),
     # dcn: randomized ``dcn:step=N`` specs against the hierarchical
     # schedule's cross-pod exchange (topo/schedule.py) — the
     # simulated-mesh recovery drill runs single-controller only.
@@ -84,8 +88,8 @@ def run_once(target: str, step: int, seed: int, timeout_s: float,
         # in the summary below — one `cat` away.
         "HVD_TPU_FLIGHT_DIR": flight_dir,
     })
-    cmd = [sys.executable, "-m", "pytest", target, "-q", "-m", "chaos",
-           "-p", "no:cacheprovider"]
+    cmd = [sys.executable, "-m", "pytest", *target.split(), "-q",
+           "-m", "chaos", "-p", "no:cacheprovider"]
     t0 = time.monotonic()
     try:
         proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
@@ -124,7 +128,9 @@ def main(argv=None) -> int:
                     help="'train' loops the elastic-recovery chaos "
                          "tests; 'serve' soaks the serving router under "
                          "randomized serve:kill fault specs (plain + "
-                         "speculative decode) plus the paged-KV "
+                         "speculative decode, and the disaggregated "
+                         "fleet's kill-mid-migration + forced "
+                         "scale-cycle drill) plus the paged-KV "
                          "serve:evict pressure drill; 'dcn' "
                          "soaks the hierarchical schedule's cross-pod "
                          "exchange under randomized dcn:* fault specs "

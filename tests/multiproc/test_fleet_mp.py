@@ -1,0 +1,127 @@
+"""Disaggregated-fleet failover across real controller processes: a
+2-rank world where rank 1 runs the PREFILL replica and rank 0 runs the
+DECODE replica plus the router.  Rank 1's fault plan kills it at its
+N-th step dispatch — for a prefill-role batcher that is the KV-
+migration handoff, so the replica dies mid-migration — and every
+request must still complete, token-identical to the single-replica
+greedy stream, on the recompute path (the decode replica serves the
+full generation once no healthy prefill remains).
+
+Seeded knobs (``HVD_TPU_CHAOS_STEP`` / ``HVD_TPU_CHAOS_SEED``) let
+``scripts/chaos_soak.py --mode serve --mp`` loop this over randomized
+injection points."""
+
+import json
+import os
+
+import pytest
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos, pytest.mark.serving]
+
+BODY = """
+import json, time
+import jax.numpy as jnp
+from horovod_tpu import faults
+from horovod_tpu.models.transformer import GPT, GPTConfig
+from horovod_tpu.serve import (ContinuousBatcher, InferenceEngine,
+                               InferenceServer, ReplicaSpec, Router)
+from horovod_tpu.utils.retry import RetryPolicy
+
+workdir = os.path.dirname(os.path.abspath(__file__))
+# Fold the soak's step into the prefill replica's handoff-event budget
+# (one handoff per request; the kill must land mid-run).
+fault_step = int(os.environ.get('HVD_TPU_CHAOS_STEP', '0')) % 8
+seed = int(os.environ.get('HVD_TPU_CHAOS_SEED', '0'))
+KEY = b'k' * 32
+N_REQUESTS, N_TOKENS = 10, 6
+ROLE = 'prefill' if rank == 1 else 'decode'
+
+cfgm = GPTConfig(vocab_size=97, n_layer=2, n_head=2, d_model=32, d_ff=64,
+                 max_seq_len=32, dtype=jnp.float32, param_dtype=jnp.float32)
+model = GPT(cfgm)
+# Same key on every rank: replicas are true model copies.
+params = model.init(jax.random.PRNGKey(0),
+                    jnp.zeros((1, 8), jnp.int32))['params']
+engine = InferenceEngine(model, params, max_slots=2, prefill_buckets=(8,),
+                         max_seq_len=32, kv_block=4)
+batcher = ContinuousBatcher(engine, max_queue=16, default_deadline_s=60,
+                            role=ROLE)
+server = InferenceServer(batcher, key=KEY, name=f'replica-{rank}',
+                         host='127.0.0.1')
+open(os.path.join(workdir, f'addr_{rank}'), 'w').write(str(server.port))
+
+def wait_for(path, timeout=120):
+    deadline = time.monotonic() + timeout
+    while not os.path.exists(path):
+        assert time.monotonic() < deadline, f'timed out waiting for {path}'
+        time.sleep(0.1)
+
+if rank == 1:
+    # The doomed prefill replica: its plan kills it at the
+    # fault_step-th step dispatch it executes — the KV-migration
+    # handoff (prefill replicas never dispatch decode).
+    faults.configure(f'serve:step={fault_step},seed={seed},mode=kill')
+    wait_for(os.path.join(workdir, 'done'))
+    kills = [h for h in faults.history() if h[0] == 'serve']
+    assert len(kills) == 1 and server.dead, (kills, server.dead)
+else:
+    wait_for(os.path.join(workdir, 'addr_1'))
+    port1 = int(open(os.path.join(workdir, 'addr_1')).read())
+    router = Router(
+        [ReplicaSpec('replica-0', [('127.0.0.1', server.port)],
+                     role='decode'),
+         ReplicaSpec('replica-1', [('127.0.0.1', port1)],
+                     role='prefill')],
+        KEY, probation_s=300.0,
+        retry_policy=RetryPolicy(attempts=10, base_delay_s=0.05,
+                                 max_delay_s=0.5))
+    responses = {}
+    migrated = 0
+    for i in range(N_REQUESTS):
+        rid = f'req-{i}'
+        resp = router.generate([i + 1, i + 2, i + 3, i + 4],
+                               max_new_tokens=N_TOKENS, request_id=rid)
+        assert resp.error is None, (i, resp.error)
+        assert len(resp.tokens) == N_TOKENS and resp.request_id == rid
+        assert rid not in responses
+        responses[rid] = resp.tokens
+        migrated += resp.migrated_to is not None
+    assert len(responses) == N_REQUESTS
+    # Replicas are identical model copies, so the disaggregation (and
+    # its mid-migration death) must be invisible in the tokens: every
+    # answer matches the local full-forward greedy oracle, whether it
+    # migrated or recomputed on the survivor.
+    for i in range(N_REQUESTS):
+        seq = [i + 1, i + 2, i + 3, i + 4]
+        want = []
+        for _ in range(N_TOKENS):
+            logits = model.apply({'params': params},
+                                 jnp.asarray([seq], jnp.int32))
+            tok = int(jnp.argmax(logits[0, -1]))
+            want.append(tok)
+            seq.append(tok)
+        assert responses[f'req-{i}'] == want, (i, responses[f'req-{i}'],
+                                               want)
+    stats = router.replica_stats()
+    benched = [k for k, v in stats.items() if not v['healthy']]
+    assert benched == ['replica-1'], stats
+    json.dump({'responses': responses, 'benched': benched,
+               'migrated': migrated},
+              open(os.path.join(workdir, 'fleet_result.json'), 'w'))
+    open(os.path.join(workdir, 'done'), 'w').write('ok')
+server.shutdown()
+print(f'rank {rank}: fleet mid-migration failover ok')
+"""
+
+
+class TestFleetFailover:
+    def test_prefill_dies_mid_migration_completes_elsewhere(
+            self, world, tmp_path):
+        world(2, BODY, timeout=300.0)
+        result = json.load(open(tmp_path / "fleet_result.json"))
+        assert len(result["responses"]) == 10
+        assert result["benched"] == ["replica-1"]
+        # Requests before the kill migrated; the rest recomputed on the
+        # surviving decode replica — both paths produced full answers.
+        step = int(os.environ.get("HVD_TPU_CHAOS_STEP", "0")) % 8
+        assert result["migrated"] <= step

@@ -127,6 +127,43 @@ def test_serving_bench_prefix_heavy_contract(tmp_path):
 
 
 @pytest.mark.slow
+def test_serving_bench_fleet_contract(tmp_path):
+    """ISSUE 11 satellite: the disaggregated-fleet bench runs on CPU
+    and reports per-role occupancy, migration overhead per request,
+    and p99 TTFT for both the fleet and the same-chip-count unified
+    regime; ``bench_regress`` accepts the artifact."""
+    out_path = str(tmp_path / "serving_fleet.json")
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmarks",
+                                      "serving_bench.py"),
+         "--fleet", "1x1", "--requests", "6", "--warmup", "1",
+         "--max-new-tokens", "4", "--buckets", "16", "--slots", "2",
+         "--prompt-max", "12", "--burst", "3", "--burst-interval",
+         "0.05", "--out", out_path],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "XLA_FLAGS": "", "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "serving_fleet_tok_per_s"
+    assert row["value"] > 0
+    assert row["failed"] == 0 and row["unified_failed"] == 0
+    # Every request crossed the fleet: prefill->decode KV migration
+    # with measurable per-request overhead.
+    assert row["migrations"] > 0
+    assert row["migrate_ms_mean"] and row["migrate_ms_mean"] > 0
+    assert row["ttft_ms_p99"] and row["ttft_ms_p99"] > 0
+    assert row["unified_ttft_ms_p99"] and row["unified_ttft_ms_p99"] > 0
+    assert "occupancy_prefill" in row and "occupancy_decode" in row
+    regress = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts",
+                                      "bench_regress.py"),
+         out_path, out_path],
+        capture_output=True, text=True, timeout=60)
+    assert regress.returncode == 0, regress.stdout[-500:]
+
+
+@pytest.mark.slow
 def test_serving_bench_trace_artifact(tmp_path):
     """ISSUE 7 satellite: ``--trace DIR`` writes a merged Perfetto
     trace for the measured window and embeds its path + critical-path
