@@ -25,24 +25,29 @@ from __future__ import annotations
 from pathlib import Path
 from typing import List, Optional, Sequence
 
-from .core import (CHECK_CATALOG, Checker, Finding, LintConfig,
-                   all_check_ids, iter_source_files, run_checks)
+from .core import (CHECK_CATALOG, CHECK_GROUPS, Checker, Finding,
+                   LintConfig, all_check_ids, expand_select,
+                   iter_source_files, run_checks)
 
 __all__ = [
-    "CHECK_CATALOG", "Checker", "Finding", "LintConfig", "all_check_ids",
-    "iter_source_files", "run_checks", "default_checkers", "run",
-    "run_jaxpr_checks", "record_findings_metric",
+    "CHECK_CATALOG", "CHECK_GROUPS", "Checker", "Finding", "LintConfig",
+    "all_check_ids", "expand_select", "iter_source_files", "run_checks",
+    "default_checkers", "run", "run_jaxpr_checks",
+    "record_findings_metric",
 ]
 
 
 def default_checkers() -> List[type]:
     from .knobs import KnobChecker
     from .locks import LockChecker
+    from .protocol import ProtocolChecker
     from .rank_divergence import RankDivergenceChecker
     from .registries import (FaultSiteChecker, MetricNameChecker,
                              SpanNameChecker)
+    from .waits import WaitChecker
     return [RankDivergenceChecker, KnobChecker, LockChecker,
-            FaultSiteChecker, MetricNameChecker, SpanNameChecker]
+            FaultSiteChecker, MetricNameChecker, SpanNameChecker,
+            ProtocolChecker, WaitChecker]
 
 
 def repo_root() -> Path:
@@ -56,7 +61,7 @@ def run(root: Optional[Path] = None,
     """Run the AST analyzers over the package; returns unsuppressed
     findings (empty = clean)."""
     cfg = LintConfig(root=Path(root) if root else repo_root(),
-                     select=list(select) if select else None)
+                     select=expand_select(list(select)) if select else None)
     return run_checks(cfg)
 
 

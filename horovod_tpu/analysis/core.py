@@ -44,7 +44,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 __all__ = [
     "Finding", "Checker", "LintConfig", "Suppression", "SourceModule",
     "run_checks", "all_check_ids", "iter_source_files", "CHECK_CATALOG",
-    "terminal_name",
+    "CHECK_GROUPS", "expand_select", "terminal_name",
 ]
 
 
@@ -107,6 +107,22 @@ CHECK_CATALOG: "Dict[str, Tuple[str, str]]" = {
         "error", "traced train-step collective sequence differs across "
                  "simulated rank environments, or disagrees with the "
                  "planner's bucket schedule"),
+    "unhandled-request-frame": (
+        "error", "wire *Request frame defined in a protocol module that "
+                 "no BasicService _handle dispatches — clients get the "
+                 "base handler's AckResponse (silent drift)"),
+    "mismatched-response": (
+        "error", "a handler's dispatch branch does not return the "
+                 "frame's paired <Stem>Response (or any *Response at "
+                 "all) — request/response pairing drift"),
+    "protocol-doc-drift": (
+        "error", "wire frame missing from the docs/serving.md protocol "
+                 "table"),
+    "unbounded-wait": (
+        "error", "blocking call (thread join, sync-primitive wait, "
+                 "queue get, lock acquire, control-plane request) with "
+                 "no timeout/deadline — one wedged peer hangs the "
+                 "process"),
     "useless-suppression": (
         "warning", "hvdlint suppression that matched no finding"),
     "bad-suppression": (
@@ -117,6 +133,36 @@ CHECK_CATALOG: "Dict[str, Tuple[str, str]]" = {
 
 def all_check_ids() -> List[str]:
     return list(CHECK_CATALOG)
+
+
+# Named check groups for --select convenience: one analyzer family per
+# alias, so CI configs say `--select protocol,waits` instead of three
+# ids.  Group names deliberately do not collide with check ids.
+CHECK_GROUPS: "Dict[str, Tuple[str, ...]]" = {
+    "protocol": ("unhandled-request-frame", "mismatched-response",
+                 "protocol-doc-drift"),
+    "waits": ("unbounded-wait",),
+    "locks": ("unguarded-mutation", "lock-order-cycle"),
+    "knobs": ("unknown-knob", "undocumented-knob", "unconsumed-knob",
+              "raw-env-read"),
+}
+
+
+def expand_select(items: Optional[Sequence[str]]) -> Optional[List[str]]:
+    """Normalize a --select list: split comma-joined values and expand
+    :data:`CHECK_GROUPS` aliases into their check ids.  Unknown names
+    pass through (the CLI validates and reports them)."""
+    if items is None:
+        return None
+    out: List[str] = []
+    for item in items:
+        for tok in (t.strip() for t in str(item).split(",")):
+            if not tok:
+                continue
+            for cid in CHECK_GROUPS.get(tok, (tok,)):
+                if cid not in out:
+                    out.append(cid)
+    return out
 
 
 @dataclasses.dataclass(frozen=True)
@@ -238,6 +284,7 @@ class LintConfig:
     fault_doc: str = "docs/fault_injection.md"
     metrics_doc: str = "docs/metrics.md"
     tracing_doc: str = "docs/tracing.md"
+    serving_doc: str = "docs/serving.md"
     select: Optional[Sequence[str]] = None   # None = all checks
     exclude_dirs: Tuple[str, ...] = ("__pycache__",)
 

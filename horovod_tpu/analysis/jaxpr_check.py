@@ -230,21 +230,25 @@ def run_jaxpr_checks(microbatches: int = 2) -> List[Finding]:
         from ..topo.schedule import compile_bucket_schedule
         from ..topo.topology import MeshTopology
 
-        old_cfg = basics._state.config
+        with basics._state.lock:
+            old_cfg = basics._state.config
         topo_cfg = dataclasses.replace(
             old_cfg, topo_schedule="hierarchical",
             topo_spec=f"2x{world // 2}")
         # Analysis-only config override, restored in finally
-        # (single-threaded CI harness).
+        # (single-threaded CI harness; published under the state lock
+        # like every other _state mutation).
         try:
-            basics._state.config = topo_cfg
+            with basics._state.lock:
+                basics._state.config = topo_cfg
             findings += check_step_rank_consistency(
                 lambda: make_train_step(loss_fn, tx),
                 lambda: (params, tx.init(params), batch),
                 path="horovod_tpu/topo/schedule.py",
                 what="make_train_step(topo_schedule=hierarchical)")
         finally:
-            basics._state.config = old_cfg
+            with basics._state.lock:
+                basics._state.config = old_cfg
 
         # The compiled IR itself must be rank-invariant too (static
         # bytes in, schedule out) — the GC3 "verifiable compiler

@@ -73,7 +73,10 @@ class _GlobalState:
         self.cross_monitor = None   # guarded-by: lock (utils.cross_stall, multi-process)
         self.parameter_manager = None   # guarded-by: lock
         self.metrics_port = None    # guarded-by: lock (bound HVD_TPU_METRICS_PORT)
-        self.lock = threading.Lock()
+        # RLock: the locked read accessors below (_require/peek) are
+        # reachable from helpers that init()/autotune apply paths call
+        # while already holding the lock.
+        self.lock = threading.RLock()
 
 
 _state = _GlobalState()
@@ -465,7 +468,7 @@ def _nearest_divisor(value: int, size: int) -> int:
 
 def parameter_manager():
     """The active autotuner, or None unless ``HOROVOD_AUTOTUNE=1``."""
-    return _require_init().parameter_manager
+    return _require("parameter_manager")
 
 
 def _apply_autotuned_fusion_threshold(value: float) -> None:
@@ -641,38 +644,60 @@ atexit.register(shutdown)
 
 
 def is_initialized() -> bool:
-    """Reference: ``hvd.is_initialized()``."""
-    return _state.initialized
+    """Reference: ``hvd.is_initialized()``.  Locked read: the flag is
+    consulted from RPC handler and batcher threads while init/shutdown
+    may be flipping it (hvdsan caught the lock-free version)."""
+    with _state.lock:
+        return _state.initialized
 
 
 def _require_init() -> _GlobalState:
-    if not _state.initialized:
-        raise NotInitializedError()
+    with _state.lock:
+        if not _state.initialized:
+            raise NotInitializedError()
     return _state
+
+
+def _require(attr: str):
+    """Locked read of one initialized-state field — THE accessor the
+    public API reads globals through, so every cross-thread read honors
+    the `# guarded-by: lock` contract the sanitizer enforces."""
+    with _state.lock:
+        if not _state.initialized:
+            raise NotInitializedError()
+        return getattr(_state, attr)
+
+
+def peek(attr: str):
+    """Locked read of one global-state field, or None pre-init — the
+    fail-soft accessor for observability paths (trace/instrument/
+    engine timeline mirrors) that must work before and after init."""
+    with _state.lock:
+        return getattr(_state, attr, None)
 
 
 def size() -> int:
     """World size in *slots* (accelerator chips) — the reduction width of
     every collective.  Reference: ``hvd.size()`` (one process per GPU)."""
-    return _require_init().mesh.size
+    return _require("mesh").size
 
 
 def rank() -> int:
     """This controller process's first slot index.  Reference:
     ``hvd.rank()``.  Per-slot rank inside SPMD code: ``ops.rank(axis)``."""
-    return _require_init().mesh.process_first_slot
+    return _require("mesh").process_first_slot
 
 
 def local_size() -> int:
     """Slots attached to this process.  Reference: ``hvd.local_size()``."""
-    return _require_init().mesh.local_size
+    return _require("mesh").local_size
 
 
 def local_rank() -> int:
     """Index of this process's first slot among local slots — 0 unless
     several controller processes share a host.  Reference:
     ``hvd.local_rank()``."""
-    return _require_init().mesh.local_rank
+    return _require("mesh").local_rank
 
 
 def cross_size() -> int:
@@ -763,21 +788,21 @@ def mpi_threads_supported() -> bool:
 def config() -> Config:
     """The resolved :class:`Config` (no reference analogue as an object;
     the reference exposes knobs only as env vars)."""
-    return _require_init().config
+    return _require("config")
 
 
 def global_mesh():
     """The framework-owned global 1-D device mesh (TPU-native concept;
     replaces the reference's global MPI/Gloo communicator)."""
-    return _require_init().mesh
+    return _require("mesh")
 
 
 def timeline():
-    return _require_init().timeline
+    return _require("timeline")
 
 
 def stall_inspector():
-    return _require_init().stall_inspector
+    return _require("stall_inspector")
 
 
 def start_timeline(path: str, mark_cycles: bool = False) -> None:

@@ -183,16 +183,28 @@ class BufferPool:
         self._lock = threading.Lock()
         self._free: List[Dict[str, np.ndarray]] = [
             {} for _ in range(max(1, int(depth)))]
+        self._outstanding = 0   # guarded-by: _lock (acquired - released)
+        from ..analysis import sanitizer as _san
+
+        _san.maybe_register("buffer_pool", self)
 
     def acquire(self) -> Optional[Dict[str, np.ndarray]]:
         with self._lock:
             if self._free:
+                self._outstanding += 1
                 return self._free.pop()
         return None
 
     def release(self, buffers: Dict[str, np.ndarray]) -> None:
         with self._lock:
             self._free.append(buffers)
+            self._outstanding -= 1
+
+    def outstanding(self) -> int:
+        """Acquired-but-unreleased buffer sets — the hvdsan teardown
+        audit's leak probe (a `Snapshot` nobody released)."""
+        with self._lock:
+            return self._outstanding
 
 
 def take_snapshot(tree: Any, *, step: int = 0,

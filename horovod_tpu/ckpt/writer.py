@@ -70,9 +70,22 @@ class AsyncWriter:
                     dropped.append(self._pending.popleft())
                     self._dropped += 1
             else:
-                self._cv.wait_for(
-                    lambda: len(self._pending) < self._inflight
-                    or self._error is not None or self._closed)
+                # Backpressure must never drop data, but a silent
+                # forever-block against a wedged disk is the hang class
+                # hvdlint's unbounded-wait check exists for: wait in
+                # bounded slices and leave a flight-recorder trail each
+                # time one expires, so a stuck submit ships evidence.
+                while not self._cv.wait_for(
+                        lambda: len(self._pending) < self._inflight
+                        or self._error is not None or self._closed,
+                        timeout=60.0):
+                    logger.warning(
+                        "%s: submit backpressured >60s (writer stuck "
+                        "against a slow filesystem?)", self._name)
+                    from ..obs import flight as _flight
+
+                    _flight.record("ckpt_backpressure", writer=self._name,
+                                   depth=len(self._pending))
                 self._raise_pending_locked()
                 if self._closed:
                     # close() won the race while we were blocked: the
@@ -174,7 +187,9 @@ class AsyncWriter:
         while True:
             with self._cv:
                 while not self._pending and not self._closed:
-                    self._cv.wait()
+                    # Bounded idle tick (not a deadline): a missed
+                    # notify can only cost one slice, never a wedge.
+                    self._cv.wait(timeout=1.0)
                 if not self._pending and self._closed:
                     return
                 item = self._pending.popleft()

@@ -57,6 +57,10 @@ PRE_INIT_KNOBS = (
     # tracing + flight recorder (lazy env gates — launcher/agent
     # processes and crash paths read them before/without init)
     "TRACE", "FLIGHT", "FLIGHT_DIR",
+    # runtime concurrency sanitizer (analysis/sanitizer.py): read
+    # lazily pre-init — the test harness and chaos_soak subprocesses
+    # enable it before (or without) hvd.init
+    "SANITIZE", "SANITIZE_REPORT",
     # import-time gate for the native FFI tier
     "USE_NATIVE_FFI",
     # benchmark outage defense (runs pre-init, often in subprocesses)

@@ -151,6 +151,9 @@ class ElasticDriver:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        from ..analysis import sanitizer as _san
+
+        _san.maybe_register("elastic_slots", self)
 
     # --- membership --------------------------------------------------------
 

@@ -172,7 +172,11 @@ def active_spec() -> Optional[str]:
 def history() -> List[Tuple[str, int, str]]:
     """Copy of the firing history ``[(site, at, action), ...]`` — the
     cross-run reproducibility artifact."""
-    return list(_active.history) if _active is not None else []
+    plan = _active
+    if plan is None:
+        return []
+    with plan._lock:
+        return list(plan.history)
 
 
 @contextlib.contextmanager

@@ -141,9 +141,7 @@ def _timeline_counter(name: str, values: Dict[str, float]) -> None:
     no timeline is configured)."""
     from .. import basics
 
-    if not basics.is_initialized():
-        return
-    tl = basics._state.timeline
+    tl = basics.peek("timeline")   # fail-soft: None pre-init
     if tl is not None and tl.enabled:
         tl.counter(name, values)
 

@@ -164,9 +164,7 @@ def _emit_timeline(rec: Dict[str, Any]) -> None:
     try:
         from .. import basics
 
-        if not basics.is_initialized():
-            return
-        tl = basics._state.timeline
+        tl = basics.peek("timeline")   # fail-soft: None pre-init
         if tl is None or not tl.enabled:
             return
         lag = max(0.0, now_us() - (rec["start_us"] + rec["dur_us"]))

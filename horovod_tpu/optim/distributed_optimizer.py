@@ -675,8 +675,7 @@ def make_train_step(
         return _obs.wrap_step(
             jax.jit(body, donate_argnums=donate_argnums), kind="train")
 
-    pm = (basics._state.parameter_manager
-          if basics.is_initialized() else None)
+    pm = basics.peek("parameter_manager")   # fail-soft: None pre-init
     if pm is not None and not pm.frozen:
         if pm.claimed:
             # A second concurrent train step feeding the same manager

@@ -316,7 +316,11 @@ def probe_full_mesh(driver: DriverService, key: bytes,
                 continue
             if time.monotonic() > deadline:
                 raise TimeoutError("mesh probe timed out")
-            resp = client.request(ProbePeerRequest(j, peer_addrs))
+            # Per-exchange deadline = whatever remains of the mesh
+            # budget: a peer wedged mid-probe must not absorb it all.
+            resp = client.request(
+                ProbePeerRequest(j, peer_addrs),
+                timeout=max(1.0, deadline - time.monotonic()))
             if resp.reachable_address is None:
                 raise ConnectionError(f"task {i} cannot reach task {j}")
             routes[(i, j)] = resp.reachable_address

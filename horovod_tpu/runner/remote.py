@@ -193,23 +193,55 @@ def remote_run(hosts: List[Tuple[str, int]], command: List[str], *,
             # would hit "already running" on the agent.
             clients[i].request(RunDistributedCommandRequest(
                 command, env or {}, ranks, world_size, coordinator),
-                idempotent=False)
+                idempotent=False, timeout=30.0)
 
         # Supervise: first nonzero exit kills the job (reference
         # behavior); all-zero on every agent means success.
         pending = {i for i, ranks in enumerate(rank_blocks) if ranks}
+        aborted = False
+
+        def _abort_all() -> None:
+            # One fan-out per job, reaching EVERY still-pending agent —
+            # a wedged agent must neither stop the fan-out to the ones
+            # after it nor (by being the failure trigger itself) leave
+            # survivors' ranks blocked in collectives forever.
+            nonlocal aborted
+            if aborted:
+                return
+            aborted = True
+            for j in sorted(pending):
+                try:
+                    clients[j].request(AbortCommandRequest(),
+                                       timeout=30.0)
+                except OSError:
+                    pass
+
         while pending:
             for i in sorted(pending):
-                codes = clients[i].request(
-                    DistributedExitCodesRequest()).codes
+                try:
+                    codes = clients[i].request(
+                        DistributedExitCodesRequest(), timeout=30.0).codes
+                except OSError as e:
+                    # Wedged/dead agent: its ranks can never report —
+                    # fail the job, stop polling it, and abort the
+                    # survivors (whose ranks would otherwise block in
+                    # collectives with the dead agent's ranks).
+                    print(f"[horovodtpurun] agent {i} unreachable ({e}); "
+                          f"treating its ranks as failed", file=sys.stderr)
+                    if exit_code == 0:
+                        exit_code = 1
+                    pending.discard(i)
+                    _abort_all()
+                    continue
                 finished = {r: c for r, c in codes.items() if c is not None}
                 bad = {r: c for r, c in finished.items() if c != 0}
-                if bad and exit_code == 0:
-                    rank, exit_code = sorted(bad.items())[0]
-                    print(f"[horovodtpurun] rank {rank} exited "
-                          f"{exit_code}; terminating job", file=sys.stderr)
-                    for j in sorted(pending):
-                        clients[j].request(AbortCommandRequest())
+                if bad:
+                    if exit_code == 0:
+                        rank, exit_code = sorted(bad.items())[0]
+                        print(f"[horovodtpurun] rank {rank} exited "
+                              f"{exit_code}; terminating job",
+                              file=sys.stderr)
+                    _abort_all()
                 if len(finished) == len(codes):
                     pending.discard(i)
             if pending:
@@ -220,7 +252,7 @@ def remote_run(hosts: List[Tuple[str, int]], command: List[str], *,
     finally:
         for client in clients.values():
             try:
-                client.request(AgentShutdownRequest())
+                client.request(AgentShutdownRequest(), timeout=15.0)
             except OSError:
                 pass
         for proc in agents:

@@ -66,7 +66,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         driver = BasicClient("driver", parse_addresses(args.driver), key)
         driver.request(RegisterTaskRequest(
             args.index, service.addresses(), resolvable_hostname(),
-            coordinator_port=service.reserve_coordinator_port()))
+            coordinator_port=service.reserve_coordinator_port()),
+            timeout=60.0)
         # Serve (probes / run-command / exit-code polls happen on the
         # service threads) until the driver says we're done.  Two exit
         # hatches so a dead driver can't leak agents or workers:

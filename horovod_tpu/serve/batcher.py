@@ -142,11 +142,15 @@ class ContinuousBatcher:
 
     @property
     def dead(self) -> bool:
-        return self._killed is not None
+        # Locked read: consulted from RPC handler + router threads
+        # while _die() may be flipping it (an hvdsan read-site catch).
+        with self._lock:
+            return self._killed is not None
 
     @property
     def draining(self) -> bool:
-        return self._draining
+        with self._lock:
+            return self._draining
 
     def drain(self) -> None:
         """Enter the drain-and-retire lifecycle: stop admitting, let
@@ -324,19 +328,24 @@ class ContinuousBatcher:
         with self._lock:
             self._slots.pop(slot, None)
         self.engine.release(slot)
-        req.finish()
+        # Stats and trace record BEFORE `done` fires: the instant
+        # finish() unblocks the waiting RPC handler, a client can get
+        # its response and scrape stats — a request its own caller sees
+        # completed must already be counted (the drain test's
+        # requests_completed race).
+        end = time.monotonic()
         if req.first_token_at is not None:
             # The decode phase of this request's trace: first token to
             # completion (what dominates long generations' latency —
             # the critical-path report should name it).
             self._record_phase(req, "hvd_tpu_serve_decode",
-                               req.first_token_at, req.finished_at,
+                               req.first_token_at, end,
                                tokens=len(req.tokens))
         self.stats.record_request(
-            ttft_s=(req.first_token_at or req.finished_at)
-            - req.submitted_at,
+            ttft_s=(req.first_token_at or end) - req.submitted_at,
             n_tokens=len(req.tokens),
-            total_s=req.finished_at - req.submitted_at)
+            total_s=end - req.submitted_at)
+        req.finish()
 
     def _emit(self, slot: int, req: ServeRequest, token: int,
               now: float, check_full: bool = True) -> None:
@@ -358,8 +367,9 @@ class ContinuousBatcher:
     def step(self) -> int:
         """One scheduling iteration; returns the number of tokens
         emitted (0 = idle)."""
-        if self._killed is not None:
-            raise ReplicaKilledError(self._killed)
+        with self._lock:
+            if self._killed is not None:
+                raise ReplicaKilledError(self._killed)
         now = time.monotonic()
         self._expire(now)
         emitted = 0
@@ -435,8 +445,9 @@ class ContinuousBatcher:
             active = dict(self._slots)
         if active:
             if faults_mod._active is not None and faults_mod.on_serve_decode():
-                self._die("injected replica kill mid-decode")
-                raise ReplicaKilledError(self._killed)
+                reason = "injected replica kill mid-decode"
+                self._die(reason)
+                raise ReplicaKilledError(reason)
             tokens = self.engine.step()
             now = time.monotonic()
             for slot, toks in tokens.items():
@@ -475,8 +486,9 @@ class ContinuousBatcher:
         kills a prefill replica mid-migration (the fleet failover
         drill)."""
         if faults_mod._active is not None and faults_mod.on_serve_decode():
-            self._die("injected replica kill mid-migration")
-            raise ReplicaKilledError(self._killed)
+            reason = "injected replica kill mid-migration"
+            self._die(reason)
+            raise ReplicaKilledError(reason)
         try:
             ok = self._migrator(self.engine, slot, req)
         except Exception as e:
@@ -539,7 +551,9 @@ class ContinuousBatcher:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
-        if self._killed is None:
+        with self._lock:
+            killed = self._killed
+        if killed is None:
             self._die("replica stopped")
 
     def queue_depth(self) -> int:

@@ -485,7 +485,7 @@ class InferenceEngine:
 
         from .. import basics
 
-        tl = basics._state.timeline if basics.is_initialized() else None
+        tl = basics.peek("timeline")   # fail-soft: None pre-init
         if tl is None or not tl.enabled:
             return contextlib.nullcontext()
         return tl.activity(name, phase, args)
@@ -532,16 +532,29 @@ class InferenceEngine:
         return bucket
 
     def free_slots(self) -> List[int]:
-        return [int(s) for s in np.nonzero(~self._active)[0]]
+        with self._slot_lock:
+            return [int(s) for s in np.nonzero(~self._active)[0]]
 
     def active_slots(self) -> List[int]:
-        return [int(s) for s in np.nonzero(self._active)[0]]
+        with self._slot_lock:
+            return [int(s) for s in np.nonzero(self._active)[0]]
 
     def slot_full(self, slot: int) -> bool:
         """True when the next decode would write past the cache (the
         next decode writes K/V at index ``_positions[slot]``, valid
         while it is ``< max_seq_len``)."""
-        return int(self._positions[slot]) >= self.max_seq_len
+        with self._slot_lock:
+            return int(self._positions[slot]) >= self.max_seq_len
+
+    def _slot_snapshot(self):
+        """Locked copy of the decode-relevant slot arrays: the step
+        paths read ONE consistent view instead of racing router-thread
+        release()/adopt() mutations field by field (hvdsan read-site
+        catch — max_slots-sized copies, nanoseconds)."""
+        with self._slot_lock:
+            return (self._active.copy(), self._positions.copy(),
+                    self._temps.copy(), self._topks.copy(),
+                    self._last_tokens.copy(), self._spec.copy())
 
     # --- guarded slot-state mutation ----------------------------------------
     # The ONE place slot state changes (the hvdlint lock checker holds
@@ -588,7 +601,8 @@ class InferenceEngine:
 
     def prefix_hit_tokens(self, slot: int) -> int:
         """Prefix tokens the last ``start()`` on ``slot`` reused."""
-        return int(self._prefix_hits[slot])
+        with self._slot_lock:
+            return int(self._prefix_hits[slot])
 
     # --- request lifecycle --------------------------------------------------
 
@@ -598,8 +612,9 @@ class InferenceEngine:
         token.  One compiled program per (bucket, slot-batch) shape —
         on the paged tier the bucket covers only the non-resident
         suffix."""
-        if self._active[slot]:
-            raise RuntimeError(f"slot {slot} is already active")
+        with self._slot_lock:
+            if self._active[slot]:
+                raise RuntimeError(f"slot {slot} is already active")
         prompt = [int(t) for t in prompt]
         n = len(prompt)
         self.check_prompt_tokens(prompt)
@@ -653,13 +668,15 @@ class InferenceEngine:
         (one token per slot on the plain path; up to ``spec_k + 1``
         under speculative decoding).  Inactive rows ride along masked
         and write into the trash block."""
-        active = self.active_slots()
+        act, pos, temps, topks, last_tokens, spec = self._slot_snapshot()
+        active = [int(s) for s in np.nonzero(act)[0]]
         if not active:
             return {}
         if self._drafter is not None and any(
-                self._spec[s] and self._temps[s] <= 0 for s in active):
-            return self._step_spec(active)
-        positions = np.where(self._active, self._positions, 0).astype(np.int32)
+                spec[s] and temps[s] <= 0 for s in active):
+            return self._step_spec(
+                active, (act, pos, temps, topks, last_tokens, spec))
+        positions = np.where(act, pos, 0).astype(np.int32)
         if self.kv_mode == "paged":
             for s in active:
                 self._kv.ensure_writable(s, int(positions[s]), 1)
@@ -667,8 +684,8 @@ class InferenceEngine:
                                 {"batch": len(active)}):
                 nxt, self._pools = self._decode_fn(
                     self._params, self._pools, jnp.asarray(self._table),
-                    jnp.asarray(self._last_tokens), jnp.asarray(positions),
-                    jnp.asarray(self._temps), jnp.asarray(self._topks),
+                    jnp.asarray(last_tokens), jnp.asarray(positions),
+                    jnp.asarray(temps), jnp.asarray(topks),
                     self._next_rng())
                 nxt = np.asarray(nxt)
         else:
@@ -676,8 +693,8 @@ class InferenceEngine:
                                 {"batch": len(active)}):
                 nxt, self._caches = self._decode_fn(
                     self._params, self._caches,
-                    jnp.asarray(self._last_tokens), jnp.asarray(positions),
-                    jnp.asarray(self._temps), jnp.asarray(self._topks),
+                    jnp.asarray(last_tokens), jnp.asarray(positions),
+                    jnp.asarray(temps), jnp.asarray(topks),
                     self._next_rng())
                 nxt = np.asarray(nxt)
         out = {}
@@ -687,28 +704,33 @@ class InferenceEngine:
             self._advance_slot(s, toks)
         return out
 
-    def _step_spec(self, active: List[int]) -> Dict[int, List[int]]:
+    def _step_spec(self, active: List[int],
+                   snap: tuple) -> Dict[int, List[int]]:
         """Draft-then-verify step: the drafter proposes ``spec_k``
         tokens per slot, the target verifies the whole draft in one
         batched forward, and each slot emits its accepted prefix plus
         the target's next token (1..K+1 tokens, token-identical to
-        plain greedy decode)."""
+        plain greedy decode).  ``snap`` is step()'s slot snapshot —
+        re-snapshotting here could disagree with ``active`` (a
+        concurrent cancel between the two reads) and write into a
+        just-released slot's chain."""
         K = self.spec_k
-        positions = np.where(self._active, self._positions, 0).astype(np.int32)
+        act, pos, temps, topks, last_tokens, spec = snap
+        positions = np.where(act, pos, 0).astype(np.int32)
         for s in active:
             p = int(positions[s])
             self._kv.ensure_writable(s, p, min(K + 1, self.max_seq_len - p))
-        spec_ok = self._active & self._spec & (self._temps <= 0)
+        spec_ok = act & spec & (temps <= 0)
         with self._activity("serve/decode", "SERVE_DECODE",
                             {"batch": len(active), "spec_k": K}):
             draft, self._drafter_caches = self._spec_draft_fn(
                 self._drafter_params, self._drafter_caches,
-                jnp.asarray(self._last_tokens), jnp.asarray(positions))
+                jnp.asarray(last_tokens), jnp.asarray(positions))
             out, accepted, self._pools = self._spec_verify_fn(
                 self._params, self._pools, jnp.asarray(self._table),
-                jnp.asarray(self._last_tokens), draft,
-                jnp.asarray(positions), jnp.asarray(self._temps),
-                jnp.asarray(self._topks), jnp.asarray(spec_ok),
+                jnp.asarray(last_tokens), draft,
+                jnp.asarray(positions), jnp.asarray(temps),
+                jnp.asarray(topks), jnp.asarray(spec_ok),
                 self._next_rng())
             out = np.asarray(out)
             accepted = np.asarray(accepted)
@@ -783,8 +805,9 @@ class InferenceEngine:
         if self.kv_mode != "paged":
             raise RuntimeError("KV import requires the paged cache "
                                "(HVD_TPU_SERVE_KV=paged)")
-        if self._active[slot]:
-            raise RuntimeError(f"slot {slot} is already active")
+        with self._slot_lock:
+            if self._active[slot]:
+                raise RuntimeError(f"slot {slot} is already active")
         prompt = [int(t) for t in prompt]
         n = len(prompt)
         self.check_prompt_tokens(prompt)

@@ -93,6 +93,9 @@ class BlockPool:
         self.cow_copies_total = 0                 # guarded-by: _lock
         self.prefix_hits_total = 0                # guarded-by: _lock
         self.prefix_tokens_shared = 0             # guarded-by: _lock
+        from ...analysis import sanitizer as _san
+
+        _san.maybe_register("kv_pool", self)
 
     # --- read side ----------------------------------------------------------
 

@@ -65,14 +65,18 @@ class Timeline:
 
     @property
     def enabled(self) -> bool:
-        return self._file is not None or self._native is not None
+        # Locked read: start_timeline/stop_timeline swap the file from
+        # other threads while obs mirrors consult this per event.
+        with self._lock:
+            return self._file is not None or self._native is not None
 
     def _now_us(self) -> float:
         return (time.perf_counter_ns() - self._t0) / 1e3
 
     def _emit(self, event: dict) -> None:
-        if self._file is None:
-            return
+        # No unlocked fast-path read: an uncontended lock acquire costs
+        # nanoseconds and the double-checked peek was a (benign-looking)
+        # read-site race on the guarded handle.
         with self._lock:
             if self._file is None:
                 return

@@ -77,7 +77,7 @@ TARGETS = {
 
 
 def run_once(target: str, step: int, seed: int, timeout_s: float,
-             flight_dir: str) -> dict:
+             flight_dir: str, sanitize: bool = False) -> dict:
     env = dict(os.environ)
     env.update({
         "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
@@ -88,6 +88,17 @@ def run_once(target: str, step: int, seed: int, timeout_s: float,
         # in the summary below — one `cat` away.
         "HVD_TPU_FLIGHT_DIR": flight_dir,
     })
+    sanitize_report = os.path.join(flight_dir, "sanitizer.json")
+    if sanitize:
+        # Soft mode: violations are recorded + flight-recorded, never
+        # raised — a chaos drill killing a replica mid-operation must
+        # not be misread as a fresh failure.  The subprocess writes its
+        # findings to the report at exit (analysis/sanitizer.py).
+        os.makedirs(flight_dir, exist_ok=True)
+        env.update({
+            "HVD_TPU_SANITIZE": "soft",
+            "HVD_TPU_SANITIZE_REPORT": sanitize_report,
+        })
     cmd = [sys.executable, "-m", "pytest", *target.split(), "-q",
            "-m", "chaos", "-p", "no:cacheprovider"]
     t0 = time.monotonic()
@@ -106,8 +117,24 @@ def run_once(target: str, step: int, seed: int, timeout_s: float,
         "duration_s": round(time.monotonic() - t0, 2),
         "tail": tail if not passed else "",
     }
+    if sanitize:
+        findings = []
+        try:
+            with open(sanitize_report) as f:
+                rep = json.load(f)
+            findings = list(rep.get("violations", []))
+            # Resource leaks ride a separate report key (the per-test
+            # audit may be opted out by crash drills) — they count as
+            # findings too, as the --sanitize help text promises.
+            findings += [{"kind": "resource-leak", "message": m}
+                         for m in rep.get("leaks", [])]
+        except (OSError, ValueError):
+            pass
+        result["sanitizer_findings"] = len(findings)
+        if findings:
+            result["sanitizer"] = findings
     dumps = sorted(glob.glob(os.path.join(flight_dir, "*.json")))
-    if passed:
+    if passed and not result.get("sanitizer_findings"):
         # Chaos drills dump on every injected firing even when recovery
         # succeeds; only failures keep their postmortems on disk.
         shutil.rmtree(flight_dir, ignore_errors=True)
@@ -139,6 +166,12 @@ def main(argv=None) -> int:
                          "under randomized checkpoint:* fault specs "
                          "(all five modes, incl. stall/partial-"
                          "manifest/crash-before-rename)")
+    ap.add_argument("--sanitize", action="store_true",
+                    help="run each iteration under HVD_TPU_SANITIZE=soft "
+                         "(hvdsan, docs/lint.md): lock-discipline and "
+                         "resource-leak findings from the subprocess are "
+                         "recorded per run (sanitizer_findings) and "
+                         "totalled in the summary")
     ap.add_argument("--master-seed", type=int, default=None,
                     help="seed for the (step, seed) draw itself — a "
                          "seeded soak is replayable end to end")
@@ -167,7 +200,8 @@ def main(argv=None) -> int:
         print(f"[chaos_soak] run {i + 1}/{args.runs}: "
               f"target={target} step={step} seed={seed}", flush=True)
         result = run_once(target, step, seed, args.timeout,
-                          os.path.join(flight_root, f"iter_{i:04d}"))
+                          os.path.join(flight_root, f"iter_{i:04d}"),
+                          sanitize=args.sanitize)
         print(f"[chaos_soak]   -> {'PASS' if result['passed'] else 'FAIL'} "
               f"({result['duration_s']}s)", flush=True)
         runs.append(result)
@@ -182,6 +216,10 @@ def main(argv=None) -> int:
         "flight_root": flight_root,
         "runs": runs,
     }
+    if args.sanitize:
+        summary["sanitize"] = True
+        summary["sanitizer_findings_total"] = sum(
+            r.get("sanitizer_findings", 0) for r in runs)
     try:   # all-green soak: don't leave an empty dump root behind
         os.rmdir(flight_root)
     except OSError:

@@ -69,7 +69,15 @@ def main(argv=None) -> int:
                          "jax; seconds, not milliseconds)")
     ap.add_argument("--select", action="append", default=None,
                     metavar="CHECK-ID",
-                    help="run only these check ids (repeatable)")
+                    help="run only these check ids (repeatable; "
+                         "comma-separated values and group aliases — "
+                         "protocol, waits, locks, knobs — expand)")
+    ap.add_argument("--sanitize-report", action="store_true",
+                    help="also report the hvdsan instrumentation "
+                         "inventory (modules/classes/attributes the "
+                         "runtime sanitizer would wrap under "
+                         "HVD_TPU_SANITIZE=1) and any violations "
+                         "recorded in this process")
     ap.add_argument("--root", default=str(REPO),
                     help="repo root (default: this script's repo)")
     args = ap.parse_args(argv)
@@ -77,11 +85,13 @@ def main(argv=None) -> int:
     try:
         analysis = _import_analysis(light=not args.jaxpr)
         if args.select:
+            args.select = analysis.expand_select(args.select)
             unknown = [c for c in args.select
                        if c not in analysis.CHECK_CATALOG]
             if unknown:
-                print(f"hvdlint: unknown check id(s) {unknown}; known: "
-                      f"{sorted(analysis.CHECK_CATALOG)}", file=sys.stderr)
+                print(f"hvdlint: unknown check id(s) {unknown}; known "
+                      f"ids: {sorted(analysis.CHECK_CATALOG)}; groups: "
+                      f"{sorted(analysis.CHECK_GROUPS)}", file=sys.stderr)
                 return 2
         if not analysis.iter_source_files(
                 analysis.LintConfig(root=Path(args.root))):
@@ -101,6 +111,16 @@ def main(argv=None) -> int:
         print(f"hvdlint: internal error: {e}", file=sys.stderr)
         return 2
 
+    sanitize = None
+    if args.sanitize_report:
+        from horovod_tpu.analysis import sanitizer
+        sanitize = sanitizer.guard_inventory(Path(args.root))
+        sanitize["violations"] = sanitizer.violations()
+        print(f"hvdsan: would instrument {sanitize['attributes']} guarded "
+              f"attribute(s) across {sanitize['classes']} class(es) in "
+              f"{sanitize['modules']} module(s); "
+              f"{len(sanitize['violations'])} recorded violation(s)")
+
     print(_table(findings))
     if args.json:
         payload = {
@@ -111,6 +131,8 @@ def main(argv=None) -> int:
             "findings": [f.as_dict() for f in findings],
             "counts": _counts(findings),
         }
+        if sanitize is not None:
+            payload["sanitize"] = sanitize
         text = json.dumps(payload, indent=2, sort_keys=True)
         if args.json == "-":
             print(text)

@@ -31,13 +31,48 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.analysis import sanitizer as _sanitizer  # noqa: E402
 
 
 @pytest.fixture(scope="session", autouse=True)
 def _init_horovod_tpu():
+    # hvdsan (HVD_TPU_SANITIZE=1): instrument every `# guarded-by`
+    # class attribute BEFORE init builds the long-lived singletons, so
+    # the whole suite runs under read+write lock assertions and the
+    # Eraser lockset pass (docs/lint.md).
+    if _sanitizer.enabled():
+        _sanitizer.install()
     hvd.init()
     yield
     hvd.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _hvdsan_teardown_audit(request):
+    """Per-test resource-lifecycle audit (sanitize mode only): any
+    refcounted resource — KV blocks, snapshot buffers, reserved elastic
+    slots — still held when the test ends fails THAT test with the
+    leak named, instead of poisoning a later one."""
+    if not _sanitizer.enabled() \
+            or request.node.get_closest_marker("no_leak_audit"):
+        yield
+        return
+    import gc
+
+    # Baseline-and-delta, not reset: registrations persist across tests
+    # so a SHARED fixture's pool is still audited — the test is charged
+    # only for what it added on top of the state it inherited.
+    baseline = _sanitizer.audit_baseline()
+    yield
+    # Collect first: a pool that died WITH the test leaked nothing (its
+    # blocks die with it) — the audit targets resources still held by
+    # survivors (shared fixtures, cross-test engines), the class that
+    # poisons later tests.
+    gc.collect()
+    leaks = _sanitizer.audit_check(record=False, baseline=baseline)
+    if leaks:
+        pytest.fail("hvdsan resource-lifecycle audit: "
+                    + "; ".join(leaks), pytrace=False)
 
 
 @pytest.fixture(scope="session")

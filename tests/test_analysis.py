@@ -513,6 +513,196 @@ def test_span_rules_ignore_non_trace_receivers(tmp_path):
     assert fs == []
 
 
+# --- wire-protocol consistency ----------------------------------------------
+
+PROTOCOL_FIXTURE = """
+class AckResponse:
+    pass
+
+
+class PingRequest:
+    pass
+
+
+class PingResponse:
+    pass
+
+
+class EchoRequest:
+    pass
+
+
+class EchoResponse:
+    pass
+
+
+class BasicService:
+    def _handle(self, req, addr):
+        if isinstance(req, PingRequest):
+            return PingResponse()
+        if isinstance(req, EchoRequest):
+            return self._echo(req)
+        return AckResponse()
+
+    def _echo(self, req):
+        return EchoResponse()
+"""
+
+PROTOCOL_DOC = "| `PingRequest` | x |\n| `EchoRequest` | x |\n" \
+               "| `GhostRequest` | x |\n"
+
+
+def test_protocol_clean_fixture(tmp_path):
+    from horovod_tpu.analysis.protocol import ProtocolChecker
+
+    fs = lint(tmp_path, {"net.py": PROTOCOL_FIXTURE}, [ProtocolChecker],
+              docs={"serving.md": PROTOCOL_DOC})
+    assert fs == [], "\n".join(f.format() for f in fs)
+
+
+def test_protocol_unhandled_frame(tmp_path):
+    from horovod_tpu.analysis.protocol import ProtocolChecker
+
+    src = PROTOCOL_FIXTURE + "\n\nclass GhostRequest:\n    pass\n"
+    fs = lint(tmp_path, {"net.py": src}, [ProtocolChecker],
+              docs={"serving.md": PROTOCOL_DOC})
+    assert checks_of(fs) == ["unhandled-request-frame"]
+    assert "GhostRequest" in fs[0].message
+
+
+def test_protocol_mismatched_response(tmp_path):
+    from horovod_tpu.analysis.protocol import ProtocolChecker
+
+    # The Ping branch answers Ack even though PingResponse exists:
+    # pairing drift a typed client would break on.
+    src = PROTOCOL_FIXTURE.replace(
+        "        if isinstance(req, PingRequest):\n"
+        "            return PingResponse()",
+        "        if isinstance(req, PingRequest):\n"
+        "            return AckResponse()")
+    fs = lint(tmp_path, {"net.py": src}, [ProtocolChecker],
+              docs={"serving.md": PROTOCOL_DOC})
+    assert checks_of(fs) == ["mismatched-response"]
+    assert "PingResponse" in fs[0].message
+
+
+def test_protocol_doc_drift(tmp_path):
+    from horovod_tpu.analysis.protocol import ProtocolChecker
+
+    fs = lint(tmp_path, {"net.py": PROTOCOL_FIXTURE}, [ProtocolChecker],
+              docs={"serving.md": "| `PingRequest` | x |\n"})
+    assert checks_of(fs) == ["protocol-doc-drift"]
+    assert "EchoRequest" in fs[0].message
+
+
+def test_protocol_ignores_non_service_modules(tmp_path):
+    from horovod_tpu.analysis.protocol import ProtocolChecker
+
+    # A *Request class in a module with no BasicService is an internal
+    # queue item (ServeRequest pattern), not a wire frame.
+    fs = lint(tmp_path, {"m.py": "class ServeRequest:\n    pass\n"},
+              [ProtocolChecker], docs={"serving.md": ""})
+    assert fs == []
+
+
+# --- bounded-wait discipline -------------------------------------------------
+
+def test_unbounded_thread_join(tmp_path):
+    from horovod_tpu.analysis.waits import WaitChecker
+
+    src = ("import threading\n"
+           "def f(fn):\n"
+           "    t = threading.Thread(target=fn)\n"
+           "    t.start()\n"
+           "    t.join()\n")
+    fs = lint(tmp_path, {"m.py": src}, [WaitChecker])
+    assert checks_of(fs) == ["unbounded-wait"]
+    assert "join" in fs[0].message
+
+
+def test_bounded_thread_join_ok(tmp_path):
+    from horovod_tpu.analysis.waits import WaitChecker
+
+    src = ("def f(self):\n"
+           "    self._thread.join(timeout=5)\n")
+    assert lint(tmp_path, {"m.py": src}, [WaitChecker]) == []
+
+
+def test_str_join_is_not_a_thread_wait(tmp_path):
+    from horovod_tpu.analysis.waits import WaitChecker
+
+    src = 'def f(xs):\n    return ", ".join(str(x) for x in xs)\n'
+    assert lint(tmp_path, {"m.py": src}, [WaitChecker]) == []
+
+
+def test_unbounded_condition_wait(tmp_path):
+    from horovod_tpu.analysis.waits import WaitChecker
+
+    src = ("def f(self):\n"
+           "    with self._cv:\n"
+           "        self._cv.wait()\n"
+           "        self._cv.wait_for(lambda: True)\n")
+    fs = lint(tmp_path, {"m.py": src}, [WaitChecker])
+    assert checks_of(fs) == ["unbounded-wait"] and len(fs) == 2
+
+
+def test_bounded_condition_wait_ok(tmp_path):
+    from horovod_tpu.analysis.waits import WaitChecker
+
+    src = ("def f(self):\n"
+           "    with self._cv:\n"
+           "        self._cv.wait(timeout=1.0)\n"
+           "        self._cv.wait_for(lambda: True, timeout=2.0)\n")
+    assert lint(tmp_path, {"m.py": src}, [WaitChecker]) == []
+
+
+def test_unbounded_queue_get_and_request(tmp_path):
+    from horovod_tpu.analysis.waits import WaitChecker
+
+    src = ("def f(self, client):\n"
+           "    item = self.task_queue.get()\n"
+           "    resp = client.request(PingRequest())\n")
+    fs = lint(tmp_path, {"m.py": src}, [WaitChecker])
+    assert checks_of(fs) == ["unbounded-wait"] and len(fs) == 2
+
+
+def test_bounded_request_ok(tmp_path):
+    from horovod_tpu.analysis.waits import WaitChecker
+
+    src = ("def f(client):\n"
+           "    return client.request(PingRequest(), timeout=30.0)\n")
+    assert lint(tmp_path, {"m.py": src}, [WaitChecker]) == []
+
+
+def test_handle_wait_is_not_flagged(tmp_path):
+    from horovod_tpu.analysis.waits import WaitChecker
+
+    # Collective Handle.wait() results are synchronous API forwarders,
+    # not thread waits — receiver-name sensitivity keeps them exempt.
+    src = ("def allreduce(tensor, handle):\n"
+           "    return handle.wait()\n")
+    assert lint(tmp_path, {"m.py": src}, [WaitChecker]) == []
+
+
+def test_unbounded_wait_suppression(tmp_path):
+    from horovod_tpu.analysis.waits import WaitChecker
+
+    src = ("def supervise(proc_thread):\n"
+           "    proc_thread.join()  # hvdlint: disable=unbounded-wait "
+           "-- agent supervises the worker for the job's whole life\n")
+    assert lint(tmp_path, {"m.py": src}, [WaitChecker]) == []
+
+
+def test_select_group_aliases_expand():
+    from horovod_tpu.analysis.core import expand_select
+
+    assert expand_select(["protocol,waits"]) == [
+        "unhandled-request-frame", "mismatched-response",
+        "protocol-doc-drift", "unbounded-wait"]
+    assert expand_select(None) is None
+    assert expand_select(["unknown-knob"]) == ["unknown-knob"]
+
+
 # --- jaxpr analyzer ----------------------------------------------------------
 
 def _toy():
@@ -598,6 +788,14 @@ def test_repo_tree_is_clean():
     collective, an undocumented knob, an unguarded mutation or catalog
     drift fails tier-1 right here."""
     findings = analysis.run(REPO)
+    assert findings == [], "\n" + "\n".join(f.format() for f in findings)
+
+
+def test_repo_tree_clean_for_protocol_and_waits():
+    """The two PR-13 static passes, scoped: every wire frame dispatched,
+    paired, documented; every blocking call deadline-bound (or
+    justified).  Group aliases exercise the --select expansion path."""
+    findings = analysis.run(REPO, select=["protocol", "waits"])
     assert findings == [], "\n" + "\n".join(f.format() for f in findings)
 
 

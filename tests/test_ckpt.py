@@ -1056,6 +1056,10 @@ def _drill(ckpt_dir, fault_spec=None, kill_after=None):
 
 
 @pytest.mark.chaos
+# The drill ABANDONS phase A's writer thread mid-save (a simulated
+# SIGKILL) — its in-flight snapshot buffer is an expected in-process
+# remnant, not a lifecycle bug, so hvdsan's teardown audit stands down.
+@pytest.mark.no_leak_audit
 class TestKillMidSaveDrill:
     def _chaos_knobs(self):
         step = int(os.environ.get("HVD_TPU_CHAOS_STEP", "6"))
