@@ -100,10 +100,22 @@ def main() -> None:
                              "against a unified fleet of the same chip "
                              "count")
     parser.add_argument("--burst", type=int, default=0,
-                        help="fleet mode: requests per arrival burst "
-                             "(default 2 x --slots)")
+                        help="fleet/swap mode: requests per arrival "
+                             "burst (default 2 x --slots)")
     parser.add_argument("--burst-interval", type=float, default=0.25,
-                        help="fleet mode: seconds between bursts")
+                        help="fleet/swap mode: seconds between bursts")
+    parser.add_argument("--swap", type=int, default=0, metavar="N",
+                        help="zero-downtime hot-swap mode "
+                             "(serve/swap.py): drive an open-loop "
+                             "bursty load through a 2-replica fleet "
+                             "while rolling N weight hot-swaps from a "
+                             "checkpoint store; reports swap_latency_ms "
+                             "(store-newer -> fleet fully flipped), "
+                             "requests_dropped_during_swap (must be 0) "
+                             "and in-window vs steady-state p99 TTFT")
+    parser.add_argument("--swap-replicas", type=int, default=2,
+                        help="swap mode: unified replicas behind the "
+                             "router")
     parser.add_argument("--trace", default=None, metavar="DIR",
                         help="write a merged per-run trace artifact "
                              "(Perfetto JSON + critical-path report; "
@@ -149,6 +161,9 @@ def main() -> None:
     params = model.init(rng, jnp.zeros((1, 8), jnp.int32))["params"]
     if args.fleet:
         run_fleet(args, model, params, buckets)
+        return
+    if args.swap > 0:
+        run_swap(args, model, params, buckets)
         return
     drafter = (model, params) if args.drafter == "self" else None
     engine = InferenceEngine(model, params, max_slots=args.slots,
@@ -327,6 +342,227 @@ def main() -> None:
                        "summary": summary, "stats": snap, "rows": rows,
                        "metrics": obs_export.json_snapshot()["metrics"],
                        **({"trace": trace_block} if trace_block else {})},
+                      f, indent=1)
+
+
+def run_swap(args, model, params, buckets) -> None:
+    """Hot-swap bench: an open-loop bursty load runs CONTINUOUSLY over
+    a small unified fleet while the controller rolls ``--swap`` weight
+    deployments from a checkpoint store (each step a perturbed param
+    set committed with manifests + digests).  The three numbers the
+    acceptance reads:
+
+    * ``swap_latency_ms`` — store-newer → fleet fully flipped (every
+      replica reporting the new version), per swap and mean;
+    * ``requests_dropped_during_swap`` — requests submitted inside any
+      swap window that did NOT complete successfully (must be 0: a
+      swap holds admission briefly, it never sheds work);
+    * ``ttft_swap_ms_p99`` vs ``ttft_steady_ms_p99`` — what the flip
+      barrier costs the tail while it drains.
+    """
+    import shutil
+    import tempfile
+
+    key = b"serving-bench-swap-key-01234567"
+    store_dir = tempfile.mkdtemp(prefix="swap_bench_store_")
+    try:
+        _run_swap_inner(args, model, params, buckets, key, store_dir)
+    finally:
+        # One full weight snapshot per version lives here — repeated
+        # bench/soak runs must not accumulate them in /tmp.
+        shutil.rmtree(store_dir, ignore_errors=True)
+
+
+def _run_swap_inner(args, model, params, buckets, key, store_dir) -> None:
+    import threading
+
+    import jax
+    import numpy as np
+
+    from horovod_tpu.ckpt import ShardStore, take_snapshot
+    from horovod_tpu.serve import (ContinuousBatcher, FleetController,
+                                   InferenceEngine, InferenceServer,
+                                   ReplicaLauncher, ReplicaSpec, Router)
+    from horovod_tpu.serve.metrics import percentile as _pct
+    from horovod_tpu.utils.retry import RetryPolicy
+
+    store = ShardStore(store_dir)
+
+    def version_params(v):
+        # Version 1 is the boot set; later versions perturb ONE block's
+        # weights (a fine-tune-like delta: the manifest diff should
+        # move a fraction of the bytes, not the model).
+        if v == 1:
+            return params
+        leaf_rng = jax.random.PRNGKey(1000 + v)
+        flat, treedef = jax.tree_util.tree_flatten(params)
+        flat = list(flat)
+        flat[0] = flat[0] + 1e-3 * v * jax.random.normal(
+            leaf_rng, flat[0].shape, flat[0].dtype)
+        return jax.tree_util.tree_unflatten(treedef, flat)
+
+    host = jax.tree_util.tree_map(np.asarray, version_params(1))
+    store.write_step(take_snapshot(host, step=1), world=1, scheme="dp")
+
+    n_rep = max(1, args.swap_replicas)
+    servers = []
+    for i in range(n_rep):
+        engine = InferenceEngine(
+            model, params, max_slots=args.slots,
+            prefill_buckets=buckets, max_seq_len=args.max_seq_len,
+            kv_cache=args.kv_cache or "paged", weights_version=1,
+            seed=args.seed)
+        batcher = ContinuousBatcher(engine, max_queue=args.queue_depth,
+                                    default_deadline_s=0)
+        servers.append(InferenceServer(
+            batcher, key=key, name=f"swap-rep-{i}", host="127.0.0.1",
+            swap_store=store_dir, subscribe=False))
+    router = Router(
+        [ReplicaSpec(s.name, [("127.0.0.1", s.port)]) for s in servers],
+        key, retry_policy=RetryPolicy(attempts=8, base_delay_s=0.05,
+                                      max_delay_s=0.5))
+    controller = FleetController(router, ReplicaLauncher(),
+                                 min_per_role=1)
+
+    py_rng = random.Random(args.seed)
+
+    def mk_prompt():
+        n = py_rng.randint(args.prompt_min, args.prompt_max)
+        return [py_rng.randrange(args.vocab) for _ in range(n)]
+
+    burst = args.burst or 2 * args.slots
+    rows, rows_lock = [], threading.Lock()
+    stop_load = threading.Event()
+    threads = []
+
+    def fire(rid, prompt):
+        t0 = time.perf_counter()
+        try:
+            resp = router.generate(prompt,
+                                   max_new_tokens=args.max_new_tokens,
+                                   request_id=rid)
+            err, ttft, ver = (resp.error, resp.ttft_ms,
+                              resp.weights_version)
+            n_tok = len(resp.tokens or ())
+        except Exception as e:
+            err, ttft, ver, n_tok = str(e), None, None, 0
+        with rows_lock:
+            rows.append({"request": rid, "submitted": t0, "error": err,
+                         "ttft_ms": ttft, "tokens": n_tok,
+                         "weights_version": ver,
+                         "latency_ms": round(
+                             (time.perf_counter() - t0) * 1e3, 3)})
+
+    def load_loop():
+        j = 0
+        while not stop_load.is_set():
+            for _ in range(burst):
+                th = threading.Thread(target=fire,
+                                      args=(f"swap-req-{j}", mk_prompt()),
+                                      daemon=True)
+                th.start()
+                threads.append(th)
+                j += 1
+            stop_load.wait(args.burst_interval)
+
+    # Warmup compiles every replica's programs before measurement.
+    warm = [threading.Thread(target=fire, args=(f"warm-{i}", mk_prompt()),
+                             daemon=True) for i in range(2 * n_rep)]
+    for t in warm:
+        t.start()
+    for t in warm:
+        t.join(timeout=120.0)
+    with rows_lock:
+        rows.clear()
+
+    loader = threading.Thread(target=load_loop, daemon=True)
+    t_bench0 = time.perf_counter()
+    loader.start()
+    swap_windows = []
+    swaps = []
+    for s in range(2, args.swap + 2):
+        time.sleep(2 * args.burst_interval)
+        host_s = jax.tree_util.tree_map(np.asarray, version_params(s))
+        w0 = time.perf_counter()
+        store.write_step(take_snapshot(host_s, step=s), world=1,
+                         scheme="dp")
+        outcomes = controller.roll_swap(s, timeout=120.0)
+        w1 = time.perf_counter()
+        ok = all(o["ok"] for o in outcomes)
+        swap_windows.append((w0, w1))
+        swaps.append({"step": s, "ok": ok,
+                      "swap_latency_ms": round((w1 - w0) * 1e3, 3),
+                      "pulled_bytes": sum(o["pulled_bytes"] or 0
+                                          for o in outcomes),
+                      "outcomes": outcomes})
+    # One rollback through the same path (the journaled-step drill).
+    time.sleep(args.burst_interval)
+    rb0 = time.perf_counter()
+    rb = controller.rollback(1, timeout=120.0)
+    rollback_ms = round((time.perf_counter() - rb0) * 1e3, 3)
+    time.sleep(2 * args.burst_interval)
+    stop_load.set()
+    loader.join(timeout=30.0)   # stop appending before iterating
+    for th in threads:
+        th.join(timeout=120.0)
+    elapsed = time.perf_counter() - t_bench0
+    for s in servers:
+        s.shutdown()
+
+    def in_window(row):
+        t = row["submitted"]
+        return any(w0 <= t <= w1 + 0.001 for w0, w1 in swap_windows)
+
+    with rows_lock:
+        all_rows = list(rows)
+    ok_rows = [r for r in all_rows if r["error"] is None]
+    swap_rows = [r for r in all_rows if in_window(r)]
+    steady_rows = [r for r in all_rows if not in_window(r)]
+    dropped_during_swap = sum(1 for r in swap_rows
+                              if r["error"] is not None)
+    ttft_swap = [r["ttft_ms"] for r in swap_rows
+                 if r["error"] is None and r["ttft_ms"] is not None]
+    ttft_steady = [r["ttft_ms"] for r in steady_rows
+                   if r["error"] is None and r["ttft_ms"] is not None]
+    lat = [s["swap_latency_ms"] for s in swaps]
+    toks = sum(r["tokens"] for r in ok_rows)
+    summary = {
+        "metric": "serving_swap_tok_per_s",
+        "value": round(toks / elapsed, 3) if elapsed > 0 else 0.0,
+        "unit": "tok/s",
+        "swaps": len(swaps),
+        "swaps_ok": sum(1 for s in swaps if s["ok"]),
+        "replicas": n_rep,
+        "requests": len(all_rows),
+        "failed": len(all_rows) - len(ok_rows),
+        "requests_dropped_during_swap": dropped_during_swap,
+        "requests_during_swap": len(swap_rows),
+        "swap_latency_ms_mean": (round(sum(lat) / len(lat), 3)
+                                 if lat else None),
+        "swap_latency_ms_max": (round(max(lat), 3) if lat else None),
+        "swap_pulled_bytes_total": sum(s["pulled_bytes"] for s in swaps),
+        "rollback_ms": rollback_ms,
+        "rollback_ok": all(o["ok"] for o in rb),
+        "ttft_swap_ms_p99": (round(_pct(ttft_swap, 99), 3)
+                             if ttft_swap else None),
+        "ttft_steady_ms_p99": (round(_pct(ttft_steady, 99), 3)
+                               if ttft_steady else None),
+        "model": {"layers": args.layers, "d_model": args.d_model,
+                  "heads": args.heads, "vocab": args.vocab},
+    }
+    for s in swaps:
+        print(json.dumps({k: v for k, v in s.items()
+                          if k != "outcomes"}), flush=True)
+    print(json.dumps(summary))
+    if args.out:
+        from horovod_tpu.obs import export as obs_export
+
+        with open(args.out, "w") as f:
+            json.dump({"platform": jax.default_backend(),
+                       "device_kind": jax.devices()[0].device_kind,
+                       "summary": summary, "swaps": swaps,
+                       "rows": all_rows,
+                       "metrics": obs_export.json_snapshot()["metrics"]},
                       f, indent=1)
 
 

@@ -26,8 +26,8 @@ from .checkpointer import AsyncCheckpointer, ResumeInfo  # noqa: F401
 from .errors import CheckpointCorruptionError  # noqa: F401
 from .journal import StepJournal  # noqa: F401
 from .manifest import (  # noqa: F401
-    Manifest, ManifestError, RestorePlan, assign_owners, plan_restore,
-    shard_filename,
+    Manifest, ManifestError, RestorePlan, assign_owners, diff_manifest,
+    plan_restore, shard_filename,
 )
 from .snapshot import (  # noqa: F401
     BufferPool, Snapshot, is_snapshotable, pytree_digest, take_snapshot,
@@ -38,7 +38,8 @@ from .writer import AsyncWriter  # noqa: F401
 __all__ = [
     "AsyncCheckpointer", "ResumeInfo", "CheckpointCorruptionError",
     "StepJournal", "Manifest", "ManifestError", "RestorePlan",
-    "assign_owners", "plan_restore", "shard_filename", "BufferPool",
+    "assign_owners", "diff_manifest", "plan_restore",
+    "shard_filename", "BufferPool",
     "Snapshot", "is_snapshotable", "pytree_digest", "take_snapshot",
     "ShardStore", "AsyncWriter",
 ]

@@ -36,7 +36,7 @@ import numpy as np
 
 __all__ = [
     "SCHEMES", "Manifest", "ManifestError", "assign_owners",
-    "shard_filename", "plan_restore", "RestorePlan",
+    "shard_filename", "plan_restore", "RestorePlan", "diff_manifest",
 ]
 
 SCHEMES = ("dp", "zero", "fsdp")
@@ -209,6 +209,33 @@ class RestorePlan:
         self.by_file = by_file
         self.nbytes = nbytes
         self.leaf_ids = leaf_ids
+
+
+def diff_manifest(manifest: Manifest,
+                  have: Dict[str, str]) -> Tuple[Dict[str, List[str]],
+                                                 Dict[str, str], int]:
+    """Pull plan for a weight hot-swap (serve/swap.py): which of
+    ``manifest``'s leaves differ from the running version.
+
+    ``have`` maps key-path → leaf digest of the version currently
+    serving.  Returns ``(by_file, changed, nbytes)``: changed leaf ids
+    grouped by shard file (the shape :meth:`ShardStore.read_leaves`
+    takes), ``{leaf_id: path}`` for the changed set, and the byte total
+    the pull will move — a fine-tune step that touched 2 of 40 leaves
+    pulls 2 leaves of bytes, decided from metadata alone."""
+    by_file: Dict[str, List[str]] = {}
+    changed: Dict[str, str] = {}
+    nbytes = 0
+    for leaf_id, entry in manifest.entries.items():
+        path = entry["path"]
+        if have.get(path) == entry["digest"]:
+            continue
+        changed[leaf_id] = path
+        by_file.setdefault(entry["file"], []).append(leaf_id)
+        nbytes += int(entry["nbytes"])
+    for ids in by_file.values():
+        ids.sort()
+    return by_file, changed, nbytes
 
 
 def plan_restore(manifest: Manifest, *, rank: int,

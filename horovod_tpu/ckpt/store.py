@@ -94,6 +94,24 @@ class ShardStore:
             return []
         return sorted(int(n) for n in names if n.isdigit())
 
+    def newest_intact_step(self,
+                           min_step: Optional[int] = None) -> Optional[int]:
+        """Newest committed step that passes manifest-granularity
+        validation — the weight-hot-swap subscriber's watch primitive
+        (serve/swap.py polls this; a damaged newest step is skipped, so
+        a torn upload never becomes a serving version).  ``min_step``
+        short-circuits the scan: steps at or below it are not even
+        validated (the subscriber already runs one of them)."""
+        for step in reversed(self.steps()):
+            if min_step is not None and step <= min_step:
+                return None
+            try:
+                self.validate_step(step)
+                return step
+            except ManifestError:
+                continue
+        return None
+
     # --- write ---------------------------------------------------------------
 
     def write_step(self, snapshot: Snapshot, *, world: int, scheme: str,

@@ -36,7 +36,7 @@ DEFAULT_COST_BETA_GBPS = 100.0
 # init, exactly like every other malformed env knob.
 
 FAULT_SITES = ("collective", "fusion", "accumulate", "discovery", "rpc",
-               "checkpoint", "serve", "dcn")
+               "checkpoint", "serve", "dcn", "swap")
 
 
 # --- pre-init knob registry --------------------------------------------------
@@ -107,6 +107,19 @@ _FAULT_MODES = {
     # raise HorovodInternalError while the exchange is being emitted
     # (trace time, like `fusion`); delay sleeps delay_ms there.
     "dcn": ("drop", "delay", "partition"),
+    # swap: the zero-downtime weight hot-swap path (serve/swap.py;
+    # docs/hot_swap.md).  `corrupt-shard` damages a pulled shard AFTER
+    # the store's manifest declared the true digests — the subscriber's
+    # per-leaf verification must discard the staged pull and keep
+    # serving the old weights; `stall` sleeps delay_ms at the pull (a
+    # slow store — the HVD_TPU_SWAP_DEADLINE_S abandon drill);
+    # `kill-mid-flip` kills the replica at the batcher's flip barrier
+    # (the flip is one atomic reference swap, so the router-failover
+    # drill must find the replica on exactly one version);
+    # `partial-fleet` aborts a rolling fleet swap midway, leaving a
+    # mixed-version fleet the router's version-matched prefix routing
+    # must serve correctly.
+    "swap": ("corrupt-shard", "stall", "kill-mid-flip", "partial-fleet"),
 }
 
 
@@ -476,6 +489,12 @@ class Config:
     fleet_scale_out_ttft_ms: float = 0.0      # HVD_TPU_FLEET_SCALE_OUT_TTFT_MS (p99 TTFT that saturates a role; 0 = off)
     fleet_scale_in_idle_s: float = 30.0       # HVD_TPU_FLEET_SCALE_IN_IDLE_S (role idle window before drain-and-retire)
     fleet_drain_deadline_s: float = 30.0      # HVD_TPU_FLEET_DRAIN_DEADLINE_S (max drain wait before forced retire)
+    # Zero-downtime weight hot-swap (horovod_tpu/serve/swap.py;
+    # docs/hot_swap.md — the checkpoint-store→serving-fleet loop)
+    swap_poll_s: float = 5.0                  # HVD_TPU_SWAP_POLL_S (subscriber store-poll cadence)
+    swap_deadline_s: float = 60.0             # HVD_TPU_SWAP_DEADLINE_S (pull+stage+flip budget per swap; past it the swap is abandoned, old weights keep serving; 0 = no deadline, 7-day liveness backstop at the barrier)
+    swap_max_concurrent: int = 1              # HVD_TPU_SWAP_MAX_CONCURRENT (replicas flipping at once in a rolling fleet swap)
+    swap_retries: int = 3                     # HVD_TPU_SWAP_RETRIES (pull attempts per swap before the rejection is final)
 
     # --- fault injection (horovod_tpu/faults.py; no reference analogue) ---
     fault_spec: Optional[str] = None          # HVD_TPU_FAULT_SPEC
@@ -574,6 +593,10 @@ class Config:
             fleet_scale_in_idle_s=_env_float("FLEET_SCALE_IN_IDLE_S", 30.0),
             fleet_drain_deadline_s=_env_float("FLEET_DRAIN_DEADLINE_S",
                                               30.0),
+            swap_poll_s=_env_float("SWAP_POLL_S", 5.0),
+            swap_deadline_s=_env_float("SWAP_DEADLINE_S", 60.0),
+            swap_max_concurrent=_env_pos_int("SWAP_MAX_CONCURRENT", 1),
+            swap_retries=_env_pos_int("SWAP_RETRIES", 3),
             fault_spec=_validated_fault_spec(_env("FAULT_SPEC")),
             cache_capacity=_env_opt_int("CACHE_CAPACITY"),
             mesh_axis_name=_env("MESH_AXIS_NAME", "hvd") or "hvd",

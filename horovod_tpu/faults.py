@@ -26,6 +26,10 @@ serve      ``serve/server.py`` request handler (drop/delay);     ``drop``/``dela
            boundary (migrate*)
 dcn        ``topo/schedule.py`` cross-pod exchange step only     ``drop``/``delay``/``partition``
            (trace time; intra-pod phases never fire)
+swap       ``serve/swap.py`` shard pull (corrupt-shard/stall),   ``corrupt-shard``/``stall``/
+           ``serve/batcher.py`` flip barrier (kill-mid-flip),    ``kill-mid-flip``/
+           ``serve/fleet/controller.py`` rolling-swap boundary   ``partial-fleet``
+           (partial-fleet)
 ========== ===================================================== =====================
 
 A plan comes from ``HVD_TPU_FAULT_SPEC`` (grammar parsed in
@@ -61,7 +65,8 @@ __all__ = [
     "on_collective", "on_fusion", "on_accumulate", "on_discovery_script",
     "on_discovery_hosts", "on_rpc", "on_checkpoint_save",
     "on_serve_request", "on_serve_decode", "on_serve_evict",
-    "on_serve_migrate", "on_dcn",
+    "on_serve_migrate", "on_dcn", "on_swap_pull", "on_swap_flip",
+    "on_swap_roll",
 ]
 
 
@@ -461,6 +466,79 @@ def on_serve_migrate() -> Optional[str]:
             return None
         return mode
     return None
+
+
+def on_swap_pull() -> Optional[str]:
+    """Site ``swap`` (modes ``corrupt-shard``/``stall``) — fires at the
+    weight subscriber's shard pull (``serve/swap.py``): each event is
+    one pull attempt, so ``swap:step=N,mode=corrupt-shard`` damages the
+    N-th pull in the process.  ``stall`` sleeps ``delay_ms`` here (a
+    slow checkpoint store — the deadline-abandon drill) and returns
+    None; ``corrupt-shard`` is returned for the subscriber to apply
+    AFTER the bytes were read but BEFORE its digest verification — the
+    manifest describes the true content, so verification MUST reject
+    the pull and the replica MUST keep serving the old weights."""
+    plan = _active
+    if plan is None:
+        return None
+    st = plan.site("swap")
+    if st is None or st.clause.mode in ("kill-mid-flip", "partial-fleet"):
+        return None
+    at = st.counter
+    if st.should_fire():
+        mode = st.clause.mode or "corrupt-shard"
+        plan.fire("swap", mode, at)
+        if mode == "stall":
+            time.sleep(st.clause.delay_ms / 1000.0)
+            return None
+        return mode
+    return None
+
+
+def on_swap_flip() -> bool:
+    """Site ``swap`` (mode ``kill-mid-flip``) — fires at the batcher's
+    swap barrier, the instant before the engine's param reference would
+    flip: each event is one flip, so ``swap:step=N,mode=kill-mid-flip``
+    reproducibly kills whichever replica executes the N-th flip in the
+    process.  Returns True when the replica must die — the flip is a
+    single atomic reference swap, so the dead replica is on exactly one
+    version and the router fails its work over exactly as for any other
+    replica death."""
+    plan = _active
+    if plan is None:
+        return False
+    st = plan.site("swap")
+    if st is None or st.clause.mode != "kill-mid-flip":
+        return False
+    at = st.counter
+    if st.should_fire():
+        plan.fire("swap", "kill-mid-flip", at)
+        return True
+    return False
+
+
+def on_swap_roll() -> bool:
+    """Site ``swap`` (mode ``partial-fleet``) — fires at the fleet
+    controller's rolling-swap batch boundary
+    (``serve/fleet/controller.py``): each event is one batch of
+    replicas about to be told to swap (one replica per event at
+    ``HVD_TPU_SWAP_MAX_CONCURRENT=1``), so
+    ``swap:step=N,mode=partial-fleet`` aborts the roll before its N-th
+    batch.  Returns True when the roll must stop there, leaving the
+    fleet mixed-version — the drill for the router's version-matched
+    prefix routing (stale KV against new weights is the
+    silent-wrongness bug this rule exists for)."""
+    plan = _active
+    if plan is None:
+        return False
+    st = plan.site("swap")
+    if st is None or st.clause.mode != "partial-fleet":
+        return False
+    at = st.counter
+    if st.should_fire():
+        plan.fire("swap", "partial-fleet", at)
+        return True
+    return False
 
 
 def on_checkpoint_save(step: int) -> Optional[str]:

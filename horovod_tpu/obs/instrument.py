@@ -538,6 +538,41 @@ def on_fleet_role_occupancy(role: str, occupancy: float,
               "live replicas per role").labels(role=role).set(replicas)
 
 
+# --- zero-downtime weight hot-swap (serve/swap.py; docs/hot_swap.md) ---------
+
+def on_swap(outcome: str, ms: float = 0.0, nbytes: int = 0) -> None:
+    """One hot-swap attempt's terminal outcome: ``ok`` (fleet serving
+    the new version), ``rejected`` (digest/manifest verification failed
+    — old weights kept), ``abandoned`` (pull past the deadline — old
+    weights kept) or ``failed`` (flip never ran: replica died / barrier
+    error).  ``ms`` is the store-newer→flipped wall time (successes
+    only); ``nbytes`` bills the shard bytes actually pulled, whatever
+    the outcome — a swap retry loop's wasted wire is an operator
+    signal."""
+    if not _m.enabled():
+        return
+    reg = _reg()
+    reg.counter("hvd_tpu_swap_total",
+                "weight hot-swap attempts").labels(outcome=outcome).inc()
+    if nbytes:
+        reg.counter("hvd_tpu_swap_bytes_pulled_total",
+                    "shard bytes pulled by weight hot-swaps").inc(nbytes)
+    if outcome == "ok":
+        reg.gauge("hvd_tpu_swap_ms",
+                  "last successful hot-swap's wall time").set(ms)
+
+
+def on_weights_version(version: int) -> None:
+    """The serving version this replica flipped to (the checkpoint
+    step number) — scraped per replica, a mixed-version fleet is
+    visible as divergent gauge values."""
+    if not _m.enabled():
+        return
+    _reg().gauge("hvd_tpu_replica_weights_version",
+                 "checkpoint step this replica's weights came "
+                 "from").set(version)
+
+
 # --- autotune decision log ---------------------------------------------------
 
 # Bounded decision log: the JSON snapshot carries it verbatim (the

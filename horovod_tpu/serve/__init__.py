@@ -29,6 +29,12 @@ training framework's existing layers:
   global prefix directory, and a :class:`FleetController` driving
   per-role elastic scale-out / drain-and-retire from queue-depth and
   TTFT signals
+* :mod:`~horovod_tpu.serve.swap` — zero-downtime weight hot-swap from
+  the checkpoint store (``ckpt/``): a :class:`WeightSubscriber` per
+  replica diff-pulls only changed shards (digest-verified), stages
+  them beside the live params, and flips atomically at the batcher's
+  swap barrier; rolling fleet swaps + instant journaled rollback ride
+  the ``SwapRequest``/``RollbackRequest`` frames (docs/hot_swap.md)
 
 Chaos: the ``serve`` fault site (``HVD_TPU_FAULT_SPEC``) drops/delays
 requests at the endpoint, kills a replica mid-decode or mid-migration,
@@ -56,5 +62,10 @@ from .router import (  # noqa: F401
 )
 from .server import (  # noqa: F401
     CancelRequest, GenerateRequest, GenerateResponse, InferenceServer,
-    StatsRequest, StatsResponse,
+    RollbackRequest, StatsRequest, StatsResponse, SwapRequest,
+    SwapResponse,
+)
+from .swap import (  # noqa: F401
+    SwapAbandonedError, SwapFailedError, SwapRejectedError,
+    WeightSubscriber,
 )

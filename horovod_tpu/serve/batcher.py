@@ -88,6 +88,11 @@ class ServeRequest:
     kv_import: Optional[tuple] = None       # (manifest, k_blocks, v_blocks)
     migrated: bool = False
     migrate_ms: Optional[float] = None
+    # Weight hot-swap (serve/swap.py): the version this request's
+    # generation ran under, captured at slot binding — the response
+    # must report THIS, not the engine's version at response-build
+    # time (a flip can land between the last token and the reply).
+    weights_version: Optional[int] = None
 
     def finish(self, error: Optional[str] = None) -> None:
         if self.done.is_set():
@@ -128,12 +133,16 @@ class ContinuousBatcher:
             raise ValueError(f"unknown fleet role {self.role!r}; "
                              f"expected prefill|decode|unified")
         self._migrator = None    # set by the server on prefill replicas
-        self.stats = ServingStats()
+        self.stats = ServingStats(weights_version=engine.weights_version)
         self._lock = threading.Lock()
         self._queue: List[ServeRequest] = []         # guarded-by: _lock
         self._slots: Dict[int, ServeRequest] = {}    # guarded-by: _lock
         self._killed: Optional[str] = None           # guarded-by: _lock
         self._draining = False                       # guarded-by: _lock
+        # Weight hot-swap barrier (serve/swap.py): a pending flip holds
+        # admission, lets in-flight generations run dry, then runs at
+        # the step boundary — no request ever sees mixed weights.
+        self._pending_flip: Optional[tuple] = None   # guarded-by: _lock
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._wake = threading.Event()
@@ -172,6 +181,81 @@ class ContinuousBatcher:
                 return
             self._draining = False
         logger.info("serving replica drain cancelled (admitting again)")
+
+    # --- weight hot-swap barrier (serve/swap.py; docs/hot_swap.md) ----------
+
+    def flip_at_barrier(self, fn, timeout: float = 60.0):
+        """Run ``fn`` (the engine's ``commit_staged``) at the next step
+        boundary with NO generation in flight, and block until it ran.
+
+        While the flip is pending the scheduler admits nothing (queued
+        requests wait — backpressure, never loss) and keeps decoding,
+        so in-flight generations finish on the version they started on;
+        the moment the slots run dry the flip executes between decode
+        bursts and admission resumes.  Returns ``fn``'s result; raises
+        ``TimeoutError`` when the slots never drained inside
+        ``timeout`` (the flip is withdrawn — old weights keep serving)
+        and ``ReplicaKilledError`` when the replica died instead of
+        flipping."""
+        with self._lock:
+            if self._killed is not None:
+                raise ReplicaKilledError(self._killed)
+            if self._pending_flip is not None:
+                raise RuntimeError("a weight flip is already pending on "
+                                   "this replica")
+            flip = (fn, threading.Event(), {})
+            self._pending_flip = flip
+        self._wake.set()
+        _, event, holder = flip
+        if not event.wait(timeout=timeout):
+            with self._lock:
+                withdrawn = self._pending_flip is flip
+                if withdrawn:
+                    self._pending_flip = None
+            if withdrawn:
+                raise TimeoutError(
+                    f"swap barrier not reached within {timeout}s "
+                    f"(in-flight generations never drained)")
+            # The flip was CLAIMED between our wait timing out and the
+            # withdraw — it will run (or die); a completed flip must
+            # not read as a timeout, and an empty holder must never
+            # read as success (int(None) downstream).
+            if not event.wait(timeout=60.0):
+                raise TimeoutError(
+                    "flip claimed at the barrier but still executing "
+                    "after 60s")
+        if "error" in holder:
+            if holder["error"].startswith("flip_failed"):
+                raise RuntimeError(holder["error"])
+            raise ReplicaKilledError(holder["error"])
+        return holder.get("result")
+
+    def _run_flip(self, flip) -> None:
+        """Execute a CLAIMED flip (batcher thread, slots empty, already
+        removed from ``_pending_flip`` — a timed-out waiter can no
+        longer withdraw it).  The ``swap:mode=kill-mid-flip`` fault
+        fires here — the last instant before the atomic reference swap,
+        so a killed replica is still on exactly one version and fails
+        over like any other death."""
+        fn, event, holder = flip
+        if faults_mod._active is not None and faults_mod.on_swap_flip():
+            reason = "injected replica kill mid-flip"
+            # The flip is already claimed, so _die cannot see it — the
+            # waiter learns here, before the death unwinds.
+            holder.setdefault("error", f"replica_killed: {reason}")
+            event.set()
+            self._die(reason)
+            raise ReplicaKilledError(reason)
+        try:
+            holder["result"] = fn()
+            if isinstance(holder["result"], int):
+                self.stats.set_weights_version(holder["result"])
+        except Exception as e:   # defensive: a failed flip keeps old weights
+            holder["error"] = f"flip_failed: {e}"
+            logger.exception("weight flip failed; serving continues on "
+                             "the old version")
+        finally:
+            event.set()
 
     def set_migrator(self, migrator) -> None:
         """Install the prefill→decode handoff callable
@@ -238,6 +322,17 @@ class ContinuousBatcher:
         # validated, but a pool-poisoning prompt must die at EVERY
         # admission boundary, not only the first.
         self.engine.check_prompt_tokens(prompt)
+        # Mixed-version guard (serve/swap.py): imported KV was computed
+        # under the sender's weights; continuing it under different
+        # ones would be silently wrong.  The refusal sends the request
+        # back to the sender's pristine KV + matching weights.
+        sender_v = manifest.get("weights_version")
+        if sender_v is not None and int(sender_v) != \
+                self.engine.weights_version:
+            raise ValueError(
+                f"version_mismatch: migrated KV from weights version "
+                f"{sender_v}, this replica serves "
+                f"{self.engine.weights_version}")
         if not manifest.get("tokens"):
             raise ValueError("migration manifest carries no emitted "
                              "tokens — nothing to continue from")
@@ -370,12 +465,30 @@ class ContinuousBatcher:
         with self._lock:
             if self._killed is not None:
                 raise ReplicaKilledError(self._killed)
+            flip = self._pending_flip
         now = time.monotonic()
         self._expire(now)
         emitted = 0
+        if flip is not None:
+            # Swap barrier: admission holds (queued requests WAIT — a
+            # swap never drops work), in-flight generations keep
+            # decoding below; the moment the slots ran dry the flip
+            # runs between decode bursts and admission resumes in this
+            # same step.  The flip is CLAIMED under the lock: a waiter
+            # whose timeout withdrew it concurrently must never see it
+            # commit afterwards (it already reported the swap abandoned
+            # and discarded the staged params).
+            claimed = None
+            with self._lock:
+                if not self._slots and self._pending_flip is flip:
+                    claimed = flip
+                    self._pending_flip = None
+            if claimed is not None:
+                self._run_flip(claimed)
+                flip = None
         # Admit: bounded prefills per step keep decode cadence for the
         # already-running requests (prefill is the expensive phase).
-        for _ in range(self.max_prefill_per_step):
+        for _ in range(self.max_prefill_per_step if flip is None else 0):
             with self._lock:
                 free = self.engine.free_slots()
                 if not free or not self._queue:
@@ -392,6 +505,18 @@ class ContinuousBatcher:
                     # replay below so the token stream is seamless.
                     manifest, kb, vb = req.kv_import
                     req.kv_import = None    # payload freed after binding
+                    # Re-check the version at BIND time: a weight flip
+                    # between adoption and this pop would bind KV from
+                    # the old weights under the new ones — the
+                    # import_failed answer routes the request to a
+                    # recompute instead (never wrong tokens).
+                    sender_v = manifest.get("weights_version")
+                    if sender_v is not None and int(sender_v) != \
+                            self.engine.weights_version:
+                        raise ValueError(
+                            f"version_mismatch at bind: KV from "
+                            f"weights version {sender_v}, replica now "
+                            f"serves {self.engine.weights_version}")
                     tokens = [int(t) for t in manifest["tokens"]]
                     self.engine.import_slot_kv(
                         slot, req.prompt, kb, vb, tokens[-1],
@@ -407,6 +532,7 @@ class ContinuousBatcher:
                 req.finish(error=(f"import_failed: {e}" if imported
                                   else f"prefill_failed: {e}"))
                 continue
+            req.weights_version = self.engine.weights_version
             if not imported:
                 req.prefix_hit_tokens = self.engine.prefix_hit_tokens(slot)
                 self.stats.record_prefix(req.prefix_hit_tokens > 0)
@@ -509,6 +635,12 @@ class ContinuousBatcher:
             self._queue.clear()
             running = list(self._slots.values())
             self._slots.clear()
+            flip, self._pending_flip = self._pending_flip, None
+        if flip is not None:
+            # A subscriber blocked on the barrier must not hang until
+            # its timeout on a replica that already died.
+            flip[2].setdefault("error", f"replica_killed: {reason}")
+            flip[1].set()
         for req in pending + running:
             self.stats.record_failed()
             req.finish(error="replica_killed")
@@ -561,6 +693,9 @@ class ContinuousBatcher:
             return len(self._queue)
 
     def snapshot(self) -> Dict:
+        # ``weights_version`` rides the stats snapshot: seeded from the
+        # engine at construction, advanced only at the flip — one
+        # consistent source, no shadow overwrite here.
         snap = self.stats.snapshot()
         snap.update(self.engine.kv_stats())
         with self._lock:
@@ -569,5 +704,6 @@ class ContinuousBatcher:
                         max_slots=self.engine.max_slots,
                         dead=self._killed is not None,
                         role=self.role,
-                        draining=self._draining)
+                        draining=self._draining,
+                        swap_pending=self._pending_flip is not None)
         return snap

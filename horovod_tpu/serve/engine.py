@@ -128,6 +128,7 @@ class InferenceEngine:
                  kv_blocks: Optional[int] = None,
                  drafter: Optional[Tuple[GPT, dict]] = None,
                  spec_k: Optional[int] = None,
+                 weights_version: int = 0,
                  seed: int = 0):
         cfg = resolved_config()
         self._model = model
@@ -161,6 +162,16 @@ class InferenceEngine:
         self._last_tokens = np.zeros(self.max_slots, np.int32)  # guarded-by: _slot_lock
         self._spec = np.zeros(self.max_slots, bool)            # guarded-by: _slot_lock
         self._prefix_hits = np.zeros(self.max_slots, np.int32)  # guarded-by: _slot_lock
+        # Weight hot-swap state (serve/swap.py; docs/hot_swap.md): the
+        # running version (the checkpoint step the params came from —
+        # 0 for boot weights that never touched the store) and the
+        # staged next version awaiting the batcher's flip barrier.
+        # Version is read from RPC/stats threads while the batcher
+        # thread flips it, and staging happens on the subscriber thread
+        # — both ride the slot lock.
+        self._weights_version = int(weights_version)  # guarded-by: _slot_lock
+        self._staged_params = None                    # guarded-by: _slot_lock
+        self._staged_version = None                   # guarded-by: _slot_lock
         self._rng = jax.random.PRNGKey(seed)
         # Trace-time counters: the bounded-recompile contract is
         # testable (each jitted program bumps its key once per trace).
@@ -763,6 +774,72 @@ class InferenceEngine:
         if self._kv is not None:
             self._kv.release(slot)
         self._clear_slot(slot)
+
+    # --- zero-downtime weight hot-swap (serve/swap.py; docs/hot_swap.md) ----
+    # Staging runs on the subscriber thread; the COMMIT runs on the
+    # batcher thread only, at the swap barrier, with no active slots —
+    # so the param reference the compiled programs read never changes
+    # under an in-flight generation, and a request runs start to finish
+    # on exactly one version.
+
+    @property
+    def params(self):
+        """The live param tree (the swap subscriber seeds its leaf
+        cache from it; treat as read-only)."""
+        return self._params
+
+    @property
+    def weights_version(self) -> int:
+        with self._slot_lock:
+            return self._weights_version
+
+    def stage_params(self, tree, version: int) -> None:
+        """Stage ``tree`` (host arrays) as version ``version`` alongside
+        the live params: leaves land on the device now, so the later
+        flip is one reference assignment, not a transfer.  Replaces any
+        previously staged version (last writer wins — the newest intact
+        step is the one worth flipping to)."""
+        device = jax.tree_util.tree_map(jnp.asarray, tree)
+        with self._slot_lock:
+            self._staged_params = device
+            self._staged_version = int(version)
+
+    def staged_version(self) -> Optional[int]:
+        with self._slot_lock:
+            return self._staged_version
+
+    def discard_staged(self) -> None:
+        """Drop a staged version (digest rejection / abandoned pull /
+        dead flip): the live params were never touched."""
+        with self._slot_lock:
+            self._staged_params = None
+            self._staged_version = None
+
+    def commit_staged(self) -> int:
+        """THE flip: atomically re-point the engine at the staged
+        params and flush the prefix cache (resident KV was computed
+        under the old weights — serving it against the new ones would
+        be silently wrong).  Batcher thread only, at the swap barrier,
+        with no active slots.  Returns the new version."""
+        with self._slot_lock:
+            if self._staged_params is None:
+                raise RuntimeError("no staged params to commit")
+            if np.count_nonzero(self._active):
+                raise RuntimeError(
+                    "commit_staged with active slots — the barrier "
+                    "must drain in-flight generations first")
+            params = self._staged_params
+            version = int(self._staged_version)
+            self._staged_params = None
+            self._staged_version = None
+            self._weights_version = version
+        self._params = params
+        if self._kv is not None:
+            self._kv.flush_cache()
+        from ..obs import instrument as _obs
+
+        _obs.on_weights_version(version)
+        return version
 
     # --- live KV migration (serve/fleet/; docs/serving.md) ------------------
     # Export/import run on the batcher thread only (they read/reassign

@@ -36,6 +36,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ... import faults as faults_mod
+from ...obs import flight as flight_mod
 from ...obs import instrument as _obs
 from ...utils.logging import get_logger
 from ..engine import resolved_config
@@ -138,6 +140,100 @@ class FleetController:
             self._draining.setdefault(name, time.monotonic())
             self._log_locked("drain", replica=name)
         logger.info("fleet drain started: %s", name)
+
+    # --- zero-downtime weight hot-swap (serve/swap.py; docs/hot_swap.md) ----
+
+    def roll_swap(self, step: int, *, rollback: bool = False,
+                  max_concurrent: Optional[int] = None,
+                  timeout: float = 120.0) -> List[dict]:
+        """Rolling fleet swap: tell every replica to hot-swap (or roll
+        back) to ``step``, at most ``HVD_TPU_SWAP_MAX_CONCURRENT``
+        flipping at once — the rest keep serving the OLD weights, so
+        fleet capacity never drops below ``N - max_concurrent`` replicas
+        mid-deployment.  Returns one outcome row per replica:
+        ``{replica, ok, error, weights_version, swap_ms,
+        pulled_bytes}``.
+
+        A per-replica failure (rejected pull, abandoned stall, wire
+        death) is recorded and the roll CONTINUES — the fleet converges
+        as far as it can, and the version-matched routing rule keeps a
+        mixed fleet correct.  The ``swap:mode=partial-fleet`` fault
+        fires at each replica boundary and aborts the remainder of the
+        roll — the deliberately-mixed-fleet drill."""
+        cfg = resolved_config()
+        bound = max(1, int(max_concurrent if max_concurrent is not None
+                           else cfg.swap_max_concurrent))
+        names = self._router.replica_names()
+        outcomes: List[dict] = []
+        aborted = False
+        for i in range(0, len(names), bound):
+            batch = names[i:i + bound]
+            if faults_mod._active is not None and faults_mod.on_swap_roll():
+                aborted = True
+                flight_mod.record("swap_roll_aborted", step=int(step),
+                                  done=len(outcomes),
+                                  remaining=len(names) - len(outcomes))
+                logger.warning(
+                    "rolling swap to step %d aborted before %s "
+                    "(partial fleet: %d/%d replicas flipped)", step,
+                    batch, len(outcomes), len(names))
+                break
+            holders = [dict() for _ in batch]
+
+            def swap_one(name: str, holder: dict) -> None:
+                try:
+                    resp = self._router.swap_replica(
+                        name, step, rollback=rollback, timeout=timeout)
+                    holder.update(
+                        ok=resp.error is None, error=resp.error,
+                        weights_version=resp.weights_version,
+                        swap_ms=resp.swap_ms,
+                        pulled_bytes=resp.pulled_bytes)
+                except Exception as e:   # wire death / unknown replica
+                    holder.update(ok=False, error=str(e),
+                                  weights_version=None, swap_ms=None,
+                                  pulled_bytes=0)
+
+            threads = [threading.Thread(target=swap_one,
+                                        args=(name, holder), daemon=True,
+                                        name=f"swap-{name}")
+                       for name, holder in zip(batch, holders)]
+            for t in threads:
+                t.start()
+            # ONE deadline for the whole batch: hung replicas must not
+            # serially stack a full timeout each.
+            batch_deadline = time.monotonic() + timeout + 10.0
+            for t in threads:
+                t.join(timeout=max(0.0,
+                                   batch_deadline - time.monotonic()))
+            for name, holder in zip(batch, holders):
+                if not holder:
+                    holder.update(ok=False,
+                                  error="swap_hung_past_timeout",
+                                  weights_version=None, swap_ms=None,
+                                  pulled_bytes=0)
+                outcomes.append(dict(holder, replica=name))
+        for name in names[len(outcomes):]:
+            outcomes.append({"replica": name, "ok": False,
+                             "error": "roll_aborted", "skipped": True,
+                             "weights_version": None, "swap_ms": None,
+                             "pulled_bytes": 0})
+        with self._lock:
+            self._log_locked("rollback" if rollback else "swap",
+                             step=int(step),
+                             ok=sum(1 for o in outcomes if o["ok"]),
+                             total=len(outcomes), aborted=aborted)
+        return outcomes
+
+    def rollback(self, step: int, *,
+                 max_concurrent: Optional[int] = None,
+                 timeout: float = 120.0) -> List[dict]:
+        """Fleet-wide instant rollback: re-point every replica at a
+        journaled ``step`` through the same staged-flip path (the
+        ``RollbackRequest`` wire frame)."""
+        return self.roll_swap(step, rollback=True,
+                              max_concurrent=max_concurrent,
+                              timeout=timeout)
 
     # --- policy loop --------------------------------------------------------
 

@@ -35,6 +35,8 @@ import threading
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
+_MISSING = object()   # stored values may legitimately be None (no version)
+
 
 class PrefixDirectory:
     """Bounded, thread-safe leading-block-key → replicas map."""
@@ -61,16 +63,22 @@ class PrefixDirectory:
             return None
         return tuple(int(t) for t in prompt[:self.block])
 
-    def record(self, key: tuple, replica) -> None:
+    def record(self, key: tuple, replica, version=None) -> None:
         """Confirm ``key`` resident on ``replica`` (served a request
-        whose prefix starts with it, or adopted a migration of it)."""
+        whose prefix starts with it, or adopted a migration of it).
+        ``version`` tags which weights the resident KV was computed
+        under (serve/swap.py): a later lookup must only route to the
+        replica while it still serves that version — resident KV from
+        OLD weights served against NEW weights would be silently wrong,
+        the one failure mode a hot-swap may never trade for its TTFT
+        win."""
         if key is None:
             return
         with self._lock:
             reps = self._entries.get(key)
             if reps is None:
                 reps = self._entries[key] = OrderedDict()
-            reps[replica] = True
+            reps[replica] = version
             reps.move_to_end(replica)
             self._entries.move_to_end(key)
             self.records_total += 1
@@ -80,6 +88,13 @@ class PrefixDirectory:
     def lookup(self, key: Optional[tuple]) -> List:
         """Replicas with ``key`` resident, most recently confirmed
         first (the caller filters for health/load)."""
+        return [rep for rep, _ in self.lookup_versioned(key)]
+
+    def lookup_versioned(self, key: Optional[tuple]) -> List[tuple]:
+        """``[(replica, recorded weights version), ...]``, most
+        recently confirmed first — the router's mixed-version routing
+        rule compares the recorded version against the replica's
+        CURRENT one and falls back to a recompute on mismatch."""
         if key is None:
             return []
         with self._lock:
@@ -88,7 +103,7 @@ class PrefixDirectory:
                 return []
             self._entries.move_to_end(key)
             self.hits_total += 1
-            return list(reversed(reps))
+            return [(rep, reps[rep]) for rep in reversed(reps)]
 
     def discard(self, key: tuple, replica) -> None:
         """Eviction notification: ``replica`` no longer holds ``key``
@@ -97,7 +112,7 @@ class PrefixDirectory:
             reps = self._entries.get(key)
             if reps is None:
                 return
-            if reps.pop(replica, None) is not None:
+            if reps.pop(replica, _MISSING) is not _MISSING:
                 self.invalidations_total += 1
             if not reps:
                 del self._entries[key]
@@ -109,7 +124,7 @@ class PrefixDirectory:
         with self._lock:
             for key in list(self._entries):
                 reps = self._entries[key]
-                if reps.pop(replica, None) is not None:
+                if reps.pop(replica, _MISSING) is not _MISSING:
                     n += 1
                 if not reps:
                     del self._entries[key]

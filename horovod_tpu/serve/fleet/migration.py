@@ -114,6 +114,13 @@ def migrate_slot(engine, slot: int, req, target, key: bytes, *,
         # Sender's post-prefill PRNG key: an idle importer adopts it so
         # temperature sampling stays bit-identical across the handoff.
         "rng": engine.export_rng(),
+        # Weight hot-swap guard (serve/swap.py): the KV was computed
+        # under THIS version; a receiver serving different weights must
+        # refuse the adoption — decoding v(N) KV under v(N+1) weights
+        # would emit silently wrong tokens.  The sender then decodes
+        # locally on its own matching weights (economics lost, tokens
+        # right).
+        "weights_version": engine.weights_version,
     }
     nbytes = int(k.nbytes + v.nbytes)
     mode = (faults_mod.on_serve_migrate()

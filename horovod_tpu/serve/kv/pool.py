@@ -129,6 +129,20 @@ class BlockPool:
         with self._lock:
             return list(self._chains.get(slot, ()))
 
+    def flush_cache(self) -> int:
+        """Drop EVERY cached (unreferenced) block and its prefix-index
+        subtree; returns the count freed.  The weight hot-swap flip
+        calls this (serve/swap.py): resident KV was computed under the
+        OLD weights, and a later prefix hit against it under the new
+        weights would emit silently wrong tokens — the one failure mode
+        a swap must never trade for its TTFT win.  Evicted leading
+        keys land in the normal eviction-notification queue, so the
+        fleet's global prefix directory learns too."""
+        with self._lock:
+            before = self.evictions_total
+            self._evict_cached_locked()
+            return self.evictions_total - before
+
     def drain_evicted_keys(self) -> List[tuple]:
         """Leading-block keys evicted since the last drain (consumed:
         the caller owns notifying the prefix directory)."""

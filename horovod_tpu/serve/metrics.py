@@ -34,7 +34,8 @@ class ServingStats:
     the signal that says "add replicas" vs "shrink the fleet").
     """
 
-    def __init__(self, window: int = 4096) -> None:
+    def __init__(self, window: int = 4096,
+                 weights_version: int = 0) -> None:
         self._lock = threading.Lock()
         self._ttft_s = Ring(window)       # guarded-by: _lock
         self._tpot_s = Ring(window)       # guarded-by: _lock
@@ -47,6 +48,13 @@ class ServingStats:
         self.tokens_out = 0               # guarded-by: _lock
         self.prefix_hits = 0              # guarded-by: _lock
         self.prefix_misses = 0            # guarded-by: _lock
+        # Weight hot-swap (serve/swap.py): the checkpoint step the
+        # replica's weights came from (seeded from the engine at
+        # batcher construction, advanced only by flips — ONE consistent
+        # path, never shadow-overwritten) and how many flips it
+        # survived.
+        self.weights_version = int(weights_version)  # guarded-by: _lock
+        self.swaps_completed = 0          # guarded-by: _lock
         self._t0 = time.monotonic()
 
     def record_request(self, ttft_s: float, n_tokens: int,
@@ -73,6 +81,13 @@ class ServingStats:
                 self.prefix_hits += 1
             else:
                 self.prefix_misses += 1
+
+    def set_weights_version(self, version: int) -> None:
+        """One completed hot-swap flip: the replica now serves
+        ``version`` (the checkpoint step)."""
+        with self._lock:
+            self.weights_version = int(version)
+            self.swaps_completed += 1
 
     def record_rejected(self) -> None:
         with self._lock:
@@ -101,6 +116,8 @@ class ServingStats:
                 "requests_rejected": self.rejected,
                 "requests_expired": self.expired,
                 "requests_failed": self.failed,
+                "weights_version": self.weights_version,
+                "swaps_completed": self.swaps_completed,
                 "tokens_out": self.tokens_out,
                 "tok_per_s": round(self.tokens_out / elapsed, 3),
                 "prefix_hits": self.prefix_hits,

@@ -60,7 +60,7 @@ from ..utils.retry import RetryPolicy, retry_call
 from .engine import resolved_config
 from .fleet.directory import PrefixDirectory
 from .server import (CancelRequest, GenerateRequest, GenerateResponse,
-                     StatsRequest)
+                     RollbackRequest, StatsRequest, SwapRequest)
 
 logger = get_logger(__name__)
 
@@ -137,6 +137,9 @@ class _ReplicaState:
         self.inflight = 0                          # guarded-by: Router._lock
         self.completed = 0                         # guarded-by: Router._lock
         self.failed = 0                            # guarded-by: Router._lock
+        # Last weights version observed on a response from this replica
+        # (serve/swap.py) — None until one reported.
+        self.weights_version: Optional[int] = None  # guarded-by: Router._lock
 
 
 class Router:
@@ -220,18 +223,37 @@ class Router:
         a key match is (at least) a one-block cache hit there."""
         return self._directory.key_for(prompt)
 
-    def _note_affinity(self, key: Optional[tuple],
-                       rep: _ReplicaState) -> None:
+    def _note_affinity(self, key: Optional[tuple], rep: _ReplicaState,
+                       version: Optional[int] = None) -> None:
         """Record residency: ``rep`` now holds this prompt's leading
-        blocks (it served the request, or adopted its migration)."""
+        blocks (it served the request, or adopted its migration).
+        ``version`` is the weights version the response reported — the
+        KV those blocks were computed under."""
         if key is not None:
-            self._directory.record(key, rep)
+            self._directory.record(key, rep, version=version)
 
     def _ingest_evictions(self, rep: _ReplicaState, resp) -> None:
         """Apply eviction notifications piggybacked on a response frame
         to the directory (the replica no longer holds these keys)."""
         for key in (getattr(resp, "evicted_prefixes", None) or ()):
             self._directory.discard(tuple(key), rep)
+
+    def _note_version(self, rep: _ReplicaState,
+                      version: Optional[int]) -> None:
+        """Track ``rep``'s weights version from a response/stats frame.
+        A CHANGE drops the replica's prefix-directory entries: its KV
+        pool was flushed at the flip, so every recorded residency is
+        stale — and even a missed notification is caught by the
+        version tag ``_resident_locked`` checks (mixed-version routing
+        rule, docs/hot_swap.md)."""
+        if version is None:
+            return
+        with self._lock:
+            changed = (rep.weights_version is not None
+                       and rep.weights_version != version)
+            rep.weights_version = int(version)
+        if changed:
+            self._directory.invalidate_replica(rep)
 
     def _pick(self, prefix_key: Optional[tuple] = None) -> _ReplicaState:
         """Round-robin over healthy replicas, preferring (1) the
@@ -284,9 +306,18 @@ class Router:
         if prefix_key is None or not fully:
             return None
         floor = min(r.inflight for r in fully)
-        for resident in self._directory.lookup(prefix_key):
-            if (resident in fully and resident.inflight - floor
-                    <= self._affinity_slack):
+        for resident, version in self._directory.lookup_versioned(
+                prefix_key):
+            if resident not in fully:
+                continue
+            if version is not None and resident.weights_version is not None \
+                    and version != resident.weights_version:
+                # Mixed-version rule (docs/hot_swap.md): the recorded
+                # residency predates a weight flip — the KV it points
+                # at was computed under OLD weights, so the hit must
+                # fall back to a recompute, never serve stale blocks.
+                continue
+            if resident.inflight - floor <= self._affinity_slack:
                 return resident
         return None
 
@@ -411,6 +442,39 @@ class Router:
         with self._lock:
             rep.draining = True
 
+    # --- weight hot-swap (serve/swap.py; docs/hot_swap.md) ------------------
+
+    def replica_names(self) -> List[str]:
+        with self._lock:
+            return [r.spec.name for r in self._replicas]
+
+    def swap_replica(self, name: str, step: int, *,
+                     rollback: bool = False, timeout: float = 120.0):
+        """Tell one replica to hot-swap (or roll back) to ``step``;
+        returns its ``SwapResponse``.  A refused/failed swap is NOT a
+        health event — the replica answered, and it is still serving
+        its old weights — so nothing here strikes it; only a wire
+        death does (via the normal strike path)."""
+        rep = self._find(name)
+        if rep is None:
+            raise ValueError(f"unknown replica {name!r}")
+        frame = (RollbackRequest(step) if rollback
+                 else SwapRequest(step))
+        try:
+            resp = self._client(rep).request(frame, idempotent=False,
+                                             timeout=timeout)
+        except OSError as e:
+            self._strike(rep)
+            raise ReplicaUnavailableError(
+                f"replica {name}: {e}") from e
+        self._note_version(rep, getattr(resp, "weights_version", None))
+        return resp
+
+    def rollback_replica(self, name: str, step: int, *,
+                         timeout: float = 120.0):
+        return self.swap_replica(name, step, rollback=True,
+                                 timeout=timeout)
+
     # --- request path -------------------------------------------------------
 
     def generate(self, prompt: Sequence[int], *,
@@ -482,6 +546,8 @@ class Router:
                     f"replica {rep.spec.name}: {resp.error}")
             self._mark_ok(rep)
             self._ingest_evictions(rep, resp)
+            self._note_version(rep, getattr(resp, "weights_version",
+                                            None))
             return resp
 
         def attempt() -> GenerateResponse:
@@ -495,7 +561,9 @@ class Router:
                 # Counted only on success: a failed route is a failover,
                 # not a cache hit, and retries must not recount.
                 _obs.on_fleet_directory_hit()
-                self._note_affinity(prefix_key, rep)
+                self._note_affinity(prefix_key, rep,
+                                    getattr(resp, "weights_version",
+                                            None))
                 return resp
             # 2. Disaggregated pipeline: admit→prefill→migrate→decode
             # when both role classes have healthy members.
@@ -505,13 +573,14 @@ class Router:
                 if pre is not None and dec is not None:
                     resp = run_on(pre, mk_req(
                         migrate_to=(dec.spec.name, dec.spec.addresses)))
+                    pre_v = getattr(resp, "weights_version", None)
                     if getattr(resp, "migrated_to", None) is None:
                         # Migration fell back (digest rejection, wire
                         # drop, busy receiver): the prefill replica
                         # finished the generation itself.
-                        self._note_affinity(prefix_key, pre)
+                        self._note_affinity(prefix_key, pre, pre_v)
                         return resp
-                    self._note_affinity(prefix_key, pre)
+                    self._note_affinity(prefix_key, pre, pre_v)
                     try:
                         final = run_on(dec, CollectRequest(rid))
                     except ReplicaUnavailableError:
@@ -544,7 +613,9 @@ class Router:
                     final.migrated_to = resp.migrated_to
                     final.migrate_ms = resp.migrate_ms
                     final.ttft_ms = resp.ttft_ms
-                    self._note_affinity(prefix_key, dec)
+                    self._note_affinity(prefix_key, dec,
+                                        getattr(final, "weights_version",
+                                                None))
                     return final
             # 3. Unified spread (also the recompute fallback when the
             # pipeline cannot run or lost a continuation).
@@ -554,7 +625,8 @@ class Router:
             resp = run_on(rep, mk_req())
             # The replica now holds this prompt's prefix blocks: later
             # requests sharing the leading block prefer it (cache hit).
-            self._note_affinity(prefix_key, rep)
+            self._note_affinity(prefix_key, rep,
+                                getattr(resp, "weights_version", None))
             return resp
 
         # One trace per request, rooted at admission (docs/tracing.md):
@@ -587,21 +659,25 @@ class Router:
         control round, and with serial polling an N-replica snapshot
         over dead peers stalled N×timeout (the satellite fix this PR
         pins with a dead-replica test)."""
-        with self._lock:
-            reps = list(self._replicas)
         now = time.monotonic()
         entries: List[Dict[str, object]] = []
-        for rep in reps:
-            entries.append({
-                "name": rep.spec.name,
-                "role": rep.spec.role,
-                "healthy": self._healthy(rep, now),
-                "draining": rep.draining,
-                "strikes": rep.strikes,
-                "inflight": rep.inflight,
-                "completed": rep.completed,
-                "failed": rep.failed,
-            })
+        with self._lock:
+            # Snapshot the health fields UNDER the lock: swap/strike
+            # threads mutate them concurrently (an hvdsan read-site
+            # catch — the swap suite runs instrumented).
+            reps = list(self._replicas)
+            for rep in reps:
+                entries.append({
+                    "name": rep.spec.name,
+                    "role": rep.spec.role,
+                    "healthy": self._healthy(rep, now),
+                    "draining": rep.draining,
+                    "strikes": rep.strikes,
+                    "inflight": rep.inflight,
+                    "completed": rep.completed,
+                    "failed": rep.failed,
+                    "weights_version": rep.weights_version,
+                })
 
         # Fetch threads write into their own holders, NOT the returned
         # entries: a thread that outlives the deadline must not mutate
@@ -615,6 +691,11 @@ class Router:
                                                  idempotent=False,
                                                  timeout=timeout)
                 holder["stats"] = resp.stats
+                # Stats are a second version source beside responses —
+                # an idle replica's flip becomes router-visible on the
+                # next controller poll, not only on its next request.
+                self._note_version(rep,
+                                   resp.stats.get("weights_version"))
             except OSError as e:
                 holder["stats_error"] = str(e)
 

@@ -40,6 +40,8 @@ from .batcher import (ContinuousBatcher, QueueFullError,
                       ReplicaDrainingError, ReplicaKilledError)
 from .engine import PromptTooLongError, SamplingParams, resolved_config
 from .fleet.migration import MigrationBuffer, MigrationError, migrate_slot
+from .swap import (SwapAbandonedError, SwapFailedError, SwapRejectedError,
+                   WeightSubscriber)
 
 logger = get_logger(__name__)
 
@@ -73,11 +75,19 @@ class GenerateResponse:
                  ttft_ms: Optional[float] = None,
                  migrated_to: Optional[str] = None,
                  migrate_ms: Optional[float] = None,
-                 evicted_prefixes: Optional[list] = None):
+                 evicted_prefixes: Optional[list] = None,
+                 weights_version: Optional[int] = None):
         self.request_id = request_id
         self.tokens = tokens
         self.error = error
         self.ttft_ms = ttft_ms
+        # Weight hot-swap (serve/swap.py): the checkpoint step this
+        # response's tokens were generated under.  The router tracks it
+        # per replica — a prefix-directory entry recorded under one
+        # version must not route a request to the same replica after it
+        # flipped (stale KV against new weights would be silently
+        # wrong), so a version change invalidates the entries.
+        self.weights_version = weights_version
         # KV migration outcome: the decode replica now carrying the
         # generation (the router collects the final tokens there) and
         # the transfer's wall time (the bench's migration-overhead
@@ -107,6 +117,47 @@ class StatsResponse:
         self.stats = stats
 
 
+class SwapRequest:
+    """Hot-swap this replica's weights to checkpoint ``step`` from its
+    subscribed store (serve/swap.py; docs/hot_swap.md): diff-pull the
+    changed shards, digest-verify, stage, flip at the batcher's swap
+    barrier.  The fleet controller's rolling swap sends these bounded
+    by ``HVD_TPU_SWAP_MAX_CONCURRENT``.  Answered with
+    :class:`SwapResponse`; every failure leaves the old weights
+    serving."""
+
+    def __init__(self, step: int):
+        self.step = int(step)
+
+
+class RollbackRequest:
+    """Instant rollback: re-point this replica at any journaled step
+    still intact in the store, through the SAME staged-flip path a
+    forward swap uses (the only difference: the newer-step check is
+    waived).  Answered with :class:`SwapResponse`."""
+
+    def __init__(self, step: int):
+        self.step = int(step)
+
+
+class SwapResponse:
+    """Outcome of a :class:`SwapRequest`/:class:`RollbackRequest`:
+    ``error`` is None once the flip committed; ``weights_version`` is
+    the version now serving either way (a failed swap reports the OLD
+    version — the replica is always on exactly one).  ``pulled_bytes``
+    and ``swap_ms`` size the manifest-diff pull."""
+
+    def __init__(self, step: int, error: Optional[str] = None,
+                 weights_version: Optional[int] = None,
+                 pulled_bytes: int = 0,
+                 swap_ms: Optional[float] = None):
+        self.step = step
+        self.error = error
+        self.weights_version = weights_version
+        self.pulled_bytes = pulled_bytes
+        self.swap_ms = swap_ms
+
+
 class InferenceServer(BasicService):
     """One serving replica: a batcher behind an authenticated socket.
 
@@ -121,10 +172,22 @@ class InferenceServer(BasicService):
                  nics: Optional[List[str]] = None,
                  replica_ranks: Optional[List[int]] = None,
                  start_batcher: bool = True,
-                 migrate_chunk_bytes: Optional[int] = None):
+                 migrate_chunk_bytes: Optional[int] = None,
+                 swap_store: Optional[str] = None,
+                 subscribe: bool = True):
         super().__init__(name, key, host=host, nics=nics)
         self._batcher = batcher
         self.replica_ranks = list(replica_ranks) if replica_ranks else None
+        # Zero-downtime weight hot-swap (serve/swap.py): with a
+        # ``swap_store`` directory this replica subscribes to the
+        # checkpoint store — polling for newer intact steps when
+        # ``subscribe`` is on, and always answering ``SwapRequest`` /
+        # ``RollbackRequest`` (the fleet controller's rolling path).
+        self.subscriber: Optional[WeightSubscriber] = None
+        if swap_store is not None:
+            self.subscriber = WeightSubscriber(batcher, swap_store)
+            if subscribe:
+                self.subscriber.start()
         # Disaggregated fleet: receiver-side migration assembly (any
         # role may adopt) and the sender-side handoff on prefill
         # replicas (serve/fleet/migration.py over this server's key).
@@ -173,7 +236,39 @@ class InferenceServer(BasicService):
             if self.replica_ranks is not None:
                 snap["replica_ranks"] = self.replica_ranks
             return StatsResponse(snap)
+        if isinstance(req, SwapRequest):
+            return self._swap(req, rollback=False)
+        if isinstance(req, RollbackRequest):
+            return self._swap(req, rollback=True)
         return super()._handle(req, client_address)
+
+    def _swap(self, req, rollback: bool) -> SwapResponse:
+        """Drive one hot-swap (or rollback) through the subscriber.
+        Every failure is a terminal per-request answer carrying the
+        version STILL serving — a failed swap is an economics event,
+        never a health strike."""
+        sub = self.subscriber
+        engine = self._batcher.engine
+        if sub is None:
+            return SwapResponse(req.step, error="no_swap_store",
+                                weights_version=engine.weights_version)
+        try:
+            info = sub.swap_to_info(req.step, rollback=rollback)
+        except SwapRejectedError as e:
+            return SwapResponse(req.step, error=f"rejected: {e}",
+                                weights_version=engine.weights_version)
+        except SwapAbandonedError as e:
+            return SwapResponse(req.step, error=f"abandoned: {e}",
+                                weights_version=engine.weights_version)
+        except (SwapFailedError, ReplicaKilledError) as e:
+            return SwapResponse(req.step, error=f"failed: {e}",
+                                weights_version=engine.weights_version)
+        # ``ms`` was measured INSIDE the swap lock — re-timing here
+        # would bill a concurrent poller swap's wait to this one.
+        return SwapResponse(
+            req.step, weights_version=int(info["version"]),
+            pulled_bytes=int(info.get("pulled_bytes", 0)),
+            swap_ms=info.get("ms", 0.0))
 
     def _kv_migrate(self, req: KvMigrateRequest) -> KvMigrateResponse:
         """One migration frame: buffer; on the final frame verify the
@@ -225,9 +320,12 @@ class InferenceServer(BasicService):
         ttft_ms = None
         if sr.first_token_at is not None:
             ttft_ms = round((sr.first_token_at - sr.submitted_at) * 1e3, 3)
-        return GenerateResponse(creq.request_id, sr.tokens,
-                                ttft_ms=ttft_ms,
-                                evicted_prefixes=self._drain_evictions())
+        return GenerateResponse(
+            creq.request_id, sr.tokens, ttft_ms=ttft_ms,
+            evicted_prefixes=self._drain_evictions(),
+            weights_version=(sr.weights_version
+                             if sr.weights_version is not None
+                             else self._batcher.engine.weights_version))
 
     def _drain_evictions(self) -> Optional[list]:
         keys = self._batcher.engine.drain_evicted_prefixes()
@@ -287,9 +385,14 @@ class InferenceServer(BasicService):
             migrated_to=(sr.migrate_to[0]
                          if sr.migrated and sr.migrate_to else None),
             migrate_ms=sr.migrate_ms,
-            evicted_prefixes=self._drain_evictions())
+            evicted_prefixes=self._drain_evictions(),
+            weights_version=(sr.weights_version
+                             if sr.weights_version is not None
+                             else self._batcher.engine.weights_version))
 
     def shutdown(self) -> None:
+        if self.subscriber is not None:
+            self.subscriber.stop()
         self._batcher.stop()
         super().shutdown()
 

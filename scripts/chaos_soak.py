@@ -30,6 +30,12 @@ mode — corrupt, partial, stall, partial-manifest, crash-before-rename —
 and the step picks the save they hit) against the resize-and-replay
 drill in ``tests/test_ckpt.py``, which must resume at the exact
 journaled step, byte-identical to an uninterrupted reference.
+``--mode swap`` soaks the zero-downtime weight hot-swap path
+(``serve/swap.py``): randomized ``swap:*`` specs (corrupt-shard /
+stall / kill-mid-flip / partial-fleet) against the chaos drill in
+``tests/test_swap.py`` — a bursty open-loop load hammered through N
+hot-swaps must drop 0 requests and keep every response token-identical
+to the fixed-weights reference for its version.
 
 Usage::
 
@@ -73,6 +79,16 @@ TARGETS = {
     # drill in tests/test_ckpt.py — resume must land on the exact
     # journaled step, byte-identical to the uninterrupted reference.
     ("ckpt", False): "tests/test_ckpt.py",
+    # swap: randomized ``swap:*`` specs (the seed draws the mode from
+    # corrupt-shard/stall/kill-mid-flip/partial-fleet, the step picks
+    # the pull/flip/roll event they hit) against the hot-swap chaos
+    # drill in tests/test_swap.py — a bursty open-loop load hammered
+    # through N randomized-fault swaps must drop 0 requests and answer
+    # every request token-identical to the fixed-weights reference for
+    # its version, with corrupt-shard swaps rejected and one journaled
+    # rollback restoring prior weights bit-identically.
+    ("swap", False): "tests/test_swap.py",
+    ("swap", True): "tests/multiproc/test_swap_mp.py",
 }
 
 
@@ -150,7 +166,8 @@ def main(argv=None) -> int:
     ap.add_argument("--mp", action="store_true",
                     help="soak the multi-process world test instead of "
                          "the single-controller one")
-    ap.add_argument("--mode", choices=("train", "serve", "dcn", "ckpt"),
+    ap.add_argument("--mode",
+                    choices=("train", "serve", "dcn", "ckpt", "swap"),
                     default="train",
                     help="'train' loops the elastic-recovery chaos "
                          "tests; 'serve' soaks the serving router under "
@@ -165,7 +182,13 @@ def main(argv=None) -> int:
                          "async checkpointer's kill-and-replay drill "
                          "under randomized checkpoint:* fault specs "
                          "(all five modes, incl. stall/partial-"
-                         "manifest/crash-before-rename)")
+                         "manifest/crash-before-rename); 'swap' soaks "
+                         "the zero-downtime weight hot-swap drill "
+                         "under randomized swap:* fault specs "
+                         "(corrupt-shard/stall/kill-mid-flip/"
+                         "partial-fleet) — bursty load through N "
+                         "swaps, 0 dropped requests, token-correct "
+                         "responses, one journaled rollback")
     ap.add_argument("--sanitize", action="store_true",
                     help="run each iteration under HVD_TPU_SANITIZE=soft "
                          "(hvdsan, docs/lint.md): lock-discipline and "

@@ -1,0 +1,171 @@
+"""Kill-mid-flip across real controller processes: a 2-rank world
+where each rank runs one serving replica over a SHARED checkpoint
+store, rank 0 also runs the router + fleet controller.  Rank 1's fault
+plan kills it at its flip barrier (``swap:mode=kill-mid-flip``) during
+the rolling swap — the flip is one atomic reference swap, so the dead
+replica is on exactly its old version and the router fails over to the
+survivor exactly as for any other replica death: every request still
+completes, token-identical to the reference for the version that
+served it, and 0 requests are dropped.
+
+Seeded knobs (``HVD_TPU_CHAOS_STEP`` / ``HVD_TPU_CHAOS_SEED``) let
+``scripts/chaos_soak.py --mode swap --mp`` loop this over randomized
+injection points."""
+
+import json
+import os
+
+import pytest
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos, pytest.mark.serving]
+
+BODY = """
+import json, time
+import jax.numpy as jnp
+from horovod_tpu import faults
+from horovod_tpu.ckpt import ShardStore, take_snapshot
+from horovod_tpu.models.transformer import GPT, GPTConfig
+from horovod_tpu.serve import (ContinuousBatcher, FleetController,
+                               InferenceEngine, InferenceServer,
+                               ReplicaLauncher, ReplicaSpec, Router)
+from horovod_tpu.utils.retry import RetryPolicy
+
+workdir = os.path.dirname(os.path.abspath(__file__))
+store_dir = os.path.join(workdir, 'swap_store')
+# Randomized injection point (scripts/chaos_soak.py --mode swap --mp):
+# two rolling deployments run; the doomed replica dies at its
+# fault_step-th flip barrier (0 = first roll, 1 = second).
+fault_step = int(os.environ.get('HVD_TPU_CHAOS_STEP', '0')) % 2
+seed = int(os.environ.get('HVD_TPU_CHAOS_SEED', '0'))
+KEY = b'k' * 32
+N_REQUESTS, N_TOKENS = 8, 5
+ROLL_STEPS = (2, 3)
+
+cfgm = GPTConfig(vocab_size=97, n_layer=2, n_head=2, d_model=32, d_ff=64,
+                 max_seq_len=32, dtype=jnp.float32, param_dtype=jnp.float32)
+model = GPT(cfgm)
+# Deterministic on every rank: the versions are genuinely different
+# inits, so a token stream proves which version produced it.
+versions = {v: model.init(jax.random.PRNGKey(100 + v),
+                          jnp.zeros((1, 8), jnp.int32))['params']
+            for v in (1, 2, 3)}
+
+def ref_tokens(params, prompt, n):
+    seq = list(prompt); out = []
+    for _ in range(n):
+        logits = model.apply({'params': params},
+                             jnp.asarray([seq], jnp.int32))
+        tok = int(jnp.argmax(logits[0, -1]))
+        out.append(tok); seq.append(tok)
+    return out
+
+engine = InferenceEngine(model, versions[1], max_slots=2,
+                         prefill_buckets=(8,), max_seq_len=32,
+                         kv_block=4, weights_version=1)
+batcher = ContinuousBatcher(engine, max_queue=16, default_deadline_s=60)
+server = InferenceServer(batcher, key=KEY, name=f'replica-{rank}',
+                         host='127.0.0.1', swap_store=store_dir,
+                         subscribe=False)
+open(os.path.join(workdir, f'addr_{rank}'), 'w').write(str(server.port))
+
+def wait_for(path, timeout=180):
+    deadline = time.monotonic() + timeout
+    while not os.path.exists(path):
+        assert time.monotonic() < deadline, f'timed out waiting for {path}'
+        time.sleep(0.1)
+
+if rank == 1:
+    # The doomed replica: its plan kills it at its fault_step-th flip
+    # barrier — mid-deployment, the exact instant before the atomic
+    # swap (seed recorded for the soak's reproducibility contract).
+    faults.configure(f'swap:step={fault_step},seed={seed},'
+                     f'mode=kill-mid-flip')
+    wait_for(os.path.join(workdir, 'done'))
+    kills = [h for h in faults.history() if h[0] == 'swap']
+    assert len(kills) == 1 and server.dead, (kills, server.dead)
+    # Dead on EXACTLY the version its last completed flip left — the
+    # killed flip never half-applied.
+    assert engine.weights_version == ROLL_STEPS[fault_step] - 1
+else:
+    store = ShardStore(store_dir)
+    for v in (1, 2, 3):
+        host = jax.tree_util.tree_map(np.asarray, versions[v])
+        store.write_step(take_snapshot(host, step=v), world=1,
+                         scheme='dp')
+    wait_for(os.path.join(workdir, 'addr_1'))
+    port1 = int(open(os.path.join(workdir, 'addr_1')).read())
+    router = Router(
+        [ReplicaSpec('replica-0', [('127.0.0.1', server.port)]),
+         ReplicaSpec('replica-1', [('127.0.0.1', port1)])],
+        KEY, probation_s=300.0,
+        retry_policy=RetryPolicy(attempts=10, base_delay_s=0.05,
+                                 max_delay_s=0.5))
+
+    class _NullLauncher(ReplicaLauncher):
+        def launch(self, role, host=None):
+            raise AssertionError('the swap drill never launches')
+        def retire(self, name):
+            pass
+
+    controller = FleetController(router, _NullLauncher(), min_per_role=1)
+    rolls = {s: {o['replica']: o
+                 for o in controller.roll_swap(s, timeout=120.0)}
+             for s in ROLL_STEPS}
+    # The survivor flipped through every roll; the doomed replica
+    # completed the rolls before its injection point and died AT the
+    # fault_step-th barrier.
+    final = ROLL_STEPS[-1]
+    for s in ROLL_STEPS:
+        assert rolls[s]['replica-0']['ok'], rolls
+        assert rolls[s]['replica-0']['weights_version'] == s
+    kill_roll = ROLL_STEPS[fault_step]
+    for s in ROLL_STEPS:
+        ok = rolls[s]['replica-1']['ok']
+        assert ok == (s < kill_roll), (fault_step, rolls)
+    refs = {v: ref_tokens(versions[v], [1, 2, 3, 4], N_TOKENS)
+            for v in (1, 2, 3)}
+    assert len({tuple(r) for r in refs.values()}) == 3
+    responses = {}
+    for i in range(N_REQUESTS):
+        rid = f'req-{i}'
+        resp = router.generate([1, 2, 3, 4], max_new_tokens=N_TOKENS,
+                               request_id=rid)
+        assert resp.error is None, (i, resp.error)
+        assert resp.tokens == refs[resp.weights_version], (
+            i, resp.weights_version, resp.tokens, refs)
+        responses[rid] = {'tokens': resp.tokens,
+                          'version': resp.weights_version}
+    stats = router.replica_stats()
+    benched = [k for k, v in stats.items() if not v['healthy']]
+    # The dead replica is benched by normal failover (first generate
+    # routed there answers replica_dead); the survivor serves the
+    # final version.
+    assert benched == ['replica-1'], stats
+    assert stats['replica-0']['weights_version'] == final
+    json.dump({'responses': responses, 'benched': benched,
+               'fault_step': fault_step,
+               'final_version': final,
+               'outcomes': {str(s): {k: dict(o) for k, o in r.items()}
+                            for s, r in rolls.items()}},
+              open(os.path.join(workdir, 'swap_result.json'), 'w'))
+    open(os.path.join(workdir, 'done'), 'w').write('ok')
+server.shutdown()
+print(f'rank {rank}: kill-mid-flip failover ok')
+"""
+
+
+class TestSwapKillMidFlip:
+    def test_kill_mid_flip_fails_over_zero_dropped(self, world, tmp_path):
+        world(2, BODY, timeout=300.0)
+        result = json.load(open(tmp_path / "swap_result.json"))
+        assert len(result["responses"]) == 8
+        assert result["benched"] == ["replica-1"]
+        # Every request completed and every answer was version-correct
+        # (asserted rank-side); the survivor carried every roll to the
+        # final version while the doomed replica died at its seeded
+        # flip barrier.
+        final = str(result["final_version"])
+        assert result["outcomes"][final]["replica-0"][
+            "weights_version"] == result["final_version"]
+        kill_roll = (2, 3)[result["fault_step"]]
+        assert not result["outcomes"][str(kill_roll)]["replica-1"]["ok"]

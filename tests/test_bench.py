@@ -164,6 +164,46 @@ def test_serving_bench_fleet_contract(tmp_path):
 
 
 @pytest.mark.slow
+def test_serving_bench_swap_contract(tmp_path):
+    """ISSUE 14 satellite: the hot-swap bench drives bursty load
+    through rolling weight swaps from a checkpoint store and reports
+    swap latency, requests dropped during the swap window (must be 0)
+    and in-window vs steady-state p99 TTFT; ``bench_regress`` accepts
+    the artifact."""
+    out_path = str(tmp_path / "serving_swap.json")
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmarks",
+                                      "serving_bench.py"),
+         "--swap", "2", "--swap-replicas", "2", "--slots", "2",
+         "--max-new-tokens", "4", "--buckets", "16", "--prompt-max",
+         "12", "--burst", "2", "--burst-interval", "0.2",
+         "--out", out_path],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "XLA_FLAGS": "", "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "serving_swap_tok_per_s"
+    assert row["swaps"] == 2 and row["swaps_ok"] == 2
+    assert row["failed"] == 0
+    assert row["requests_dropped_during_swap"] == 0
+    assert row["swap_latency_ms_mean"] and row["swap_latency_ms_mean"] > 0
+    # The manifest diff moved bytes (a perturbed leaf per version).
+    assert row["swap_pulled_bytes_total"] > 0
+    assert row["rollback_ok"] is True and row["rollback_ms"] > 0
+    artifact = json.load(open(out_path))
+    assert artifact["summary"]["requests_dropped_during_swap"] == 0
+    assert len(artifact["swaps"]) == 2
+    assert "metrics" in artifact
+    regress = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts",
+                                      "bench_regress.py"),
+         out_path, out_path],
+        capture_output=True, text=True, timeout=60)
+    assert regress.returncode == 0, regress.stdout[-500:]
+
+
+@pytest.mark.slow
 def test_serving_bench_trace_artifact(tmp_path):
     """ISSUE 7 satellite: ``--trace DIR`` writes a merged Perfetto
     trace for the measured window and embeds its path + critical-path

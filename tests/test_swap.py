@@ -1,0 +1,915 @@
+"""Zero-downtime weight hot-swap (horovod_tpu/serve/swap.py):
+checkpoint store → serving fleet without dropping or corrupting a
+single request.
+
+The oracles (ISSUE 14 acceptance):
+
+* **manifest diff** — a swap pulls ONLY the shards whose digests
+  changed, byte-counted;
+* **staged-flip token identity** — a request straddling the swap
+  finishes token-identical to the PRE-swap reference (in-flight
+  generations run start-to-finish on one version), and post-flip
+  requests match the new-weights reference;
+* **digest rejection** — a corrupt shard discards the staged pull and
+  the replica keeps serving the old weights;
+* **rollback** — a journaled step restores bit-identically through the
+  same staged-flip path;
+* **mixed-version rules** — prefix-directory hits must match the
+  replica's current version, and a migrated KV payload is refused by a
+  receiver on different weights (stale KV against new weights is the
+  silent-wrongness bug);
+* **the chaos drill** at the bottom: bursty open-loop load through >=5
+  rolling hot-swaps with randomized ``swap:*`` faults — 0 dropped
+  requests, every response token-identical to the fixed-weights
+  reference for its version, one rollback restoring prior weights
+  bit-identically (``scripts/chaos_soak.py --mode swap`` loops it).
+"""
+
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu import faults
+from horovod_tpu.ckpt import (AsyncCheckpointer, ShardStore, diff_manifest,
+                              take_snapshot)
+from horovod_tpu.models.transformer import GPT, GPTConfig
+from horovod_tpu.serve import (
+    ContinuousBatcher, FleetController, InferenceEngine, InferenceServer,
+    ReplicaKilledError, ReplicaLauncher, ReplicaSpec, Router,
+    SamplingParams, SwapAbandonedError, SwapRejectedError,
+    WeightSubscriber,
+)
+from horovod_tpu.serve.swap import leaf_digests
+from horovod_tpu.utils.retry import RetryPolicy
+
+pytestmark = pytest.mark.serving
+
+KEY = b"k" * 32
+VOCAB = 97
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def model_and_versions():
+    """One tiny GPT plus three GENUINELY different param versions
+    (independent inits — greedy outputs differ between them, so a
+    token stream proves which version produced it)."""
+    cfg = GPTConfig(vocab_size=VOCAB, n_layer=2, n_head=2, d_model=32,
+                    d_ff=64, max_seq_len=32, dtype=jnp.float32,
+                    param_dtype=jnp.float32)
+    model = GPT(cfg)
+    versions = {
+        v: model.init(jax.random.PRNGKey(100 + v),
+                      jnp.zeros((1, 8), jnp.int32))["params"]
+        for v in (1, 2, 3)
+    }
+    return model, versions
+
+
+def _host(tree):
+    return jax.tree_util.tree_map(np.asarray, tree)
+
+
+def _write_versions(directory, versions):
+    store = ShardStore(directory)
+    for step, tree in sorted(versions.items()):
+        store.write_step(take_snapshot(_host(tree), step=step),
+                         world=1, scheme="dp")
+    return store
+
+
+def _ref_tokens(model, params, prompt, n):
+    seq = list(prompt)
+    out = []
+    for _ in range(n):
+        logits = model.apply({"params": params},
+                             jnp.asarray([seq], jnp.int32))
+        tok = int(jnp.argmax(logits[0, -1]))
+        out.append(tok)
+        seq.append(tok)
+    return out
+
+
+def _engine(model, params, version=1, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("prefill_buckets", (8, 16))
+    kw.setdefault("max_seq_len", 32)
+    kw.setdefault("kv_block", 4)
+    return InferenceEngine(model, params, weights_version=version, **kw)
+
+
+def _replica(model, params, tmp_path, name="rep", version=1, role="unified",
+             start=True, **engine_kw):
+    engine = _engine(model, params, version=version, **engine_kw)
+    batcher = ContinuousBatcher(engine, max_queue=32,
+                                default_deadline_s=60, role=role)
+    server = InferenceServer(batcher, key=KEY, name=name,
+                             host="127.0.0.1", start_batcher=start,
+                             swap_store=str(tmp_path), subscribe=False)
+    return server
+
+
+PROMPT = [3, 1, 4, 1, 5, 9, 2, 6]
+
+
+class TestStoreWatchAndDiff:
+    def test_newest_intact_step_skips_damaged(self, tmp_path,
+                                              model_and_versions):
+        model, versions = model_and_versions
+        store = _write_versions(tmp_path, versions)
+        assert store.newest_intact_step() == 3
+        # Damage the newest step's manifest: the watch must fall back
+        # to the newest INTACT step, never offer a torn upload.
+        mpath = os.path.join(store.step_dir(3), "manifest.json")
+        with open(mpath, "w") as f:
+            f.write("{ torn json")
+        assert store.newest_intact_step() == 2
+        assert store.newest_intact_step(min_step=2) is None
+
+    def test_diff_pulls_only_changed_shards_byte_counted(
+            self, tmp_path, model_and_versions):
+        model, versions = model_and_versions
+        t1 = _host(versions[1])
+        # t2 = t1 with exactly ONE leaf replaced.
+        flat, treedef = jax.tree_util.tree_flatten(t1)
+        changed_leaf = flat[0]
+        flat2 = [np.asarray(a, np.float32) for a in flat]
+        flat2[0] = flat2[0] + 1.0
+        t2 = jax.tree_util.tree_unflatten(treedef, flat2)
+        store = ShardStore(str(tmp_path))
+        store.write_step(take_snapshot(t1, step=1), world=1, scheme="dp")
+        store.write_step(take_snapshot(t2, step=2), world=1, scheme="dp")
+        have = {path: digest for path, (digest, _)
+                in leaf_digests(t1).items()}
+        manifest = store.validate_step(2)
+        by_file, changed, nbytes = diff_manifest(manifest, have)
+        assert len(changed) == 1
+        assert nbytes == int(changed_leaf.nbytes)
+        # The unchanged version diffs as empty: nothing to move.
+        m1 = store.validate_step(1)
+        by_file1, changed1, nbytes1 = diff_manifest(m1, have)
+        assert not by_file1 and not changed1 and nbytes1 == 0
+        # An empty cache pulls everything.
+        by_all, changed_all, nbytes_all = diff_manifest(manifest, {})
+        assert len(changed_all) == len(manifest.entries)
+        assert nbytes_all == manifest.nbytes
+
+
+class TestSubscriberSwap:
+    def test_poll_swaps_and_pulls_only_changed_bytes(
+            self, tmp_path, model_and_versions):
+        model, versions = model_and_versions
+        _write_versions(tmp_path, {1: versions[1], 2: versions[2]})
+        engine = _engine(model, versions[1])
+        batcher = ContinuousBatcher(engine, max_queue=8,
+                                    default_deadline_s=60)
+        batcher.start()
+        try:
+            sub = WeightSubscriber(batcher, str(tmp_path),
+                                   deadline_s=60)
+            assert sub.version == 1
+            assert sub.poll_once() == 2
+            assert engine.weights_version == 2
+            # Independent inits share only the zero-initialized leaves;
+            # the pull must have moved strictly fewer bytes than the
+            # model (the diff, not a full download).
+            manifest = sub.store.validate_step(2)
+            assert 0 < sub.last_swap["pulled_bytes"] < manifest.nbytes
+            assert sub.last_swap["pulled_leaves"] < \
+                sub.last_swap["total_leaves"]
+            # Nothing newer: the next poll is a no-op.
+            assert sub.poll_once() is None
+            # Post-flip generations run on the NEW weights.
+            req = batcher.submit(PROMPT, SamplingParams(max_new_tokens=5))
+            assert req.done.wait(timeout=30)
+            assert req.tokens == _ref_tokens(model, versions[2],
+                                             PROMPT, 5)
+        finally:
+            batcher.stop()
+
+    def test_straddling_request_matches_pre_swap_reference(
+            self, tmp_path, model_and_versions):
+        """THE token-identity oracle: a generation in flight when the
+        swap is requested finishes on the version it started on."""
+        model, versions = model_and_versions
+        _write_versions(tmp_path, {1: versions[1], 2: versions[2]})
+        engine = _engine(model, versions[1])
+        batcher = ContinuousBatcher(engine, max_queue=8,
+                                    default_deadline_s=60)
+        batcher.start()
+        try:
+            sub = WeightSubscriber(batcher, str(tmp_path), deadline_s=60)
+            n = 8
+            req = batcher.submit(PROMPT, SamplingParams(max_new_tokens=n))
+            # Genuinely in flight before the swap is requested (its
+            # first token emitted, generation still running).
+            deadline = time.monotonic() + 30
+            while req.first_token_at is None:
+                assert time.monotonic() < deadline
+                time.sleep(0.002)
+            # Swap while the generation is in flight: the barrier holds
+            # admission and flips only once the slots ran dry.
+            assert sub.swap_to(2) == 2
+            assert req.done.wait(timeout=30)
+            assert req.error is None
+            assert req.tokens == _ref_tokens(model, versions[1],
+                                             PROMPT, n)
+            after = batcher.submit(PROMPT,
+                                   SamplingParams(max_new_tokens=n))
+            assert after.done.wait(timeout=30)
+            assert after.tokens == _ref_tokens(model, versions[2],
+                                               PROMPT, n)
+        finally:
+            batcher.stop()
+
+    def test_corrupt_shard_rejected_keeps_old_weights(
+            self, tmp_path, model_and_versions):
+        model, versions = model_and_versions
+        _write_versions(tmp_path, {1: versions[1], 2: versions[2]})
+        engine = _engine(model, versions[1])
+        batcher = ContinuousBatcher(engine, max_queue=8,
+                                    default_deadline_s=60)
+        batcher.start()
+        try:
+            sub = WeightSubscriber(batcher, str(tmp_path), retries=2,
+                                   deadline_s=60)
+            with faults.inject("swap:p=1,mode=corrupt-shard"):
+                with pytest.raises(SwapRejectedError,
+                                   match="digest verification"):
+                    sub.swap_to(2)
+                fired = [h for h in faults.history()
+                         if h[0] == "swap"]
+                assert len(fired) == 2   # one per retry attempt
+            # Old weights still serving, nothing staged left behind.
+            assert engine.weights_version == 1
+            assert sub.version == 1
+            assert engine.staged_version() is None
+            req = batcher.submit(PROMPT, SamplingParams(max_new_tokens=5))
+            assert req.done.wait(timeout=30)
+            assert req.tokens == _ref_tokens(model, versions[1],
+                                             PROMPT, 5)
+            # poll_once absorbs the rejection (the watch loop survives
+            # a bad upload).
+            with faults.inject("swap:p=1,mode=corrupt-shard"):
+                assert sub.poll_once() is None
+        finally:
+            batcher.stop()
+
+    def test_corrupt_shard_single_fault_retry_recovers(
+            self, tmp_path, model_and_versions):
+        """A one-shot corruption is absorbed by the RetryPolicy: the
+        second pull attempt verifies clean and the swap completes."""
+        model, versions = model_and_versions
+        _write_versions(tmp_path, {1: versions[1], 2: versions[2]})
+        engine = _engine(model, versions[1])
+        batcher = ContinuousBatcher(engine, max_queue=8,
+                                    default_deadline_s=60)
+        batcher.start()
+        try:
+            sub = WeightSubscriber(batcher, str(tmp_path), retries=3,
+                                   deadline_s=60)
+            with faults.inject("swap:step=0,mode=corrupt-shard"):
+                assert sub.swap_to(2) == 2
+                assert len([h for h in faults.history()
+                            if h[0] == "swap"]) == 1
+            assert engine.weights_version == 2
+        finally:
+            batcher.stop()
+
+    def test_stall_past_deadline_abandons(self, tmp_path,
+                                          model_and_versions):
+        model, versions = model_and_versions
+        _write_versions(tmp_path, {1: versions[1], 2: versions[2]})
+        engine = _engine(model, versions[1])
+        batcher = ContinuousBatcher(engine, max_queue=8,
+                                    default_deadline_s=60)
+        batcher.start()
+        try:
+            sub = WeightSubscriber(batcher, str(tmp_path),
+                                   deadline_s=0.15)
+            with faults.inject("swap:p=1,mode=stall,delay_ms=400"):
+                with pytest.raises(SwapAbandonedError):
+                    sub.swap_to(2)
+            assert engine.weights_version == 1
+            assert engine.staged_version() is None
+        finally:
+            batcher.stop()
+
+    def test_rollback_restores_journaled_step_bit_identically(
+            self, tmp_path, model_and_versions):
+        model, versions = model_and_versions
+        # The trainer's side: journaled saves through the checkpointer.
+        with AsyncCheckpointer(str(tmp_path), world=1, scheme="dp",
+                               async_save=False) as ckpt:
+            for step in (1, 2):
+                ckpt.save(step, _host(versions[step]))
+                ckpt.journal_step(step)
+            journaled = [e["step"] for e in ckpt.journal.read()[0]]
+        assert journaled == [1, 2]
+        engine = _engine(model, versions[1])
+        batcher = ContinuousBatcher(engine, max_queue=8,
+                                    default_deadline_s=60)
+        batcher.start()
+        try:
+            sub = WeightSubscriber(batcher, str(tmp_path), deadline_s=60)
+            assert sub.swap_to(2) == 2
+            # Forward swaps refuse an older step; rollback is explicit.
+            with pytest.raises(SwapRejectedError, match="older"):
+                sub.swap_to(1)
+            assert sub.swap_to(1, rollback=True) == 1
+            assert sub.last_swap["rollback"] is True
+            # Bit-identical restoration of the journaled step.
+            want = jax.tree_util.tree_leaves(
+                jax.tree_util.tree_map(
+                    lambda a: np.asarray(a, np.float32),
+                    _host(versions[1])))
+            got = [np.asarray(leaf) for leaf in
+                   jax.tree_util.tree_leaves(engine.params)]
+            assert len(want) == len(got)
+            for w, g in zip(want, got):
+                assert w.dtype == g.dtype and np.array_equal(w, g)
+            req = batcher.submit(PROMPT, SamplingParams(max_new_tokens=5))
+            assert req.done.wait(timeout=30)
+            assert req.tokens == _ref_tokens(model, versions[1],
+                                             PROMPT, 5)
+        finally:
+            batcher.stop()
+
+    def test_rollback_pins_forward_watch(self, tmp_path,
+                                         model_and_versions):
+        """A subscribed replica's poller must NOT re-deploy the steps
+        just rolled back from; the next explicit forward swap unpins
+        the watch (review finding: the poller was silently undoing the
+        operator's rollback within one poll period)."""
+        model, versions = model_and_versions
+        _write_versions(tmp_path, {1: versions[1], 2: versions[2]})
+        engine = _engine(model, versions[1])
+        batcher = ContinuousBatcher(engine, max_queue=8,
+                                    default_deadline_s=60)
+        batcher.start()
+        try:
+            sub = WeightSubscriber(batcher, str(tmp_path), deadline_s=60)
+            assert sub.poll_once() == 2
+            assert sub.swap_to(1, rollback=True) == 1
+            # Step 2 is still intact in the store, but the watch is
+            # pinned — the poller must not re-deploy it.
+            assert sub.poll_once() is None
+            assert engine.weights_version == 1
+            # Even a poll tick that slipped PAST the held-check (queued
+            # on the swap lock while the rollback ran) is stopped by
+            # the in-lock re-check.
+            assert sub.swap_to(2, _from_poll=True) == 1
+            assert engine.weights_version == 1
+            # An explicit forward swap unpins and applies.
+            assert sub.swap_to(2) == 2
+            assert sub.poll_once() is None   # nothing newer than 2
+        finally:
+            batcher.stop()
+
+    def test_noop_swap_reports_zero_pull(self, tmp_path,
+                                         model_and_versions):
+        """Re-rolling a step the replica already serves answers ok with
+        ZERO pulled bytes — not the previous swap's pull accounting."""
+        model, versions = model_and_versions
+        _write_versions(tmp_path, {1: versions[1], 2: versions[2]})
+        server = _replica(model, versions[1], tmp_path, name="rep-0")
+        try:
+            router = Router([ReplicaSpec("rep-0",
+                                         [("127.0.0.1", server.port)])],
+                            KEY)
+            first = router.swap_replica("rep-0", 2, timeout=60.0)
+            assert first.error is None and first.pulled_bytes > 0
+            again = router.swap_replica("rep-0", 2, timeout=60.0)
+            assert again.error is None and again.weights_version == 2
+            assert again.pulled_bytes == 0
+        finally:
+            server.shutdown()
+
+    def test_prefix_cache_flushed_on_flip(self, tmp_path,
+                                          model_and_versions):
+        """Stale-KV guard: a prompt resident in the paged prefix cache
+        BEFORE the swap must recompute after it — served against the
+        new weights, the old blocks would emit silently wrong tokens."""
+        model, versions = model_and_versions
+        _write_versions(tmp_path, {1: versions[1], 2: versions[2]})
+        engine = _engine(model, versions[1])
+        batcher = ContinuousBatcher(engine, max_queue=8,
+                                    default_deadline_s=60)
+        batcher.start()
+        try:
+            first = batcher.submit(PROMPT,
+                                   SamplingParams(max_new_tokens=4))
+            assert first.done.wait(timeout=30)
+            # The prompt's blocks are resident now.
+            assert engine.prefix_probe(PROMPT) > 0
+            sub = WeightSubscriber(batcher, str(tmp_path), deadline_s=60)
+            assert sub.swap_to(2) == 2
+            assert engine.prefix_probe(PROMPT) == 0, \
+                "flip must flush the prefix cache"
+            again = batcher.submit(PROMPT,
+                                   SamplingParams(max_new_tokens=4))
+            assert again.done.wait(timeout=30)
+            assert again.tokens == _ref_tokens(model, versions[2],
+                                               PROMPT, 4)
+        finally:
+            batcher.stop()
+
+
+class TestFlipBarrier:
+    def test_flip_waits_for_inflight_and_runs_between_bursts(
+            self, model_and_versions):
+        model, versions = model_and_versions
+        engine = _engine(model, versions[1])
+        batcher = ContinuousBatcher(engine, max_queue=8,
+                                    default_deadline_s=60)
+        req = batcher.submit(PROMPT, SamplingParams(max_new_tokens=4))
+        batcher.step()   # the request occupies a slot BEFORE the flip
+        engine.stage_params(_host(versions[2]), 2)
+        result = {}
+
+        def flip():
+            result["version"] = batcher.flip_at_barrier(
+                engine.commit_staged, timeout=30.0)
+
+        t = threading.Thread(target=flip, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 30
+        while not req.done.is_set():
+            assert time.monotonic() < deadline
+            batcher.step()
+            # While the request is in flight the version cannot move.
+            if not req.done.is_set():
+                assert engine.weights_version == 1
+        while "version" not in result and time.monotonic() < deadline:
+            batcher.step()
+            time.sleep(0.01)
+        t.join(timeout=10)
+        assert result["version"] == 2
+        assert engine.weights_version == 2
+        assert req.tokens == _ref_tokens(model, versions[1], PROMPT, 4)
+
+    def test_flip_holds_admission_until_flipped(self,
+                                                model_and_versions):
+        """A request QUEUED while the flip is pending waits (despite a
+        free slot!) and admits only after the flip — it runs whole on
+        the new weights, and was never dropped."""
+        model, versions = model_and_versions
+        engine = _engine(model, versions[1])
+        batcher = ContinuousBatcher(engine, max_queue=8,
+                                    default_deadline_s=60)
+        first = batcher.submit(PROMPT, SamplingParams(max_new_tokens=6))
+        batcher.step()   # first occupies slot 0; slot 1 stays free
+        engine.stage_params(_host(versions[2]), 2)
+        t = threading.Thread(
+            target=lambda: batcher.flip_at_barrier(engine.commit_staged,
+                                                   timeout=30.0),
+            daemon=True)
+        t.start()
+        deadline = time.monotonic() + 10
+        while not batcher.snapshot()["swap_pending"]:
+            assert time.monotonic() < deadline
+            time.sleep(0.002)
+        second = batcher.submit([2, 7, 1, 8, 2, 8],
+                                SamplingParams(max_new_tokens=4))
+        while not first.done.is_set():
+            batcher.step()
+            if not first.done.is_set():
+                # Admission held: a free slot exists, yet the queued
+                # request must wait out the swap window.
+                snap = batcher.snapshot()
+                assert snap["queue_depth"] == 1, snap
+        while not second.done.is_set():
+            assert time.monotonic() < deadline + 20
+            batcher.step()
+            time.sleep(0.002)
+        t.join(timeout=10)
+        assert first.tokens == _ref_tokens(model, versions[1], PROMPT, 6)
+        assert second.tokens == _ref_tokens(model, versions[2],
+                                            [2, 7, 1, 8, 2, 8], 4)
+
+    def test_kill_mid_flip_fails_over_not_mixed(self,
+                                                model_and_versions):
+        """The flip is one atomic reference swap: a replica killed at
+        the barrier dies on EXACTLY the old version, its in-flight work
+        fails back to the router, and the barrier waiter learns."""
+        model, versions = model_and_versions
+        engine = _engine(model, versions[1])
+        batcher = ContinuousBatcher(engine, max_queue=8,
+                                    default_deadline_s=60)
+        req = batcher.submit(PROMPT, SamplingParams(max_new_tokens=4))
+        engine.stage_params(_host(versions[2]), 2)
+        caught = {}
+
+        def flip():
+            try:
+                batcher.flip_at_barrier(engine.commit_staged,
+                                        timeout=30.0)
+            except ReplicaKilledError as e:
+                caught["err"] = e
+
+        t = threading.Thread(target=flip, daemon=True)
+        t.start()
+        with faults.inject("swap:step=0,mode=kill-mid-flip"):
+            with pytest.raises(ReplicaKilledError):
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    batcher.step()
+                    time.sleep(0.005)
+        t.join(timeout=10)
+        assert "err" in caught
+        assert batcher.dead
+        # Dead on exactly the OLD version; the request failed over.
+        assert engine.weights_version == 1
+        assert req.done.is_set() and req.error == "replica_killed"
+
+    def test_withdrawn_flip_never_commits(self, model_and_versions):
+        """A barrier wait that times out WITHDRAWS the flip: later
+        steps must not execute it (review finding: the step loop could
+        still commit a flip its waiter had already reported abandoned
+        and discarded)."""
+        model, versions = model_and_versions
+        engine = _engine(model, versions[1])
+        batcher = ContinuousBatcher(engine, max_queue=8,
+                                    default_deadline_s=60)
+        req = batcher.submit(PROMPT, SamplingParams(max_new_tokens=6))
+        batcher.step()   # the slot stays busy past the tiny timeout
+        engine.stage_params(_host(versions[2]), 2)
+        with pytest.raises(TimeoutError):
+            batcher.flip_at_barrier(engine.commit_staged, timeout=0.05)
+        while not req.done.is_set():
+            batcher.step()
+        for _ in range(3):   # idle steps after the drain
+            batcher.step()
+        # The withdrawn flip never ran: old version serving, the staged
+        # tree untouched (its owner decides whether to discard).
+        assert engine.weights_version == 1
+        assert engine.staged_version() == 2
+        engine.discard_staged()
+
+    def test_die_releases_barrier_waiter(self, model_and_versions):
+        model, versions = model_and_versions
+        engine = _engine(model, versions[1])
+        batcher = ContinuousBatcher(engine, max_queue=8,
+                                    default_deadline_s=60)
+        engine.stage_params(_host(versions[2]), 2)
+        caught = {}
+
+        def flip():
+            try:
+                batcher.flip_at_barrier(engine.commit_staged,
+                                        timeout=30.0)
+            except ReplicaKilledError as e:
+                caught["err"] = str(e)
+
+        t = threading.Thread(target=flip, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        batcher._die("test shutdown")
+        t.join(timeout=10)
+        assert not t.is_alive() and "replica_killed" in caught["err"]
+
+
+class TestWireAndRouter:
+    def test_swap_and_rollback_frames_over_wire(self, tmp_path,
+                                                model_and_versions):
+        model, versions = model_and_versions
+        _write_versions(tmp_path, {1: versions[1], 2: versions[2]})
+        server = _replica(model, versions[1], tmp_path, name="rep-0")
+        try:
+            router = Router([ReplicaSpec("rep-0",
+                                         [("127.0.0.1", server.port)])],
+                            KEY,
+                            retry_policy=RetryPolicy(attempts=4,
+                                                     base_delay_s=0.02,
+                                                     max_delay_s=0.2))
+            resp = router.swap_replica("rep-0", 2, timeout=60.0)
+            assert resp.error is None and resp.weights_version == 2
+            assert resp.pulled_bytes > 0 and resp.swap_ms is not None
+            # Router-side version tracking + stats column.
+            stats = router.replica_stats(timeout=5.0)
+            assert stats["rep-0"]["weights_version"] == 2
+            assert stats["rep-0"]["stats"]["weights_version"] == 2
+            assert stats["rep-0"]["stats"]["swaps_completed"] == 1
+            # Generations report the version that produced them.
+            out = router.generate(PROMPT, max_new_tokens=4)
+            assert out.error is None
+            assert out.weights_version == 2
+            assert out.tokens == _ref_tokens(model, versions[2],
+                                             PROMPT, 4)
+            # Rollback frame rides the same path.
+            rb = router.rollback_replica("rep-0", 1, timeout=60.0)
+            assert rb.error is None and rb.weights_version == 1
+            out = router.generate(PROMPT, max_new_tokens=4,
+                                  request_id="after-rollback")
+            assert out.tokens == _ref_tokens(model, versions[1],
+                                             PROMPT, 4)
+        finally:
+            server.shutdown()
+
+    def test_swap_without_store_answers_terminal_error(
+            self, model_and_versions):
+        model, versions = model_and_versions
+        engine = _engine(model, versions[1])
+        batcher = ContinuousBatcher(engine, max_queue=8,
+                                    default_deadline_s=60)
+        server = InferenceServer(batcher, key=KEY, name="bare",
+                                 host="127.0.0.1")
+        try:
+            router = Router([ReplicaSpec("bare",
+                                         [("127.0.0.1", server.port)])],
+                            KEY)
+            resp = router.swap_replica("bare", 2, timeout=10.0)
+            assert resp.error == "no_swap_store"
+            assert resp.weights_version == 1   # still the old version
+            # Not a health event: the replica keeps serving.
+            out = router.generate(PROMPT, max_new_tokens=3)
+            assert out.error is None
+        finally:
+            server.shutdown()
+
+    def test_swap_to_missing_step_rejected_old_weights_serving(
+            self, tmp_path, model_and_versions):
+        model, versions = model_and_versions
+        _write_versions(tmp_path, {1: versions[1]})
+        server = _replica(model, versions[1], tmp_path, name="rep-0")
+        try:
+            router = Router([ReplicaSpec("rep-0",
+                                         [("127.0.0.1", server.port)])],
+                            KEY)
+            resp = router.swap_replica("rep-0", 7, timeout=30.0)
+            assert resp.error is not None and "rejected" in resp.error
+            assert resp.weights_version == 1
+            out = router.generate(PROMPT, max_new_tokens=3)
+            assert out.error is None
+        finally:
+            server.shutdown()
+
+    def test_directory_hit_requires_version_match(self):
+        """Mixed-version routing rule, unit level: a residency entry
+        recorded under version 1 must not route once the replica
+        reports version 2 — the request falls back to the spread."""
+        specs = [ReplicaSpec("a", [("127.0.0.1", 1)]),
+                 ReplicaSpec("b", [("127.0.0.1", 2)])]
+        router = Router(specs, KEY)
+        rep_a = router._find("a")
+        key = tuple(range(router._affinity_block))
+        router._note_version(rep_a, 1)
+        router._note_affinity(key, rep_a, 1)
+        with router._lock:
+            fully = list(router._replicas)
+            assert router._resident_locked(key, fully) is rep_a
+        # The replica flips: its entries are invalidated AND any
+        # survivor would fail the version tag check.
+        router._note_version(rep_a, 2)
+        with router._lock:
+            assert router._resident_locked(key, fully) is None
+        # Re-confirmed under the new version: routable again.
+        router._note_affinity(key, rep_a, 2)
+        with router._lock:
+            assert router._resident_locked(key, fully) is rep_a
+
+    def test_adopt_refuses_mismatched_version_kv(self,
+                                                 model_and_versions):
+        """A migrated KV payload computed under other weights must be
+        refused at adoption (the sender falls back to its own pristine
+        KV + matching weights — tokens never wrong)."""
+        model, versions = model_and_versions
+        engine = _engine(model, versions[1], version=2)
+        batcher = ContinuousBatcher(engine, max_queue=8,
+                                    default_deadline_s=60)
+        manifest = {"request_id": "m-1", "prompt": PROMPT,
+                    "tokens": [5], "weights_version": 1,
+                    "sampling": {"max_new_tokens": 4, "temperature": 0.0,
+                                 "top_k": 0, "stop_token": None,
+                                 "spec": False}}
+        with pytest.raises(ValueError, match="version_mismatch"):
+            batcher.adopt(manifest, np.zeros((2, 2, 4, 2, 16)),
+                          np.zeros((2, 2, 4, 2, 16)))
+
+
+class _NullLauncher(ReplicaLauncher):
+    def launch(self, role, host=None):
+        raise AssertionError("the swap drill never launches replicas")
+
+    def retire(self, name):
+        pass
+
+
+def _fleet(model, params, tmp_path, n=2):
+    servers = [_replica(model, params, tmp_path, name=f"rep-{i}")
+               for i in range(n)]
+    router = Router(
+        [ReplicaSpec(s.name, [("127.0.0.1", s.port)]) for s in servers],
+        KEY, retry_policy=RetryPolicy(attempts=10, base_delay_s=0.02,
+                                      max_delay_s=0.3))
+    controller = FleetController(router, _NullLauncher(), min_per_role=1)
+    return servers, router, controller
+
+
+class TestRollingFleetSwap:
+    def test_roll_swap_bounded_and_converges(self, tmp_path,
+                                             model_and_versions):
+        model, versions = model_and_versions
+        _write_versions(tmp_path, {1: versions[1], 2: versions[2]})
+        servers, router, controller = _fleet(model, versions[1],
+                                             tmp_path)
+        try:
+            outcomes = controller.roll_swap(2, max_concurrent=1,
+                                            timeout=60.0)
+            assert [o["replica"] for o in outcomes] == ["rep-0", "rep-1"]
+            assert all(o["ok"] for o in outcomes)
+            assert all(o["weights_version"] == 2 for o in outcomes)
+            stats = router.replica_stats(timeout=5.0)
+            assert all(e["weights_version"] == 2
+                       for e in stats.values())
+            out = router.generate(PROMPT, max_new_tokens=4)
+            assert out.tokens == _ref_tokens(model, versions[2],
+                                             PROMPT, 4)
+        finally:
+            for s in servers:
+                s.shutdown()
+
+    @pytest.mark.chaos
+    def test_partial_fleet_mixed_versions_stay_correct(
+            self, tmp_path, model_and_versions):
+        """The ``partial-fleet`` drill: the roll aborts midway, the
+        fleet is deliberately mixed-version, and every response is
+        still token-identical to the reference for the version that
+        produced it (the version-matched routing rule at work)."""
+        model, versions = model_and_versions
+        _write_versions(tmp_path, {1: versions[1], 2: versions[2]})
+        servers, router, controller = _fleet(model, versions[1],
+                                             tmp_path)
+        try:
+            with faults.inject("swap:step=1,mode=partial-fleet"):
+                outcomes = controller.roll_swap(2, max_concurrent=1,
+                                                timeout=60.0)
+            assert outcomes[0]["ok"] and \
+                outcomes[0]["weights_version"] == 2
+            assert not outcomes[1]["ok"] and \
+                outcomes[1]["error"] == "roll_aborted"
+            refs = {v: _ref_tokens(model, versions[v], PROMPT, 4)
+                    for v in (1, 2)}
+            assert refs[1] != refs[2]   # the oracle can tell versions
+            seen = set()
+            for i in range(8):
+                out = router.generate(PROMPT, max_new_tokens=4,
+                                      request_id=f"mixed-{i}")
+                assert out.error is None
+                assert out.tokens == refs[out.weights_version], \
+                    (i, out.weights_version, out.tokens)
+                seen.add(out.weights_version)
+            # Completing the roll converges the fleet.
+            outcomes = controller.roll_swap(2, timeout=60.0)
+            assert all(o["ok"] for o in outcomes)
+            out = router.generate(PROMPT, max_new_tokens=4,
+                                  request_id="converged")
+            assert out.tokens == refs[2]
+        finally:
+            for s in servers:
+                s.shutdown()
+
+
+class TestChaosDrill:
+    """THE acceptance drill: a bursty open-loop load hammers the router
+    through >=5 rolling hot-swaps with randomized ``swap:*`` faults —
+    0 dropped requests, every response token-identical to the
+    fixed-weights reference for its version, corrupt-shard swaps
+    rejected with the fleet still serving, one journaled rollback
+    restoring prior weights bit-identically.
+
+    ``HVD_TPU_CHAOS_STEP``/``HVD_TPU_CHAOS_SEED`` randomize the fault
+    schedule (``scripts/chaos_soak.py --mode swap`` loops them)."""
+
+    @pytest.mark.chaos
+    def test_hot_swap_chaos_drill(self, tmp_path, model_and_versions):
+        import random
+
+        model, versions = model_and_versions
+        chaos_step = int(os.environ.get("HVD_TPU_CHAOS_STEP", "2"))
+        chaos_seed = int(os.environ.get("HVD_TPU_CHAOS_SEED", "0"))
+        rng = random.Random(chaos_seed * 1000003 + chaos_step)
+        n_swaps = 5
+        n_tok = 4
+        # Version plan: 5 forward swaps cycling through 3 genuinely
+        # different param sets (written once; later steps re-save an
+        # earlier set under a new step number — cheap and still a real
+        # manifest diff).
+        step_params = {s: versions[1 + (s - 1) % 3]
+                       for s in range(1, n_swaps + 2)}
+        _write_versions(tmp_path, step_params)
+        refs = {s: _ref_tokens(model, p, PROMPT, n_tok)
+                for s, p in step_params.items()}
+        assert refs[1] != refs[2] != refs[3]
+
+        servers, router, controller = _fleet(model, step_params[1],
+                                             tmp_path)
+        results, lock, threads = [], threading.Lock(), []
+        stop = threading.Event()
+
+        def fire(rid, prompt):
+            try:
+                resp = router.generate(prompt, max_new_tokens=n_tok,
+                                       request_id=rid)
+                row = {"id": rid, "error": resp.error,
+                       "tokens": resp.tokens,
+                       "version": resp.weights_version}
+            except Exception as e:
+                row = {"id": rid, "error": str(e), "tokens": None,
+                       "version": None}
+            with lock:
+                results.append(row)
+
+        def load_loop():
+            j = 0
+            while not stop.is_set():
+                for _ in range(2):
+                    th = threading.Thread(
+                        target=fire, args=(f"drill-{j}", PROMPT),
+                        daemon=True)
+                    th.start()
+                    threads.append(th)
+                    j += 1
+                stop.wait(0.15)
+
+        try:
+            # Warm every replica's compiled programs off the record.
+            warm = [threading.Thread(target=fire,
+                                     args=(f"warm-{i}", PROMPT),
+                                     daemon=True) for i in range(4)]
+            for t in warm:
+                t.start()
+            for t in warm:
+                t.join(timeout=60)
+            with lock:
+                results.clear()
+
+            loader = threading.Thread(target=load_loop, daemon=True)
+            loader.start()
+            corrupt_rejected = 0
+            for s in range(2, n_swaps + 2):
+                mode = rng.choice([None, None, "corrupt-shard", "stall",
+                                   "partial-fleet"])
+                spec = {
+                    "corrupt-shard": "swap:p=1,mode=corrupt-shard",
+                    "stall": "swap:p=1,mode=stall,delay_ms=40",
+                    "partial-fleet":
+                        f"swap:step={rng.randrange(3)},"
+                        f"mode=partial-fleet",
+                }.get(mode)
+                if spec is None:
+                    outcomes = controller.roll_swap(s, timeout=60.0)
+                else:
+                    with faults.inject(spec):
+                        outcomes = controller.roll_swap(s, timeout=60.0)
+                if mode == "corrupt-shard":
+                    # Every pull damaged: the fleet must REJECT the
+                    # version and keep serving the old weights.
+                    assert not any(o["ok"] for o in outcomes), outcomes
+                    corrupt_rejected += 1
+                elif mode is None or mode == "stall":
+                    assert all(o["ok"] for o in outcomes), outcomes
+                time.sleep(0.2)
+            # One journaled rollback through the same path.
+            rb = controller.rollback(1, timeout=60.0)
+            assert all(o["ok"] for o in rb), rb
+            time.sleep(0.3)
+            stop.set()
+            loader.join(timeout=10)
+            for th in threads:
+                th.join(timeout=60)
+        finally:
+            stop.set()
+            engines = [s._batcher.engine for s in servers]
+            for s in servers:
+                s.shutdown()
+
+        with lock:
+            rows = list(results)
+        assert rows, "the load loop produced no requests"
+        dropped = [r for r in rows if r["error"] is not None]
+        assert not dropped, f"dropped {len(dropped)}: {dropped[:3]}"
+        for r in rows:
+            assert r["version"] in refs, r
+            assert r["tokens"] == refs[r["version"]], r
+        # The rollback restored step 1's weights bit-identically on
+        # every replica.
+        want = [np.asarray(a, np.float32) for a in
+                jax.tree_util.tree_leaves(_host(step_params[1]))]
+        for engine in engines:
+            got = [np.asarray(leaf) for leaf in
+                   jax.tree_util.tree_leaves(engine.params)]
+            for w, g in zip(want, got):
+                assert np.array_equal(w, g)
+        assert all(e.weights_version == 1 for e in engines)
