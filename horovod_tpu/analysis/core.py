@@ -97,6 +97,10 @@ CHECK_CATALOG: "Dict[str, Tuple[str, str]]" = {
     "metric-doc-drift": (
         "error", "registered obs metric missing from the docs/metrics.md "
                  "catalog"),
+    "metric-tenant-cardinality": (
+        "error", "tenant-labeled metric series minted outside the obs "
+                 "registry's 64-series cardinality cap (an unbounded "
+                 "tenant-id label is a memory leak per tenant)"),
     "span-name": (
         "error", "trace span violates naming rules (hvd_tpu_ prefix on "
                  "every literal span/record_span/instant name)"),

@@ -126,17 +126,65 @@ def _receiver_is(func: ast.expr, modname: str) -> bool:
             and func.value.id == modname)
 
 
+_TENANT_LABELS = ("tenant", "tenant_id")
+
+
 class MetricNameChecker(Checker):
-    checks = ("metric-name", "metric-doc-drift")
+    checks = ("metric-name", "metric-doc-drift",
+              "metric-tenant-cardinality")
 
     def __init__(self, cfg: LintConfig) -> None:
         super().__init__(cfg)
         # name -> (kind, path, line) first registration seen
         self.metrics: Dict[str, Tuple[str, str, int]] = {}
 
+    def _check_tenant_labels(self, mod: SourceModule) -> None:
+        """``metric-tenant-cardinality``: a ``.labels(tenant=…)`` call
+        must sit on an obs-registry metric family — the registry's
+        64-series cap (overflow collapses to ``other``) is what makes
+        an open-ended tenant-id label safe.  A tenant label minted on
+        anything else (a hand-rolled dict-of-series, a raw exporter)
+        grows one series per tenant forever: at "millions of users"
+        that is a memory leak wearing a dashboard."""
+        # One-level local resolution: ``fam = reg.counter(...)`` then
+        # ``fam.labels(tenant=...)`` is the capped idiom too.
+        family_names: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and _terminal(node.value.func) in _METRIC_KINDS
+                    and _metric_receiver(node.value.func)):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        family_names.add(t.id)
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and _terminal(node.func) == "labels"):
+                continue
+            tenant_kw = next((kw for kw in node.keywords
+                              if kw.arg in _TENANT_LABELS), None)
+            if tenant_kw is None:
+                continue
+            recv = node.func.value if isinstance(node.func,
+                                                 ast.Attribute) else None
+            capped = (
+                (isinstance(recv, ast.Call)
+                 and _terminal(recv.func) in _METRIC_KINDS
+                 and _metric_receiver(recv.func))
+                or (isinstance(recv, ast.Name)
+                    and recv.id in family_names))
+            if not capped:
+                self.emit(
+                    "metric-tenant-cardinality", mod.path, node.lineno,
+                    f"per-tenant label {tenant_kw.arg!r} minted outside "
+                    f"the obs registry — tenant-labeled series must ride "
+                    f"the registry's 64-series overflow cap "
+                    f"(docs/metrics.md cardinality rules)")
+
     def check_module(self, mod: SourceModule) -> None:
         if mod.path.endswith("obs/metrics.py"):
             return  # the generic registry itself registers nothing
+        self._check_tenant_labels(mod)
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Call):
                 continue

@@ -36,7 +36,7 @@ DEFAULT_COST_BETA_GBPS = 100.0
 # init, exactly like every other malformed env knob.
 
 FAULT_SITES = ("collective", "fusion", "accumulate", "discovery", "rpc",
-               "checkpoint", "serve", "dcn", "swap")
+               "checkpoint", "serve", "dcn", "swap", "qos")
 
 
 # --- pre-init knob registry --------------------------------------------------
@@ -120,7 +120,76 @@ _FAULT_MODES = {
     # mixed-version fleet the router's version-matched prefix routing
     # must serve correctly.
     "swap": ("corrupt-shard", "stall", "kill-mid-flip", "partial-fleet"),
+    # qos: the multi-tenant scheduling tier (serve/qos/; docs/qos.md).
+    # `invert` fires at the WFQ scheduler's pop and inverts the pick
+    # (the LOWEST-priority flow is dispatched — a priority-inversion
+    # bug injected on purpose: the preemption and brownout layers must
+    # still hold the interactive SLO); `flood` fires at the admission
+    # budget charge and waives the tenant's token bucket for that
+    # admission (one tenant flooding past its budget — weighted-fair
+    # queueing must still protect the other tenants).
+    "qos": ("invert", "flood"),
 }
+
+
+# --- multi-tenant QoS grammar (HVD_TPU_QOS_*) --------------------------------
+# Service classes of the SLO-aware scheduler (serve/qos/; docs/qos.md):
+# `interactive` is deadline-protected (never shed, may preempt),
+# `standard` is the default, `batch` is throughput traffic (first to be
+# preempted and shed).  The weight/share/budget maps below use one
+# ``key=value`` comma grammar, parsed here so a typo'd spec fails at
+# init — a silently-misparsed QoS policy would starve real tenants.
+
+QOS_CLASSES = ("interactive", "standard", "batch")
+
+
+def parse_qos_map(spec: str, what: str,
+                  keys: Optional[tuple] = None,
+                  positive: Optional[bool] = None) -> "dict[str, float]":
+    """Parse ``a=2,b=0.5`` into ``{key: float}``.  ``keys`` restricts
+    the key namespace (class-weight maps must name QoS classes);
+    tenant maps accept any non-empty tenant id.  ``positive`` requires
+    values > 0 (defaults to True for keyed maps): weights and SHARES
+    must be positive — a share of 0 would silently starve the tenant,
+    the exact failure WFQ exists to prevent — while BUDGET maps keep
+    0 = unlimited."""
+    require_pos = positive if positive is not None else keys is not None
+    out: dict = {}
+    for raw in spec.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        key, sep, val = raw.partition("=")
+        key, val = key.strip(), val.strip()
+        if not sep or not key or not val:
+            raise ValueError(
+                f"{what}: expected key=value entries, got {raw!r}")
+        if keys is not None and key not in keys:
+            raise ValueError(
+                f"{what}: unknown key {key!r}; expected one of {keys}")
+        if key in out:
+            raise ValueError(f"{what}: duplicate key {key!r}")
+        try:
+            fval = float(val)
+        except ValueError as e:
+            raise ValueError(
+                f"{what}: bad value {val!r} for {key!r}") from e
+        if fval < 0 or (require_pos and fval <= 0):
+            raise ValueError(
+                f"{what}: value for {key!r} must be "
+                f"{'> 0' if require_pos else '>= 0'}, got {fval}")
+        out[key] = fval
+    return out
+
+
+def _validated_qos_map(spec: Optional[str], what: str,
+                       keys: Optional[tuple] = None,
+                       positive: Optional[bool] = None) -> Optional[str]:
+    """Empty/unset → None; anything else must parse (fail at init)."""
+    if not spec or not spec.strip():
+        return None
+    parse_qos_map(spec, what, keys, positive=positive)
+    return spec
 
 
 # --- two-tier topology spec grammar (HVD_TPU_TOPO_SPEC) ----------------------
@@ -489,6 +558,19 @@ class Config:
     fleet_scale_out_ttft_ms: float = 0.0      # HVD_TPU_FLEET_SCALE_OUT_TTFT_MS (p99 TTFT that saturates a role; 0 = off)
     fleet_scale_in_idle_s: float = 30.0       # HVD_TPU_FLEET_SCALE_IN_IDLE_S (role idle window before drain-and-retire)
     fleet_drain_deadline_s: float = 30.0      # HVD_TPU_FLEET_DRAIN_DEADLINE_S (max drain wait before forced retire)
+    # SLO-aware multi-tenant QoS scheduling (horovod_tpu/serve/qos/;
+    # docs/qos.md — weighted-fair admission, paged-KV preemption,
+    # graceful brownout; the scenario-diversity tier of ROADMAP item 5)
+    qos_class_weights: str = "interactive=8,standard=4,batch=1"  # HVD_TPU_QOS_CLASS_WEIGHTS (WFQ weight per service class)
+    qos_tenant_shares: Optional[str] = None   # HVD_TPU_QOS_TENANT_SHARES ("tenant=share,..." WFQ multiplier; unset = 1 each)
+    qos_tenant_budgets: Optional[str] = None  # HVD_TPU_QOS_TENANT_BUDGETS ("tenant=tokens_per_s,..."; 0 = unlimited)
+    qos_default_budget: float = 0.0           # HVD_TPU_QOS_DEFAULT_BUDGET (tokens/s for tenants not in the budget map; 0 = unlimited)
+    qos_burst_s: float = 2.0                  # HVD_TPU_QOS_BURST_S (token-bucket capacity = rate x burst window)
+    qos_preempt: bool = True                  # HVD_TPU_QOS_PREEMPT (deadline-aware batch preemption for interactive requests)
+    qos_slo_ttft_ms: float = 0.0              # HVD_TPU_QOS_SLO_TTFT_MS (interactive p99 TTFT SLO the brownout ladder defends; 0 = off)
+    qos_brownout_high: float = 0.75           # HVD_TPU_QOS_BROWNOUT_HIGH (queue-depth fraction that steps the brownout ladder UP)
+    qos_brownout_low: float = 0.25            # HVD_TPU_QOS_BROWNOUT_LOW (queue-depth fraction below which un-browning may begin)
+    qos_brownout_hold_s: float = 5.0          # HVD_TPU_QOS_BROWNOUT_HOLD_S (hysteresis hold below LOW before each un-brown step)
     # Zero-downtime weight hot-swap (horovod_tpu/serve/swap.py;
     # docs/hot_swap.md — the checkpoint-store→serving-fleet loop)
     swap_poll_s: float = 5.0                  # HVD_TPU_SWAP_POLL_S (subscriber store-poll cadence)
@@ -593,6 +675,23 @@ class Config:
             fleet_scale_in_idle_s=_env_float("FLEET_SCALE_IN_IDLE_S", 30.0),
             fleet_drain_deadline_s=_env_float("FLEET_DRAIN_DEADLINE_S",
                                               30.0),
+            qos_class_weights=_validated_qos_map(
+                _env("QOS_CLASS_WEIGHTS",
+                     "interactive=8,standard=4,batch=1"),
+                "qos class weights", QOS_CLASSES)
+            or "interactive=8,standard=4,batch=1",
+            qos_tenant_shares=_validated_qos_map(
+                _env("QOS_TENANT_SHARES"), "qos tenant shares",
+                positive=True),
+            qos_tenant_budgets=_validated_qos_map(
+                _env("QOS_TENANT_BUDGETS"), "qos tenant budgets"),
+            qos_default_budget=_env_float("QOS_DEFAULT_BUDGET", 0.0),
+            qos_burst_s=_env_float("QOS_BURST_S", 2.0),
+            qos_preempt=_env_bool("QOS_PREEMPT", True),
+            qos_slo_ttft_ms=_env_float("QOS_SLO_TTFT_MS", 0.0),
+            qos_brownout_high=_env_float("QOS_BROWNOUT_HIGH", 0.75),
+            qos_brownout_low=_env_float("QOS_BROWNOUT_LOW", 0.25),
+            qos_brownout_hold_s=_env_float("QOS_BROWNOUT_HOLD_S", 5.0),
             swap_poll_s=_env_float("SWAP_POLL_S", 5.0),
             swap_deadline_s=_env_float("SWAP_DEADLINE_S", 60.0),
             swap_max_concurrent=_env_pos_int("SWAP_MAX_CONCURRENT", 1),

@@ -30,6 +30,9 @@ swap       ``serve/swap.py`` shard pull (corrupt-shard/stall),   ``corrupt-shard
            ``serve/batcher.py`` flip barrier (kill-mid-flip),    ``kill-mid-flip``/
            ``serve/fleet/controller.py`` rolling-swap boundary   ``partial-fleet``
            (partial-fleet)
+qos        ``serve/qos/sched.py`` WFQ pop (invert);              ``invert``/``flood``
+           ``serve/batcher.py`` + ``serve/qos/brownout.py``
+           admission budget charge (flood)
 ========== ===================================================== =====================
 
 A plan comes from ``HVD_TPU_FAULT_SPEC`` (grammar parsed in
@@ -66,7 +69,7 @@ __all__ = [
     "on_discovery_hosts", "on_rpc", "on_checkpoint_save",
     "on_serve_request", "on_serve_decode", "on_serve_evict",
     "on_serve_migrate", "on_dcn", "on_swap_pull", "on_swap_flip",
-    "on_swap_roll",
+    "on_swap_roll", "on_qos_pick", "on_qos_admit",
 ]
 
 
@@ -537,6 +540,49 @@ def on_swap_roll() -> bool:
     at = st.counter
     if st.should_fire():
         plan.fire("swap", "partial-fleet", at)
+        return True
+    return False
+
+
+def on_qos_pick() -> bool:
+    """Site ``qos`` (mode ``invert``) — fires at the WFQ scheduler's
+    pop (``serve/qos/sched.py``): each event is one queue dispatch, so
+    ``qos:step=N,mode=invert`` reproducibly inverts the N-th pick in
+    the process — the scheduler dispatches from the LOWEST-priority
+    backlogged flow instead of the highest, a priority-inversion bug
+    injected on purpose.  Returns True when the pick must invert; the
+    drill asserts the deadline-preemption and brownout layers still
+    hold the interactive SLO through the inversion."""
+    plan = _active
+    if plan is None:
+        return False
+    st = plan.site("qos")
+    if st is None or st.clause.mode != "invert":
+        return False
+    at = st.counter
+    if st.should_fire():
+        plan.fire("qos", "invert", at)
+        return True
+    return False
+
+
+def on_qos_admit() -> bool:
+    """Site ``qos`` (mode ``flood``) — fires at the admission budget
+    charge (``serve/qos/policy.py`` consumers: the batcher's admission
+    and the router's QoS gate): each event is one charge, so
+    ``qos:step=N,mode=flood`` reproducibly waives the tenant's token
+    bucket at the N-th charge — one tenant floods past its budget, and
+    weighted-fair queueing must still keep the other tenants' share of
+    the slots.  Returns True when the charge must be waived."""
+    plan = _active
+    if plan is None:
+        return False
+    st = plan.site("qos")
+    if st is None or st.clause.mode != "flood":
+        return False
+    at = st.counter
+    if st.should_fire():
+        plan.fire("qos", "flood", at)
         return True
     return False
 

@@ -35,7 +35,8 @@ __all__ = [
     "set_mfu", "set_hidden_comm_estimate", "on_topo_plan",
     "on_topo_estimator", "on_ckpt_save", "on_ckpt_write",
     "on_ckpt_restore", "on_ckpt_journal", "on_ckpt_coalesced",
-    "on_ckpt_inflight",
+    "on_ckpt_inflight", "on_qos_shed", "on_qos_preempt",
+    "on_qos_budget_reject", "on_qos_brownout_level",
 ]
 
 
@@ -571,6 +572,50 @@ def on_weights_version(version: int) -> None:
     _reg().gauge("hvd_tpu_replica_weights_version",
                  "checkpoint step this replica's weights came "
                  "from").set(version)
+
+
+# --- multi-tenant QoS scheduling (serve/qos/; docs/qos.md) -------------------
+
+def on_qos_shed(qos_class: str) -> None:
+    """One request shed by the brownout ladder; ``qos_class`` comes
+    from the closed QOS_CLASSES set (interactive is structurally
+    absent — the ladder cannot shed it)."""
+    if not _m.enabled():
+        return
+    _reg().counter("hvd_tpu_qos_sheds_total",
+                   "requests shed by the brownout ladder, by "
+                   "class").labels(cls=qos_class).inc()
+
+
+def on_qos_preempt() -> None:
+    """One batch generation evicted-and-requeued so an interactive
+    request makes its deadline (serve/qos/preempt.py)."""
+    if not _m.enabled():
+        return
+    _reg().counter("hvd_tpu_qos_preemptions_total",
+                   "batch generations preempted for interactive "
+                   "deadlines").inc()
+
+
+def on_qos_budget_reject(tenant: str) -> None:
+    """One admission rejected by a tenant's token budget.  The
+    ``tenant`` label is open-ended by nature — it rides the registry's
+    64-series cardinality cap (overflow collapses to ``other``), the
+    contract hvdlint's tenant-cardinality check enforces."""
+    if not _m.enabled():
+        return
+    _reg().counter("hvd_tpu_qos_budget_rejects_total",
+                   "admissions rejected by per-tenant token "
+                   "budgets").labels(tenant=tenant).inc()
+
+
+def on_qos_brownout_level(level: int) -> None:
+    """The brownout ladder's current level (0 = full service, 1 = batch
+    shed, 2 = batch + standard shed)."""
+    if not _m.enabled():
+        return
+    _reg().gauge("hvd_tpu_qos_brownout_level",
+                 "brownout shed-ladder level").set(level)
 
 
 # --- autotune decision log ---------------------------------------------------

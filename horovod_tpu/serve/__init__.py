@@ -36,10 +36,19 @@ training framework's existing layers:
   swap barrier; rolling fleet swaps + instant journaled rollback ride
   the ``SwapRequest``/``RollbackRequest`` frames (docs/hot_swap.md)
 
+* :mod:`~horovod_tpu.serve.qos` — SLO-aware multi-tenant QoS
+  scheduling (docs/qos.md): service classes with per-tenant
+  token-bucket budgets, weighted-fair (stride) admission replacing the
+  FIFO queue, deadline-aware preemption of batch generations to the
+  paged-KV prefix cache (token-identical resumption), and router-level
+  rate limits with a graceful-brownout shed ladder (batch first, then
+  standard, never interactive)
+
 Chaos: the ``serve`` fault site (``HVD_TPU_FAULT_SPEC``) drops/delays
 requests at the endpoint, kills a replica mid-decode or mid-migration,
-and damages KV transfers at the migration boundary
-(docs/serving.md has recipes).
+and damages KV transfers at the migration boundary; the ``qos`` site
+drills priority inversion and budget floods (docs/serving.md and
+docs/qos.md have recipes).
 """
 
 from .batcher import (  # noqa: F401
@@ -56,6 +65,10 @@ from .kv import (  # noqa: F401
     BlockPool, KVPoolExhaustedError, PrefixIndex,
 )
 from .metrics import ServingStats, percentile  # noqa: F401
+from .qos import (  # noqa: F401
+    BrownoutController, BudgetExhaustedError, QosGate, QosPolicy,
+    QosQueue, RequestShedError,
+)
 from .router import (  # noqa: F401
     NoHealthyReplicasError, ReplicaSpec, ReplicaUnavailableError, Router,
     register_replica_process_sets, replica_slot_groups,

@@ -14,6 +14,17 @@ queueing unboundedly — at "millions of users" scale an unbounded queue
 converts overload into latency collapse and OOM; a reject converts it
 into a router-visible signal that shifts load to another replica.
 
+The admission queue is the **weighted-fair QoS scheduler**
+(serve/qos/; docs/qos.md): every ``(tenant, class)`` pair is one
+stride-scheduled flow, per-tenant token buckets bound sustained
+consumption (typed ``BudgetExhaustedError`` rejections), queued
+deadline expiry rides a min-heap instead of a queue walk, and an
+interactive request about to miss its deadline/TTFT-SLO preempts the
+youngest batch generation — its KV parks in the paged prefix cache and
+the resumption replays only the non-resident tail, token-identical to
+the uninterrupted run.  A single unconfigured flow is exact FIFO, so
+default behavior is unchanged.
+
 Fault site ``serve:mode=kill`` fires at the decode dispatch (each
 event = one real decode step): the batcher dies mid-decode exactly the
 way a preempted replica does, failing queued + in-flight requests so
@@ -30,11 +41,14 @@ from typing import Dict, List, Optional, Sequence
 
 from .. import faults as faults_mod
 from ..obs import flight as flight_mod
+from ..obs import instrument as _obs
 from ..obs import trace as trace_mod
 from ..utils.logging import get_logger
 from .engine import (InferenceEngine, PromptTooLongError, SamplingParams,
                      resolved_config)
 from .metrics import ServingStats
+from .qos import QosPolicy, QosQueue, validate_class
+from .qos import preempt as preempt_mod
 
 logger = get_logger(__name__)
 
@@ -93,6 +107,17 @@ class ServeRequest:
     # must report THIS, not the engine's version at response-build
     # time (a flip can land between the last token and the reply).
     weights_version: Optional[int] = None
+    # Multi-tenant QoS (serve/qos/; docs/qos.md): the flow this request
+    # rides in the weighted-fair queue, its admission budget charge
+    # (refunded pro-rata at completion), and the preemption carry —
+    # ``resume_state`` is ``(emitted tokens, engine RNG snapshot)`` set
+    # when a batch generation is evicted-and-requeued so resumption
+    # replays only the tail, token-identical to the uninterrupted run.
+    tenant: str = "default"
+    qos_class: str = "standard"
+    budget_charged: float = 0.0
+    preemptions: int = 0
+    resume_state: Optional[tuple] = None
 
     def finish(self, error: Optional[str] = None) -> None:
         if self.done.is_set():
@@ -114,7 +139,10 @@ class ContinuousBatcher:
                  max_queue: Optional[int] = None,
                  max_prefill_per_step: int = 1,
                  default_deadline_s: Optional[float] = None,
-                 role: Optional[str] = None):
+                 role: Optional[str] = None,
+                 qos_policy: Optional[QosPolicy] = None,
+                 qos_preempt: Optional[bool] = None,
+                 qos_slo_ttft_ms: Optional[float] = None):
         cfg = resolved_config()
         self.engine = engine
         self.max_queue = int(max_queue if max_queue is not None
@@ -134,8 +162,26 @@ class ContinuousBatcher:
                              f"expected prefill|decode|unified")
         self._migrator = None    # set by the server on prefill replicas
         self.stats = ServingStats(weights_version=engine.weights_version)
+        # Multi-tenant QoS (serve/qos/): flow weights + tenant budgets
+        # from the HVD_TPU_QOS_* knobs; the admission queue is the
+        # weighted-fair scheduler (a single unconfigured flow is exact
+        # FIFO, so default behavior is unchanged), and deadline-aware
+        # preemption is gated on the paged cache — eviction is only
+        # cheap when the KV survives in the prefix index.
+        self._policy = (qos_policy if qos_policy is not None
+                        else QosPolicy.from_config(cfg))
+        self._preempt_enabled = (
+            bool(qos_preempt if qos_preempt is not None
+                 else cfg.qos_preempt)
+            and engine.kv_mode == "paged")
+        # Interactive TTFT SLO (HVD_TPU_QOS_SLO_TTFT_MS): with it set,
+        # preemption fires aggressively enough to land interactive
+        # first tokens inside the budget; 0 = deadline feasibility only.
+        self._slo_ttft_s = float(
+            qos_slo_ttft_ms if qos_slo_ttft_ms is not None
+            else cfg.qos_slo_ttft_ms) / 1e3
         self._lock = threading.Lock()
-        self._queue: List[ServeRequest] = []         # guarded-by: _lock
+        self._queue: QosQueue = QosQueue(self._policy)  # guarded-by: _lock
         self._slots: Dict[int, ServeRequest] = {}    # guarded-by: _lock
         self._killed: Optional[str] = None           # guarded-by: _lock
         self._draining = False                       # guarded-by: _lock
@@ -268,15 +314,21 @@ class ContinuousBatcher:
                sampling: Optional[SamplingParams] = None,
                request_id: Optional[str] = None,
                deadline_s: Optional[float] = None,
-               migrate_to: Optional[tuple] = None) -> ServeRequest:
+               migrate_to: Optional[tuple] = None,
+               tenant: Optional[str] = None,
+               qos_class: Optional[str] = None) -> ServeRequest:
         """Enqueue one generation.  Raises :class:`QueueFullError` at
-        capacity, :class:`ReplicaKilledError` on a dead replica and
-        :class:`ReplicaDrainingError` on a draining one; oversized
+        capacity, :class:`ReplicaKilledError` on a dead replica,
+        :class:`ReplicaDrainingError` on a draining one and
+        :class:`~horovod_tpu.serve.qos.BudgetExhaustedError` when the
+        tenant's token bucket cannot cover the request; oversized
         prompts raise :class:`PromptTooLongError` up front (admitting
         them would waste a slot to fail later).  ``migrate_to`` is the
         decode target a prefill-role replica hands this request's KV to
-        after the first token."""
+        after the first token; ``tenant``/``qos_class`` place the
+        request in the weighted-fair scheduler (docs/qos.md)."""
         sampling = sampling or SamplingParams()
+        qos_class = validate_class(qos_class)
         if sampling.max_new_tokens > self.max_new_tokens_cap:
             sampling = dataclasses.replace(
                 sampling, max_new_tokens=self.max_new_tokens_cap)
@@ -298,7 +350,8 @@ class ContinuousBatcher:
             submitted_at=time.monotonic(),
             prefix_hit_tokens=hit,
             trace_ctx=trace_mod.current(),
-            migrate_to=migrate_to)
+            migrate_to=migrate_to,
+            tenant=(tenant or "default"), qos_class=qos_class)
         self._admit(req)
         return req
 
@@ -344,22 +397,48 @@ class ContinuousBatcher:
             deadline=(now + limit) if limit and limit > 0 else None,
             submitted_at=now,
             trace_ctx=trace_mod.current(),
-            kv_import=(manifest, k_blocks, v_blocks))
+            kv_import=(manifest, k_blocks, v_blocks),
+            tenant=manifest.get("tenant", "default"),
+            qos_class=validate_class(manifest.get("qos_class")))
         self._admit(req)
         return req
 
     def _admit(self, req: ServeRequest) -> None:
-        with self._lock:
-            if self._killed is not None:
-                raise ReplicaKilledError(self._killed)
-            if self._draining:
-                raise ReplicaDrainingError(
-                    "replica draining (no new admissions)")
-            if len(self._queue) >= self.max_queue:
-                self.stats.record_rejected()
-                raise QueueFullError(
-                    f"admission queue full ({self.max_queue} waiting)")
-            self._queue.append(req)
+        # Tenant budget BEFORE the queue bound: an over-budget request
+        # must see its typed rejection (retry_after), not be misread as
+        # replica backpressure.  The charge is the reservation — prompt
+        # plus the generation cap — with the unused part refunded at
+        # completion; the `qos:mode=flood` fault waives it (one tenant
+        # flooding past its budget, the WFQ-fairness drill).
+        need = len(req.prompt) + req.sampling.max_new_tokens
+        if faults_mod._active is not None and faults_mod.on_qos_admit():
+            need = 0
+        if need > 0:
+            try:
+                req.budget_charged = self._policy.charge(req.tenant, need)
+            except Exception:
+                self.stats.record_budget_rejected(req.tenant)
+                _obs.on_qos_budget_reject(req.tenant)
+                raise
+        try:
+            with self._lock:
+                if self._killed is not None:
+                    raise ReplicaKilledError(self._killed)
+                if self._draining:
+                    raise ReplicaDrainingError(
+                        "replica draining (no new admissions)")
+                if len(self._queue) >= self.max_queue:
+                    self.stats.record_rejected()
+                    raise QueueFullError(
+                        f"admission queue full ({self.max_queue} "
+                        f"waiting)")
+                self._queue.push(req)
+        except Exception:
+            # A refused admission must hand the reservation back — the
+            # tokens were never going to be served.
+            self._policy.refund(req.tenant, req.budget_charged)
+            req.budget_charged = 0.0
+            raise
         self._wake.set()
 
     def cancel(self, request_id: str) -> bool:
@@ -369,11 +448,8 @@ class ContinuousBatcher:
         when something was cancelled."""
         target_slot = None
         with self._lock:
-            req = next((r for r in self._queue
-                        if r.request_id == request_id), None)
-            if req is not None:
-                self._queue.remove(req)
-            else:
+            req = self._queue.remove(request_id)
+            if req is None:
                 for slot, r in self._slots.items():
                     if r.request_id == request_id:
                         target_slot, req = slot, r
@@ -384,25 +460,35 @@ class ContinuousBatcher:
             return False
         if target_slot is not None:
             self.engine.release(target_slot)
+        self._settle_budget(req)
         req.finish(error="cancelled")
         return True
 
     # --- scheduling ---------------------------------------------------------
 
     def _expire(self, now: float) -> None:
+        # Queued expiry is the deadline min-heap (O(expired · log n) —
+        # one peek when nothing expired, never a queue walk); in-flight
+        # expiry stays a scan, bounded by max_slots.
         with self._lock:
-            queued = [r for r in self._queue if r.deadline is not None
-                      and now > r.deadline]
-            for r in queued:
-                self._queue.remove(r)
+            queued = self._queue.pop_expired(now)
             running = [(s, r) for s, r in self._slots.items()
                        if r.deadline is not None and now > r.deadline]
             for s, r in running:
                 del self._slots[s]
                 self.engine.release(s)
         for r in queued + [r for _, r in running]:
-            self.stats.record_expired()
+            self._settle_budget(r)
+            self.stats.record_expired(r.qos_class)
             r.finish(error="deadline_exceeded")
+
+    def _settle_budget(self, req: ServeRequest) -> None:
+        """Refund the unused part of the admission reservation exactly
+        once (any terminal path: completion, expiry, cancel, death)."""
+        charged, req.budget_charged = req.budget_charged, 0.0
+        if charged > 0:
+            used = len(req.prompt) + len(req.tokens)
+            self._policy.refund(req.tenant, charged - used)
 
     def _record_phase(self, req: ServeRequest, name: str,
                       start_mono: float, end_mono: float, **args) -> None:
@@ -436,10 +522,12 @@ class ContinuousBatcher:
             self._record_phase(req, "hvd_tpu_serve_decode",
                                req.first_token_at, end,
                                tokens=len(req.tokens))
+        self._settle_budget(req)
         self.stats.record_request(
             ttft_s=(req.first_token_at or end) - req.submitted_at,
             n_tokens=len(req.tokens),
-            total_s=end - req.submitted_at)
+            total_s=end - req.submitted_at,
+            qos_class=req.qos_class, tenant=req.tenant)
         req.finish()
 
     def _emit(self, slot: int, req: ServeRequest, token: int,
@@ -458,6 +546,165 @@ class ContinuousBatcher:
                 or (stop is not None and token == stop)
                 or (check_full and self.engine.slot_full(slot))):
             self._finish_slot(slot, req)
+
+    def _prefill_into(self, slot: int, req: ServeRequest) -> int:
+        """Bring ``req`` into ``slot`` — local prefill, migrated-KV
+        import, or preemption resume — and emit its first token(s);
+        returns the tokens emitted.  The caller already placed ``req``
+        in ``self._slots[slot]``."""
+        emitted = 0
+        prefill_t0 = time.monotonic()
+        imported = req.kv_import is not None
+        resumed = req.resume_state is not None
+        if resumed and req.weights_version is not None and \
+                req.weights_version != self.engine.weights_version:
+            # Mixed-version guard (docs/hot_swap.md): the tokens
+            # emitted before the preemption came from the weights the
+            # replica served THEN; a hot-swap flip landed while the
+            # request sat requeued, and resuming under the new weights
+            # would splice two models' outputs into one response.
+            # Restart from scratch on the current version — the client
+            # sees only the final, single-version stream (the flip
+            # already flushed the parked KV, so nothing stale is
+            # reused either way).
+            req.resume_state = None
+            req.tokens.clear()
+            resumed = False
+        try:
+            if imported:
+                # Migrated-in request: bind the wire-received KV in
+                # place of a prefill; the sender's emitted tokens
+                # replay below so the token stream is seamless.
+                manifest, kb, vb = req.kv_import
+                req.kv_import = None    # payload freed after binding
+                # Re-check the version at BIND time: a weight flip
+                # between adoption and this pop would bind KV from
+                # the old weights under the new ones — the
+                # import_failed answer routes the request to a
+                # recompute instead (never wrong tokens).
+                sender_v = manifest.get("weights_version")
+                if sender_v is not None and int(sender_v) != \
+                        self.engine.weights_version:
+                    raise ValueError(
+                        f"version_mismatch at bind: KV from "
+                        f"weights version {sender_v}, replica now "
+                        f"serves {self.engine.weights_version}")
+                tokens = [int(t) for t in manifest["tokens"]]
+                self.engine.import_slot_kv(
+                    slot, req.prompt, kb, vb, tokens[-1],
+                    req.sampling, rng=manifest.get("rng"))
+            elif resumed:
+                # Preempted generation coming back (serve/qos/): the
+                # prefix cache covers what survived, the engine
+                # recomputes the tail, and nothing already emitted is
+                # re-sampled — decode continues where it stopped.
+                prev, rng = req.resume_state
+                req.resume_state = None
+                req.prefix_hit_tokens = self.engine.resume_slot(
+                    slot, req.prompt, prev, req.sampling, rng=rng)
+                tokens = []
+            else:
+                tokens = [self.engine.start(slot, req.prompt,
+                                            req.sampling)]
+        except Exception as e:   # defensive: engine bug ≠ wedged slot
+            with self._lock:
+                self._slots.pop(slot, None)
+            self.engine.release(slot)
+            self._settle_budget(req)
+            self.stats.record_failed(req.qos_class)
+            req.finish(error=(f"import_failed: {e}" if imported
+                              else f"prefill_failed: {e}"))
+            return 0
+        req.weights_version = self.engine.weights_version
+        if not imported and not resumed:
+            req.prefix_hit_tokens = self.engine.prefix_hit_tokens(slot)
+            self.stats.record_prefix(req.prefix_hit_tokens > 0)
+        self._record_phase(req, "hvd_tpu_serve_queued",
+                           req.submitted_at, prefill_t0)
+        self._record_phase(req, "hvd_tpu_serve_prefill", prefill_t0,
+                           time.monotonic(),
+                           prompt_len=len(req.prompt), slot=slot,
+                           prefix_hit=req.prefix_hit_tokens,
+                           imported=imported, resumed=resumed)
+        if req.done.is_set():
+            # Cancelled/expired between admission and prefill
+            # completion: cancel() found no active slot to release
+            # (engine.start had not activated it yet), so release
+            # here or the slot leaks as a ghost forever.
+            with self._lock:
+                self._slots.pop(slot, None)
+            self.engine.release(slot)
+            return emitted
+        now2 = time.monotonic()
+        for j, token in enumerate(tokens):
+            emitted += 1
+            self._emit(slot, req, token, now2,
+                       check_full=(j == len(tokens) - 1))
+            if req.done.is_set():
+                break
+        if (not imported and not resumed and self.role == "prefill"
+                and self._migrator is not None
+                and req.migrate_to is not None
+                and not req.done.is_set()):
+            self._handoff(slot, req)
+        return emitted
+
+    def _maybe_preempt(self, now: float) -> int:
+        """Deadline-aware preemption (serve/qos/preempt.py): when a
+        queued interactive request would miss its deadline waiting for
+        a natural slot release, evict the youngest batch generation —
+        its KV drops to the prefix cache, not the floor — requeue it
+        with resume state, and prefill the interactive request into
+        the freed slot NOW.  Returns tokens emitted (the interactive
+        prefill's first token)."""
+        if not self._preempt_enabled:
+            return 0
+        with self._lock:
+            if self.engine.free_slots():
+                return 0    # a slot is free: ordinary admission wins
+            urgent = self._queue.urgent("interactive")
+            if urgent is None:
+                return 0
+            active = dict(self._slots)
+        _, ireq = urgent
+        est = preempt_mod.estimate_slot_wait_s(
+            active, self.stats.tpot_estimate_s())
+        if not preempt_mod.should_preempt(ireq, now, est,
+                                          self._slo_ttft_s):
+            return 0
+        eligible = {s: r for s, r in active.items()
+                    if self.engine.can_resume(len(r.prompt),
+                                              len(r.tokens))}
+        victim = preempt_mod.pick_victim(eligible)
+        if victim is None:
+            return 0    # nothing preemptible: the deadline may expire
+        slot, vreq = victim
+        with self._lock:
+            # Re-validate both ends under the lock: the victim may have
+            # finished and the interactive request may have been
+            # cancelled/dispatched since the snapshot.
+            if self._slots.get(slot) is not vreq:
+                return 0
+            if self._queue.remove(ireq.request_id) is None:
+                return 0
+            self._slots[slot] = ireq
+        rng = self.engine.preempt_slot(slot, vreq.prompt, vreq.tokens)
+        vreq.resume_state = (list(vreq.tokens), rng)
+        vreq.preemptions += 1
+        self.stats.record_preempted()
+        _obs.on_qos_preempt()
+        flight_mod.record("qos_preempted", request=vreq.request_id,
+                          emitted=len(vreq.tokens),
+                          for_request=ireq.request_id)
+        logger.info("preempted batch request %s (%d tokens in) for "
+                    "interactive %s", vreq.request_id, len(vreq.tokens),
+                    ireq.request_id)
+        # Requeue bypasses the admission bound and the budget charge:
+        # the victim's tokens are already paid for, and dropping
+        # preempted work would turn a scheduling decision into loss.
+        with self._lock:
+            self._queue.push(vreq)
+        return self._prefill_into(slot, ireq)
 
     def step(self) -> int:
         """One scheduling iteration; returns the number of tokens
@@ -486,84 +733,26 @@ class ContinuousBatcher:
             if claimed is not None:
                 self._run_flip(claimed)
                 flip = None
+        # Deadline-aware preemption (serve/qos/): before ordinary
+        # admission, an interactive request that would miss its
+        # deadline waiting for a natural slot release evicts the
+        # youngest batch generation and takes its slot this same step.
+        if flip is None:
+            emitted += self._maybe_preempt(now)
         # Admit: bounded prefills per step keep decode cadence for the
         # already-running requests (prefill is the expensive phase).
+        # Pops come out in weighted-fair order (serve/qos/sched.py).
         for _ in range(self.max_prefill_per_step if flip is None else 0):
             with self._lock:
                 free = self.engine.free_slots()
-                if not free or not self._queue:
+                if not free or not len(self._queue):
                     break
-                req = self._queue.pop(0)
+                req = self._queue.pop()
+                if req is None:
+                    break
                 slot = free[0]
                 self._slots[slot] = req
-            prefill_t0 = time.monotonic()
-            imported = req.kv_import is not None
-            try:
-                if imported:
-                    # Migrated-in request: bind the wire-received KV in
-                    # place of a prefill; the sender's emitted tokens
-                    # replay below so the token stream is seamless.
-                    manifest, kb, vb = req.kv_import
-                    req.kv_import = None    # payload freed after binding
-                    # Re-check the version at BIND time: a weight flip
-                    # between adoption and this pop would bind KV from
-                    # the old weights under the new ones — the
-                    # import_failed answer routes the request to a
-                    # recompute instead (never wrong tokens).
-                    sender_v = manifest.get("weights_version")
-                    if sender_v is not None and int(sender_v) != \
-                            self.engine.weights_version:
-                        raise ValueError(
-                            f"version_mismatch at bind: KV from "
-                            f"weights version {sender_v}, replica now "
-                            f"serves {self.engine.weights_version}")
-                    tokens = [int(t) for t in manifest["tokens"]]
-                    self.engine.import_slot_kv(
-                        slot, req.prompt, kb, vb, tokens[-1],
-                        req.sampling, rng=manifest.get("rng"))
-                else:
-                    tokens = [self.engine.start(slot, req.prompt,
-                                                req.sampling)]
-            except Exception as e:   # defensive: engine bug ≠ wedged slot
-                with self._lock:
-                    self._slots.pop(slot, None)
-                self.engine.release(slot)
-                self.stats.record_failed()
-                req.finish(error=(f"import_failed: {e}" if imported
-                                  else f"prefill_failed: {e}"))
-                continue
-            req.weights_version = self.engine.weights_version
-            if not imported:
-                req.prefix_hit_tokens = self.engine.prefix_hit_tokens(slot)
-                self.stats.record_prefix(req.prefix_hit_tokens > 0)
-            self._record_phase(req, "hvd_tpu_serve_queued",
-                               req.submitted_at, prefill_t0)
-            self._record_phase(req, "hvd_tpu_serve_prefill", prefill_t0,
-                               time.monotonic(),
-                               prompt_len=len(req.prompt), slot=slot,
-                               prefix_hit=req.prefix_hit_tokens,
-                               imported=imported)
-            if req.done.is_set():
-                # Cancelled/expired between admission and prefill
-                # completion: cancel() found no active slot to release
-                # (engine.start had not activated it yet), so release
-                # here or the slot leaks as a ghost forever.
-                with self._lock:
-                    self._slots.pop(slot, None)
-                self.engine.release(slot)
-                continue
-            now2 = time.monotonic()
-            for j, token in enumerate(tokens):
-                emitted += 1
-                self._emit(slot, req, token, now2,
-                           check_full=(j == len(tokens) - 1))
-                if req.done.is_set():
-                    break
-            if (not imported and self.role == "prefill"
-                    and self._migrator is not None
-                    and req.migrate_to is not None
-                    and not req.done.is_set()):
-                self._handoff(slot, req)
+            emitted += self._prefill_into(slot, req)
         # Decode: one token for every active request.  The kill fault's
         # event coordinate is this dispatch — guarded so an unarmed
         # plan costs one attribute read.
@@ -631,8 +820,7 @@ class ContinuousBatcher:
         refuse new work — replica death as the router observes it."""
         with self._lock:
             self._killed = reason
-            pending = self._queue[:]
-            self._queue.clear()
+            pending = self._queue.drain()
             running = list(self._slots.values())
             self._slots.clear()
             flip, self._pending_flip = self._pending_flip, None
@@ -642,7 +830,8 @@ class ContinuousBatcher:
             flip[2].setdefault("error", f"replica_killed: {reason}")
             flip[1].set()
         for req in pending + running:
-            self.stats.record_failed()
+            self._settle_budget(req)
+            self.stats.record_failed(req.qos_class)
             req.finish(error="replica_killed")
         n = len(pending) + len(running)
         flight_mod.record("replica_died", reason=reason, failed=n)
@@ -700,6 +889,7 @@ class ContinuousBatcher:
         snap.update(self.engine.kv_stats())
         with self._lock:
             snap.update(queue_depth=len(self._queue),
+                        queued_by_class=self._queue.depths(),
                         active_slots=len(self._slots),
                         max_slots=self.engine.max_slots,
                         dead=self._killed is not None,

@@ -775,6 +775,137 @@ class InferenceEngine:
             self._kv.release(slot)
         self._clear_slot(slot)
 
+    # --- deadline-aware preemption (serve/qos/; docs/qos.md) ----------------
+    # Preempt/resume run on the batcher thread only (they drive the
+    # same donated pools prefill/decode do); the QoS scheduler owns the
+    # decision, this is the KV mechanics.
+
+    def preempt_slot(self, slot: int, prompt: Sequence[int],
+                     emitted: Sequence[int]):
+        """Evict ``slot``'s generation for later resumption: index the
+        full computed sequence (prompt + all emitted tokens whose K/V
+        exists) into the prefix cache, release the slot, and return the
+        engine's RNG snapshot.  The blocks drop to the LRU but stay
+        reachable through the prefix index, so :meth:`resume_slot`
+        re-admits with a prefix hit and recomputes only the tail —
+        eviction costs a slot swap, not the generation's compute.
+
+        The RNG snapshot is taken BEFORE release so a resume restores
+        the exact stream the uninterrupted run would be on — the
+        temperature half of the token-identity oracle (same
+        sole-active-slot contract as KV migration's rng carry)."""
+        rng = np.asarray(self._rng)
+        emitted = [int(t) for t in emitted]
+        if self._kv is not None and emitted:
+            # K/V coverage at preempt time is [0, n + k - 1): the last
+            # emitted token is pending consumption, its K/V not yet
+            # written — index exactly what is resident.
+            seq = [int(t) for t in prompt] + emitted[:-1]
+            if seq:
+                self._kv.index_prompt(slot, seq)
+        self.release(slot)
+        return rng
+
+    def can_resume(self, n_prompt: int, n_emitted: int) -> bool:
+        """Whether a generation of this shape survives a
+        preempt/resume cycle here: the paged tier rebuilds arbitrarily
+        long tails in bucket-sized chunks, but a drafter's dense cache
+        has no chunked rebuild — its prefill writes one whole bucket —
+        so on drafter engines only sequences fitting the largest
+        bucket are preemptible (the scheduler skips other victims)."""
+        n = n_prompt + max(0, n_emitted - 1)
+        if self._drafter is not None:
+            return n <= self.prefill_buckets[-1]
+        return 0 < n < self.max_seq_len
+
+    def resume_slot(self, slot: int, prompt: Sequence[int],
+                    emitted: Sequence[int], sampling: SamplingParams,
+                    rng=None) -> int:
+        """Re-admit a preempted generation into ``slot``: rebuild K/V
+        for ``prompt + emitted[:-1]`` (prefix hit covers whatever
+        survived in the cache, a prefill forward recomputes the rest —
+        its sampled token is discarded, nothing already emitted is ever
+        re-sampled), then bind the slot so the next ``step()`` consumes
+        ``emitted[-1]`` at the position the preemption interrupted.
+        Returns the prefix-hit token count.
+
+        ``rng`` (the snapshot :meth:`preempt_slot` returned) is
+        restored AFTER the recompute forward — the recompute's own
+        discarded draw must not perturb the stream — and only while no
+        other slot is active, mirroring ``import_slot_kv``'s contract:
+        temperature resumption is then bit-identical to the
+        uninterrupted run; with concurrent traffic it stays
+        distributionally correct (greedy is deterministic either
+        way)."""
+        with self._slot_lock:
+            if self._active[slot]:
+                raise RuntimeError(f"slot {slot} is already active")
+        prompt = [int(t) for t in prompt]
+        emitted = [int(t) for t in emitted]
+        if not emitted:
+            raise ValueError("resume_slot needs at least one emitted "
+                             "token (preemption happens post-prefill)")
+        self.check_prompt_tokens(prompt)
+        seq = prompt + emitted[:-1]
+        n = len(seq)
+        if self.kv_mode == "paged":
+            hit = self._kv.begin_request(slot, seq)
+            # Recompute the non-resident tail in bucket-sized chunks:
+            # the paged prefill program takes a start offset, so a
+            # resumed sequence longer than the largest bucket (a long
+            # generation whose cache was evicted under pressure) still
+            # rebuilds — an ordinary prompt never needs this, a resume
+            # must not die on it.
+            top = self.prefill_buckets[-1]
+            pos = hit
+            while pos < n:
+                ns = min(n - pos, top)
+                L = self.bucket_for(ns)
+                self._kv.ensure_writable(slot, pos, ns)
+                padded = np.zeros((1, L), np.int32)
+                padded[0, :ns] = np.asarray(seq[pos:pos + ns], np.int32)
+                fn = self._prefill_fns[L]
+                with self._activity(f"serve/slot{slot}", "SERVE_PREFILL",
+                                    {"bucket": L, "prompt_len": n,
+                                     "prefix_hit": hit, "resumed": True}):
+                    _, self._pools = fn(
+                        self._params, self._pools,
+                        jnp.asarray(self._table[slot]),
+                        jnp.asarray(padded), jnp.int32(pos),
+                        jnp.int32(ns), self._next_rng(),
+                        jnp.float32(sampling.temperature),
+                        jnp.int32(sampling.top_k))
+                pos += ns
+            self._kv.index_prompt(slot, seq)
+        else:
+            hit = 0
+            L = self.bucket_for(n)
+            padded = np.zeros((1, L), np.int32)
+            padded[0, :n] = np.asarray(seq, np.int32)
+            fn = self._prefill_fns[L]
+            with self._activity(f"serve/slot{slot}", "SERVE_PREFILL",
+                                {"bucket": L, "prompt_len": n,
+                                 "resumed": True}):
+                _, self._caches = fn(
+                    self._params, self._caches, jnp.asarray(padded),
+                    jnp.int32(n), jnp.int32(slot), self._next_rng(),
+                    jnp.float32(sampling.temperature),
+                    jnp.int32(sampling.top_k))
+        if rng is not None and not self.active_slots():
+            self._rng = jnp.asarray(np.asarray(rng, np.uint32))
+        if self._drafter is not None:
+            # Mirror start(): the drafter recomputes the sequence (its
+            # dense cache shares nothing) so speculative decode can
+            # draft from the resumed position immediately.
+            Lf = self.bucket_for(n)
+            dp = np.zeros((1, Lf), np.int32)
+            dp[0, :n] = np.asarray(seq, np.int32)
+            self._drafter_caches = self._draft_prefill_fns[Lf](
+                self._drafter_params, self._drafter_caches,
+                jnp.asarray(dp), jnp.int32(slot))
+        self._bind_slot(slot, n, emitted[-1], sampling, hit)
+        return hit
+
     # --- zero-downtime weight hot-swap (serve/swap.py; docs/hot_swap.md) ----
     # Staging runs on the subscriber thread; the COMMIT runs on the
     # batcher thread only, at the swap barrier, with no active slots —

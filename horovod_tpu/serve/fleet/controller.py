@@ -47,6 +47,13 @@ logger = get_logger(__name__)
 ROLES = ("prefill", "decode", "unified")
 
 
+def _interactive_p99(entry: dict) -> Optional[float]:
+    """Interactive-class p99 TTFT from one replica's stats snapshot
+    (None before that class completed anything there)."""
+    return (entry["stats"].get("qos", {}).get("interactive", {})
+            .get("ttft_ms_p99"))
+
+
 class ReplicaLauncher:
     """Deployment interface the controller scales through: ``launch``
     brings up one replica of ``role`` (on ``host`` when placement is
@@ -71,7 +78,8 @@ class FleetController:
                  scale_out_ttft_ms: Optional[float] = None,
                  scale_in_idle_s: Optional[float] = None,
                  drain_deadline_s: Optional[float] = None,
-                 stats_timeout_s: float = 2.0) -> None:
+                 stats_timeout_s: float = 2.0,
+                 qos_gate=None) -> None:
         cfg = resolved_config()
         self._router = router
         self._launcher = launcher
@@ -91,6 +99,13 @@ class FleetController:
             drain_deadline_s if drain_deadline_s is not None
             else cfg.fleet_drain_deadline_s)
         self.stats_timeout_s = float(stats_timeout_s)
+        # QoS brownout (serve/qos/brownout.py): the controller feeds
+        # the router's shed ladder the SAME signals it scales on —
+        # fleet-mean queue depth and interactive p99 TTFT.  None when
+        # the router runs ungated (falls back to the router's own gate
+        # so one wiring suffices).
+        self._qos_gate = (qos_gate if qos_gate is not None
+                          else getattr(router, "qos_gate", None))
         self._lock = threading.Lock()
         self._draining: Dict[str, float] = {}   # name -> drain start  guarded-by: _lock
         self._placement: Dict[str, str] = {}    # name -> reserved host  guarded-by: _lock
@@ -244,6 +259,7 @@ class FleetController:
         now = time.monotonic() if now is None else now
         stats = self._router.replica_stats(timeout=self.stats_timeout_s)
         actions: List[dict] = []
+        self._feed_brownout(stats, now)
         actions += self._finish_drains(stats, now)
         by_role: Dict[str, List[dict]] = {}
         with self._lock:
@@ -267,9 +283,17 @@ class FleetController:
             queues = [e["stats"]["queue_depth"] for e in live]
             ttfts = [e["stats"].get("ttft_ms_p99") for e in live]
             ttfts = [t for t in ttfts if t is not None]
+            # Per-class scale signal (serve/qos/): the INTERACTIVE tail
+            # triggers scale-out on its own — a batch-dominated
+            # aggregate can look calm while the SLO class is drowning,
+            # and capacity (not shedding) is the right first answer.
+            ittfts = [_interactive_p99(e) for e in live]
+            ittfts = [t for t in ittfts if t is not None]
             saturated = (sum(queues) / len(queues) > self.scale_out_queue
                          or (self.scale_out_ttft_ms > 0 and ttfts
-                             and max(ttfts) > self.scale_out_ttft_ms))
+                             and max(ttfts) > self.scale_out_ttft_ms)
+                         or (self.scale_out_ttft_ms > 0 and ittfts
+                             and max(ittfts) > self.scale_out_ttft_ms))
             busy = any(q > 0 or e["stats"]["active_slots"] > 0
                        for q, e in zip(queues, live))
             with self._lock:
@@ -292,6 +316,22 @@ class FleetController:
                 actions.append({"action": "drain", "role": role,
                                 "replica": victim})
         return actions
+
+    def _feed_brownout(self, stats: Dict[str, dict],
+                       now: float) -> None:
+        """Feed the QoS gate's brownout ladder one control round's
+        signals: fleet-mean queue depth and the worst interactive p99
+        TTFT — the same obs-derived numbers the scale policy reads."""
+        if self._qos_gate is None:
+            return
+        live = [e for e in stats.values() if "stats" in e]
+        if not live:
+            return
+        queues = [e["stats"]["queue_depth"] for e in live]
+        ittfts = [_interactive_p99(e) for e in live]
+        ittfts = [t for t in ittfts if t is not None]
+        self._qos_gate.observe(sum(queues) / len(queues),
+                               max(ittfts) if ittfts else None, now=now)
 
     def _finish_drains(self, stats: Dict[str, dict],
                        now: float) -> List[dict]:

@@ -121,6 +121,12 @@ def migrate_slot(engine, slot: int, req, target, key: bytes, *,
         # locally on its own matching weights (economics lost, tokens
         # right).
         "weights_version": engine.weights_version,
+        # Multi-tenant QoS (serve/qos/): the flow identity travels with
+        # the request so the decode replica's weighted-fair scheduler
+        # and per-class stats see the same tenant/class the router
+        # admitted.
+        "tenant": req.tenant,
+        "qos_class": req.qos_class,
     }
     nbytes = int(k.nbytes + v.nbytes)
     mode = (faults_mod.on_serve_migrate()

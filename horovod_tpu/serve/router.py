@@ -59,6 +59,7 @@ from ..utils.logging import get_logger
 from ..utils.retry import RetryPolicy, retry_call
 from .engine import resolved_config
 from .fleet.directory import PrefixDirectory
+from .qos import QosGate, validate_class
 from .server import (CancelRequest, GenerateRequest, GenerateResponse,
                      RollbackRequest, StatsRequest, SwapRequest)
 
@@ -183,6 +184,21 @@ class Router:
         self._affinity_slack = max(1, int(cfg.serve_max_batch))
         self._directory = PrefixDirectory(self._affinity_block,
                                           max_entries=1024)
+        # Multi-tenant QoS gate (serve/qos/brownout.py): per-tenant
+        # rate limits + the brownout shed ladder, consulted BEFORE any
+        # replica is touched.  None = no router-tier policy (the
+        # batcher tier may still enforce budgets).
+        self._qos_gate: Optional[QosGate] = None
+
+    def attach_qos(self, gate: QosGate) -> None:
+        """Install the router-tier QoS gate (docs/qos.md): every
+        ``generate`` runs its shed/budget checks first, and the fleet
+        controller feeds it the overload signals each control round."""
+        self._qos_gate = gate
+
+    @property
+    def qos_gate(self) -> Optional[QosGate]:
+        return self._qos_gate
 
     # --- health -------------------------------------------------------------
 
@@ -482,18 +498,32 @@ class Router:
                  top_k: int = 0, stop_token: Optional[int] = None,
                  deadline_s: Optional[float] = None,
                  request_id: Optional[str] = None,
-                 spec: bool = False) -> GenerateResponse:
+                 spec: bool = False,
+                 tenant: Optional[str] = None,
+                 qos_class: Optional[str] = None) -> GenerateResponse:
         """Route one generation; at-most-once per ``request_id``.
 
         Retryable failures (dead/busy/killed replica, wire errors)
         re-enter the queue under the retry policy and land on another
         replica; terminal errors (deadline, oversized prompt) return
         as-is.  ``spec=True`` opts into speculative decoding on
-        replicas that run a drafter."""
+        replicas that run a drafter.  ``tenant``/``qos_class`` place
+        the request in the QoS scheduler (docs/qos.md); with a gate
+        attached, a brownout shed or an exhausted tenant budget raises
+        the typed retriable rejection BEFORE any replica is touched."""
         rid = request_id or uuid.uuid4().hex
+        qos_class = validate_class(qos_class)
+        tenant = tenant or "default"
         with self._lock:
             if rid in self._done:
                 return self._done[rid]
+        gate_charge = 0.0
+        if self._qos_gate is not None:
+            # Raises RequestShedError / BudgetExhaustedError — typed,
+            # retriable by the CLIENT after retry_after_s, and costing
+            # the fleet nothing (no replica ever sees the request).
+            gate_charge = self._qos_gate.admit(
+                tenant, qos_class, len(prompt) + max_new_tokens)
         prefix_key = self._prefix_key(prompt)
         # Response-read timeout: a generation legitimately runs for the
         # request's whole deadline — reading it under the snappy probe
@@ -511,7 +541,8 @@ class Router:
                                    temperature=temperature, top_k=top_k,
                                    stop_token=stop_token,
                                    deadline_s=deadline_s, spec=spec,
-                                   migrate_to=migrate_to)
+                                   migrate_to=migrate_to,
+                                   tenant=tenant, qos_class=qos_class)
 
         # A collect failure means the decode replica lost the migrated
         # continuation — later attempts recompute on the unified path
@@ -634,13 +665,28 @@ class Router:
         # span, and the batcher's queued/prefill/decode phases all
         # parent under it, so the merged trace answers "where did this
         # request's latency go" across processes.
-        with trace_mod.span("hvd_tpu_serve_request", root=True,
-                            args={"request_id": rid,
-                                  "max_new_tokens": max_new_tokens}):
-            resp = retry_call(
-                attempt, policy=self._retry_policy,
-                retry_on=(ReplicaUnavailableError, NoHealthyReplicasError),
-                describe=f"serve generate {rid}")
+        try:
+            with trace_mod.span("hvd_tpu_serve_request", root=True,
+                                args={"request_id": rid,
+                                      "max_new_tokens": max_new_tokens}):
+                resp = retry_call(
+                    attempt, policy=self._retry_policy,
+                    retry_on=(ReplicaUnavailableError,
+                              NoHealthyReplicasError),
+                    describe=f"serve generate {rid}")
+        except Exception:
+            if self._qos_gate is not None and gate_charge > 0:
+                # A lost request served nothing: hand the whole
+                # reservation back, or a few fleet outages would drain
+                # the tenant's bucket and convert replica failures
+                # into budget_exhausted rejections.
+                self._qos_gate.refund(tenant, gate_charge)
+            raise
+        if self._qos_gate is not None and gate_charge > 0:
+            # Refund the unused reservation: the charge covered prompt
+            # + the generation cap, the tenant pays prompt + delivered.
+            used = len(prompt) + len(resp.tokens or ())
+            self._qos_gate.refund(tenant, gate_charge - used)
         with self._lock:
             self._done[rid] = resp
             while len(self._done) > self._dedupe_window:

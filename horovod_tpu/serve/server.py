@@ -40,6 +40,7 @@ from .batcher import (ContinuousBatcher, QueueFullError,
                       ReplicaDrainingError, ReplicaKilledError)
 from .engine import PromptTooLongError, SamplingParams, resolved_config
 from .fleet.migration import MigrationBuffer, MigrationError, migrate_slot
+from .qos import BudgetExhaustedError
 from .swap import (SwapAbandonedError, SwapFailedError, SwapRejectedError,
                    WeightSubscriber)
 
@@ -51,7 +52,9 @@ class GenerateRequest:
                  max_new_tokens: int = 16, temperature: float = 0.0,
                  top_k: int = 0, stop_token: Optional[int] = None,
                  deadline_s: Optional[float] = None, spec: bool = False,
-                 migrate_to: Optional[tuple] = None):
+                 migrate_to: Optional[tuple] = None,
+                 tenant: Optional[str] = None,
+                 qos_class: Optional[str] = None):
         self.request_id = request_id
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -67,6 +70,11 @@ class GenerateRequest:
         # the first token; None (or a non-prefill replica) runs the
         # full generation locally.
         self.migrate_to = migrate_to
+        # Multi-tenant QoS (serve/qos/; docs/qos.md): the weighted-fair
+        # flow this request rides; old peers simply never set them
+        # (pickled frames, getattr defaults on the receiving side).
+        self.tenant = tenant
+        self.qos_class = qos_class
 
 
 class GenerateResponse:
@@ -346,7 +354,18 @@ class InferenceServer(BasicService):
             sr = self._batcher.submit(
                 req.prompt, sampling, request_id=req.request_id,
                 deadline_s=req.deadline_s,
-                migrate_to=getattr(req, "migrate_to", None))
+                migrate_to=getattr(req, "migrate_to", None),
+                tenant=getattr(req, "tenant", None),
+                qos_class=getattr(req, "qos_class", None))
+        except BudgetExhaustedError as e:
+            # Typed retriable rejection (docs/qos.md): the CLIENT backs
+            # off retry_after_s — the router must neither strike this
+            # replica nor re-run the request elsewhere (the budget is
+            # policy, not health).
+            return GenerateResponse(
+                req.request_id, None,
+                error=f"budget_exhausted: retry_after_s="
+                      f"{e.retry_after_s:.2f}")
         except QueueFullError:
             return GenerateResponse(req.request_id, None, error="busy")
         except ReplicaDrainingError:

@@ -89,6 +89,13 @@ TARGETS = {
     # rollback restoring prior weights bit-identically.
     ("swap", False): "tests/test_swap.py",
     ("swap", True): "tests/multiproc/test_swap_mp.py",
+    # qos: randomized ``qos:*`` specs (the seed draws invert vs flood,
+    # the step picks the WFQ pop / budget charge they hit) against the
+    # brownout drill in tests/test_qos.py — mixed-tenant overload with
+    # an injected priority inversion or budget flood must keep
+    # interactive p99 TTFT inside the configured SLO while batch sheds
+    # and preempts.
+    ("qos", False): "tests/test_qos.py",
 }
 
 
@@ -167,7 +174,8 @@ def main(argv=None) -> int:
                     help="soak the multi-process world test instead of "
                          "the single-controller one")
     ap.add_argument("--mode",
-                    choices=("train", "serve", "dcn", "ckpt", "swap"),
+                    choices=("train", "serve", "dcn", "ckpt", "swap",
+                             "qos"),
                     default="train",
                     help="'train' loops the elastic-recovery chaos "
                          "tests; 'serve' soaks the serving router under "
@@ -188,7 +196,11 @@ def main(argv=None) -> int:
                          "(corrupt-shard/stall/kill-mid-flip/"
                          "partial-fleet) — bursty load through N "
                          "swaps, 0 dropped requests, token-correct "
-                         "responses, one journaled rollback")
+                         "responses, one journaled rollback; 'qos' "
+                         "soaks the multi-tenant scheduler under "
+                         "randomized qos:invert/flood fault specs — "
+                         "the brownout drill must hold the interactive "
+                         "SLO while batch sheds and preempts")
     ap.add_argument("--sanitize", action="store_true",
                     help="run each iteration under HVD_TPU_SANITIZE=soft "
                          "(hvdsan, docs/lint.md): lock-discipline and "

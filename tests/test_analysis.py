@@ -462,6 +462,40 @@ def test_metric_doc_drift(tmp_path):
     assert checks_of(fs) == ["metric-doc-drift"]
 
 
+def test_metric_tenant_cardinality_flags_uncapped_labels(tmp_path):
+    """ISSUE 15 satellite: a tenant-id label minted outside the obs
+    registry (whose 64-series cap bounds it) is one series per tenant
+    forever — flagged at lint time."""
+    src = (
+        "def instrument(reg, exporter, tenant):\n"
+        # Registry-chained: rides the cap — clean.
+        '    reg.counter("hvd_tpu_ok_total").labels(tenant=tenant).inc()\n'
+        # One-level local family binding: also the capped idiom.
+        '    fam = reg.counter("hvd_tpu_fam_total")\n'
+        "    fam.labels(tenant=tenant).inc()\n"
+        # Hand-rolled series object: unbounded — flagged.
+        "    exporter.labels(tenant=tenant)\n"
+        # tenant_id spelling is held to the same rule.
+        "    exporter.labels(tenant_id=tenant)\n"
+    )
+    fs = lint(tmp_path, {"m.py": src}, [MetricNameChecker],
+              docs={"metrics.md": "hvd_tpu_ok_total hvd_tpu_fam_total"})
+    assert checks_of(fs) == ["metric-tenant-cardinality"]
+    assert len(fs) == 2
+    assert all("64-series" in f.message for f in fs)
+
+
+def test_metric_tenant_cardinality_clean_without_tenant_labels(tmp_path):
+    src = (
+        "def instrument(reg, exporter):\n"
+        '    reg.counter("hvd_tpu_x_total").labels(site="a").inc()\n'
+        '    exporter.labels(kind="b")\n'   # no tenant label: not ours
+    )
+    fs = lint(tmp_path, {"m.py": src}, [MetricNameChecker],
+              docs={"metrics.md": "hvd_tpu_x_total"})
+    assert checks_of(fs) == []
+
+
 def test_span_naming_rules(tmp_path):
     src = (
         "from ..obs import trace as trace_mod\n\n"

@@ -1,0 +1,735 @@
+"""SLO-aware multi-tenant QoS scheduling (horovod_tpu/serve/qos/):
+weighted-fair admission, token-bucket budgets with typed rejections,
+deadline-aware paged-KV preemption with the token-identity oracle, and
+the brownout shed ladder's hysteresis.
+
+The chaos class at the bottom is the ISSUE 15 drill: a randomized
+``qos:invert``/``qos:flood`` fault injected into the scheduler must
+not break the interactive SLO — preemption and weighted fairness are
+the safety net the drill exercises (``scripts/chaos_soak.py --mode
+qos`` loops it over randomized injection points)."""
+
+import os
+import random
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from horovod_tpu import faults
+from horovod_tpu.models.transformer import GPT, GPTConfig
+from horovod_tpu.serve import (
+    BrownoutController, BudgetExhaustedError, ContinuousBatcher,
+    InferenceEngine, InferenceServer, QosGate, QosPolicy, QosQueue,
+    ReplicaSpec, RequestShedError, Router, SamplingParams, ServingStats,
+)
+from horovod_tpu.serve.qos import preempt as preempt_mod
+from horovod_tpu.serve.qos import validate_class
+
+pytestmark = pytest.mark.serving
+
+KEY = b"k" * 32
+VOCAB = 97
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = GPTConfig(vocab_size=VOCAB, n_layer=2, n_head=2, d_model=32,
+                    d_ff=64, max_seq_len=32, dtype=jnp.float32,
+                    param_dtype=jnp.float32)
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _engine(model_and_params, **kw):
+    model, params = model_and_params
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("prefill_buckets", (8, 16))
+    kw.setdefault("max_seq_len", 32)
+    return InferenceEngine(model, params, **kw)
+
+
+def _run_engine(engine, slot, prompt, n_tokens, temperature=0.0):
+    toks = [engine.start(slot, prompt, SamplingParams(
+        max_new_tokens=n_tokens, temperature=temperature))]
+    while len(toks) < n_tokens:
+        toks.extend(engine.step()[slot])
+    engine.release(slot)
+    return toks[:n_tokens]
+
+
+def _drive(batcher, until, timeout=60.0):
+    t0 = time.monotonic()
+    while not until():
+        batcher.step()
+        assert time.monotonic() - t0 < timeout, "drive timed out"
+
+
+class _Q:
+    """Minimal ServeRequest stand-in for direct QosQueue tests."""
+
+    def __init__(self, rid, tenant, cls, deadline=None):
+        self.request_id = rid
+        self.tenant = tenant
+        self.qos_class = cls
+        self.deadline = deadline
+
+
+# --- weighted-fair queue ------------------------------------------------------
+
+class TestWfq:
+    def test_single_flow_is_fifo(self):
+        q = QosQueue(QosPolicy())
+        for i in range(6):
+            q.push(_Q(f"r{i}", "default", "standard"))
+        assert [q.pop().request_id for _ in range(6)] == \
+            [f"r{i}" for i in range(6)]
+
+    def test_hot_tenant_cannot_starve_small_tenant(self):
+        """ISSUE 15 tentpole: one tenant flooding the queue advances
+        its own virtual clock past everyone else's — the small
+        tenant's requests dispatch interleaved, not after the flood."""
+        q = QosQueue(QosPolicy())
+        for i in range(20):
+            q.push(_Q(f"hot-{i}", "hot", "standard"))
+        for i in range(4):
+            q.push(_Q(f"small-{i}", "small", "standard"))
+        order = [q.pop().request_id for _ in range(24)]
+        small_at = [i for i, rid in enumerate(order)
+                    if rid.startswith("small")]
+        # Equal weights alternate: all 4 small requests inside the
+        # first 9 dispatches despite 20 hot requests queued first.
+        assert max(small_at) <= 8, order
+
+    def test_class_weights_bias_dispatch(self):
+        """interactive (weight 8) receives ~8x batch's (weight 1)
+        dispatch share while both are backlogged."""
+        q = QosQueue(QosPolicy())
+        for i in range(16):
+            q.push(_Q(f"i{i}", "t", "interactive"))
+            q.push(_Q(f"b{i}", "t", "batch"))
+        first = [q.pop().request_id for _ in range(18)]
+        n_inter = sum(1 for rid in first if rid.startswith("i"))
+        assert n_inter >= 14, first
+
+    def test_tenant_shares_scale_weight(self):
+        q = QosQueue(QosPolicy(tenant_shares={"paid": 4.0}))
+        for i in range(12):
+            q.push(_Q(f"paid-{i}", "paid", "standard"))
+            q.push(_Q(f"free-{i}", "free", "standard"))
+        first = [q.pop().request_id for _ in range(10)]
+        assert sum(1 for r in first if r.startswith("paid")) >= 7, first
+
+    def test_idle_flow_banks_no_credit(self):
+        """A flow that sat idle re-enters at the live virtual time —
+        a burst arriving after the idle period interleaves with flows
+        that kept working instead of replaying its banked clock and
+        jumping the whole backlog."""
+        q = QosQueue(QosPolicy())
+        q.push(_Q("lazy-0", "lazy", "standard"))
+        assert q.pop().request_id == "lazy-0"
+        for i in range(8):
+            q.push(_Q(f"busy-{i}", "busy", "standard"))
+        for _ in range(6):
+            q.pop()   # busy advances the virtual clock
+        for i in range(4):
+            q.push(_Q(f"lazy-{i + 1}", "lazy", "standard"))
+        first4 = [q.pop().request_id for _ in range(4)]
+        # Without the reactivation clamp all 4 lazy arrivals would
+        # dispatch before any remaining busy work (their stale clock
+        # sits far behind); with it, busy interleaves.
+        assert any(r.startswith("busy") for r in first4), first4
+        assert any(r.startswith("lazy") for r in first4), first4
+
+    def test_remove_and_len(self):
+        q = QosQueue(QosPolicy())
+        q.push(_Q("a", "t", "standard"))
+        q.push(_Q("b", "t", "standard"))
+        assert len(q) == 2
+        assert q.remove("a").request_id == "a"
+        assert q.remove("a") is None
+        assert len(q) == 1
+        assert q.pop().request_id == "b"
+        assert q.pop() is None
+
+
+class TestDeadlineHeap:
+    def test_expiry_is_heap_ordered_and_lazy(self):
+        """ISSUE 15 satellite: expiry pops the deadline min-heap —
+        dispatched/cancelled requests' stale heap entries are skipped,
+        and requests without deadlines never expire."""
+        q = QosQueue(QosPolicy())
+        q.push(_Q("d2", "t", "standard", deadline=2.0))
+        q.push(_Q("d1", "t", "standard", deadline=1.0))
+        q.push(_Q("never", "t", "standard"))
+        q.push(_Q("d3", "t", "standard", deadline=3.0))
+        popped = q.pop()   # WFQ/FIFO head: d2 leaves the queue
+        assert popped.request_id == "d2"
+        expired = q.pop_expired(2.5)
+        # d2 was dispatched (stale heap entry skipped), d1 expired;
+        # d3 and the deadline-less request survive.
+        assert [r.request_id for r in expired] == ["d1"]
+        assert q.pop_expired(2.5) == []
+        assert len(q) == 2
+        expired = q.pop_expired(10.0)
+        assert [r.request_id for r in expired] == ["d3"]
+        assert q.pop().request_id == "never"
+
+    def test_expired_queue_requests_finish_typed(self, model_and_params):
+        engine = _engine(model_and_params, max_slots=1)
+        batcher = ContinuousBatcher(engine, default_deadline_s=0)
+        blocker = batcher.submit([1, 2, 3], SamplingParams(
+            max_new_tokens=8), qos_class="standard")
+        batcher.step()   # blocker owns the only slot
+        doomed = batcher.submit([4, 5], SamplingParams(max_new_tokens=4),
+                                deadline_s=0.01, qos_class="batch")
+        time.sleep(0.03)
+        batcher.step()
+        assert doomed.error == "deadline_exceeded"
+        _drive(batcher, lambda: blocker.done.is_set())
+
+
+# --- token-bucket budgets -----------------------------------------------------
+
+class TestBudgets:
+    def test_budget_exhaustion_is_typed_and_retriable(self):
+        policy = QosPolicy(tenant_budgets={"t": 10.0}, burst_s=4.0)
+        assert policy.charge("t", 30.0) == 30.0   # capacity 40
+        with pytest.raises(BudgetExhaustedError) as ei:
+            policy.charge("t", 30.0)
+        assert ei.value.tenant == "t"
+        assert ei.value.retry_after_s > 0
+        # Unlimited tenants never charge.
+        assert policy.charge("free", 1e6) == 0.0
+
+    def test_bucket_refills_over_time(self):
+        policy = QosPolicy(tenant_budgets={"t": 1000.0}, burst_s=0.01)
+        policy.charge("t", 10.0)
+        with pytest.raises(BudgetExhaustedError):
+            policy.charge("t", 10.0)
+        time.sleep(0.05)   # 1000 tok/s refills the tiny bucket
+        assert policy.charge("t", 10.0) == 10.0
+
+    def test_zero_tenant_share_rejected_at_parse(self):
+        """A share of 0 would silently starve the tenant — the exact
+        failure WFQ exists to prevent — so it fails at init like every
+        other malformed knob; budgets keep 0 = unlimited."""
+        from horovod_tpu.config import parse_qos_map
+        with pytest.raises(ValueError):
+            parse_qos_map("acme=0", "qos tenant shares", positive=True)
+        assert parse_qos_map("acme=0", "qos tenant budgets") == \
+            {"acme": 0.0}
+
+    def test_batcher_rejection_lands_on_obs_counter(self,
+                                                    model_and_params):
+        """Batcher-tier budgets are the default wiring — their
+        rejections must feed hvd_tpu_qos_budget_rejects_total too, or
+        dashboards are blind in the default configuration."""
+        from horovod_tpu.obs import metrics as obs_metrics
+        engine = _engine(model_and_params)
+        batcher = ContinuousBatcher(
+            engine, default_deadline_s=0,
+            qos_policy=QosPolicy(tenant_budgets={"tiny": 0.5},
+                                 burst_s=2.0))
+        with pytest.raises(BudgetExhaustedError):
+            batcher.submit([1] * 4, SamplingParams(max_new_tokens=16),
+                           tenant="tiny")
+        snap = obs_metrics.registry().snapshot()
+        series = {tuple(s["labels"].items()): s["value"]
+                  for s in snap.get("hvd_tpu_qos_budget_rejects_total",
+                                    [])}
+        assert series.get((("tenant", "tiny"),), 0) >= 1, series
+
+    def test_gate_refunds_full_charge_when_fleet_fails(self):
+        """A lost request served nothing: the router hands the whole
+        gate reservation back — replica failures must not convert into
+        budget_exhausted rejections for the tenant."""
+        from horovod_tpu.utils.retry import RetryPolicy
+        gate = QosGate(policy=QosPolicy(tenant_budgets={"t": 0.5},
+                                        burst_s=60.0))   # capacity 30
+        router = Router(
+            [ReplicaSpec("ghost", [("127.0.0.1", 1)])], KEY,
+            retry_policy=RetryPolicy(attempts=2, base_delay_s=0.01,
+                                     max_delay_s=0.02),
+            probe_timeout=0.2)
+        router.attach_qos(gate)
+        for _ in range(3):   # 3 x 20-token reservations > capacity
+            with pytest.raises(Exception) as ei:
+                router.generate([1, 2, 3, 4], max_new_tokens=16,
+                                tenant="t")
+            # The failure is the FLEET's, never the budget's.
+            assert not isinstance(ei.value, BudgetExhaustedError), \
+                ei.value
+
+    def test_refund_returns_unused_reservation(self):
+        policy = QosPolicy(tenant_budgets={"t": 1.0}, burst_s=40.0)
+        policy.charge("t", 30.0)
+        policy.refund("t", 25.0)
+        assert policy.charge("t", 30.0) == 30.0   # refund made room
+
+    def test_batcher_admission_charges_and_rejects(self,
+                                                   model_and_params):
+        engine = _engine(model_and_params)
+        batcher = ContinuousBatcher(
+            engine, default_deadline_s=0,
+            qos_policy=QosPolicy(tenant_budgets={"limited": 5.0},
+                                 burst_s=8.0))   # capacity 40
+        sp = SamplingParams(max_new_tokens=16)
+        r1 = batcher.submit([1] * 4, sp, tenant="limited")   # 20 tokens
+        batcher.submit([1] * 4, sp, tenant="limited")        # 40 total
+        with pytest.raises(BudgetExhaustedError):
+            batcher.submit([1] * 4, sp, tenant="limited")
+        # Other tenants are untouched by the exhausted bucket.
+        r4 = batcher.submit([2] * 4, sp, tenant="other")
+        _drive(batcher, lambda: r1.done.is_set() and r4.done.is_set())
+        snap = batcher.stats.snapshot()
+        assert snap["budget_rejects"] == 1
+        assert snap["tenants"]["limited"]["rejected"] == 1
+
+    def test_budget_rejection_over_the_wire(self, model_and_params):
+        """The wire answer is a typed retriable rejection — the router
+        returns it terminally (no failover burns a second replica on a
+        policy decision) and never strikes the replica."""
+        engine = _engine(model_and_params)
+        # Near-zero refill rate: the rejection must hold however slowly
+        # the instrumented (hvdsan) run gets here.
+        batcher = ContinuousBatcher(
+            engine, default_deadline_s=0,
+            qos_policy=QosPolicy(tenant_budgets={"limited": 0.5},
+                                 burst_s=40.0))   # capacity 20
+        server = InferenceServer(batcher, key=KEY, name="qos-rep",
+                                 host="127.0.0.1")
+        router = Router([ReplicaSpec("qos-rep",
+                                     [("127.0.0.1", server.port)])], KEY)
+        try:
+            ok = router.generate([3, 4, 5], max_new_tokens=16,
+                                 tenant="limited")
+            assert ok.error is None and len(ok.tokens) > 0
+            rej = router.generate([3, 4, 6], max_new_tokens=16,
+                                  tenant="limited")
+            assert rej.error is not None
+            assert rej.error.startswith("budget_exhausted")
+            assert "retry_after_s" in rej.error
+            stats = router.replica_stats(timeout=5.0)
+            assert stats["qos-rep"]["strikes"] == 0
+        finally:
+            server.shutdown()
+
+
+# --- brownout ladder ----------------------------------------------------------
+
+class TestBrownout:
+    def mk(self, **kw):
+        kw.setdefault("queue_capacity", 100)
+        kw.setdefault("high", 0.8)
+        kw.setdefault("low", 0.2)
+        kw.setdefault("hold_s", 5.0)
+        return BrownoutController(**kw)
+
+    def test_sheds_batch_first_then_standard_never_interactive(self):
+        b = self.mk()
+        b.observe(90, now=0.0)
+        assert b.level == 1
+        with pytest.raises(RequestShedError) as ei:
+            b.check("batch")
+        assert ei.value.retry_after_s > 0
+        b.check("standard")        # level 1: standard still serves
+        b.check("interactive")
+        b.observe(90, now=1.0)
+        assert b.level == 2
+        with pytest.raises(RequestShedError):
+            b.check("standard")
+        b.check("interactive")     # NEVER shed, even at max level
+
+    def test_hysteresis_no_oscillation_in_the_band(self):
+        """A load hovering between LOW and HIGH must not flap the
+        ladder — the band holds the level, un-browning needs hold_s of
+        uninterrupted calm."""
+        b = self.mk()
+        b.observe(90, now=0.0)
+        assert b.level == 1
+        for t in range(1, 20):     # in-band: neither overload nor calm
+            b.observe(50, now=float(t))
+            assert b.level == 1    # pinned: no shed/un-shed oscillation
+        b.observe(10, now=21.0)    # calm clock starts
+        assert b.level == 1
+        b.observe(10, now=23.0)    # 2s calm < hold 5s
+        assert b.level == 1
+        b.observe(50, now=24.0)    # calm interrupted: clock resets
+        b.observe(10, now=25.0)
+        b.observe(10, now=29.0)    # only 4s since the reset
+        assert b.level == 1
+        b.observe(10, now=31.0)    # 6s uninterrupted calm
+        assert b.level == 0
+
+    def test_unbrowns_one_step_per_hold(self):
+        b = self.mk()
+        b.observe(90, now=0.0)
+        b.observe(90, now=1.0)
+        assert b.level == 2
+        b.observe(5, now=2.0)
+        b.observe(5, now=8.0)      # hold passed: 2 -> 1, not -> 0
+        assert b.level == 1
+        b.observe(5, now=14.0)
+        assert b.level == 0
+
+    def test_slo_breach_steps_up_even_with_empty_queue(self):
+        b = self.mk(slo_ttft_ms=100.0)
+        b.observe(0, interactive_ttft_p99_ms=250.0, now=0.0)
+        assert b.level == 1
+
+    def test_gate_shed_is_pre_replica(self, model_and_params):
+        engine = _engine(model_and_params)
+        batcher = ContinuousBatcher(engine, default_deadline_s=0)
+        server = InferenceServer(batcher, key=KEY, name="gate-rep",
+                                 host="127.0.0.1")
+        router = Router([ReplicaSpec("gate-rep",
+                                     [("127.0.0.1", server.port)])], KEY)
+        gate = QosGate(brownout=self.mk())
+        router.attach_qos(gate)
+        try:
+            gate.observe(90, now=0.0)   # level 1: batch sheds
+            with pytest.raises(RequestShedError):
+                router.generate([1, 2, 3], max_new_tokens=4,
+                                qos_class="batch")
+            ok = router.generate([1, 2, 3], max_new_tokens=4,
+                                 qos_class="interactive")
+            assert ok.error is None
+            # The shed cost the replica nothing (never reached it).
+            stats = router.replica_stats(timeout=5.0)
+            assert stats["gate-rep"]["stats"]["requests_completed"] == 1
+        finally:
+            server.shutdown()
+
+
+# --- deadline-aware preemption ------------------------------------------------
+
+class TestPreemption:
+    def test_pick_victim_is_youngest_batch(self):
+        class R:
+            def __init__(self, cls, tokens, sub):
+                self.qos_class = cls
+                self.tokens = [0] * tokens
+                self.submitted_at = sub
+                self.done = threading.Event()
+        active = {0: R("interactive", 1, 1.0), 1: R("batch", 5, 2.0),
+                  2: R("batch", 2, 3.0)}
+        slot, req = preempt_mod.pick_victim(active)
+        assert slot == 2                       # fewest emitted tokens
+        assert preempt_mod.pick_victim(
+            {0: R("standard", 1, 1.0)}) is None  # only batch preempts
+
+    def test_preempt_resume_token_identity_greedy(self, model_and_params):
+        """THE oracle (ISSUE 15 acceptance): a preempted+resumed batch
+        generation's final output is token-identical to its
+        uninterrupted reference."""
+        prompt = [5, 11, 17, 23]
+        n_tok = 24
+        ref = _run_engine(_engine(model_and_params, max_slots=1),
+                          0, prompt, n_tok)
+
+        engine = _engine(model_and_params, max_slots=1)
+        batcher = ContinuousBatcher(engine, default_deadline_s=0)
+        breq = batcher.submit(prompt, SamplingParams(max_new_tokens=n_tok),
+                              qos_class="batch")
+        for _ in range(4):
+            batcher.step()
+        assert 0 < len(breq.tokens) < n_tok
+        # Tight-deadline interactive request: waiting ~19 more decodes
+        # would miss it, so the batch generation is evicted.
+        ireq = batcher.submit([2, 4, 6], SamplingParams(max_new_tokens=3),
+                              deadline_s=0.6, qos_class="interactive")
+        _drive(batcher, lambda: ireq.done.is_set())
+        assert ireq.error is None and len(ireq.tokens) == 3
+        assert breq.preemptions == 1
+        assert breq.error is None or not breq.done.is_set()
+        _drive(batcher, lambda: breq.done.is_set())
+        assert breq.error is None
+        assert breq.tokens == ref
+        snap = batcher.stats.snapshot()
+        assert snap["preemptions"] == 1
+        # The resumption re-admitted against resident KV (prefix hit).
+        assert breq.prefix_hit_tokens > 0
+
+    def test_preempt_resume_token_identity_temperature(self,
+                                                       model_and_params):
+        """Temperature sampling resumes bit-identically: the RNG
+        snapshot taken at preemption is restored after the tail
+        recompute (sole-active-slot contract, like KV migration)."""
+        prompt = [7, 3, 9]
+        n_tok = 20
+        ref = _run_engine(_engine(model_and_params, max_slots=1, seed=5),
+                          0, prompt, n_tok, temperature=0.8)
+
+        engine = _engine(model_and_params, max_slots=1, seed=5)
+        batcher = ContinuousBatcher(engine, default_deadline_s=0)
+        breq = batcher.submit(
+            prompt, SamplingParams(max_new_tokens=n_tok, temperature=0.8),
+            qos_class="batch")
+        for _ in range(5):
+            batcher.step()
+        assert 0 < len(breq.tokens) < n_tok
+        ireq = batcher.submit([2, 4], SamplingParams(max_new_tokens=2),
+                              deadline_s=0.6, qos_class="interactive")
+        _drive(batcher, lambda: ireq.done.is_set())
+        assert breq.preemptions == 1
+        _drive(batcher, lambda: breq.done.is_set())
+        assert breq.error is None
+        assert breq.tokens == ref
+
+    def test_resume_recomputes_after_cache_eviction(self,
+                                                    model_and_params):
+        """Even when the parked KV is evicted between preemption and
+        resumption (allocation pressure), the resume recomputes the
+        whole tail — tokens identical, only the economics lost."""
+        prompt = [5, 11, 17, 23]
+        n_tok = 24
+        ref = _run_engine(_engine(model_and_params, max_slots=1),
+                          0, prompt, n_tok)
+        engine = _engine(model_and_params, max_slots=1)
+        batcher = ContinuousBatcher(engine, default_deadline_s=0)
+        breq = batcher.submit(prompt, SamplingParams(max_new_tokens=n_tok),
+                              qos_class="batch")
+        for _ in range(4):
+            batcher.step()
+        ireq = batcher.submit([2, 4, 6], SamplingParams(max_new_tokens=3),
+                              deadline_s=0.6, qos_class="interactive")
+        _drive(batcher, lambda: ireq.done.is_set())
+        assert breq.preemptions == 1
+        engine._kv.flush_cache()   # forced pressure: parked KV gone
+        _drive(batcher, lambda: breq.done.is_set())
+        assert breq.error is None
+        assert breq.tokens == ref
+
+    def test_resume_chunked_past_largest_bucket(self, model_and_params):
+        """A resumed sequence longer than the largest prefill bucket
+        rebuilds in bucket-sized chunks (engine.resume_slot) — long
+        generations stay preemptible."""
+        prompt = [3, 1, 4, 1, 5]
+        n_tok = 25                      # 5 + 25 = 30 < 32
+        engine = _engine(model_and_params, max_slots=1)
+        ref = _run_engine(_engine(model_and_params, max_slots=1),
+                          0, prompt, n_tok)
+        sp = SamplingParams(max_new_tokens=n_tok)
+        toks = [engine.start(0, prompt, sp)]
+        while len(toks) < 20:           # seq = 5 + 19 = 24 > bucket 16
+            toks.extend(engine.step()[0])
+        rng = engine.preempt_slot(0, prompt, toks)
+        engine._kv.flush_cache()        # force the full chunked rebuild
+        engine.resume_slot(0, prompt, toks, sp, rng=rng)
+        while len(toks) < n_tok:
+            toks.extend(engine.step()[0])
+        engine.release(0)
+        assert toks[:n_tok] == ref
+
+    def test_resume_after_weight_flip_restarts_single_version(
+            self, model_and_params):
+        """A hot-swap flip landing while a preempted request sits
+        requeued must not splice two weight versions into one response:
+        the resume restarts from scratch on the new weights, and the
+        final output is token-identical to a fresh run there
+        (docs/hot_swap.md mixed-version rule)."""
+        model, params = model_and_params
+        flat, treedef = jax.tree_util.tree_flatten(params)
+        flat = list(flat)
+        flat[0] = flat[0] + 0.01
+        new_params = jax.tree_util.tree_unflatten(treedef, flat)
+        prompt = [5, 11, 17, 23]
+        n_tok = 24
+        ref_new = _run_engine(
+            InferenceEngine(model, new_params, max_slots=1,
+                            prefill_buckets=(8, 16), max_seq_len=32),
+            0, prompt, n_tok)
+
+        engine = _engine(model_and_params, max_slots=1)
+        batcher = ContinuousBatcher(engine, default_deadline_s=0)
+        breq = batcher.submit(prompt, SamplingParams(max_new_tokens=n_tok),
+                              qos_class="batch")
+        for _ in range(4):
+            batcher.step()
+        ireq = batcher.submit([2, 4, 6], SamplingParams(max_new_tokens=2),
+                              deadline_s=0.6, qos_class="interactive")
+        _drive(batcher, lambda: ireq.done.is_set())
+        assert breq.preemptions == 1
+        # The flip lands while breq sits requeued (no active slots).
+        import numpy as np
+        engine.stage_params(
+            jax.tree_util.tree_map(np.asarray, new_params), version=2)
+        engine.commit_staged()
+        _drive(batcher, lambda: breq.done.is_set())
+        assert breq.error is None
+        assert breq.tokens == ref_new
+        assert breq.weights_version == 2
+
+    def test_preempt_resume_token_identity_speculative(self,
+                                                       model_and_params):
+        """A speculative-decoding batch victim resumes token-identical
+        too: the drafter's dense cache is rebuilt at resume and
+        accepted-prefix semantics keep the stream equal to plain
+        greedy (the engine skips victims whose sequence no longer fits
+        the drafter's one-bucket rebuild — ``can_resume``)."""
+        model, params = model_and_params
+        prompt = [5, 11, 17, 23]
+        n_tok = 13                      # 4 + 12 = 16 <= bucket 16
+        ref = _run_engine(_engine(model_and_params, max_slots=1),
+                          0, prompt, n_tok)
+        engine = _engine(model_and_params, max_slots=1,
+                         drafter=(model, params), spec_k=2)
+        batcher = ContinuousBatcher(engine, default_deadline_s=0)
+        breq = batcher.submit(
+            prompt, SamplingParams(max_new_tokens=n_tok, spec=True),
+            qos_class="batch")
+        batcher.step()   # ONE step: spec bursts emit several per step
+        assert 0 < len(breq.tokens) < n_tok
+        # Tight deadline: waiting out even the self-drafted burst
+        # cadence would miss it.
+        ireq = batcher.submit([2, 4, 6], SamplingParams(max_new_tokens=2),
+                              deadline_s=0.12, qos_class="interactive")
+        _drive(batcher, lambda: ireq.done.is_set())
+        assert breq.preemptions == 1
+        _drive(batcher, lambda: breq.done.is_set())
+        assert breq.error is None
+        assert breq.tokens == ref
+
+    def test_can_resume_guards_drafter_bucket(self, model_and_params):
+        model, params = model_and_params
+        engine = _engine(model_and_params, max_slots=1,
+                         drafter=(model, params), spec_k=2)
+        assert engine.can_resume(4, 10)       # 13 <= bucket 16
+        assert not engine.can_resume(10, 10)  # 19 > bucket 16
+        plain = _engine(model_and_params, max_slots=1)
+        assert plain.can_resume(10, 18)       # chunked rebuild: fine
+
+    def test_no_preemption_when_disabled(self, model_and_params):
+        engine = _engine(model_and_params, max_slots=1)
+        batcher = ContinuousBatcher(engine, default_deadline_s=0,
+                                    qos_preempt=False)
+        breq = batcher.submit([1, 2, 3], SamplingParams(max_new_tokens=24),
+                              qos_class="batch")
+        batcher.step()
+        ireq = batcher.submit([2, 4], SamplingParams(max_new_tokens=2),
+                              deadline_s=30.0, qos_class="interactive")
+        for _ in range(6):
+            batcher.step()
+        assert breq.preemptions == 0
+        _drive(batcher, lambda: breq.done.is_set() and ireq.done.is_set())
+
+    def test_interactive_admitted_within_two_steps_under_flood(
+            self, model_and_params):
+        """The structural half of the overload acceptance: with every
+        slot and the queue full of batch work, a deadline-carrying
+        interactive request reaches a slot within two scheduling steps
+        (preemption), instead of waiting out the flood."""
+        engine = _engine(model_and_params, max_slots=2)
+        batcher = ContinuousBatcher(engine, default_deadline_s=0)
+        batch = [batcher.submit([1, 2, 3], SamplingParams(
+            max_new_tokens=24), tenant="bulk", qos_class="batch")
+            for _ in range(8)]
+        batcher.step()
+        batcher.step()   # both slots now run batch generations
+        ireq = batcher.submit([2, 4, 6], SamplingParams(max_new_tokens=2),
+                              deadline_s=0.8, qos_class="interactive")
+        steps = 0
+        while ireq.first_token_at is None and steps < 2:
+            batcher.step()
+            steps += 1
+        assert ireq.first_token_at is not None, \
+            f"interactive starved for {steps} steps"
+        _drive(batcher, lambda: all(r.done.is_set() for r in batch)
+               and ireq.done.is_set())
+        assert ireq.error is None
+        # Batch degraded gracefully: preempted work finished correctly.
+        assert all(r.error is None for r in batch)
+
+    def test_per_class_stats_in_snapshot(self, model_and_params):
+        engine = _engine(model_and_params)
+        batcher = ContinuousBatcher(engine, default_deadline_s=0)
+        reqs = [batcher.submit([1, 2], SamplingParams(max_new_tokens=2),
+                               tenant="a", qos_class="interactive"),
+                batcher.submit([3, 4], SamplingParams(max_new_tokens=2),
+                               tenant="b", qos_class="batch")]
+        _drive(batcher, lambda: all(r.done.is_set() for r in reqs))
+        snap = batcher.snapshot()
+        assert snap["qos"]["interactive"]["completed"] == 1
+        assert snap["qos"]["batch"]["completed"] == 1
+        assert snap["qos"]["interactive"]["ttft_ms_p99"] > 0
+        assert snap["qos"]["batch"]["goodput_tok_per_s"] > 0
+        assert snap["tenants"]["a"]["tokens_out"] == 2
+        assert "queued_by_class" in snap
+
+
+class TestStatsBounds:
+    def test_tenant_rollup_is_bounded(self):
+        stats = ServingStats()
+        for i in range(80):
+            stats.record_request(0.01, 2, 0.02, qos_class="standard",
+                                 tenant=f"tenant-{i}")
+        snap = stats.snapshot()
+        assert len(snap["tenants"]) <= 65
+        assert "other" in snap["tenants"]
+
+    def test_validate_class(self):
+        assert validate_class(None) == "standard"
+        assert validate_class("Interactive") == "interactive"
+        with pytest.raises(ValueError):
+            validate_class("platinum")
+
+
+# --- chaos: priority-inversion / flood drills ---------------------------------
+
+@pytest.mark.chaos
+class TestQosChaosDrill:
+    def test_brownout_drill_holds_interactive_slo(self, model_and_params):
+        """ISSUE 15 drill (chaos_soak --mode qos): a randomized
+        ``qos:invert`` or ``qos:flood`` injection against a
+        mixed-tenant overload — every interactive request must
+        complete inside the configured SLO while the batch flood
+        absorbs the damage (preemption/requeue, never wrong output)."""
+        step = int(os.environ.get("HVD_TPU_CHAOS_STEP", "3"))
+        seed = int(os.environ.get("HVD_TPU_CHAOS_SEED", "0"))
+        mode = random.Random(seed).choice(["invert", "flood"])
+        spec = f"qos:step={step},mode={mode},times=3"
+        slo_ms = 1500.0
+        engine = _engine(model_and_params, max_slots=2)
+        batcher = ContinuousBatcher(
+            engine, default_deadline_s=0,
+            qos_policy=QosPolicy(tenant_budgets={"bulk": 200.0},
+                                 burst_s=2.0))
+        inter, batch = [], []
+        with faults.inject(spec):
+            for i in range(8):
+                try:
+                    batch.append(batcher.submit(
+                        [1 + i % 7, 2, 3],
+                        SamplingParams(max_new_tokens=24),
+                        tenant="bulk", qos_class="batch"))
+                except BudgetExhaustedError:
+                    pass   # the budget drill: over-budget flood rejected
+            batcher.step()
+            batcher.step()
+            for i in range(4):
+                inter.append(batcher.submit(
+                    [2 + i, 4, 6], SamplingParams(max_new_tokens=3),
+                    deadline_s=slo_ms / 1e3, qos_class="interactive"))
+                batcher.step()
+            _drive(batcher, lambda: all(r.done.is_set()
+                                        for r in inter + batch))
+        assert all(r.error is None for r in inter), \
+            [(r.request_id, r.error) for r in inter]
+        snap = batcher.stats.snapshot()
+        p99 = snap["qos"]["interactive"]["ttft_ms_p99"]
+        assert p99 is not None and p99 <= slo_ms, snap["qos"]
+        # Batch degraded gracefully: preempted/requeued work finished
+        # (admitted requests), never with wrong or missing output.
+        assert all(r.error is None for r in batch)
