@@ -100,6 +100,28 @@ def main() -> None:
                              "--overlap summary (0 = pure-wire sweep: "
                              "est reports 0; pass your model's backward "
                              "time to see the modeled hidden fraction)")
+    parser.add_argument("--kernel", default="spmd",
+                        choices=["spmd", "pallas"],
+                        help="lowering backend for the int8 wire "
+                             "(topo.schedule KERNELS): 'pallas' routes "
+                             "the quantize/dequantize stages through the "
+                             "fused Pallas kernels "
+                             "(ops/pallas_collectives.py — interpret "
+                             "mode on CPU, bit-identical to the SPMD "
+                             "wire); applies to --compression int8 and "
+                             "--fused-sweep")
+    parser.add_argument("--fused-sweep", action="store_true",
+                        help="sweep the compiled-schedule wire per "
+                             "(bucket size, compressor) under the "
+                             "--kernel backend, emitting one "
+                             "bench_regress-schema row per combo into "
+                             "the artifact's 'sweep' list (metric names "
+                             "carry compressor+bucket but NOT kernel, "
+                             "so a spmd-kernel artifact diffs directly "
+                             "against a pallas-kernel one) plus the "
+                             "schedule's structural "
+                             "hbm_materializations count; allreduce "
+                             "only")
     parser.add_argument("--topology", default=None, metavar="PODSxCHIPS",
                         help="sweep the topology-aware schedule compiler "
                              "(horovod_tpu/topo/) on a simulated "
@@ -144,6 +166,19 @@ def main() -> None:
         if args.two_phase or args.overlap or args.compression != "none":
             parser.error("--topology is its own vehicle; run other "
                          "sweeps separately")
+    if args.fused_sweep:
+        if args.collective != "allreduce":
+            parser.error("--fused-sweep applies to the allreduce sweep "
+                         "only")
+        if args.two_phase or args.overlap or args.topology \
+                or args.compression != "none":
+            parser.error("--fused-sweep is its own vehicle; run other "
+                         "sweeps separately")
+    if args.kernel != "spmd" and not (
+            args.fused_sweep or args.compression == "int8"):
+        parser.error("--kernel pallas applies to the int8 wire "
+                     "(--compression int8 or --fused-sweep); other "
+                     "tiers have no quantize stage to fuse")
     # Metric identity carries the vehicle: a compressed-wire sweep must
     # never overwrite the BASELINE allreduce row in trend tooling.
     metric = (f"{args.collective}_busbw_peak" if args.compression == "none"
@@ -160,6 +195,11 @@ def main() -> None:
                        "_wire_busbw_peak")
     if args.topology:
         metric = "allreduce_topo_hierarchical_busbw_peak"
+    if args.fused_sweep:
+        # Kernel-free identity: the spmd- and pallas-backend artifacts
+        # share every metric name, so bench_regress diffs fused against
+        # unfused directly (the backend rides along as a string field).
+        metric = "allreduce_fused_wire_busbw_peak"
 
     if args.cpu_mesh:
         from horovod_tpu.utils.platform import force_cpu_mesh
@@ -232,10 +272,18 @@ def main() -> None:
         comp_cls = {"exact": Comp.none, "fp16": Comp.fp16,
                     "bf16": Comp.bf16, "int8": Comp.int8}[args.compression]
         gm = hvd.global_mesh()
-        def per_slot(xb):  # [1, elems] — this slot's gradient shard
-            red = comp_cls.spmd_allreduce(xb[0], op="sum",
-                                          axis=gm.axis_name)
-            return red[None]
+        if args.kernel == "pallas":
+            from horovod_tpu.ops import pallas_collectives as pc
+
+            def per_slot(xb):  # [1, elems] — fused int8 wire
+                red = pc.fused_allreduce(xb[0], op="sum",
+                                         axis=gm.axis_name)
+                return red[None]
+        else:
+            def per_slot(xb):  # [1, elems] — this slot's gradient shard
+                red = comp_cls.spmd_allreduce(xb[0], op="sum",
+                                              axis=gm.axis_name)
+                return red[None]
 
         @jax.jit
         def spmd_wire(stack):
@@ -412,6 +460,54 @@ def main() -> None:
                     "choose": lambda b: topo_sched.compile_bucket_schedule(
                         int(b), topo, params)}
 
+    fused_ctx = None
+    if args.fused_sweep:
+        # Fused-kernel vehicle: the compiled-schedule wire per
+        # compressor, lowered through the --kernel backend.  The
+        # schedule is a flat-mesh two_phase (RS+AG — both steps ICI, so
+        # under kernel=pallas every quantize stage fuses); 'exact' runs
+        # the same executor uncompressed as the apples-to-apples
+        # control (no quantize stage — the backend is a no-op there by
+        # construction, which the row pair makes visible).  CPU timings
+        # gate the fused path against the unfused wire; the TPU win is
+        # structural and rides along as each schedule's
+        # hbm_materializations count.
+        import numpy as np
+        from horovod_tpu._compat import shard_map
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from horovod_tpu.ops.compression import Compression as Comp
+        from horovod_tpu.topo import schedule as topo_sched
+        from horovod_tpu.topo.topology import MeshTopology
+
+        gm = hvd.global_mesh()
+        ftopo = MeshTopology(pods=1, chips_per_pod=n)
+        fused_comps = {"exact": Comp.none, "int8": Comp.int8}
+
+        def _mk_stack(elems):  # noqa: F811 — RS shards the flat n ways
+            elems = ((elems + n - 1) // n) * n
+            return _global_stack((n, elems), dtype), elems
+
+        def _fused_sched(nbytes):
+            return topo_sched.compile_bucket_schedule(
+                int(nbytes), ftopo, force="two_phase", kernel=args.kernel)
+
+        def _fused_wire(comp_cls):
+            def per_slot(xb):  # [1, elems] — this slot's gradient
+                sched = _fused_sched(int(xb.shape[-1]) * bytes_per)
+                red = topo_sched.execute_schedule(
+                    xb[0], sched, axis=gm.axis_name, op="sum",
+                    compression=comp_cls)
+                return red[None]
+
+            return jax.jit(shard_map(per_slot, mesh=gm.mesh,
+                                     in_specs=P(gm.axis_name),
+                                     out_specs=P(gm.axis_name)))
+
+        runs = {name: _fused_wire(cls)
+                for name, cls in fused_comps.items()}
+        fused_ctx = {"comps": fused_comps, "sched": _fused_sched,
+                     "record": topo_sched.record_plans}
+
     factor = ((2 * (n - 1) / n) if args.collective == "allreduce"
               else (n - 1) / n) if n > 1 else 1.0
 
@@ -446,6 +542,24 @@ def main() -> None:
                    "busbw_GBps": round(busbw, 3), "n_slots": n}
             if path:
                 row["path"] = path
+            if fused_ctx is not None:
+                # bench_regress-schema row per (bucket, kernel,
+                # compressor): metric identity carries compressor +
+                # bucket, never the kernel, so the two backends'
+                # artifacts diff metric-for-metric; the recorded plan's
+                # structural HBM count rides along (config field, not a
+                # perf metric — bench_regress skips it).
+                comp_cls = fused_ctx["comps"][path]
+                sched = fused_ctx["sched"](payload)
+                fused_ctx["record"]([sched], comp_cls, bytes_per)
+                row["metric"] = (f"allreduce_fused_wire_{path}_"
+                                 f"{real_elems}el_busbw")
+                row["value"] = row["busbw_GBps"]
+                row["unit"] = "GB/s"
+                row["kernel"] = args.kernel
+                row["bucket_elems"] = real_elems
+                row["hbm_materializations"] = \
+                    sched.hbm_materializations(comp_cls)
             if topo_ctx is not None:
                 t, p = topo_ctx["topo"], topo_ctx["params"]
                 from horovod_tpu.topo.costmodel import (
@@ -473,6 +587,8 @@ def main() -> None:
     elif args.topology:
         peak_rows = [r for r in results
                      if r.get("path") == "hierarchical"]
+    elif args.fused_sweep:
+        peak_rows = [r for r in results if r.get("path") == "int8"]
     else:
         peak_rows = results
     peak = max(r["busbw_GBps"] for r in peak_rows)
@@ -484,6 +600,10 @@ def main() -> None:
     if args.compression != "none":
         summary["compression"] = args.compression
         summary["vehicle"] = "spmd_gradient_wire"
+        if args.compression == "int8":
+            # Backend is provenance, not identity: the pallas wire is
+            # bit-identical, so the row stays diff-comparable.
+            summary["kernel"] = args.kernel
     if args.two_phase:
         single_peak = max(r["busbw_GBps"] for r in results
                           if r.get("path") == "single_phase")
@@ -520,6 +640,24 @@ def main() -> None:
             "dcn_alpha_us": p.dcn.alpha_us,
             "dcn_beta_gbps": p.dcn.beta_gbps,
         })
+    if args.fused_sweep:
+        exact_peak = max(r["busbw_GBps"] for r in results
+                         if r.get("path") == "exact")
+        summary.update({
+            "vehicle": "topo_schedule_wire",
+            "kernel": args.kernel,
+            "exact_busbw_peak": exact_peak,
+            "int8_vs_exact": round(peak / exact_peak, 3)
+            if exact_peak else None,
+            # Structural TPU-speedup surface: total standalone HBM
+            # intermediates in the recorded int8 plans (0 under the
+            # fused backend on this all-ICI schedule; 4 per bucket on
+            # the SPMD wire).  Config-class field — bench_regress
+            # excludes it from the perf diff.
+            "hbm_materializations": sum(
+                r["hbm_materializations"] for r in results
+                if r.get("path") == "int8"),
+        })
     if args.overlap:
         from horovod_tpu.ops.fusion import estimate_overlap_hidden_fraction
 
@@ -544,12 +682,16 @@ def main() -> None:
         # per-tier wire bytes + dispatch counts behind the busbw rows.
         from horovod_tpu.obs import export as obs_export
 
+        doc = {"platform": jax.default_backend(),
+               "device_kind": jax.devices()[0].device_kind,
+               "summary": summary, "rows": results,
+               "metrics": obs_export.json_snapshot()["metrics"]}
+        if args.fused_sweep:
+            # bench_regress reads summary + this sweep list (rows stay
+            # diagnostic): one gated metric per (bucket, compressor).
+            doc["sweep"] = [r for r in results if "metric" in r]
         with open(args.out, "w") as f:
-            json.dump({"platform": jax.default_backend(),
-                       "device_kind": jax.devices()[0].device_kind,
-                       "summary": summary, "rows": results,
-                       "metrics": obs_export.json_snapshot()["metrics"]},
-                      f, indent=1)
+            json.dump(doc, f, indent=1)
 
 
 if __name__ == "__main__":

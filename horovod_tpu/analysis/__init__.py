@@ -40,6 +40,7 @@ __all__ = [
 def default_checkers() -> List[type]:
     from .knobs import KnobChecker
     from .locks import LockChecker
+    from .pallas import PallasChecker
     from .protocol import ProtocolChecker
     from .rank_divergence import RankDivergenceChecker
     from .registries import (FaultSiteChecker, MetricNameChecker,
@@ -47,7 +48,7 @@ def default_checkers() -> List[type]:
     from .waits import WaitChecker
     return [RankDivergenceChecker, KnobChecker, LockChecker,
             FaultSiteChecker, MetricNameChecker, SpanNameChecker,
-            ProtocolChecker, WaitChecker]
+            ProtocolChecker, WaitChecker, PallasChecker]
 
 
 def repo_root() -> Path:

@@ -127,6 +127,11 @@ CHECK_CATALOG: "Dict[str, Tuple[str, str]]" = {
                  "queue get, lock acquire, control-plane request) with "
                  "no timeout/deadline — one wedged peer hangs the "
                  "process"),
+    "pallas-interpret-flag": (
+        "error", "pl.pallas_call that does not thread an `interpret` "
+                 "parameter to a public keyword (hardcoded or missing "
+                 "— the kernel drops out of the CPU-mesh correctness "
+                 "gate)"),
     "useless-suppression": (
         "warning", "hvdlint suppression that matched no finding"),
     "bad-suppression": (

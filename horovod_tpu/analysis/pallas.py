@@ -1,0 +1,81 @@
+"""CPU-testability discipline for Pallas kernels
+(``pallas-interpret-flag``).
+
+Every Pallas kernel in the tree is oracle-tested by running the
+identical ``pl.pallas_call`` under interpret mode on the CPU mesh and
+comparing against a reference implementation (tests/test_pallas_*).
+That only works if the flag is *threaded*: the call passes
+``interpret=`` from a keyword its public entry point exposes, rather
+than hardcoding a mode.  A kernel that omits the flag (TPU-compiled
+always — untestable in CI, where the TPU backend is in outage) or pins
+it to a literal (``interpret=True`` never exercises the Mosaic
+lowering path the comment claims to have tested) silently drops out of
+the correctness gate.
+
+The policy this checker enforces, per ``pl.pallas_call`` site:
+
+* the call passes an ``interpret=`` keyword;
+* its value is an expression (a threaded parameter, typically through
+  ``ops.pallas_common.resolve_interpret``), not a bare literal;
+* the defining module exposes at least one public (non-underscore)
+  function with an ``interpret`` parameter — the escape hatch callers
+  and tests actually reach.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Checker, SourceModule, terminal_name
+
+
+def _public_interpret_fn(tree: ast.AST) -> bool:
+    """Does the module define a public function exposing ``interpret``
+    as a parameter (positional-or-keyword or keyword-only)?"""
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name.startswith("_"):
+            continue
+        params = list(node.args.args) + list(node.args.kwonlyargs)
+        if any(a.arg == "interpret" for a in params):
+            return True
+    return False
+
+
+class PallasChecker(Checker):
+    checks = ("pallas-interpret-flag",)
+
+    def check_module(self, mod: SourceModule) -> None:
+        sites = [node for node in ast.walk(mod.tree)
+                 if isinstance(node, ast.Call)
+                 and terminal_name(node.func) == "pallas_call"]
+        if not sites:
+            return
+        has_public = _public_interpret_fn(mod.tree)
+        for call in sites:
+            kw = next((k for k in call.keywords if k.arg == "interpret"),
+                      None)
+            if kw is None:
+                self.emit(
+                    "pallas-interpret-flag", mod.path, call.lineno,
+                    "pl.pallas_call without interpret= — the kernel "
+                    "cannot run under the CPU test mesh; thread a "
+                    "public interpret keyword through "
+                    "pallas_common.resolve_interpret")
+            elif isinstance(kw.value, ast.Constant):
+                self.emit(
+                    "pallas-interpret-flag", mod.path, call.lineno,
+                    f"pl.pallas_call(interpret={kw.value.value!r}) "
+                    "hardcodes the execution mode — thread a caller-"
+                    "supplied flag instead (None resolves to "
+                    "\"interpret off-TPU\" via "
+                    "pallas_common.resolve_interpret)")
+            if not has_public:
+                self.emit(
+                    "pallas-interpret-flag", mod.path, call.lineno,
+                    "module defines Pallas kernels but no public "
+                    "function exposes an `interpret` parameter — tests "
+                    "and callers have no escape hatch to reach this "
+                    "kernel on CPU")
+                has_public = True   # one finding per module suffices

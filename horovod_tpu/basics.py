@@ -287,7 +287,10 @@ def _maybe_build_parameter_manager(cfg):
     With ``HVD_TPU_TOPO_SCHEDULE`` on (any value but ``off``) over a
     genuinely two-tier mesh, the ``topo_schedule`` axis joins (1..3 =
     flat/two_phase/hierarchical — docs/topology.md): the per-tier cost
-    model proposes, the GP disposes.
+    model proposes, the GP disposes.  Whenever topo scheduling is on
+    (any mesh) the ``topo_kernel`` axis joins too (1..2 = spmd/pallas
+    — docs/fused_collectives.md): fused vs unfused lowering per bucket
+    set.
     All knobs are applied at the re-jit boundary (the next-cycle
     application point of the reference); see ``optim/autotune.py`` and
     ``_apply_autotuned_knobs``."""
@@ -349,6 +352,16 @@ def _maybe_build_parameter_manager(cfg):
                 _TOPO_LATTICE.index(live_topo) + 1
                 if live_topo in _TOPO_LATTICE
                 else len(_TOPO_LATTICE))   # auto seeds at hierarchical
+        # Lowering-backend axis (1..2 = spmd/pallas): fused vs unfused
+        # per bucket set is a legitimate GP discovery — the fused
+        # kernels win on HBM-bound buckets and tie elsewhere (bit-
+        # identical wire either way).  Not gated on two_tier: flat and
+        # two-phase schedules on a one-pod mesh ride the ICI tier, and
+        # those steps fuse too (docs/fused_collectives.md).
+        knobs["topo_kernel"] = (1, len(_KERNEL_LATTICE))
+        initial["topo_kernel"] = (
+            _KERNEL_LATTICE.index(cfg.topo_kernel) + 1
+            if cfg.topo_kernel in _KERNEL_LATTICE else 1)
     if joint:
         # log2 search over [1, size]; proposals snap to the nearest
         # divisor of the slot count (1 and size both mean "flat"
@@ -443,6 +456,11 @@ _COMPRESSOR_LATTICE = ("none", "fp16", "bf16", "int8")
 # and is what the knob replaces, so it is not itself a search point).
 _TOPO_LATTICE = ("flat", "two_phase", "hierarchical")
 
+# Schedule-lowering backend lattice (1..2): the plain SPMD/HLO wire vs
+# the fused Pallas quantize-collective kernels (config.TOPO_KERNELS
+# order, so the applied point round-trips through HVD_TPU_TOPO_KERNEL).
+_KERNEL_LATTICE = ("spmd", "pallas")
+
 
 def _nearest_pow2(value: int) -> int:
     """Nearest power of two in log space (microbatch proposals must land
@@ -486,7 +504,8 @@ def _apply_autotuned_knobs(values) -> dict:
     the nearest divisor of the slot count; ``pipeline_depth`` snaps to
     an int in [1, 8]; ``two_phase``/``overlap`` snap to their 1=off /
     2=on lattices; ``microbatches`` snaps to a power of two;
-    ``compressor`` snaps to the none/fp16/bf16/int8 lattice) —
+    ``compressor`` snaps to the none/fp16/bf16/int8 lattice;
+    ``topo_kernel`` snaps to the spmd/pallas lattice) —
     the caller re-points the manager at these, so keys must match
     ``pm.knob_names`` even where the Config field is spelled
     differently (``two_phase`` → ``two_phase_allreduce``)."""
@@ -529,6 +548,11 @@ def _apply_autotuned_knobs(values) -> dict:
                   len(_TOPO_LATTICE))
         updates["topo_schedule"] = _TOPO_LATTICE[idx - 1]
         applied["topo_schedule"] = idx
+    if "topo_kernel" in values:
+        idx = min(max(1, int(round(values["topo_kernel"]))),
+                  len(_KERNEL_LATTICE))
+        updates["topo_kernel"] = _KERNEL_LATTICE[idx - 1]
+        applied["topo_kernel"] = idx
     # The swap races with concurrent trace-time config() readers
     # (serving threads, a re-jitting train step) — publish under the
     # state lock like every other _state mutation.

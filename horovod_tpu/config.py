@@ -231,6 +231,11 @@ def _validated_topo_spec(spec: Optional[str]) -> Optional[str]:
 # Schedule algorithms the topo compiler can emit / be pinned to.
 TOPO_SCHEDULES = ("off", "auto", "flat", "two_phase", "hierarchical")
 
+# Lowering backends for a compiled schedule's steps: the plain SPMD/HLO
+# wire, or the fused Pallas quantize-collective kernels
+# (ops/pallas_collectives.py; int8-compressed ICI steps only).
+TOPO_KERNELS = ("spmd", "pallas")
+
 
 @dataclasses.dataclass(frozen=True)
 class FaultClause:
@@ -476,6 +481,7 @@ class Config:
     #     100k-GPU collectives line in PAPERS.md) ---
     topo_spec: Optional[str] = None    # HVD_TPU_TOPO_SPEC ("PODSxCHIPS"; unset = infer from jax.devices())
     topo_schedule: str = "off"         # HVD_TPU_TOPO_SCHEDULE (off|auto|flat|two_phase|hierarchical)
+    topo_kernel: str = "spmd"          # HVD_TPU_TOPO_KERNEL (spmd|pallas; fused quantize-collective lowering)
     topo_cost_freeze: bool = False     # HVD_TPU_TOPO_COST_FREEZE (pin the per-tier α/β; stop online refinement)
     topo_alpha_dcn_us: float = 100.0   # HVD_TPU_TOPO_ALPHA_DCN_US (per-hop launch latency on the inter-pod tier)
     topo_beta_dcn_gbps: float = 10.0   # HVD_TPU_TOPO_BETA_DCN_GBPS (per-hop bandwidth on the inter-pod tier)
@@ -611,6 +617,8 @@ class Config:
             topo_spec=_validated_topo_spec(_env("TOPO_SPEC")),
             topo_schedule=_env_choice("TOPO_SCHEDULE", "off",
                                       TOPO_SCHEDULES) or "off",
+            topo_kernel=_env_choice("TOPO_KERNEL", "spmd",
+                                    TOPO_KERNELS) or "spmd",
             topo_cost_freeze=_env_bool("TOPO_COST_FREEZE", False),
             topo_alpha_dcn_us=_env_float("TOPO_ALPHA_DCN_US", 100.0),
             topo_beta_dcn_gbps=_env_float("TOPO_BETA_DCN_GBPS", 10.0),

@@ -261,13 +261,18 @@ def on_collective_dispatch(op: str, nbytes: int) -> None:
 
 def on_topo_plan(algo_buckets: Dict[str, int], *,
                  tier_bytes: Dict[str, int],
-                 est_cost_us: Dict[str, float]) -> None:
+                 est_cost_us: Dict[str, float],
+                 kernels: Optional[Dict[str, int]] = None,
+                 hbm_materializations: Optional[int] = None) -> None:
     """Trace-time record of one compiled topo plan (all buckets of one
     fused apply): per-tier wire bytes (counters accumulate per trace,
     like the fusion tiers; the compiled program replays the plan every
-    step), the cost model's per-tier makespan, and the per-algorithm
+    step), the cost model's per-tier makespan, the per-algorithm
     bucket counts (``algo`` labels come from the closed
-    flat/two_phase/hierarchical set)."""
+    flat/two_phase/hierarchical set), the per-lowering-backend bucket
+    counts (``kernel`` ∈ {spmd, pallas}) and the plan's structural HBM
+    intermediate count (the fused-collective tier's TPU-side win,
+    asserted by structure since the CPU bench can't time HBM)."""
     if not _m.enabled():
         return
     reg = _reg()
@@ -275,6 +280,15 @@ def on_topo_plan(algo_buckets: Dict[str, int], *,
         reg.counter("hvd_tpu_topo_schedules_total",
                     "topo schedules compiled, by algorithm").labels(
                         algo=algo).inc(buckets)
+    for kern, buckets in (kernels or {}).items():
+        reg.counter("hvd_tpu_topo_kernel_schedules_total",
+                    "topo schedules compiled, by lowering backend").labels(
+                        kernel=kern).inc(buckets)
+    if hbm_materializations is not None:
+        reg.gauge("hvd_tpu_topo_hbm_materializations",
+                  "standalone HBM intermediates the latest topo plan "
+                  "materializes around its compressed collectives "
+                  "(0 for fused ICI steps)").set(hbm_materializations)
     for tier, nbytes in tier_bytes.items():
         reg.counter("hvd_tpu_topo_wire_bytes_total",
                     "bytes the compiled topo schedule puts on each "

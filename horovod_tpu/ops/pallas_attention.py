@@ -30,10 +30,9 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .pallas_common import _LANES, resolve_interpret, round_up
+
 _NEG_INF = -1e30
-# Lane width of the m/den scratch rows (the TPU vector lane count; the
-# scalars are replicated across it to keep scratch tileable).
-_LANES = 128
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
@@ -232,8 +231,7 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = False,
             f"flash_attention_padded for causal self-attention")
     if causal and t != tk:
         raise ValueError("causal flash attention requires Tq == Tk")
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    interpret = resolve_interpret(interpret)
 
     def pack(x):
         tb = x.shape[1]
@@ -258,9 +256,9 @@ def flash_attention_padded(q, k, v, *, scale: Optional[float] = None,
         raise ValueError("flash_attention_padded is self-attention only")
     blk = max(block_q, block_k)
     if t >= blk:
-        tp = -(-t // blk) * blk          # round up to a block multiple
+        tp = round_up(t, blk)            # round up to a block multiple
     else:
-        tp = -(-t // 8) * 8              # short seq: one 8-aligned block
+        tp = round_up(t, 8)              # short seq: one 8-aligned block
     pad = tp - t
     cfg = dict(causal=True, scale=scale, block_q=block_q, block_k=block_k,
                interpret=interpret)

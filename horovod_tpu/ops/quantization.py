@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as _np
 from jax import lax
 
 from .._compat import axis_size as _axis_size
@@ -43,11 +44,20 @@ from . import spmd
 
 _EPS = 1e-30
 
+# Reciprocal of the int8 clip range as an f32 constant.  The scale is
+# computed as an explicit multiply (not ``absmax / 127.0``) so the op
+# is stable under XLA's fusion rewrites: a division by a constant may
+# or may not become a reciprocal-multiply depending on surrounding
+# fusion, which would make the HLO wire and the Pallas fused kernels
+# (ops/pallas_collectives.py) differ in the last ulp.  Multiplies are
+# never rewritten, so both tiers stay bit-identical.
+_INV127 = float(_np.float32(1.0 / 127.0))
+
 
 def _quantize_blocks(blocks):
     """``blocks [..., b]`` → (int8 ``[..., b]``, f32 scales ``[...]``),
     symmetric per-block scaling."""
-    scale = jnp.maximum(jnp.max(jnp.abs(blocks), axis=-1) / 127.0, _EPS)
+    scale = jnp.maximum(jnp.max(jnp.abs(blocks), axis=-1) * _INV127, _EPS)
     q = jnp.clip(jnp.round(blocks / scale[..., None]), -127, 127)
     return q.astype(jnp.int8), scale.astype(jnp.float32)
 

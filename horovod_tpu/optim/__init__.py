@@ -3,5 +3,5 @@
 from .distributed_optimizer import (  # noqa: F401
     DistributedOptimizer, make_train_step, DistributedOptimizerState,
 )
-from .fsdp import make_fsdp_train_step  # noqa: F401
+from .fsdp import make_fsdp_train_step, unshard_matmul  # noqa: F401
 from .zero import make_zero_train_step  # noqa: F401

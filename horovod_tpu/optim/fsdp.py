@@ -49,6 +49,32 @@ def fsdp_spec(leaf, n: int, axis: str) -> P:
     return P(*spec)
 
 
+def unshard_matmul(x, w_shard, *, axis: str = "hvd", groups=None,
+                   block_m: int = 128, block_n: int = 128,
+                   block_k: int = 512, interpret: Optional[bool] = None):
+    """Fused epilogue for the FSDP unshard path, for explicit-collective
+    regions (``shard_map`` layers, the serving tier) where the GSPMD
+    partitioner is not doing the gathering: ``x [M, K] @ w_shard
+    [K, N/n]`` as a blocked Pallas matmul whose epilogue tile feeds an
+    activation all-gather — numerically ``x @ all_gather(w_shard,
+    axis=columns)`` (``[M, N]``, rank-major columns), but the gathered
+    weight (``K × N`` bytes per layer, the unshard path's dominant HBM
+    materialization) never exists; the wire carries the ``M × N``
+    activation straight out of the kernel.  Wins whenever ``M < K`` —
+    the long-thin-layer regime FSDP lives in.  Delegates to
+    :func:`~horovod_tpu.ops.pallas_collectives.fused_matmul_allgather`
+    (``interpret=`` runs the identical kernel on the CPU test mesh).
+
+    Inside :func:`make_fsdp_train_step` the partitioner already fuses
+    its own gathers; this helper is the same optimization made
+    available where the schedule is hand-built."""
+    from ..ops.pallas_collectives import fused_matmul_allgather
+
+    return fused_matmul_allgather(x, w_shard, axis=axis, groups=groups,
+                                  block_m=block_m, block_n=block_n,
+                                  block_k=block_k, interpret=interpret)
+
+
 def make_fsdp_train_step(
     loss_fn: Callable,
     optimizer: optax.GradientTransformation,

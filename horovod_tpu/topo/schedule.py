@@ -44,6 +44,17 @@ Groups = Optional[Tuple[Tuple[int, ...], ...]]
 ALGO_FLAT, ALGO_TWO_PHASE, ALGO_HIERARCHICAL = "flat", "two_phase", \
     "hierarchical"
 
+# Lowering backends for a schedule's steps.  ``spmd`` is the HLO wire
+# (quantize / collective / dequantize as separate XLA regions);
+# ``pallas`` lowers int8-compressed ICI steps to the fused kernels in
+# ``ops/pallas_collectives.py`` (quantize-pack feeding the collective
+# operand directly, dequantize fused into the consumer).  DCN steps
+# and uncompressed wires keep the SPMD path under either backend —
+# the fusion win is the HBM round-trip around the quantize math, which
+# only the int8 intra-tier steps have.
+KERNEL_SPMD, KERNEL_PALLAS = "spmd", "pallas"
+KERNELS = (KERNEL_SPMD, KERNEL_PALLAS)
+
 
 @dataclasses.dataclass(frozen=True)
 class ScheduleStep:
@@ -68,6 +79,7 @@ class CollectiveSchedule:
     nbytes: int
     est_cost_us: float
     topo: MeshTopology
+    kernel: str = KERNEL_SPMD
 
     def tier_bytes(self) -> Dict[str, int]:
         """Wire bytes per tier (exact dtype bytes; the executor scales
@@ -76,6 +88,42 @@ class CollectiveSchedule:
         for s in self.steps:
             out[s.tier] = out.get(s.tier, 0) + s.payload_bytes
         return out
+
+    def hbm_materializations(self, compression) -> int:
+        """Structural accounting for the recorded plan: standalone HBM
+        intermediates the executor materializes around this schedule's
+        collectives on the compressed wire.  The unfused SPMD int8 path
+        writes the quantized payload before the collective and the
+        dequantized buffer after it — 2 per rs/ag step, 4 per ar (the
+        transport runs RS+AG internally).  The fused Pallas backend
+        produces the wire operands inside the quantize kernel and
+        consumes them inside the dequantize/apply kernel, so compressed
+        ICI steps add none; DCN steps keep the SPMD path under either
+        backend.  Uncompressed wires have no quantize stage to count.
+        This is the TPU-speedup assertion the CPU bench can't measure:
+        fewer HBM round-trips per step, counted in the plan itself."""
+        if not _is_int8(compression):
+            return 0
+        total = 0
+        for s in self.steps:
+            if self.kernel == KERNEL_PALLAS and s.tier == "ici":
+                continue
+            total += 4 if s.op == "ar" else 2
+        return total
+
+
+def _is_int8(compression) -> bool:
+    """Whether ``compression`` is the int8 transport (the only wire
+    with quantize/dequantize stages the Pallas backend can fuse).
+    Compressors travel as classes (``Compression.int8``), but accept
+    instances too."""
+    from ..ops.compression import Int8Compressor
+
+    if compression is None:
+        return False
+    if isinstance(compression, type):
+        return issubclass(compression, Int8Compressor)
+    return isinstance(compression, Int8Compressor)
 
 
 def choose_algo(nbytes: int, topo: MeshTopology,
@@ -127,10 +175,15 @@ def _dispatch_algo(nbytes: int, topo: MeshTopology,
 def compile_bucket_schedule(nbytes: int, topo: MeshTopology,
                             params: Optional[TopoCostParams] = None, *,
                             force: Optional[str] = None,
+                            kernel: str = KERNEL_SPMD,
                             ) -> CollectiveSchedule:
     """Compile one bucket's schedule.  ``force`` pins the algorithm
     (the autotuner's and the bench's explicit lattice points); None
-    lets the cost model choose (``auto``)."""
+    lets the cost model choose (``auto``).  ``kernel`` selects the
+    lowering backend recorded in the IR (spmd | pallas); the executor
+    applies it per step — only int8-compressed ICI steps fuse."""
+    if kernel not in KERNELS:
+        raise ValueError(f"kernel must be one of {KERNELS}, got {kernel!r}")
     params = params or default_params()
     algo = force if force in (ALGO_FLAT, ALGO_TWO_PHASE,
                               ALGO_HIERARCHICAL) else \
@@ -158,7 +211,7 @@ def compile_bucket_schedule(nbytes: int, topo: MeshTopology,
         steps = (ScheduleStep("ar", flat_tier, None, nbytes),)
         cost = flat_cost_us(nbytes, topo, params)
     return CollectiveSchedule(algo=algo, steps=steps, nbytes=nbytes,
-                              est_cost_us=cost, topo=topo)
+                              est_cost_us=cost, topo=topo, kernel=kernel)
 
 
 class ScheduleCompiler:
@@ -169,10 +222,12 @@ class ScheduleCompiler:
 
     def __init__(self, topo: MeshTopology,
                  params: Optional[TopoCostParams] = None,
-                 force: Optional[str] = None) -> None:
+                 force: Optional[str] = None,
+                 kernel: str = KERNEL_SPMD) -> None:
         self.topo = topo
         self.params = params or default_params()
         self.force = force
+        self.kernel = kernel
         self._cache: Dict[int, CollectiveSchedule] = {}
 
     def compile(self, nbytes: int) -> CollectiveSchedule:
@@ -180,23 +235,32 @@ class ScheduleCompiler:
         sched = self._cache.get(nbytes)
         if sched is None:
             sched = self._cache[nbytes] = compile_bucket_schedule(
-                nbytes, self.topo, self.params, force=self.force)
+                nbytes, self.topo, self.params, force=self.force,
+                kernel=self.kernel)
         return sched
 
 
 def maybe_compiler(world_size: int, groups=None,
-                   mode: Optional[str] = None) -> Optional[ScheduleCompiler]:
+                   mode: Optional[str] = None,
+                   kernel: Optional[str] = None,
+                   ) -> Optional[ScheduleCompiler]:
     """Trace-time resolution of the topo scheduling gate: a compiler
     when ``HVD_TPU_TOPO_SCHEDULE`` (or an explicit ``mode``) turns it
     on AND the reduction runs over the whole mesh (process-set
     sub-reductions keep the flat wire — tier groups are defined on the
     global axis) AND the resolved topology matches the group width.
-    Returns None otherwise — callers fall back to the flat planner."""
-    if mode is None:
+    Returns None otherwise — callers fall back to the flat planner.
+    ``kernel`` overrides the lowering backend; None reads
+    ``HVD_TPU_TOPO_KERNEL`` (the autotuner's ``topo_kernel`` knob
+    rewrites that config field between traces)."""
+    if mode is None or kernel is None:
         from .. import basics
 
-        mode = (basics.config().topo_schedule
-                if basics.is_initialized() else "off")
+        cfg = basics.config() if basics.is_initialized() else None
+        if mode is None:
+            mode = cfg.topo_schedule if cfg is not None else "off"
+        if kernel is None:
+            kernel = cfg.topo_kernel if cfg is not None else KERNEL_SPMD
     if mode == "off" or groups is not None or world_size <= 1:
         return None
     topo = config_topology(world_size)
@@ -204,7 +268,7 @@ def maybe_compiler(world_size: int, groups=None,
         return None
     force = None if mode == "auto" else mode
     return ScheduleCompiler(topo, estimator().effective_params(),
-                            force=force)
+                            force=force, kernel=kernel)
 
 
 # --- execution ---------------------------------------------------------------
@@ -221,9 +285,10 @@ def record_plans(scheds: Sequence[CollectiveSchedule], compression,
                  itemsize: int,
                  params: Optional[TopoCostParams] = None) -> None:
     """Trace-time plan record for a set of compiled per-bucket
-    schedules: per-tier wire bytes and per-tier modeled cost into the
-    obs registry (``hvd_tpu_topo_*``; docs/metrics.md), plus the
-    per-tier byte note the online estimator refines β from.  Bytes are
+    schedules: per-tier wire bytes, per-tier modeled cost, per-kernel
+    backend counts and the plan's structural HBM-materialization count
+    into the obs registry (``hvd_tpu_topo_*``; docs/metrics.md), plus
+    the per-tier byte note the online estimator refines β from.  Bytes are
     scaled by the compressor's wire ratio, like every fusion-tier
     record.  ``params`` must be the point the schedules were compiled
     with (the caller's ``ScheduleCompiler.params``) so the published
@@ -240,8 +305,12 @@ def record_plans(scheds: Sequence[CollectiveSchedule], compression,
     tier_bytes: Dict[str, int] = {}
     tier_cost: Dict[str, float] = {}
     by_algo: Dict[str, int] = {}
+    by_kernel: Dict[str, int] = {}
+    hbm_mats = 0
     for sched in scheds:
         by_algo[sched.algo] = by_algo.get(sched.algo, 0) + 1
+        by_kernel[sched.kernel] = by_kernel.get(sched.kernel, 0) + 1
+        hbm_mats += sched.hbm_materializations(compression)
         for t, b in sched.tier_bytes().items():
             tier_bytes[t] = tier_bytes.get(t, 0) + int(b * ratio)
         if sched.algo == ALGO_HIERARCHICAL:
@@ -255,7 +324,8 @@ def record_plans(scheds: Sequence[CollectiveSchedule], compression,
             tier_cost[t] = tier_cost.get(t, 0.0) + sched.est_cost_us
     if _obs.enabled():
         _obs.on_topo_plan(by_algo, tier_bytes=tier_bytes,
-                          est_cost_us=tier_cost)
+                          est_cost_us=tier_cost, kernels=by_kernel,
+                          hbm_materializations=hbm_mats)
     estimator().note_plan(tier_bytes)
 
 
@@ -266,12 +336,27 @@ def _on_dcn_step(stage: str) -> None:
         _faults.on_dcn(stage)
 
 
+def _step_fused(sched: CollectiveSchedule, kernel: Optional[str],
+                compression, tier: str) -> bool:
+    """Per-step backend selection: a step lowers to the fused Pallas
+    kernels only when the pallas backend is active (explicit override
+    wins, else the schedule's recorded ``kernel``), the step rides the
+    intra tier (DCN steps keep the SPMD path), and the wire is the int8
+    transport (the only one with quantize stages to fuse).  The fused
+    kernels are bit-identical to the SPMD wire, so mixing backends
+    across steps cannot change the result."""
+    k = kernel if kernel is not None else sched.kernel
+    return k == KERNEL_PALLAS and tier == "ici" and _is_int8(compression)
+
+
 def execute_schedule(x, sched: CollectiveSchedule, *, axis: str, op: str,
-                     compression) -> "jax.Array":
+                     compression, kernel: Optional[str] = None,
+                     ) -> "jax.Array":
     """Run one compiled schedule over a flat 1-D buffer inside an SPMD
     region: allreduce semantics (every slot returns the full reduction
     over the whole mesh), on the compressor's wire.  ``op`` is
-    sum/average."""
+    sum/average.  ``kernel`` overrides the schedule's recorded lowering
+    backend (the bench's explicit axis); None honors the IR."""
     import jax.numpy as jnp
 
     from ..obs import trace as _trace
@@ -281,34 +366,62 @@ def execute_schedule(x, sched: CollectiveSchedule, *, axis: str, op: str,
             f"topo schedules support op=sum/average, got {op!r}")
     n = sched.topo.size
     if n <= 1 or sched.algo == ALGO_FLAT:
+        if _step_fused(sched, kernel, compression, sched.steps[0].tier) \
+                and n > 1:
+            from ..ops import pallas_collectives as _pc
+
+            return _pc.fused_allreduce(x, op=op, axis=axis, groups=None)
         return compression.spmd_allreduce(x, op=op, axis=axis, groups=None)
     if sched.algo == ALGO_TWO_PHASE:
         pad = (-x.size) % n
         xp = jnp.concatenate([x, jnp.zeros((pad,), x.dtype)]) if pad else x
-        shard = compression.spmd_reducescatter(xp, op=op, axis=axis,
-                                               groups=None)
-        full = compression.spmd_allgather(shard, axis=axis, groups=None)
+        if _step_fused(sched, kernel, compression, sched.steps[0].tier):
+            from ..ops import pallas_collectives as _pc
+
+            shard = _pc.fused_quantize_reducescatter(xp, op=op, axis=axis,
+                                                     groups=None)
+            full = _pc.fused_quantize_allgather(shard, axis=axis,
+                                                groups=None)
+        else:
+            shard = compression.spmd_reducescatter(xp, op=op, axis=axis,
+                                                   groups=None)
+            full = compression.spmd_allgather(shard, axis=axis, groups=None)
         return full[: x.size]
     # hierarchical: RS-intra (ICI) -> cross-pod exchange on the sharded
     # fragment (DCN) -> AG-intra (ICI).  Internal reductions run op=sum;
     # one exact division by the full mesh width lands at the end so the
     # result matches the flat wire's average bit-for-bit on exact data.
+    # Under kernel=pallas the two ICI steps lower to the fused kernels
+    # (bit-identical wire); the DCN step keeps the SPMD path.
     intra = _groups_list(sched.steps[0].groups)
     cross = _groups_list(sched.steps[1].groups)
+    fuse_intra = _step_fused(sched, kernel, compression, "ici")
+    if fuse_intra:
+        from ..ops import pallas_collectives as _pc
     pad = (-x.size) % n
     xp = jnp.concatenate([x, jnp.zeros((pad,), x.dtype)]) if pad else x
     with _trace.span("hvd_tpu_topo_rs_intra",
-                     args={"bytes": sched.steps[0].payload_bytes}):
-        frag = compression.spmd_reducescatter(xp, op="sum", axis=axis,
-                                              groups=intra)
+                     args={"bytes": sched.steps[0].payload_bytes,
+                           "kernel": "pallas" if fuse_intra else "spmd"}):
+        if fuse_intra:
+            frag = _pc.fused_quantize_reducescatter(xp, op="sum", axis=axis,
+                                                    groups=intra)
+        else:
+            frag = compression.spmd_reducescatter(xp, op="sum", axis=axis,
+                                                  groups=intra)
     _on_dcn_step("xpod")
     with _trace.span("hvd_tpu_topo_xpod",
                      args={"bytes": sched.steps[1].payload_bytes}):
         frag = compression.spmd_allreduce(frag, op="sum", axis=axis,
                                           groups=cross)
     with _trace.span("hvd_tpu_topo_ag_intra",
-                     args={"bytes": sched.steps[2].payload_bytes}):
-        full = compression.spmd_allgather(frag, axis=axis, groups=intra)
+                     args={"bytes": sched.steps[2].payload_bytes,
+                           "kernel": "pallas" if fuse_intra else "spmd"}):
+        if fuse_intra:
+            full = _pc.fused_quantize_allgather(frag, axis=axis,
+                                                groups=intra)
+        else:
+            full = compression.spmd_allgather(frag, axis=axis, groups=intra)
     out = full[: x.size]
     if op == "average":
         out = out / n
@@ -316,22 +429,33 @@ def execute_schedule(x, sched: CollectiveSchedule, *, axis: str, op: str,
 
 
 def hierarchical_reduce_scatter(x, sched: CollectiveSchedule, *,
-                                axis: str, op: str, compression):
+                                axis: str, op: str, compression,
+                                kernel: Optional[str] = None):
     """The RS half for the overlap microbatch wire: RS-intra (ICI) then
     RS across pods (DCN) on the fragment.  ``x`` must already be padded
     to the mesh width; returns this slot's ``x.size/n`` shard.  Shards
     come back in (chip, pod)-major order — a fixed permutation of the
     flat RS layout that :func:`hierarchical_all_gather` inverts, so
-    accumulate-then-gather is flat-equivalent."""
+    accumulate-then-gather is flat-equivalent.  Under ``kernel=pallas``
+    (explicit, or the schedule's recorded backend) the ICI step lowers
+    to the fused quantize→RS kernel; the DCN step keeps SPMD."""
     from ..obs import trace as _trace
 
     n = sched.topo.size
     intra = _groups_list(sched.steps[0].groups)
     cross = _groups_list(sched.steps[1].groups)
+    fuse_intra = _step_fused(sched, kernel, compression, "ici")
     with _trace.span("hvd_tpu_topo_rs_intra",
-                     args={"bytes": sched.steps[0].payload_bytes}):
-        frag = compression.spmd_reducescatter(x, op="sum", axis=axis,
-                                              groups=intra)
+                     args={"bytes": sched.steps[0].payload_bytes,
+                           "kernel": "pallas" if fuse_intra else "spmd"}):
+        if fuse_intra:
+            from ..ops import pallas_collectives as _pc
+
+            frag = _pc.fused_quantize_reducescatter(x, op="sum", axis=axis,
+                                                    groups=intra)
+        else:
+            frag = compression.spmd_reducescatter(x, op="sum", axis=axis,
+                                                  groups=intra)
     _on_dcn_step("xpod_rs")
     with _trace.span("hvd_tpu_topo_xpod",
                      args={"bytes": sched.steps[1].payload_bytes}):
@@ -343,19 +467,30 @@ def hierarchical_reduce_scatter(x, sched: CollectiveSchedule, *,
 
 
 def hierarchical_all_gather(shard, sched: CollectiveSchedule, *,
-                            axis: str, compression):
+                            axis: str, compression,
+                            kernel: Optional[str] = None):
     """The AG half: gather across pods (DCN) to rebuild the fragment,
     then AG-intra (ICI) to rebuild the full padded buffer — the exact
-    inverse of :func:`hierarchical_reduce_scatter`'s permutation."""
+    inverse of :func:`hierarchical_reduce_scatter`'s permutation.
+    Under ``kernel=pallas`` the ICI gather lowers to the fused
+    AG→dequantize kernel; the DCN step keeps SPMD."""
     from ..obs import trace as _trace
 
     intra = _groups_list(sched.steps[0].groups)
     cross = _groups_list(sched.steps[1].groups)
+    fuse_intra = _step_fused(sched, kernel, compression, "ici")
     _on_dcn_step("xpod_ag")
     with _trace.span("hvd_tpu_topo_xpod",
                      args={"bytes": sched.steps[1].payload_bytes}):
         frag = compression.spmd_allgather(shard, axis=axis, groups=cross)
     with _trace.span("hvd_tpu_topo_ag_intra",
-                     args={"bytes": sched.steps[2].payload_bytes}):
-        full = compression.spmd_allgather(frag, axis=axis, groups=intra)
+                     args={"bytes": sched.steps[2].payload_bytes,
+                           "kernel": "pallas" if fuse_intra else "spmd"}):
+        if fuse_intra:
+            from ..ops import pallas_collectives as _pc
+
+            full = _pc.fused_quantize_allgather(frag, axis=axis,
+                                                groups=intra)
+        else:
+            full = compression.spmd_allgather(frag, axis=axis, groups=intra)
     return full

@@ -13,6 +13,10 @@ Accepted file shapes (everything the in-tree benchmarks emit):
   metrics;
 * ``{"summary": {...}, "rows": [...]}`` (``allreduce_bench.py --out``) —
   the summary is read, rows are ignored (per-size noise isn't a metric);
+  a ``"sweep"`` list (``--fused-sweep``) is read row-by-row — each entry
+  is a gated metric in its own right (per bucket x compressor, named
+  without the kernel backend so fused and unfused artifacts diff
+  directly);
 * a JSON list or JSONL stream of such objects.
 
 Direction is inferred from the metric name: names containing
@@ -52,6 +56,14 @@ _NON_METRIC_KEYS = {
     "bench_buckets", "per_chip_batch", "probe_attempts", "requests",
     "warmup", "iters", "steps_per_call", "metrics", "trace",
     "prefix_shared", "spec_k", "prefix_hit",
+    # Fused-sweep structure (allreduce_bench.py --fused-sweep): bucket
+    # geometry and the schedule's structural HBM-intermediate count are
+    # experiment configuration — the pallas backend's count DROPPING to
+    # 0 is the design, not a higher-is-better metric regressing.
+    "bucket_elems", "block_size", "hbm_materializations",
+    # Quotient of two independently-gated wall-clock metrics (int8 peak
+    # over exact peak); gating it too double-counts denominator jitter.
+    "int8_vs_exact",
 }
 
 _LOWER_IS_BETTER_TOKENS = ("_ms", "_us", "time", "latency", "ttft", "tpot")
@@ -77,7 +89,11 @@ def _rows(path: str):
                if line.strip()]
     if isinstance(doc, dict):
         if "summary" in doc and isinstance(doc["summary"], dict):
-            return [doc["summary"]]
+            # Sweep entries (--fused-sweep) gate individually alongside
+            # the headline summary; plain "rows" stay diagnostic.
+            sweep = [r for r in doc.get("sweep", [])
+                     if isinstance(r, dict)]
+            return [doc["summary"]] + sweep
         return [doc]
     if isinstance(doc, list):
         out = []

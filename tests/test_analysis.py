@@ -873,3 +873,95 @@ def test_cli_nonzero_on_findings(tmp_path):
         capture_output=True, text=True, timeout=300)
     assert proc.returncode == 1, proc.stdout + proc.stderr
     assert "rank-divergent-collective" in proc.stdout
+
+
+# --- Pallas interpret-flag discipline ----------------------------------------
+
+GOOD_PALLAS = """
+from jax.experimental import pallas as pl
+
+def _kern(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+def copy(x, *, interpret=None):
+    from horovod_tpu.ops.pallas_common import resolve_interpret
+    return pl.pallas_call(_kern, out_shape=x,
+                          interpret=resolve_interpret(interpret))(x)
+"""
+
+MISSING_INTERPRET = """
+from jax.experimental import pallas as pl
+
+def _kern(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+def copy(x, *, interpret=None):
+    return pl.pallas_call(_kern, out_shape=x)(x)
+"""
+
+HARDCODED_INTERPRET = """
+from jax.experimental import pallas as pl
+
+def _kern(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+def copy(x, *, interpret=None):
+    return pl.pallas_call(_kern, out_shape=x, interpret=True)(x)
+"""
+
+NO_PUBLIC_ESCAPE = """
+from jax.experimental import pallas as pl
+
+def _kern(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+def _copy(x, interpret):
+    return pl.pallas_call(_kern, out_shape=x, interpret=interpret)(x)
+
+def copy(x):
+    return _copy(x, False)
+"""
+
+
+def test_pallas_interpret_threaded_ok(tmp_path):
+    from horovod_tpu.analysis.pallas import PallasChecker
+
+    assert lint(tmp_path, {"m.py": GOOD_PALLAS}, [PallasChecker]) == []
+
+
+def test_pallas_interpret_missing(tmp_path):
+    from horovod_tpu.analysis.pallas import PallasChecker
+
+    fs = lint(tmp_path, {"m.py": MISSING_INTERPRET}, [PallasChecker])
+    assert checks_of(fs) == ["pallas-interpret-flag"]
+    assert "without interpret=" in fs[0].message
+
+
+def test_pallas_interpret_hardcoded(tmp_path):
+    from horovod_tpu.analysis.pallas import PallasChecker
+
+    fs = lint(tmp_path, {"m.py": HARDCODED_INTERPRET}, [PallasChecker])
+    assert checks_of(fs) == ["pallas-interpret-flag"]
+    assert "hardcodes" in fs[0].message
+
+
+def test_pallas_no_public_escape_hatch(tmp_path):
+    from horovod_tpu.analysis.pallas import PallasChecker
+
+    fs = lint(tmp_path, {"m.py": NO_PUBLIC_ESCAPE}, [PallasChecker])
+    assert checks_of(fs) == ["pallas-interpret-flag"]
+    assert "public" in fs[0].message
+
+
+def test_pallas_modules_without_kernels_are_ignored(tmp_path):
+    from horovod_tpu.analysis.pallas import PallasChecker
+
+    src = "def pallas_call_lookalike(x):\n    return x\n"
+    assert lint(tmp_path, {"m.py": src}, [PallasChecker]) == []
+
+
+def test_pallas_check_in_default_set():
+    from horovod_tpu import analysis
+    from horovod_tpu.analysis.pallas import PallasChecker
+
+    assert PallasChecker in analysis.default_checkers()

@@ -1,0 +1,457 @@
+"""Fused quantize-collective Pallas kernels (ops/pallas_collectives.py).
+
+Interpret-mode oracle tier: every fused kernel runs under the 8-slot
+CPU mesh and is compared against the unfused int8 reference wire
+(ops/quantization.py + ops/compression.py).  The wire contract is
+**bitwise** — quantized payloads, per-block scales, reduced results and
+error-feedback residuals must be identical to the SPMD lowering across
+consecutive steps, so the autotuner can flip the backend mid-run
+without perturbing training numerics.  Optimizer-apply and matmul
+epilogues are allclose-tight (one FMA-contraction rounding of slack —
+the gathered/dequantized gradient itself stays bitwise; see the kernel
+docstrings).
+
+Also pins the satellite regression: ragged tail blocks quantize on the
+absmax of the *real* elements only (zero padding can never raise a
+block scale), in both the wire transport and the stack-tier
+``Int8Compressor.compress_stack`` / ``local_error`` simulation.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu import basics
+from horovod_tpu._compat import shard_map
+from horovod_tpu.obs import metrics as obs_metrics
+from horovod_tpu.ops import pallas_collectives as pc
+from horovod_tpu.ops import quantization as qz
+from horovod_tpu.ops import spmd
+from horovod_tpu.ops.compression import Compression, Int8Compressor
+from horovod_tpu.topo.schedule import (KERNEL_PALLAS, KERNEL_SPMD,
+                                       compile_bucket_schedule,
+                                       execute_schedule,
+                                       hierarchical_all_gather,
+                                       hierarchical_reduce_scatter,
+                                       maybe_compiler, record_plans)
+from horovod_tpu.topo.topology import MeshTopology
+
+TOPO24 = MeshTopology(pods=2, chips_per_pod=4)
+
+
+def _run_spmd(fn, x, axis="hvd"):
+    gm = hvd.global_mesh()
+    body = shard_map(fn, mesh=gm.mesh, in_specs=P(axis), out_specs=P(axis),
+                     check=False)
+    return body(x)
+
+
+def _metric(name, **labels):
+    for series in obs_metrics.registry().snapshot().get(name, []):
+        if series.get("labels", {}) == {str(k): str(v)
+                                        for k, v in labels.items()}:
+            return series.get("value", series.get("count"))
+    return 0.0
+
+
+# --- wire parity: bitwise against the unfused int8 reference ----------------
+
+class TestQuantizeBlocks:
+    def test_bitwise_vs_reference(self):
+        rng = np.random.RandomState(0)
+        blocks = jnp.asarray(rng.randn(13, 1024), jnp.float32)
+        q_ref, s_ref = qz._quantize_blocks(blocks)
+        q_p, s_p = pc.quantize_blocks(blocks)
+        np.testing.assert_array_equal(np.asarray(q_ref), np.asarray(q_p))
+        np.testing.assert_array_equal(np.asarray(s_ref), np.asarray(s_p))
+
+    def test_dequantize_roundtrip_bitwise(self):
+        rng = np.random.RandomState(1)
+        blocks = jnp.asarray(rng.randn(5, 256), jnp.float32)
+        q, s = pc.quantize_blocks(blocks)
+        deq_ref = q.astype(jnp.float32) * s[:, None]
+        deq_p = pc.dequantize_blocks(q, s)
+        np.testing.assert_array_equal(np.asarray(deq_ref), np.asarray(deq_p))
+
+    def test_quant_dequant_matches_reference(self):
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.randn(3000), jnp.float32)  # ragged vs 1024
+        np.testing.assert_array_equal(
+            np.asarray(qz.quant_dequant(x, block_size=1024)),
+            np.asarray(pc.pallas_quant_dequant(x, block_size=1024)))
+
+
+class TestFusedWireParity:
+    def test_reducescatter_bitwise(self, world_size):
+        # k=300 -> ragged tail blocks inside every destination chunk.
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(world_size, world_size * 300), jnp.float32)
+        ref = _run_spmd(
+            lambda v: qz.int8_reducescatter(v.reshape(-1), op="average"), x)
+        fus = _run_spmd(
+            lambda v: pc.fused_quantize_reducescatter(v.reshape(-1),
+                                                      op="average"), x)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(fus))
+
+    def test_allgather_bitwise(self, world_size):
+        rng = np.random.RandomState(4)
+        sh = jnp.asarray(rng.randn(world_size, 300), jnp.float32)
+        ref = _run_spmd(
+            lambda v: qz.int8_allgather(v.reshape(-1)).reshape(1, -1), sh)
+        fus = _run_spmd(
+            lambda v: pc.fused_quantize_allgather(v.reshape(-1))
+            .reshape(1, -1), sh)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(fus))
+
+    def test_allreduce_bitwise_odd_size(self, world_size):
+        rng = np.random.RandomState(5)
+        x = jnp.asarray(rng.randn(world_size, 257), jnp.float32)
+        ref = _run_spmd(lambda v: qz.int8_allreduce(v, op="sum"), x)
+        fus = _run_spmd(lambda v: pc.fused_allreduce(v, op="sum"), x)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(fus))
+
+    def test_error_feedback_residual_two_steps(self, world_size):
+        """EF residuals must be bitwise across >= 2 consecutive steps:
+        the residual feeds back into the next step's gradient, so any
+        drift between backends compounds instead of staying bounded."""
+        comp = Compression.int8
+        rng = np.random.RandomState(6)
+        g = jnp.asarray(rng.randn(2000), jnp.float32)
+        b = qz.wire_block_size(g.size, world_size)
+        r_ref = comp.local_error(g, block_size=b)
+        r_fus = pc.pallas_local_error(g, block_size=b)
+        np.testing.assert_array_equal(np.asarray(r_ref), np.asarray(r_fus))
+        g2 = jnp.asarray(rng.randn(2000), jnp.float32) + r_ref
+        np.testing.assert_array_equal(
+            np.asarray(comp.local_error(g2, block_size=b)),
+            np.asarray(pc.pallas_local_error(g2, block_size=b)))
+
+    def test_local_error_int_dtype_is_zero(self):
+        x = jnp.arange(16, dtype=jnp.int32)
+        np.testing.assert_array_equal(np.asarray(pc.pallas_local_error(x)),
+                                      np.zeros(16, np.int32))
+
+    def test_rejects_order_ops(self, world_size):
+        with pytest.raises(ValueError, match="sum/average"):
+            _run_spmd(
+                lambda v: pc.fused_quantize_reducescatter(
+                    v.reshape(-1), op="max"),
+                jnp.ones((world_size, world_size)))
+
+
+# --- fused optimizer-apply epilogues ----------------------------------------
+
+class TestFusedOptimizerApply:
+    def test_sgd_apply(self, world_size):
+        k, lr = 300, 0.1
+        rng = np.random.RandomState(7)
+        param = jnp.asarray(rng.randn(world_size * k), jnp.float32)
+        shards = jnp.asarray(rng.randn(world_size, k), jnp.float32)
+
+        def unfused(v):
+            g = qz.int8_allgather(v.reshape(-1))
+            return (param - lr * g).reshape(1, -1)
+
+        def fused(v):
+            return pc.fused_allgather_sgd_apply(
+                param, v.reshape(-1), lr=lr).reshape(1, -1)
+
+        ref = _run_spmd(unfused, shards)
+        fus = _run_spmd(fused, shards)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(fus),
+                                   atol=1e-6, rtol=1e-6)
+
+    def test_adam_apply(self, world_size):
+        k, lr = 300, 0.1
+        b1, b2, eps, step = 0.9, 0.999, 1e-8, 3
+        rng = np.random.RandomState(8)
+        param = jnp.asarray(rng.randn(world_size * k), jnp.float32)
+        mu = jnp.asarray(rng.randn(world_size * k), jnp.float32) * 0.01
+        nu = jnp.abs(jnp.asarray(rng.randn(world_size * k),
+                                 jnp.float32)) * 0.001
+        shards = jnp.asarray(rng.randn(world_size, k), jnp.float32)
+
+        def unfused(v):
+            g = qz.int8_allgather(v.reshape(-1))
+            m_new = b1 * mu + (1 - b1) * g
+            v_new = b2 * nu + (1 - b2) * (g * g)
+            upd = (m_new / (1.0 - b1 ** step)) \
+                / (jnp.sqrt(v_new / (1.0 - b2 ** step)) + eps)
+            return jnp.concatenate([param - lr * upd, m_new,
+                                    v_new]).reshape(1, -1)
+
+        def fused(v):
+            p2, m2, v2 = pc.fused_allgather_adam_apply(
+                param, mu, nu, v.reshape(-1), lr=lr, step=step,
+                b1=b1, b2=b2, eps=eps)
+            return jnp.concatenate([p2, m2, v2]).reshape(1, -1)
+
+        ref = _run_spmd(unfused, shards)
+        fus = _run_spmd(fused, shards)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(fus),
+                                   atol=1e-6, rtol=1e-6)
+
+    def test_adam_rejects_step_zero(self):
+        z = jnp.zeros((8,), jnp.float32)
+        with pytest.raises(ValueError, match="step"):
+            pc.fused_allgather_adam_apply(z, z, z, z, lr=0.1, step=0)
+
+
+# --- fused matmul + all-gather epilogue (FSDP unshard path) -----------------
+
+class TestFusedMatmulAllgather:
+    def test_matches_gather_then_matmul(self, world_size):
+        M, K, NL = 24, 96, 40
+        rng = np.random.RandomState(9)
+        xa = jnp.asarray(rng.randn(M, K), jnp.float32)
+        w = jnp.asarray(rng.randn(world_size, K, NL), jnp.float32)
+        n = world_size
+
+        def unfused(wl):
+            wfull = spmd.allgather(wl.reshape(K, NL), tiled=True)
+            wg = wfull.reshape(n, K, NL).transpose(1, 0, 2).reshape(K, n * NL)
+            return (xa @ wg).reshape(1, M, n * NL)
+
+        def fused(wl):
+            return pc.fused_matmul_allgather(
+                xa, wl.reshape(K, NL)).reshape(1, M, n * NL)
+
+        gm = hvd.global_mesh()
+        ref = shard_map(unfused, mesh=gm.mesh, in_specs=P("hvd"),
+                        out_specs=P("hvd"), check=False)(w)
+        fus = shard_map(fused, mesh=gm.mesh, in_specs=P("hvd"),
+                        out_specs=P("hvd"), check=False)(w)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(fus),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_fsdp_unshard_matmul_export(self, world_size):
+        from horovod_tpu.optim import unshard_matmul
+        assert unshard_matmul is not None
+
+    def test_single_device_degenerate(self):
+        rng = np.random.RandomState(10)
+        xa = jnp.asarray(rng.randn(8, 16), jnp.float32)
+        w = jnp.asarray(rng.randn(16, 24), jnp.float32)
+        got = pc.fused_matmul_allgather(xa, w, groups=[[0]])
+        np.testing.assert_allclose(np.asarray(got), np.asarray(xa @ w),
+                                   rtol=1e-5, atol=1e-5)
+
+
+# --- schedule IR backend: kernel="pallas" lowering tier ----------------------
+
+class TestScheduleKernelBackend:
+    def test_execute_schedule_backend_parity(self, world_size):
+        """Hierarchical schedule, pallas vs spmd backend: bitwise-equal
+        results (the fused ICI steps reproduce the SPMD wire exactly;
+        the DCN step is shared)."""
+        sp = compile_bucket_schedule(1 << 16, TOPO24, force="hierarchical",
+                                     kernel=KERNEL_SPMD)
+        pl_ = compile_bucket_schedule(1 << 16, TOPO24, force="hierarchical",
+                                      kernel=KERNEL_PALLAS)
+        rng = np.random.RandomState(11)
+        x = jnp.asarray(rng.randn(world_size, 300), jnp.float32)
+        ref = _run_spmd(
+            lambda v: execute_schedule(v.reshape(-1), sp, axis="hvd",
+                                       op="average",
+                                       compression=Compression.int8), x)
+        fus = _run_spmd(
+            lambda v: execute_schedule(v.reshape(-1), pl_, axis="hvd",
+                                       op="average",
+                                       compression=Compression.int8), x)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(fus))
+
+    def test_kernel_override_wins(self, world_size):
+        """The executor's explicit ``kernel=`` (the bench axis) overrides
+        the IR's recorded backend — and stays bitwise-equal."""
+        sp = compile_bucket_schedule(1 << 16, TOPO24, force="hierarchical")
+        rng = np.random.RandomState(12)
+        x = jnp.asarray(rng.randn(world_size, 64), jnp.float32)
+        ref = _run_spmd(
+            lambda v: execute_schedule(v.reshape(-1), sp, axis="hvd",
+                                       op="sum",
+                                       compression=Compression.int8), x)
+        fus = _run_spmd(
+            lambda v: execute_schedule(v.reshape(-1), sp, axis="hvd",
+                                       op="sum",
+                                       compression=Compression.int8,
+                                       kernel=KERNEL_PALLAS), x)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(fus))
+
+    def test_hier_rs_ag_roundtrip_parity(self, world_size):
+        """The overlap wire's split halves (RS then deferred AG) under
+        the pallas backend match the spmd lowering bitwise."""
+        sched = compile_bucket_schedule(1 << 14, TOPO24,
+                                        force="hierarchical",
+                                        kernel=KERNEL_PALLAS)
+        rng = np.random.RandomState(13)
+        x = jnp.asarray(rng.randn(world_size, world_size * 40), jnp.float32)
+
+        def body(kernel):
+            def fn(v):
+                sh = hierarchical_reduce_scatter(
+                    v.reshape(-1), sched, axis="hvd", op="average",
+                    compression=Compression.int8, kernel=kernel)
+                return hierarchical_all_gather(
+                    sh, sched, axis="hvd", compression=Compression.int8,
+                    kernel=kernel)
+            return fn
+
+        ref = _run_spmd(body(KERNEL_SPMD), x)
+        fus = _run_spmd(body(KERNEL_PALLAS), x)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(fus))
+
+    def test_two_phase_backend_parity(self, world_size):
+        topo = MeshTopology(1, world_size)
+        sp = compile_bucket_schedule(1 << 20, topo, force="two_phase")
+        rng = np.random.RandomState(14)
+        x = jnp.asarray(rng.randn(world_size, 128), jnp.float32)
+        ref = _run_spmd(
+            lambda v: execute_schedule(v.reshape(-1), sp, axis="hvd",
+                                       op="sum",
+                                       compression=Compression.int8), x)
+        fus = _run_spmd(
+            lambda v: execute_schedule(v.reshape(-1), sp, axis="hvd",
+                                       op="sum",
+                                       compression=Compression.int8,
+                                       kernel=KERNEL_PALLAS), x)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(fus))
+
+    def test_rejects_unknown_kernel(self):
+        with pytest.raises(ValueError, match="kernel"):
+            compile_bucket_schedule(1 << 10, TOPO24, kernel="cuda")
+
+    def test_maybe_compiler_reads_config_kernel(self, world_size):
+        old = basics._state.config
+        basics._state.config = dataclasses.replace(
+            old, topo_spec="2x4", topo_schedule="hierarchical",
+            topo_kernel="pallas")
+        try:
+            comp = maybe_compiler(world_size)
+            assert comp is not None
+            sched = comp.compile(1 << 16)
+            assert sched.kernel == KERNEL_PALLAS
+        finally:
+            basics._state.config = old
+
+    def test_hbm_materializations_structural(self):
+        """The TPU-speedup assertion the CPU bench cannot time: the
+        fused backend removes every compressed-ICI-step HBM round-trip
+        from the plan; only the DCN exchange still materializes."""
+        sp = compile_bucket_schedule(1 << 16, TOPO24, force="hierarchical",
+                                     kernel=KERNEL_SPMD)
+        pl_ = compile_bucket_schedule(1 << 16, TOPO24, force="hierarchical",
+                                      kernel=KERNEL_PALLAS)
+        spmd_mats = sp.hbm_materializations(Compression.int8)
+        pallas_mats = pl_.hbm_materializations(Compression.int8)
+        assert pallas_mats < spmd_mats, (pallas_mats, spmd_mats)
+        # hierarchical = rs(ici) + ar(dcn) + ag(ici): 2+4+2 unfused,
+        # only the DCN ar's 4 remain fused.
+        assert spmd_mats == 8 and pallas_mats == 4
+        # Uncompressed wires have no quantize stage to count.
+        assert sp.hbm_materializations(Compression.none) == 0
+        assert pl_.hbm_materializations(Compression.none) == 0
+        # A fully-ICI two-phase schedule fuses everything away.
+        tp = compile_bucket_schedule(1 << 20, MeshTopology(1, 8),
+                                     force="two_phase",
+                                     kernel=KERNEL_PALLAS)
+        assert tp.hbm_materializations(Compression.int8) == 0
+        assert tp.hbm_materializations(Int8Compressor) == \
+            tp.hbm_materializations(Compression.int8)
+
+    def test_record_plans_emits_kernel_metrics(self):
+        if not obs_metrics.enabled():
+            pytest.skip("metrics disabled")
+        sp = compile_bucket_schedule(1 << 16, TOPO24, force="hierarchical",
+                                     kernel=KERNEL_SPMD)
+        pl_ = compile_bucket_schedule(1 << 16, TOPO24, force="hierarchical",
+                                      kernel=KERNEL_PALLAS)
+        before_sp = _metric("hvd_tpu_topo_kernel_schedules_total",
+                            kernel="spmd")
+        before_pl = _metric("hvd_tpu_topo_kernel_schedules_total",
+                            kernel="pallas")
+        record_plans([sp, pl_], Compression.int8, 4)
+        assert _metric("hvd_tpu_topo_kernel_schedules_total",
+                       kernel="spmd") == before_sp + 1
+        assert _metric("hvd_tpu_topo_kernel_schedules_total",
+                       kernel="pallas") == before_pl + 1
+        assert _metric("hvd_tpu_topo_hbm_materializations") == \
+            sp.hbm_materializations(Compression.int8) \
+            + pl_.hbm_materializations(Compression.int8)
+
+
+# --- satellite regression: ragged tail blocks --------------------------------
+
+class TestRaggedTailBlocks:
+    """Zero padding must never change a tail block's scale or payload:
+    the pad extends the block with zeros, |0| cannot raise the absmax,
+    and the pad positions quantize to q=0 and are sliced off.  Pinned
+    here so a future vectorization of the pad path cannot silently
+    regress the tail-block math."""
+
+    def test_tail_scale_uses_real_elements_only(self):
+        b = 64
+        x = np.zeros(b, np.float32)
+        tail = np.array([0.5, -2.0, 1.25], np.float32)
+        x[:3] = tail
+        q, s = qz._quantize_blocks(jnp.asarray(x).reshape(1, b))
+        want = max(np.abs(tail).max() * np.float32(1.0 / 127.0),
+                   qz._EPS)
+        np.testing.assert_allclose(np.asarray(s)[0], want, rtol=1e-7)
+
+    def test_quant_dequant_invariant_under_zero_pad(self):
+        rng = np.random.RandomState(20)
+        x = rng.randn(200).astype(np.float32)  # ragged vs block 64
+        full = np.zeros(256, np.float32)
+        full[:200] = x
+        got = qz.quant_dequant(jnp.asarray(x), block_size=64)
+        padded = qz.quant_dequant(jnp.asarray(full), block_size=64)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(padded)[:200])
+
+    def test_all_zero_block_floors_at_eps(self):
+        x = jnp.zeros((2, 32), jnp.float32)
+        q, s = qz._quantize_blocks(x)
+        np.testing.assert_array_equal(np.asarray(q), np.zeros((2, 32)))
+        np.testing.assert_allclose(np.asarray(s), qz._EPS)
+        np.testing.assert_array_equal(
+            np.asarray(qz.quant_dequant(x.reshape(-1), block_size=32)),
+            np.zeros(64, np.float32))
+
+    def test_compress_stack_ragged_rows_match_per_row_wire(self, world_size):
+        """Stack-tier simulation with a ragged row length: every row
+        must equal the wire's per-row quant-dequant at the group-derived
+        block (the two tiers' numerics may not diverge on ragged
+        shapes)."""
+        rows, row_elems = 4, 300  # 300 % wire block != 0
+        rng = np.random.RandomState(21)
+        x = jnp.asarray(rng.randn(rows, row_elems), jnp.float32)
+        out, ctx = Int8Compressor.compress_stack(x, world_size)
+        assert ctx is None
+        b = qz.wire_block_size(row_elems, world_size)
+        for i in range(rows):
+            np.testing.assert_array_equal(
+                np.asarray(out[i]),
+                np.asarray(qz.quant_dequant(x[i], block_size=b)))
+
+    def test_local_error_ragged_matches_manual(self):
+        rng = np.random.RandomState(22)
+        x = jnp.asarray(rng.randn(777), jnp.float32)  # ragged vs 1024
+        r = Int8Compressor.local_error(x)
+        np.testing.assert_array_equal(
+            np.asarray(r),
+            np.asarray(x - qz.quant_dequant(x, block_size=1024)))
+
+    def test_pallas_tail_parity_with_reference(self):
+        """The fused kernels' pad-then-slice tail path reproduces the
+        reference bit-for-bit on a deliberately awkward size (prime
+        block count, ragged tail)."""
+        rng = np.random.RandomState(23)
+        x = jnp.asarray(rng.randn(7 * 64 + 13), jnp.float32)
+        np.testing.assert_array_equal(
+            np.asarray(qz.quant_dequant(x, block_size=64)),
+            np.asarray(pc.pallas_quant_dequant(x, block_size=64)))
