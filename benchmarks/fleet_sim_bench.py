@@ -1,0 +1,137 @@
+"""Fleet-simulator benchmark: calibration + 1000-replica capacity.
+
+Two phases, one artifact (``SIM_r17.json``-style, gated by
+``scripts/bench_regress.py``):
+
+1. **Calibration** (the sim-vs-real oracle, docs/fleet_sim.md): an
+   UNLOADED 4-replica run whose end-to-end TTFT percentiles must
+   reproduce the measured distribution the replica profile was fitted
+   from (``SERVING_r11``'s unified tier) — queueing is ~zero at the
+   calibration rate, so the event pipeline + lognormal sampler is
+   what's measured.  Reported as ``calibration_error_p50``/``_p99``
+   (relative error, lower is better; the acceptance band is ±15%).
+
+2. **Capacity** (the ISSUE 17 acceptance run): 1000 simulated replicas
+   × 10⁴ bursty open-loop requests under seeded replica-kill
+   injection, every SLO invariant checked.  Reported as
+   ``fleet_sim_events_per_s`` (the headline), ``sim_wall_time_s``
+   (must stay seconds, not minutes), and ``invariant_violations``
+   (zero-tolerance in bench_regress: any increase from 0 fails).
+
+Pure CPU, no accelerator, deterministic by seed::
+
+    python benchmarks/fleet_sim_bench.py                 # defaults
+    python benchmarks/fleet_sim_bench.py --replicas 1000 \\
+        --requests 10000 --out SIM_r17.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from horovod_tpu.serve.fleet.sim import FleetSim
+from horovod_tpu.serve.fleet.traces import load_profile, make_trace
+
+
+def run_calibration(seed: int, requests: int) -> dict:
+    profile = load_profile()
+    trace = make_trace(requests, seed=seed, rate_rps=5.0,
+                       burst_factor=1.0)
+    sim = FleetSim(replicas=4, seed=seed, profile=profile,
+                   scale_in_idle_s=1e9, record_events=False)
+    report = sim.run(trace)
+    out = {
+        "profile_source": profile.source,
+        "profile_ttft_ms_p50": profile.ttft_ms.p50_ms,
+        "profile_ttft_ms_p99": profile.ttft_ms.p99_ms,
+        "sim_ttft_ms_p50": report["ttft_ms_p50"],
+        "sim_ttft_ms_p99": report["ttft_ms_p99"],
+        "calibration_error_p50": abs(
+            report["ttft_ms_p50"] - profile.ttft_ms.p50_ms)
+        / profile.ttft_ms.p50_ms,
+        "calibration_error_p99": abs(
+            report["ttft_ms_p99"] - profile.ttft_ms.p99_ms)
+        / profile.ttft_ms.p99_ms,
+        "calibration_violations": report["invariants"]
+        ["violations_total"],
+    }
+    return out
+
+
+def run_capacity(seed: int, replicas: int, requests: int,
+                 rate_rps: float, fault_spec: str) -> dict:
+    trace = make_trace(requests, seed=seed, rate_rps=rate_rps)
+    sim = FleetSim(replicas=replicas, seed=seed, max_replicas=replicas,
+                   record_events=False)
+    t0 = time.monotonic()
+    report = sim.run(trace, fault_spec=fault_spec or None)
+    wall = time.monotonic() - t0
+    return {
+        "replicas": replicas,
+        "requests": report["requests"],
+        "events": report["events"],
+        "sim_wall_time_s": round(wall, 3),
+        "events_per_s": round(report["events"] / max(1e-9, wall), 1),
+        "delivered": report["delivered"],
+        "kills": report["kills"],
+        "faults_injected": report["faults_fired"],
+        "scale_out": report["scale_out"],
+        "scale_in": report["scale_in"],
+        "invariant_checks": report["invariants"]["checks_total"],
+        "invariant_violations": report["invariants"]
+        ["violations_total"],
+        "violation_rows": report["invariants"]["violations"][:16],
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--seed", type=int, default=1)
+    parser.add_argument("--replicas", type=int, default=1000)
+    parser.add_argument("--requests", type=int, default=10_000,
+                        help="capacity-phase trace size")
+    parser.add_argument("--rate-rps", type=float, default=2000.0)
+    parser.add_argument("--calibration-requests", type=int,
+                        default=2000)
+    parser.add_argument("--fault-spec",
+                        default="serve:p=0.001,seed=2,mode=kill",
+                        help="fault grammar for the capacity phase "
+                             "('' disables)")
+    parser.add_argument("--out", default=None,
+                        help="write the JSON artifact here")
+    args = parser.parse_args()
+    logging.disable(logging.WARNING)   # thousands of simulated rounds
+
+    calib = run_calibration(args.seed, args.calibration_requests)
+    print(json.dumps({"phase": "calibration", **calib}), flush=True)
+    cap = run_capacity(args.seed, args.replicas, args.requests,
+                       args.rate_rps, args.fault_spec)
+    print(json.dumps({"phase": "capacity",
+                      **{k: v for k, v in cap.items()
+                         if k != "violation_rows"}}), flush=True)
+
+    summary = {
+        "metric": "fleet_sim_events_per_s",
+        "value": cap["events_per_s"],
+        "unit": "events/s",
+        **{k: v for k, v in cap.items() if k != "events_per_s"},
+        **calib,
+    }
+    print(json.dumps(summary))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"platform": "cpu", "device_kind": "cpu",
+                       "summary": summary}, f, indent=1)
+            f.write("\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -632,6 +632,30 @@ def on_qos_brownout_level(level: int) -> None:
                  "brownout shed-ladder level").set(level)
 
 
+# --- fleet chaos simulator (serve/fleet/sim.py; docs/fleet_sim.md) -----------
+
+
+def on_sim_run(events: int, checks: int, violations: int) -> None:
+    """One completed fleet-simulation run: events processed, invariant
+    checks evaluated, and violations found (the number that must stay
+    zero — bench_regress gates it with zero tolerance)."""
+    if not _m.enabled():
+        return
+    reg = _reg()
+    reg.counter("hvd_tpu_sim_events_total",
+                "discrete events processed by fleet-sim runs").inc(
+                    events)
+    reg.counter("hvd_tpu_sim_invariant_checks_total",
+                "SLO invariant checks evaluated by fleet-sim "
+                "runs").inc(checks)
+    reg.counter("hvd_tpu_sim_invariant_violations_total",
+                "SLO invariant violations found by fleet-sim "
+                "runs").inc(violations)
+    reg.gauge("hvd_tpu_sim_last_violations",
+              "invariant violations in the most recent fleet-sim "
+              "run").set(violations)
+
+
 # --- autotune decision log ---------------------------------------------------
 
 # Bounded decision log: the JSON snapshot carries it verbatim (the

@@ -79,10 +79,15 @@ class FleetController:
                  scale_in_idle_s: Optional[float] = None,
                  drain_deadline_s: Optional[float] = None,
                  stats_timeout_s: float = 2.0,
-                 qos_gate=None) -> None:
+                 qos_gate=None, clock=None) -> None:
         cfg = resolved_config()
         self._router = router
         self._launcher = launcher
+        # Injectable monotonic clock: drain timers, idle clocks and
+        # swap-roll deadlines read THIS so the fleet simulator
+        # (serve/fleet/sim.py) can run the policy loop under virtual
+        # time; default is the real clock — behavior unchanged.
+        self._clock = clock if clock is not None else time.monotonic
         self._driver = driver   # elastic ElasticDriver (placement), optional
         self.min_per_role = int(min_per_role)
         self.max_replicas = int(max_replicas)
@@ -152,7 +157,7 @@ class FleetController:
         deadline passed)."""
         self._router.drain_replica(name)
         with self._lock:
-            self._draining.setdefault(name, time.monotonic())
+            self._draining.setdefault(name, self._clock())
             self._log_locked("drain", replica=name)
         logger.info("fleet drain started: %s", name)
 
@@ -217,10 +222,10 @@ class FleetController:
                 t.start()
             # ONE deadline for the whole batch: hung replicas must not
             # serially stack a full timeout each.
-            batch_deadline = time.monotonic() + timeout + 10.0
+            batch_deadline = self._clock() + timeout + 10.0
             for t in threads:
                 t.join(timeout=max(0.0,
-                                   batch_deadline - time.monotonic()))
+                                   batch_deadline - self._clock()))
             for name, holder in zip(batch, holders):
                 if not holder:
                     holder.update(ok=False,
@@ -256,10 +261,20 @@ class FleetController:
         """One control round; returns the actions taken (for logs and
         drills).  Cheap by construction: the stats snapshot polls
         replicas concurrently under one deadline."""
-        now = time.monotonic() if now is None else now
+        now = self._clock() if now is None else now
         stats = self._router.replica_stats(timeout=self.stats_timeout_s)
         actions: List[dict] = []
         self._feed_brownout(stats, now)
+        # Brownout counts as fleet-wide busyness (a simulator-found
+        # death spiral, pinned by tests/test_fleet_sim.py): at level >
+        # 0 the ladder is actively hiding demand — queues look calm
+        # precisely BECAUSE traffic is being shed, so an "idle" role is
+        # an artifact of the shed, not spare capacity.  Scaling in here
+        # shrinks the fleet the un-shed backlog is about to re-flood,
+        # re-tripping the ladder: shed → scale-in → overload → shed,
+        # forever.  While the ladder is up no role's idle clock runs.
+        shed_active = bool(getattr(
+            getattr(self._qos_gate, "brownout", None), "level", 0))
         actions += self._finish_drains(stats, now)
         by_role: Dict[str, List[dict]] = {}
         with self._lock:
@@ -294,8 +309,9 @@ class FleetController:
                              and max(ttfts) > self.scale_out_ttft_ms)
                          or (self.scale_out_ttft_ms > 0 and ittfts
                              and max(ittfts) > self.scale_out_ttft_ms))
-            busy = any(q > 0 or e["stats"]["active_slots"] > 0
-                       for q, e in zip(queues, live))
+            busy = (shed_active
+                    or any(q > 0 or e["stats"]["active_slots"] > 0
+                           for q, e in zip(queues, live)))
             with self._lock:
                 if busy:
                     self._idle_since.pop(role, None)

@@ -58,7 +58,8 @@ class BrownoutController:
 
     def __init__(self, *, queue_capacity: int,
                  high: float = 0.75, low: float = 0.25,
-                 hold_s: float = 5.0, slo_ttft_ms: float = 0.0) -> None:
+                 hold_s: float = 5.0, slo_ttft_ms: float = 0.0,
+                 clock=None) -> None:
         if not 0.0 <= low < high:
             raise ValueError(
                 f"brownout thresholds need 0 <= low < high, got "
@@ -68,6 +69,10 @@ class BrownoutController:
         self.low = float(low)
         self.hold_s = float(hold_s)
         self.slo_ttft_ms = float(slo_ttft_ms)
+        # Injectable monotonic clock for the hold/hysteresis timers —
+        # the fleet simulator (serve/fleet/sim.py) runs the ladder
+        # under virtual time; default is the real clock.
+        self._clock = clock if clock is not None else time.monotonic
         self._lock = threading.Lock()
         self._level = 0                    # guarded-by: _lock
         self._calm_since: Optional[float] = None  # guarded-by: _lock
@@ -95,7 +100,7 @@ class BrownoutController:
         """Feed one control-round's signals; returns the (possibly
         stepped) level.  ``now`` is injectable for deterministic
         hysteresis tests."""
-        now = time.monotonic() if now is None else now
+        now = self._clock() if now is None else now
         frac = queue_depth_mean / self.queue_capacity
         slo_breached = (self.slo_ttft_ms > 0
                         and interactive_ttft_p99_ms is not None

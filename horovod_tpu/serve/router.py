@@ -151,13 +151,27 @@ class Router:
                  strikes: Optional[int] = None,
                  probation_s: Optional[float] = None,
                  probe_timeout: float = 5.0,
-                 dedupe_window: int = 1024):
+                 dedupe_window: int = 1024,
+                 clock=None,
+                 client_factory=None):
         if not replicas:
             raise ValueError("router needs at least one replica")
         cfg = resolved_config()
         self._replicas = [_ReplicaState(r) for r in replicas]
         self._key = key
         self._probe_timeout = probe_timeout
+        # Injectable monotonic clock: probation windows and stats
+        # deadlines read THIS, so the fleet simulator (serve/fleet/sim
+        # .py) can run health policy under a virtual clock.  Default is
+        # the real clock — production behavior unchanged.
+        self._clock = clock if clock is not None else time.monotonic
+        # Transport seam: builds the per-replica client instead of
+        # BasicClient.  A deterministic in-process transport (the sim's
+        # replicas, a unit test's fake) answers the same wire frames
+        # without sockets; with a factory installed, stats snapshots
+        # poll serially — there is no network I/O to overlap, and
+        # thread scheduling would perturb a simulation's determinism.
+        self._client_factory = client_factory
         self._strike_limit = int(strikes if strikes is not None
                                  else cfg.serve_replica_strikes)
         self._probation_s = float(probation_s if probation_s is not None
@@ -214,7 +228,7 @@ class Router:
             rep.failed += 1
             rep.client = None    # re-probe on next use
             if fatal or rep.strikes >= self._strike_limit:
-                rep.dead_until = time.monotonic() + self._probation_s
+                rep.dead_until = self._clock() + self._probation_s
                 benched = True
                 logger.warning(
                     "replica %s benched for %.1fs (%d strike(s))",
@@ -281,7 +295,7 @@ class Router:
         the lock before release, so a concurrent wave cannot pile onto
         a possibly-still-dead peer); success rejoins it via
         ``_mark_ok``, failure re-strikes."""
-        now = time.monotonic()
+        now = self._clock()
         with self._lock:
             half_open = [r for r in self._replicas
                          if r.dead_until is not None
@@ -368,12 +382,16 @@ class Router:
             # Probe outside the lock (network I/O); publish under it so
             # concurrent callers converge on one client instead of
             # racing duplicate probes.
-            client = BasicClient(
-                rep.spec.name, rep.spec.addresses, self._key,
-                probe_timeout=self._probe_timeout,
-                # The router owns cross-replica retries; a transparent
-                # same-replica retry here would stack policies.
-                retry_policy=RetryPolicy(attempts=1))
+            if self._client_factory is not None:
+                client = self._client_factory(rep.spec)
+            else:
+                client = BasicClient(
+                    rep.spec.name, rep.spec.addresses, self._key,
+                    probe_timeout=self._probe_timeout,
+                    # The router owns cross-replica retries; a
+                    # transparent same-replica retry here would stack
+                    # policies.
+                    retry_policy=RetryPolicy(attempts=1))
             with self._lock:
                 if rep.client is None:
                     rep.client = client
@@ -602,21 +620,44 @@ class Router:
                 pre = self._pick_role("prefill")
                 dec = self._pick_role("decode")
                 if pre is not None and dec is not None:
-                    resp = run_on(pre, mk_req(
-                        migrate_to=(dec.spec.name, dec.spec.addresses)))
-                    pre_v = getattr(resp, "weights_version", None)
-                    if getattr(resp, "migrated_to", None) is None:
-                        # Migration fell back (digest rejection, wire
-                        # drop, busy receiver): the prefill replica
-                        # finished the generation itself.
-                        self._note_affinity(prefix_key, pre, pre_v)
-                        return resp
-                    self._note_affinity(prefix_key, pre, pre_v)
+                    # Reserve the decode target for the whole
+                    # prefill+migrate window.  ``inflight`` on the
+                    # decode otherwise only rises when the collect
+                    # starts — so N concurrent submits all see the same
+                    # least-loaded decode and the fleet convoys its
+                    # migrations into one receiver (found at simulated
+                    # scale by serve/fleet/sim.py's no_migration_convoy
+                    # invariant).  Inbound migration is load from the
+                    # moment the target is chosen.
+                    with self._lock:
+                        dec.inflight += 1
+                    reserved = True
                     try:
-                        final = run_on(dec, CollectRequest(rid))
-                    except ReplicaUnavailableError:
-                        state["force_unified"] = True
-                        raise
+                        resp = run_on(pre, mk_req(
+                            migrate_to=(dec.spec.name,
+                                        dec.spec.addresses)))
+                        pre_v = getattr(resp, "weights_version", None)
+                        if getattr(resp, "migrated_to", None) is None:
+                            # Migration fell back (digest rejection,
+                            # wire drop, busy receiver): the prefill
+                            # replica finished the generation itself.
+                            self._note_affinity(prefix_key, pre, pre_v)
+                            return resp
+                        self._note_affinity(prefix_key, pre, pre_v)
+                        # Hand the reservation off to the collect: from
+                        # here ``run_on(dec, …)`` carries the count.
+                        with self._lock:
+                            dec.inflight -= 1
+                        reserved = False
+                        try:
+                            final = run_on(dec, CollectRequest(rid))
+                        except ReplicaUnavailableError:
+                            state["force_unified"] = True
+                            raise
+                    finally:
+                        if reserved:
+                            with self._lock:
+                                dec.inflight -= 1
                     if final.error == "unknown_request" or (
                             final.error or "").startswith("import_failed"):
                         # The decode replica lost the continuation
@@ -705,7 +746,7 @@ class Router:
         control round, and with serial polling an N-replica snapshot
         over dead peers stalled N×timeout (the satellite fix this PR
         pins with a dead-replica test)."""
-        now = time.monotonic()
+        now = self._clock()
         entries: List[Dict[str, object]] = []
         with self._lock:
             # Snapshot the health fields UNDER the lock: swap/strike
@@ -745,25 +786,36 @@ class Router:
             except OSError as e:
                 holder["stats_error"] = str(e)
 
-        threads = [threading.Thread(target=fetch, args=(rep, holder),
-                                    daemon=True,
-                                    name=f"stats-{rep.spec.name}")
-                   for rep, holder in zip(reps, holders)]
-        for t in threads:
-            t.start()
-        # One overall deadline (timeout + connect grace), not per
-        # replica: the snapshot returns when the fleet answered or the
-        # clock ran out, whichever is first.
-        deadline = time.monotonic() + timeout + 1.0
-        for t in threads:
-            t.join(max(0.0, deadline - time.monotonic()))
-        out: Dict[str, dict] = {}
-        for idx, (entry, holder, t) in enumerate(zip(entries, holders,
-                                                     threads)):
-            if t.is_alive():
-                entry["stats_error"] = f"timeout after {timeout}s"
-            else:
+        if self._client_factory is not None:
+            # In-process transport (simulation/tests): the "wire" is a
+            # deterministic method call, so there is nothing to overlap
+            # and thread interleaving would only cost reproducibility —
+            # at 1000 simulated replicas per control round, it would
+            # also dominate the simulator's CPU budget.
+            for rep, holder in zip(reps, holders):
+                fetch(rep, holder)
+            for entry, holder in zip(entries, holders):
                 entry.update(holder)
+        else:
+            threads = [threading.Thread(target=fetch, args=(rep, holder),
+                                        daemon=True,
+                                        name=f"stats-{rep.spec.name}")
+                       for rep, holder in zip(reps, holders)]
+            for t in threads:
+                t.start()
+            # One overall deadline (timeout + connect grace), not per
+            # replica: the snapshot returns when the fleet answered or
+            # the clock ran out, whichever is first.
+            deadline = self._clock() + timeout + 1.0
+            for t in threads:
+                t.join(max(0.0, deadline - self._clock()))
+            for entry, holder, t in zip(entries, holders, threads):
+                if t.is_alive():
+                    entry["stats_error"] = f"timeout after {timeout}s"
+                else:
+                    entry.update(holder)
+        out: Dict[str, dict] = {}
+        for idx, entry in enumerate(entries):
             key = str(entry["name"])
             if key in out:   # duplicate display names stay visible
                 key = f"{key}[{idx}]"

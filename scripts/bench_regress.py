@@ -64,9 +64,26 @@ _NON_METRIC_KEYS = {
     # Quotient of two independently-gated wall-clock metrics (int8 peak
     # over exact peak); gating it too double-counts denominator jitter.
     "int8_vs_exact",
+    # Fleet-sim structure (benchmarks/fleet_sim_bench.py): event/check
+    # counts scale with the scenario, and fault/scale/kill tallies ARE
+    # the scenario — the gated signals are the calibration errors, the
+    # violation count (zero-tolerance below), and events_per_s.
+    "events", "replicas", "invariant_checks", "faults_injected",
+    "kills", "scale_out", "scale_in", "level_transitions", "delivered",
+    # The fitted profile and the sim's raw percentiles are calibration
+    # INPUTS/outputs whose job is to MATCH, not to shrink — the gated
+    # signal is calibration_error_*, their relative difference.
+    "profile_ttft_ms_p50", "profile_ttft_ms_p99",
+    "sim_ttft_ms_p50", "sim_ttft_ms_p99",
 }
 
-_LOWER_IS_BETTER_TOKENS = ("_ms", "_us", "time", "latency", "ttft", "tpot")
+_LOWER_IS_BETTER_TOKENS = ("_ms", "_us", "time", "latency", "ttft",
+                           "tpot", "error", "violation")
+
+# Zero-tolerance metrics: the baseline value SHOULD be 0 (invariant
+# violations), so the o == 0 "nothing to regress from" skip in
+# ``compare`` must not wave new ones through — any increase fails.
+_ZERO_TOLERANCE_RE = re.compile(r"violation")
 
 # Override checked FIRST: ratio/rate/acceptance metrics are
 # higher-is-better even when the name also carries a latency token
@@ -149,6 +166,18 @@ def compare(old: dict, new: dict, threshold: float):
     report, regressions = [], []
     for name in sorted(set(old) & set(new)):
         o, v = old[name], new[name]
+        if _ZERO_TOLERANCE_RE.search(name.lower()):
+            # 0 is the healthy baseline here: report each new unit as
+            # +100% (no relative base exists) and fail on ANY increase.
+            change = (v - o) / abs(o) if o else float(v)
+            row = {"metric": name, "old": o, "new": v,
+                   "change_pct": round(change * 100.0, 2),
+                   "direction": "zero_tolerance",
+                   "regressed": v > o}
+            report.append(row)
+            if row["regressed"]:
+                regressions.append(row)
+            continue
         if o == 0:
             # Nothing to regress FROM (outage rounds emit 0.0); only a
             # direction exists when the old value is meaningful.

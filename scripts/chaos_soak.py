@@ -35,13 +35,21 @@ journaled step, byte-identical to an uninterrupted reference.
 stall / kill-mid-flip / partial-fleet) against the chaos drill in
 ``tests/test_swap.py`` — a bursty open-loop load hammered through N
 hot-swaps must drop 0 requests and keep every response token-identical
-to the fixed-weights reference for its version.
+to the fixed-weights reference for its version.  ``--mode sim`` soaks
+the fleet-scale discrete-event simulator (``serve/fleet/sim.py``;
+docs/fleet_sim.md): the step indexes a fault menu spanning the whole
+vocabulary and the drill asserts zero SLO-invariant violations with
+exact request accounting against the real control plane under a
+virtual clock.  ``--modes a,b,c`` runs several modes' loops back to
+back and writes ONE merged summary (per-mode tallies under
+``per_mode``; exit 0 iff every run of every mode passed).
 
 Usage::
 
     python scripts/chaos_soak.py --runs 20 --out chaos_soak.json
     python scripts/chaos_soak.py --runs 5 --mp --master-seed 7
     python scripts/chaos_soak.py --runs 20 --mode serve
+    python scripts/chaos_soak.py --runs 5 --modes sim,qos,swap
 """
 
 from __future__ import annotations
@@ -96,6 +104,15 @@ TARGETS = {
     # interactive p99 TTFT inside the configured SLO while batch sheds
     # and preempts.
     ("qos", False): "tests/test_qos.py",
+    # sim: the fleet-scale discrete-event chaos drill
+    # (tests/test_fleet_sim.py; docs/fleet_sim.md).  The step indexes a
+    # menu spanning the WHOLE fault vocabulary (serve:kill,
+    # migrate-drop + dcn delay, dcn drop, swap:stall mid-roll,
+    # qos:invert, qos:flood) and the seed picks the trace + replica
+    # topology (unified vs prefill/decode); the simulator drives the
+    # REAL controller/router/gate under a virtual clock and must end
+    # with zero SLO-invariant violations and exact request accounting.
+    ("sim", False): "tests/test_fleet_sim.py",
 }
 
 
@@ -175,7 +192,7 @@ def main(argv=None) -> int:
                          "the single-controller one")
     ap.add_argument("--mode",
                     choices=("train", "serve", "dcn", "ckpt", "swap",
-                             "qos"),
+                             "qos", "sim"),
                     default="train",
                     help="'train' loops the elastic-recovery chaos "
                          "tests; 'serve' soaks the serving router under "
@@ -200,7 +217,20 @@ def main(argv=None) -> int:
                          "soaks the multi-tenant scheduler under "
                          "randomized qos:invert/flood fault specs — "
                          "the brownout drill must hold the interactive "
-                         "SLO while batch sheds and preempts")
+                         "SLO while batch sheds and preempts; 'sim' "
+                         "soaks the fleet-scale discrete-event "
+                         "simulator (docs/fleet_sim.md) — the step "
+                         "draws from a menu covering the whole fault "
+                         "vocabulary and the real control plane must "
+                         "keep every SLO invariant with exact request "
+                         "accounting")
+    ap.add_argument("--modes", default=None,
+                    help="comma-separated list of modes (e.g. "
+                         "'sim,qos,swap'): run every listed mode's "
+                         "soak loop back to back and write ONE merged "
+                         "pass/fail summary (per-mode tallies under "
+                         "'per_mode', exit 0 iff every run of every "
+                         "mode passed); overrides --mode")
     ap.add_argument("--sanitize", action="store_true",
                     help="run each iteration under HVD_TPU_SANITIZE=soft "
                          "(hvdsan, docs/lint.md): lock-discipline and "
@@ -224,26 +254,47 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     rng = random.Random(args.master_seed)
-    if (args.mode, args.mp) not in TARGETS:
-        ap.error(f"--mode {args.mode} has no --mp target")
-    target = TARGETS[(args.mode, args.mp)]
+    if args.modes:
+        modes = [m.strip() for m in args.modes.split(",") if m.strip()]
+        if not modes:
+            ap.error("--modes needs at least one mode")
+        bad = [m for m in modes if (m, False) not in TARGETS]
+        if bad:
+            ap.error(f"--modes: unknown mode(s) {', '.join(bad)}")
+    else:
+        modes = [args.mode]
+    for mode in modes:
+        if (mode, args.mp) not in TARGETS:
+            ap.error(f"--mode {mode} has no --mp target")
     flight_root = os.path.abspath(args.flight_root or args.out + ".flight")
     runs = []
-    for i in range(args.runs):
-        step = rng.randrange(0, args.max_step + 1)
-        seed = rng.randrange(0, 1 << 30)
-        print(f"[chaos_soak] run {i + 1}/{args.runs}: "
-              f"target={target} step={step} seed={seed}", flush=True)
-        result = run_once(target, step, seed, args.timeout,
-                          os.path.join(flight_root, f"iter_{i:04d}"),
-                          sanitize=args.sanitize)
-        print(f"[chaos_soak]   -> {'PASS' if result['passed'] else 'FAIL'} "
-              f"({result['duration_s']}s)", flush=True)
-        runs.append(result)
+    for mode in modes:
+        target = TARGETS[(mode, args.mp)]
+        for i in range(args.runs):
+            step = rng.randrange(0, args.max_step + 1)
+            seed = rng.randrange(0, 1 << 30)
+            print(f"[chaos_soak] {mode} run {i + 1}/{args.runs}: "
+                  f"target={target} step={step} seed={seed}", flush=True)
+            # Single-mode keeps the historical iter_NNNN dump-dir names;
+            # a merged soak namespaces per mode so iterations can't
+            # collide across modes.
+            leaf = (f"iter_{i:04d}" if len(modes) == 1
+                    else f"{mode}_iter_{i:04d}")
+            result = run_once(target, step, seed, args.timeout,
+                              os.path.join(flight_root, leaf),
+                              sanitize=args.sanitize)
+            result["mode"] = mode
+            print(f"[chaos_soak]   -> "
+                  f"{'PASS' if result['passed'] else 'FAIL'} "
+                  f"({result['duration_s']}s)", flush=True)
+            runs.append(result)
 
     summary = {
-        "target": target,
-        "mode": args.mode,
+        # Merged across --modes: 'target'/'mode' stay the historical
+        # single-mode scalars when one mode ran, comma-joined otherwise.
+        "target": " ".join(dict.fromkeys(
+            TARGETS[(m, args.mp)] for m in modes)),
+        "mode": ",".join(modes),
         "master_seed": args.master_seed,
         "total": len(runs),
         "passed": sum(r["passed"] for r in runs),
@@ -251,6 +302,18 @@ def main(argv=None) -> int:
         "flight_root": flight_root,
         "runs": runs,
     }
+    if len(modes) > 1:
+        summary["per_mode"] = {
+            m: {
+                "target": TARGETS[(m, args.mp)],
+                "total": sum(r["mode"] == m for r in runs),
+                "passed": sum(r["mode"] == m and r["passed"]
+                              for r in runs),
+                "failed": sum(r["mode"] == m and not r["passed"]
+                              for r in runs),
+            }
+            for m in modes
+        }
     if args.sanitize:
         summary["sanitize"] = True
         summary["sanitizer_findings_total"] = sum(

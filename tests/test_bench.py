@@ -579,6 +579,81 @@ def test_bench_regress_skips_metrics_block(tmp_path):
     assert report["regressions"] == 0
 
 
+def test_bench_regress_zero_tolerance_for_violations(tmp_path):
+    """ISSUE 17 satellite: invariant-violation metrics gate with zero
+    tolerance — the old==0 "nothing to regress from" skip must not
+    wave new violations through (0 -> N is exactly the failure the
+    fleet sim exists to catch)."""
+    old = {"metric": "fleet_sim_events_per_s", "value": 10000.0,
+           "invariant_violations": 0}
+    new = {"metric": "fleet_sim_events_per_s", "value": 10000.0,
+           "invariant_violations": 3}
+    out = _regress(tmp_path, old, new)
+    assert out.returncode == 1
+    report = json.loads(out.stdout)
+    rows = {r["metric"]: r for r in report["rows"]}
+    row = rows["fleet_sim_events_per_s.invariant_violations"]
+    assert row["direction"] == "zero_tolerance"
+    assert row["regressed"] is True
+    # And the reverse (violations FIXED) is an improvement, not a diff
+    # failure.
+    assert _regress(tmp_path, new, old).returncode == 0
+
+
+def test_bench_regress_sim_artifact_shape(tmp_path):
+    """The fleet-sim artifact (benchmarks/fleet_sim_bench.py): event
+    counts and fault tallies are scenario structure (skipped), the
+    calibration errors gate lower-is-better, and a worsened
+    calibration regresses."""
+    base = {"summary": {
+        "metric": "fleet_sim_events_per_s", "value": 14000.0,
+        "replicas": 1000, "requests": 10000, "events": 50000,
+        "sim_wall_time_s": 3.5, "kills": 13, "faults_injected": 13,
+        "invariant_checks": 10000, "invariant_violations": 0,
+        "calibration_error_p50": 0.04, "calibration_error_p99": 0.11,
+        "profile_ttft_ms_p50": 121.9, "profile_ttft_ms_p99": 4508.4}}
+    worse = json.loads(json.dumps(base))
+    worse["summary"]["events"] = 90000        # structure: not gated
+    worse["summary"]["kills"] = 40            # structure: not gated
+    out = _regress(tmp_path, base, worse)
+    assert out.returncode == 0, out.stderr
+    worse["summary"]["calibration_error_p99"] = 0.5
+    out = _regress(tmp_path, base, worse)
+    assert out.returncode == 1
+    rows = {r["metric"]: r
+            for r in json.loads(out.stdout)["rows"]}
+    bad = rows["fleet_sim_events_per_s.calibration_error_p99"]
+    assert bad["direction"] == "lower_is_better"
+    assert bad["regressed"] is True
+
+
+@pytest.mark.sim
+def test_fleet_sim_bench_smoke(tmp_path):
+    """End-to-end fleet_sim_bench at toy scale: runs clean, emits the
+    gated artifact, and bench_regress accepts it against itself."""
+    art = tmp_path / "SIM_smoke.json"
+    run = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "benchmarks", "fleet_sim_bench.py"),
+         "--replicas", "8", "--requests", "400", "--rate-rps", "200",
+         "--calibration-requests", "1500", "--out", str(art)],
+        capture_output=True, text=True, timeout=300)
+    assert run.returncode == 0, run.stderr
+    doc = json.loads(art.read_text())
+    s = doc["summary"]
+    assert s["metric"] == "fleet_sim_events_per_s" and s["value"] > 0
+    assert s["invariant_violations"] == 0
+    # Toy-scale band: 1500 samples put ~15 in the p99 tail, so the
+    # estimator is noisier than the full bench's ±15%.
+    assert s["calibration_error_p99"] < 0.30
+    regress = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "scripts", "bench_regress.py"),
+         str(art), str(art)],
+        capture_output=True, text=True, timeout=60)
+    assert regress.returncode == 0, regress.stderr
+
+
 def test_bench_regress_skips_trace_block(tmp_path):
     """The embedded per-run trace pointer + critical-path report
     (--trace; docs/tracing.md) is diagnostic like "metrics": two
