@@ -56,6 +56,14 @@ def main() -> None:
                         choices=["none", "fp16", "bf16", "int8"],
                         help="gradient-wire compression tier "
                              "(hvd.Compression.<tier>)")
+    parser.add_argument("--layout", action="append", default=None,
+                        metavar="SPEC",
+                        help="repeatable: sweep mesh-plan layouts "
+                             "('data=8', 'data=4,fsdp=2', ...) through "
+                             "the SAME train step — one JSON row per "
+                             "layout with tokens/sec/chip and the "
+                             "modeled per-axis wire bytes "
+                             "(docs/mesh_plan.md)")
     parser.add_argument("--trace", default=None, metavar="DIR",
                         help="write a merged per-run trace artifact "
                              "(Perfetto JSON + critical-path report; "
@@ -127,6 +135,70 @@ def main() -> None:
     per_slot_rows = max(1, batch // n_chips)
     mb_req = args.microbatches or hvd.config().microbatches
     mb = snap_microbatches(mb_req, per_slot_rows)
+    if args.layout:
+        # Layout sweep (docs/mesh_plan.md): every spec rides the SAME
+        # step factory — only the session MeshPlan differs, so rows are
+        # comparable layout-for-layout.  One JSON line per layout
+        # (bench_regress reads the JSONL stream); the modeled per-axis
+        # wire carries the _est suffix so gating skips it.
+        from horovod_tpu import basics as _basics
+
+        stem = ("gpt_medium" if args.preset == "full" else "gpt_tiny")
+        grad_bytes = sum(leaf.size * leaf.dtype.itemsize
+                         for leaf in jax.tree.leaves(params))
+        original_spec = hvd.config().mesh_plan
+        try:
+            for spec in args.layout:
+                plan = hvd.apply_mesh_plan(spec)
+                b_in = shard_batch(inputs, plan.mesh, plan.batch_spec())
+                b_tg = shard_batch(targets, plan.mesh, plan.batch_spec())
+                step = hvd.make_train_step(
+                    loss_fn, tx, donate=False,
+                    microbatches=mb if args.microbatches
+                    else (mb if mb > 1 else None),
+                    overlap=args.overlap, compression=compressor)
+                p = jax.tree.map(jnp.copy, params)
+                s = tx.init(p)
+
+                @partial(jax.jit, donate_argnums=(0, 1))
+                def chunk(p, s):
+                    loss = jnp.zeros((), jnp.float32)
+                    for _ in range(args.steps_per_call):
+                        p, s, loss = step(p, s, (b_in, b_tg))
+                    return p, s, loss
+
+                for _ in range(args.warmup):
+                    p, s, loss = chunk(p, s)
+                if args.warmup:
+                    float(loss)
+                t0 = time.perf_counter()
+                for _ in range(args.iters):
+                    p, s, loss = chunk(p, s)
+                float(loss)
+                dt = time.perf_counter() - t0
+                tps = (batch * seq * args.iters
+                       * args.steps_per_call / dt)
+                tag = spec.replace("=", "").replace(",", "_")
+                row = {
+                    "metric": f"{stem}_train_tokens_per_sec_per_chip"
+                              f"_layout_{tag}",
+                    "value": round(tps / n_chips, 2),
+                    "unit": "tokens/sec/chip",
+                    "vs_baseline": None,
+                    "layout": spec,
+                    "n_params": n_params,
+                    "seq_len": seq,
+                    "microbatches": mb,
+                }
+                for ax, nbytes in sorted(
+                        plan.modeled_wire_bytes(grad_bytes).items()):
+                    row[f"wire_bytes_{ax}_est"] = nbytes
+                print(json.dumps(row))
+                sys.stdout.flush()
+        finally:
+            hvd.apply_mesh_plan(original_spec)
+        return
+
     # An explicit --microbatches (even 1) pins the count; only an unset
     # flag defers to HVD_TPU_MICROBATCHES — so the JSON row always
     # describes the experiment that actually ran.

@@ -24,10 +24,12 @@ from .basics import (  # noqa: F401
     mpi_built, nccl_built, gloo_built, ccl_built, cuda_built, rocm_built,
     ddl_built, xla_built, mpi_enabled, gloo_enabled, xla_enabled,
     mpi_threads_supported,
-    config, global_mesh, start_timeline, stop_timeline,
+    config, global_mesh, mesh_plan, apply_mesh_plan,
+    start_timeline, stop_timeline,
     parameter_manager,
     NotInitializedError,
 )
+from .plan import MeshPlan  # noqa: F401
 from .config import Config  # noqa: F401
 from .process_sets import (  # noqa: F401
     ProcessSet, add_process_set, remove_process_set, global_process_set,

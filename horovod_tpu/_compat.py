@@ -30,8 +30,10 @@ def enable_x64(new_val: bool = True):
     return _enable_x64(new_val)
 
 
-def axis_size(axis: str) -> int:
-    """Static width of a named mesh axis inside an SPMD region.
+def axis_size(axis) -> int:
+    """Static width of a named mesh axis inside an SPMD region.  A
+    tuple of names (a multi-axis MeshPlan's reduce wire) is the product
+    of the per-name widths.
 
     ``jax.lax.axis_size`` only exists on newer jax; older versions
     resolve the width from the abstract mesh (shard_map regions) or, as
@@ -40,6 +42,11 @@ def axis_size(axis: str) -> int:
     import jax
     from jax import lax
 
+    if isinstance(axis, (tuple, list)):
+        n = 1
+        for a in axis:
+            n *= axis_size(a)
+        return n
     if hasattr(lax, "axis_size"):
         return lax.axis_size(axis)
     try:

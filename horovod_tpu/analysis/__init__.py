@@ -43,12 +43,13 @@ def default_checkers() -> List[type]:
     from .pallas import PallasChecker
     from .protocol import ProtocolChecker
     from .rank_divergence import RankDivergenceChecker
-    from .registries import (FaultSiteChecker, MetricNameChecker,
-                             SpanNameChecker)
+    from .registries import (FaultSiteChecker, MeshAxisChecker,
+                             MetricNameChecker, SpanNameChecker)
     from .waits import WaitChecker
     return [RankDivergenceChecker, KnobChecker, LockChecker,
-            FaultSiteChecker, MetricNameChecker, SpanNameChecker,
-            ProtocolChecker, WaitChecker, PallasChecker]
+            FaultSiteChecker, MeshAxisChecker, MetricNameChecker,
+            SpanNameChecker, ProtocolChecker, WaitChecker,
+            PallasChecker]
 
 
 def repo_root() -> Path:

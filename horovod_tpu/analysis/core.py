@@ -91,6 +91,10 @@ CHECK_CATALOG: "Dict[str, Tuple[str, str]]" = {
     "fault-site-doc-drift": (
         "error", "fault site in the config.py grammar missing from "
                  "docs/fault_injection.md"),
+    "unknown-mesh-axis": (
+        "error", "literal mesh-axis name (PartitionSpec entry, axis= "
+                 "keyword, or *_axis default) absent from the "
+                 "config.py MESH_AXES plan catalog"),
     "metric-name": (
         "error", "obs metric violates naming rules (hvd_tpu_ prefix; "
                  "counters end _total, others must not)"),

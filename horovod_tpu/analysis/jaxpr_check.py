@@ -267,4 +267,35 @@ def run_jaxpr_checks(microbatches: int = 2) -> List[Finding]:
                 f"compiles {topo_scheds[0]}, rank 1 compiles "
                 f"{topo_scheds[1]} — the schedule IR must be identical "
                 f"on every rank"))
+
+    # 7. Planner-built steps (horovod_tpu/plan/): a MeshPlan-derived
+    # train step — multi-axis reduce wire, plan-registered process sets
+    # — must be just as rank-invariant as the legacy 1-D step.  The
+    # plan is installed the way init() installs it (compile + process-
+    # set registration under a config override), restored in finally.
+    if world > 1 and world % 2 == 0:
+        import dataclasses
+
+        from .. import plan as _plan_mod
+
+        with basics._state.lock:
+            old_cfg = basics._state.config
+            old_plan = basics._state.mesh_plan
+        spec = f"data={world // 2},fsdp=2"
+        plan_cfg = dataclasses.replace(old_cfg, mesh_plan=spec)
+        try:
+            with basics._state.lock:
+                basics._state.config = plan_cfg
+                basics._state.mesh_plan = _plan_mod.compile_plan(spec)
+                basics._state.mesh_plan.register_process_sets(
+                    basics._state.process_sets)
+            findings += check_step_rank_consistency(
+                lambda: make_train_step(loss_fn, tx),
+                lambda: (params, tx.init(params), batch),
+                path="horovod_tpu/plan/mesh_plan.py",
+                what=f"make_train_step(mesh_plan={spec})")
+        finally:
+            with basics._state.lock:
+                basics._state.config = old_cfg
+                basics._state.mesh_plan = old_plan
     return findings

@@ -17,6 +17,13 @@ checked against their single sources of truth:
   counters end ``_total``, gauges/histograms must not — and appear in
   the ``docs/metrics.md`` catalog.  Dashboards are written against the
   docs; an undocumented series is invisible operational surface.
+* **Mesh axes** (``unknown-mesh-axis``).  ``config.MESH_AXES`` is the
+  planner's axis vocabulary (``horovod_tpu/plan/``).  Every literal
+  axis name in a ``PartitionSpec``/``P(...)``, every string passed to
+  an ``axis``/``axis_name``/``*_axis`` keyword, and every such
+  parameter default must come from that catalog — a typo'd axis name
+  builds a mesh/sharding that silently diverges from the plan's
+  derived wiring instead of failing loudly.
 * **Span names** (``span-name`` / ``span-doc-drift``).  Literal span
   names passed to the tracing layer (``trace.span("…")`` /
   ``trace.record_span("…")`` / ``trace.instant("…")`` on any
@@ -284,6 +291,88 @@ class SpanNameChecker(Checker):
                     "span-doc-drift", path, line,
                     f"span {name!r} is recorded but missing from the "
                     f"{self.cfg.tracing_doc} span catalog")
+
+
+_SPEC_CALLS = ("P", "PartitionSpec")
+_AXIS_KWARGS = ("axis", "axis_name")
+
+
+def _is_axis_param(name: str) -> bool:
+    return name in _AXIS_KWARGS or name.endswith("_axis")
+
+
+class MeshAxisChecker(Checker):
+    """``unknown-mesh-axis``: literal axis names must come from the
+    ``config.MESH_AXES`` planner vocabulary (the MeshPlan axis catalog,
+    docs/mesh_plan.md).  Covered positions: positional entries of
+    ``P(...)``/``PartitionSpec(...)`` (including tuple entries — the
+    multi-axis reduce wire), string values of ``axis``/``axis_name``/
+    ``*_axis`` keywords on any call, and string defaults of parameters
+    with those names."""
+
+    checks = ("unknown-mesh-axis",)
+
+    def __init__(self, cfg: LintConfig) -> None:
+        super().__init__(cfg)
+        self.axes: Set[str] = set()
+        self.refs: list = []       # (path, line, name, where)
+
+    def _collect(self, mod: SourceModule, node: ast.expr,
+                 where: str) -> None:
+        elts = (node.elts if isinstance(node, (ast.Tuple, ast.List))
+                else [node])
+        for e in elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                self.refs.append((mod.path, e.lineno, e.value, where))
+
+    def check_module(self, mod: SourceModule) -> None:
+        if mod.path.endswith("/config.py"):
+            for node in mod.tree.body:
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == "MESH_AXES"
+                        for t in node.targets):
+                    if isinstance(node.value, (ast.Tuple, ast.List)):
+                        self.axes = {
+                            e.value for e in node.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                if _terminal(node.func) in _SPEC_CALLS:
+                    for arg in node.args:
+                        self._collect(mod, arg, "PartitionSpec entry")
+                for kw in node.keywords:
+                    if kw.arg and _is_axis_param(kw.arg):
+                        self._collect(mod, kw.value,
+                                      f"{kw.arg}= keyword")
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                a = node.args
+                pos = a.posonlyargs + a.args
+                for param, default in zip(pos[len(pos)
+                                              - len(a.defaults):],
+                                          a.defaults):
+                    if _is_axis_param(param.arg) and default is not None:
+                        self._collect(mod, default,
+                                      f"{param.arg}= default")
+                for param, default in zip(a.kwonlyargs, a.kw_defaults):
+                    if _is_axis_param(param.arg) and default is not None:
+                        self._collect(mod, default,
+                                      f"{param.arg}= default")
+
+    def finalize(self) -> None:
+        if not self.axes:
+            raise RuntimeError("hvdlint: config.MESH_AXES not found — "
+                               "mesh-axis checks need the axis catalog")
+        for path, line, name, where in self.refs:
+            if name not in self.axes:
+                self.emit(
+                    "unknown-mesh-axis", path, line,
+                    f"axis name {name!r} ({where}) is not in the "
+                    f"config.MESH_AXES plan catalog "
+                    f"{tuple(sorted(self.axes))} — a typo'd axis "
+                    f"silently diverges from the MeshPlan wiring "
+                    f"(docs/mesh_plan.md)")
 
 
 def _trace_receiver(func: ast.expr) -> bool:

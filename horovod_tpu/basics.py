@@ -67,6 +67,8 @@ class _GlobalState:
         self.initialized: bool = False   # guarded-by: lock
         self.config: Optional[Config] = None   # guarded-by: lock
         self.mesh = None            # guarded-by: lock (horovod_tpu.mesh.GlobalMesh)
+        self.mesh_plan = None       # guarded-by: lock (plan.MeshPlan — the session parallelism plan)
+        self.layout_lattice = None  # guarded-by: lock (autotune layout specs; index 1 = the live plan)
         self.process_sets = None    # guarded-by: lock (process_sets.ProcessSetTable)
         self.timeline = None        # guarded-by: lock (utils.timeline.Timeline)
         self.stall_inspector = None  # guarded-by: lock
@@ -177,6 +179,14 @@ def init(config: Optional[Config] = None) -> None:
         _state.config = cfg
         _state.mesh = GlobalMesh.build(axis_name=cfg.mesh_axis_name)
         _state.process_sets = _ps.ProcessSetTable(_state.mesh)
+        # The session parallelism plan (docs/mesh_plan.md): unset knob →
+        # the 1-D default plan wrapping the global mesh (bit-identical
+        # legacy wiring); a declared HVD_TPU_MESH_PLAN builds the named
+        # layout and registers one process set per axis group.
+        from . import plan as _plan
+
+        _state.mesh_plan = _plan.compile_plan(cfg.mesh_plan)
+        _state.mesh_plan.register_process_sets(_state.process_sets)
         _state.timeline = Timeline(_per_process_path(cfg.timeline),
                                    mark_cycles=cfg.timeline_mark_cycles)
         _state.stall_inspector = StallInspector(
@@ -362,6 +372,24 @@ def _maybe_build_parameter_manager(cfg):
         initial["topo_kernel"] = (
             _KERNEL_LATTICE.index(cfg.topo_kernel) + 1
             if cfg.topo_kernel in _KERNEL_LATTICE else 1)
+    if cfg.mesh_plan is not None and size > 1:
+        # Layout search (docs/mesh_plan.md): with a declared plan the
+        # GP also searches 2-D DP×FSDP splits of the same world — index
+        # 1 is the LIVE layout (scores attribute to what the job runs),
+        # later indices the progressively deeper fsdp splits from
+        # plan.layout_lattice.  Applied at the re-jit boundary like
+        # every other trace-time knob: the plan (and its mesh) rebuild,
+        # and the step factory re-resolves them on the next trace.
+        from . import plan as _plan
+
+        layouts = _plan.layout_lattice(size)
+        if cfg.mesh_plan in layouts:
+            layouts.remove(cfg.mesh_plan)
+        layouts = [cfg.mesh_plan] + layouts
+        if len(layouts) > 1:
+            knobs["layout"] = (1, len(layouts))
+            initial["layout"] = 1
+            _state.layout_lattice = layouts  # hvdlint: disable=unguarded-mutation -- runs under init()'s `with _state.lock:` (sole caller)
     if joint:
         # log2 search over [1, size]; proposals snap to the nearest
         # divisor of the slot count (1 and size both mean "flat"
@@ -553,11 +581,30 @@ def _apply_autotuned_knobs(values) -> dict:
                   len(_KERNEL_LATTICE))
         updates["topo_kernel"] = _KERNEL_LATTICE[idx - 1]
         applied["topo_kernel"] = idx
+    if "layout" in values:
+        with st.lock:
+            layouts = st.layout_lattice
+        if layouts:
+            idx = min(max(1, int(round(values["layout"]))), len(layouts))
+            updates["mesh_plan"] = layouts[idx - 1]
+            applied["layout"] = idx
     # The swap races with concurrent trace-time config() readers
     # (serving threads, a re-jitting train step) — publish under the
     # state lock like every other _state mutation.
     with st.lock:
+        relayout = "mesh_plan" in updates \
+            and updates["mesh_plan"] != st.config.mesh_plan
         st.config = dataclasses.replace(st.config, **updates)
+        if relayout:
+            # A layout flip rebuilds the session plan (new mesh, new
+            # axis process sets) — the caller's re-jit then re-resolves
+            # mesh/axis/shardings from the fresh plan on its next trace.
+            from . import plan as _plan
+            from .obs import instrument as _obs
+
+            st.mesh_plan = _plan.compile_plan(st.config.mesh_plan)
+            st.mesh_plan.register_process_sets(st.process_sets)
+            _obs.on_plan_relayout()
     return applied
 
 
@@ -658,6 +705,8 @@ def shutdown() -> None:
         if _state.parameter_manager is not None:
             _state.parameter_manager.close()
         _state.mesh = None
+        _state.mesh_plan = None
+        _state.layout_lattice = None
         _state.process_sets = None
         _state.timeline = None
         _state.stall_inspector = None
@@ -819,6 +868,36 @@ def global_mesh():
     """The framework-owned global 1-D device mesh (TPU-native concept;
     replaces the reference's global MPI/Gloo communicator)."""
     return _require("mesh")
+
+
+def mesh_plan():
+    """The session :class:`~horovod_tpu.plan.MeshPlan` — the single
+    source of truth every parallelism entry point derives its axes,
+    shardings, process sets and topo tiers from (docs/mesh_plan.md).
+    Unset ``HVD_TPU_MESH_PLAN`` → the 1-D default plan over
+    :func:`global_mesh`."""
+    return _require("mesh_plan")
+
+
+def apply_mesh_plan(spec):
+    """Rebuild the session plan from an axis spec (``"data=4,fsdp=2"``;
+    ``None`` restores the 1-D default) — the public relayout entry the
+    benchmark layout sweep uses.  Steps built BEFORE the swap keep
+    their traced wiring; rebuild them (or let the autotuner's re-jit do
+    it) to pick up the new plan.  Returns the new plan."""
+    import dataclasses
+
+    from . import plan as _plan
+    from .obs import instrument as _obs
+
+    st = _require_init()
+    plan = _plan.compile_plan(spec)
+    with st.lock:
+        st.config = dataclasses.replace(st.config, mesh_plan=spec)
+        st.mesh_plan = plan
+        plan.register_process_sets(st.process_sets)
+    _obs.on_plan_relayout()
+    return plan
 
 
 def timeline():

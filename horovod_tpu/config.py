@@ -237,6 +237,86 @@ TOPO_SCHEDULES = ("off", "auto", "flat", "two_phase", "hierarchical")
 TOPO_KERNELS = ("spmd", "pallas")
 
 
+# --- mesh-plan axis grammar (HVD_TPU_MESH_PLAN) ------------------------------
+# ``axis=size,axis=size`` — e.g. ``data=4,fsdp=2`` declares a 2-D layout
+# over the global device set.  Parsed here (like the fault and topo
+# grammars) so a typo'd layout fails loudly at init, and so hvdlint's
+# ``unknown-mesh-axis`` checker can discover the axis catalog from this
+# module's AST without importing jax.
+#
+# The catalog is the CLOSED namespace of mesh-axis names: the planner
+# axes (``data``/``fsdp``/``tensor``/``pipe``/``expert`` — the
+# MeshPlan vocabulary of horovod_tpu/plan/) plus the legacy short names
+# the pre-plan entry points standardized on (``hvd`` for the 1-D global
+# mesh, ``dp``/``tp``/``sp``/``pp``/``ep`` for parallel/).  Any string
+# axis name passed to a collective or sharding must come from this
+# tuple (docs/lint.md: ``unknown-mesh-axis``).
+MESH_AXES = ("data", "fsdp", "tensor", "pipe", "expert",
+             "hvd", "dp", "tp", "sp", "pp", "ep")
+
+
+def parse_mesh_plan(spec: str,
+                    world_size: Optional[int] = None) -> "dict[str, int]":
+    """Parse ``HVD_TPU_MESH_PLAN`` (``data=4,fsdp=2``) into an ordered
+    ``{axis: size}`` map.  Axis names must come from :data:`MESH_AXES`;
+    sizes must be positive ints; duplicate (overlapping) axes are
+    rejected.  With ``world_size`` the axis sizes must factor the device
+    count exactly — a plan that silently dropped devices would be a
+    wrong-answer wire, not a slow one."""
+    out: "dict[str, int]" = {}
+    for raw in spec.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        key, sep, val = raw.partition("=")
+        key, val = key.strip(), val.strip()
+        if not sep or not key or not val:
+            raise ValueError(
+                f"mesh plan: expected axis=size entries, got {raw!r}")
+        if key not in MESH_AXES:
+            raise ValueError(
+                f"mesh plan: unknown axis {key!r}; expected one of "
+                f"{MESH_AXES}")
+        if key in out:
+            raise ValueError(
+                f"mesh plan: axis {key!r} appears twice — each axis "
+                f"names one disjoint factor of the device set")
+        try:
+            size = int(val)
+        except ValueError as e:
+            raise ValueError(
+                f"mesh plan: bad size {val!r} for axis {key!r}") from e
+        if size < 1:
+            raise ValueError(
+                f"mesh plan: size for axis {key!r} must be >= 1, "
+                f"got {size}")
+        out[key] = size
+    if not out:
+        raise ValueError("mesh plan: empty spec (expected e.g. "
+                         "'data=4,fsdp=2')")
+    if world_size is not None:
+        prod = 1
+        for size in out.values():
+            prod *= size
+        if prod != world_size:
+            raise ValueError(
+                f"mesh plan: axis sizes {dict(out)} multiply to {prod} "
+                f"but the mesh has {world_size} devices — the plan must "
+                f"factor the device count exactly (e.g. "
+                f"'data={world_size}' or a divisor split)")
+    return out
+
+
+def _validated_mesh_plan(spec: Optional[str]) -> Optional[str]:
+    """Empty/unset → None; anything else must parse (fail at init).
+    The device-count divisibility check runs at plan-build time, when
+    the mesh is known."""
+    if not spec or not spec.strip():
+        return None
+    parse_mesh_plan(spec)  # raises ValueError on a malformed spec
+    return spec
+
+
 @dataclasses.dataclass(frozen=True)
 class FaultClause:
     """One parsed clause of a fault spec: what fires at one site.
@@ -594,6 +674,7 @@ class Config:
 
     # --- TPU-specific (no reference analogue) ---
     mesh_axis_name: str = "hvd"               # HVD_TPU_MESH_AXIS_NAME
+    mesh_plan: Optional[str] = None           # HVD_TPU_MESH_PLAN ("data=4,fsdp=2" axis layout; unset = 1-D data plan)
     use_native_planner: bool = True           # HVD_TPU_USE_NATIVE_PLANNER (C++ fusion planner)
     native_coordinator: bool = True           # HVD_TPU_NATIVE_COORD (cross-process stall monitor)
 
@@ -707,6 +788,7 @@ class Config:
             fault_spec=_validated_fault_spec(_env("FAULT_SPEC")),
             cache_capacity=_env_opt_int("CACHE_CAPACITY"),
             mesh_axis_name=_env("MESH_AXIS_NAME", "hvd") or "hvd",
+            mesh_plan=_validated_mesh_plan(_env("MESH_PLAN")),
             use_native_planner=_env_bool("USE_NATIVE_PLANNER", True),
             native_coordinator=_env_bool("NATIVE_COORD", True),
         )

@@ -37,6 +37,7 @@ __all__ = [
     "on_ckpt_restore", "on_ckpt_journal", "on_ckpt_coalesced",
     "on_ckpt_inflight", "on_qos_shed", "on_qos_preempt",
     "on_qos_budget_reject", "on_qos_brownout_level",
+    "plan_compile_span", "set_plan_axes", "on_plan_relayout",
 ]
 
 
@@ -316,6 +317,41 @@ def on_topo_estimator(tier: str, alpha_us: float,
     reg.gauge("hvd_tpu_topo_cost_beta_gbps",
               "estimated per-hop bandwidth, by tier").labels(
                   tier=tier).set(beta_gbps)
+
+
+# --- mesh plan (horovod_tpu/plan/; docs/mesh_plan.md) ------------------------
+
+def plan_compile_span(spec: str):
+    """Span around one :func:`plan.compile_plan` build — mesh
+    construction plus per-axis process-set registration.  Rooted: plan
+    compiles happen at init and at autotune re-layout boundaries, never
+    inside a step dispatch."""
+    return _trace.span("hvd_tpu_plan_compile", root=True,
+                       args={"spec": spec})
+
+
+def set_plan_axes(axes: Dict[str, int]) -> None:
+    """Publish the live plan's axis sizes (one gauge series per declared
+    axis — the closed MESH_AXES set bounds cardinality).  Stale axes
+    from a previous layout keep their last value; the relayout counter
+    marks which scrape windows straddle a flip."""
+    if not _m.enabled():
+        return
+    reg = _reg()
+    for axis, size in axes.items():
+        reg.gauge("hvd_tpu_plan_axes",
+                  "live mesh-plan axis sizes, by axis").labels(
+                      axis=axis).set(size)
+
+
+def on_plan_relayout() -> None:
+    """One autotune layout flip: the session plan was rebuilt (new mesh
+    factorization + process sets) at a re-jit boundary."""
+    if not _m.enabled():
+        return
+    _reg().counter("hvd_tpu_plan_relayouts_total",
+                   "mesh-plan layout rebuilds (autotune re-jit "
+                   "boundaries)").inc()
 
 
 # --- durable state (horovod_tpu/ckpt/; docs/checkpointing.md) ----------------

@@ -381,11 +381,18 @@ def DistributedOptimizer(
             return cfg.error_feedback
         return False
 
-    def _axis() -> str:
+    def _axis():
         if axis_name is not None:
             return axis_name
         from .. import basics
 
+        plan = basics.peek("mesh_plan")
+        if plan is not None:
+            # The session plan's derived reduce wire: the bare legacy name
+            # for 1-D plans (bit-identical), a name tuple for multi-axis
+            # layouts.  Resolved at trace time so a layout flip re-jit
+            # picks up the new wire.
+            return plan.reduce_axis()
         return (basics.config().mesh_axis_name
                 if basics.is_initialized() else "hvd")
 
@@ -400,7 +407,9 @@ def DistributedOptimizer(
     def _groups():
         if process_set is None:
             return None, None
-        groups = process_set.axis_index_groups()
+        from .. import plan as _plan_mod
+
+        groups = _plan_mod.collective_groups(process_set)
         member_groups = [list(process_set.ranks)] if groups else None
         return groups, member_groups
 
@@ -505,14 +514,35 @@ def DistributedOptimizer(
 
 
 def resolve_mesh_axis(mesh, axis_name: Optional[str]):
-    """(mesh_obj, axis) for a train-step builder: the framework mesh by
-    default, or an explicit ``jax.sharding.Mesh`` with its first axis."""
+    """(mesh_obj, axis) for a train-step builder: the session
+    :class:`~horovod_tpu.plan.MeshPlan` by default (its mesh and its
+    derived gradient-reduce axis — the bare legacy name for 1-D plans, a
+    name tuple for multi-axis layouts), or an explicit
+    ``jax.sharding.Mesh`` with its first axis.  An explicit ``axis_name``
+    always wins."""
     from .. import basics
 
     if mesh is None:
+        plan = basics.peek("mesh_plan")
+        if plan is not None:
+            if axis_name is None:
+                return plan.mesh, plan.reduce_axis()
+            if plan.has_axis(axis_name):
+                return plan.mesh, axis_name
         gm = basics.global_mesh()
         return gm.mesh, (axis_name or gm.axis_name)
     return mesh, (axis_name or list(mesh.axis_names)[0])
+
+
+def axis_width(mesh_obj, axis) -> int:
+    """Participant count of one reduce wire: the axis size, or the
+    product over a multi-axis plan's name tuple."""
+    if isinstance(axis, (tuple, list)):
+        n = 1
+        for a in axis:
+            n *= int(mesh_obj.shape[a])
+        return n
+    return int(mesh_obj.shape[axis])
 
 
 def make_train_step(
@@ -559,9 +589,9 @@ def make_train_step(
     ``aux`` comes back stacked ``[microbatches, ...]`` per slot.
     """
     from .. import basics
+    from .. import plan as _plan_mod
 
     _check_reduce_args(op, compression)
-    mesh_obj, axis = resolve_mesh_axis(mesh, axis_name)
 
     # Does the optimizer itself allreduce?  Decided at trace time by
     # inspecting the *actual* optimizer state for a
@@ -581,10 +611,6 @@ def make_train_step(
                      is_leaf=lambda n: isinstance(n, DistributedOptimizerState))
         return found
 
-    groups = process_set.axis_index_groups() if process_set is not None else None
-    member_groups = ([list(process_set.ranks)]
-                     if process_set is not None and groups else None)
-
     def _threshold():
         return (basics.config().fusion_threshold
                 if basics.is_initialized() else 64 * 1024 * 1024)
@@ -603,77 +629,92 @@ def make_train_step(
             return cfg.cost_alpha_us, cfg.cost_beta_gbps
         return DEFAULT_COST_ALPHA_US, DEFAULT_COST_BETA_GBPS
 
-    def per_slot_step(params, opt_state, batch):
-        reduce_here = (distributed if distributed is not None
-                       else not _contains_dist_state(opt_state))
-        comp = _resolve_compression(compression)
-        if (reduce_here and compression is None
-                and comp is not Compression.none):
-            # Config/autotune-driven lossy tier on a path with no EF
-            # residual (EF state lives in DistributedOptimizer /
-            # make_zero_train_step): legitimate, but the bias
-            # accumulates unchecked over long runs — say so once.
-            global _lossy_no_ef_warned
-            if not _lossy_no_ef_warned:
-                _lossy_no_ef_warned = True
-                logger.warning(
-                    "HVD_TPU_COMPRESSION drives a lossy gradient wire "
-                    "on a step without error-feedback state; wrap the "
-                    "optimizer in DistributedOptimizer("
-                    "error_feedback=True) to carry the residual on "
-                    "long runs")
-        grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
-        mb = _resolve_microbatches(microbatches, batch)
-        reduced = False
-        if mb > 1:
-            alpha_us, beta_gbps = _cost_knobs()
-            loss, grads, aux, reduced = _microbatch_grads(
-                grad_fn, params, batch, mb, has_aux=has_aux,
-                overlap=(_overlap_on() and reduce_here
-                         and op != C.Adasum),
-                spmd_op="average" if op == C.Average else "sum",
-                axis=axis, groups=groups, compression=comp,
-                threshold=_threshold(), alpha_us=alpha_us,
-                beta_gbps=beta_gbps)
-        elif has_aux:
-            (loss, aux), grads = grad_fn(params, batch)
-        else:
-            loss, grads = grad_fn(params, batch)
-            aux = None
-        if reduce_here and not reduced:
-            grads = _allreduce_grads(
-                grads, op=op, axis=axis,
-                groups=member_groups if op == C.Adasum else groups,
-                compression=comp, threshold=_threshold(),
-                two_phase=two_phase, pipeline_depth=pipeline_depth,
-            )
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        loss = spmd.allreduce(loss, op="average", axis=axis, groups=groups)
-        if has_aux:
-            # Per-slot aux values come back stacked [size, ...]; add the
-            # slot axis so scalars survive out_specs=P(axis).
-            aux = jax.tree.map(lambda a: jnp.asarray(a)[None], aux)
-            return params, opt_state, loss, aux
-        return params, opt_state, loss
+    def _build_body():
+        # Resolved INSIDE the builder (not at make time): the autotuner's
+        # layout knob swaps the session MeshPlan at a re-jit boundary,
+        # and rebuild() must pick up the new mesh + reduce axis + groups
+        # — the same trace-time contract as every other tuned knob.
+        mesh_obj, axis = resolve_mesh_axis(mesh, axis_name)
+        groups = _plan_mod.collective_groups(process_set)
+        member_groups = ([list(process_set.ranks)]
+                         if process_set is not None and groups else None)
 
-    body = shard_map(
-        per_slot_step,
-        mesh=mesh_obj,
-        in_specs=(P(), P(), P(axis)),
-        out_specs=(P(), P(), P()) + ((P(axis),) if has_aux else ()),
-        check=False,
-    )
+        def per_slot_step(params, opt_state, batch):
+            reduce_here = (distributed if distributed is not None
+                           else not _contains_dist_state(opt_state))
+            comp = _resolve_compression(compression)
+            if (reduce_here and compression is None
+                    and comp is not Compression.none):
+                # Config/autotune-driven lossy tier on a path with no EF
+                # residual (EF state lives in DistributedOptimizer /
+                # make_zero_train_step): legitimate, but the bias
+                # accumulates unchecked over long runs — say so once.
+                global _lossy_no_ef_warned
+                if not _lossy_no_ef_warned:
+                    _lossy_no_ef_warned = True
+                    logger.warning(
+                        "HVD_TPU_COMPRESSION drives a lossy gradient wire "
+                        "on a step without error-feedback state; wrap the "
+                        "optimizer in DistributedOptimizer("
+                        "error_feedback=True) to carry the residual on "
+                        "long runs")
+            grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
+            mb = _resolve_microbatches(microbatches, batch)
+            reduced = False
+            if mb > 1:
+                alpha_us, beta_gbps = _cost_knobs()
+                loss, grads, aux, reduced = _microbatch_grads(
+                    grad_fn, params, batch, mb, has_aux=has_aux,
+                    overlap=(_overlap_on() and reduce_here
+                             and op != C.Adasum),
+                    spmd_op="average" if op == C.Average else "sum",
+                    axis=axis, groups=groups, compression=comp,
+                    threshold=_threshold(), alpha_us=alpha_us,
+                    beta_gbps=beta_gbps)
+            elif has_aux:
+                (loss, aux), grads = grad_fn(params, batch)
+            else:
+                loss, grads = grad_fn(params, batch)
+                aux = None
+            if reduce_here and not reduced:
+                grads = _allreduce_grads(
+                    grads, op=op, axis=axis,
+                    groups=member_groups if op == C.Adasum else groups,
+                    compression=comp, threshold=_threshold(),
+                    two_phase=two_phase, pipeline_depth=pipeline_depth,
+                )
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            loss = spmd.allreduce(loss, op="average", axis=axis,
+                                  groups=groups)
+            if has_aux:
+                # Per-slot aux values come back stacked [size, ...]; add
+                # the slot axis so scalars survive out_specs=P(axis).
+                aux = jax.tree.map(lambda a: jnp.asarray(a)[None], aux)
+                return params, opt_state, loss, aux
+            return params, opt_state, loss
+
+        return shard_map(
+            per_slot_step,
+            mesh=mesh_obj,
+            in_specs=(P(), P(), P(axis)),
+            out_specs=(P(), P(), P()) + ((P(axis),) if has_aux else ()),
+            check=False,
+        )
+
     donate_argnums = (0, 1) if donate else ()
 
     def build():
         # A fresh jit wrapper re-traces, so trace-time reads of
         # config().fusion_threshold (here and inside a wrapped
-        # DistributedOptimizer) pick up autotune proposals.  The obs
-        # wrapper records step wall time / tokens per dispatch (no-op
-        # when HVD_TPU_METRICS=0 — it returns the jitted step itself).
+        # DistributedOptimizer) pick up autotune proposals; the body
+        # itself is also rebuilt so a layout flip re-derives mesh +
+        # axis + groups from the new session plan.  The obs wrapper
+        # records step wall time / tokens per dispatch (no-op when
+        # HVD_TPU_METRICS=0 — it returns the jitted step itself).
         return _obs.wrap_step(
-            jax.jit(body, donate_argnums=donate_argnums), kind="train")
+            jax.jit(_build_body(), donate_argnums=donate_argnums),
+            kind="train")
 
     pm = basics.peek("parameter_manager")   # fail-soft: None pre-init
     if pm is not None and not pm.frozen:

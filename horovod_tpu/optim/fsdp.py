@@ -38,15 +38,12 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 def fsdp_spec(leaf, n: int, axis: str) -> P:
     """PartitionSpec sharding ``leaf``'s largest ``n``-divisible axis;
     replicated when nothing divides (small biases/scalars — their bytes
-    don't matter)."""
-    shape = getattr(leaf, "shape", ())
-    candidates = [(s, i) for i, s in enumerate(shape) if s % n == 0 and s >= n]
-    if not candidates:
-        return P()
-    _, dim = max(candidates)
-    spec = [None] * len(shape)
-    spec[dim] = axis
-    return P(*spec)
+    don't matter).  Shim over the planner's parameter-placement rule
+    (:func:`horovod_tpu.plan.fsdp_param_spec`) — kept so existing
+    callers keep their import path."""
+    from ..plan import fsdp_param_spec
+
+    return fsdp_param_spec(leaf, n, axis)
 
 
 def unshard_matmul(x, w_shard, *, axis: str = "hvd", groups=None,
@@ -138,7 +135,22 @@ def make_fsdp_train_step(
             "make_zero_train_step)")
     del pipeline_depth  # partitioner-scheduled; accepted for uniformity
 
-    mesh_obj, axis = resolve_mesh_axis(mesh, axis_name)
+    from .. import basics
+
+    plan = basics.peek("mesh_plan")
+    if mesh is None and axis_name is None and plan is not None:
+        # Derive the FSDP wiring from the session plan: parameters
+        # shard over the plan's shard axis (``fsdp`` when declared; the
+        # sole data axis of a 1-D plan — the legacy behavior), and a
+        # declared ``data`` axis alongside ``fsdp`` selects HSDP
+        # (replicate params across data, shard over fsdp) without the
+        # caller threading dp_axis by hand.
+        mesh_obj = plan.mesh
+        axis = plan.shard_axis() or plan.axis_names[0]
+        if dp_axis is None and axis == "fsdp" and plan.has_axis("data"):
+            dp_axis = "data"
+    else:
+        mesh_obj, axis = resolve_mesh_axis(mesh, axis_name)
     n = mesh_obj.shape[axis]
     if dp_axis is not None:
         if dp_axis not in mesh_obj.shape:

@@ -103,13 +103,16 @@ def make_zero_train_step(
     wire."""
     from ..ops.compression import Compression
     from .distributed_optimizer import (_resolve_compression,
-                                        resolve_mesh_axis)
+                                        axis_width, resolve_mesh_axis)
 
     if op not in (C.Average, C.Sum):
         raise ValueError(f"ZeRO gradient reduction supports Average/Sum, "
                          f"got {op!r}")
+    # The session plan supplies mesh + reduce axis when no explicit mesh
+    # is given; a multi-axis plan's name tuple rides every collective
+    # below unchanged (lax accepts tuples), with ``n`` the product width.
     mesh_obj, axis = resolve_mesh_axis(mesh, axis_name)
-    n = mesh_obj.shape[axis]
+    n = axis_width(mesh_obj, axis)
 
     def _ef_on() -> bool:
         if error_feedback is not None:

@@ -34,6 +34,20 @@ def _constrain(x, spec: P):
         return x
 
 
+def _expert_axes():
+    """(expert, tensor) axis names for the sharding hints, derived from
+    the session :class:`~horovod_tpu.plan.MeshPlan` at trace time: the
+    planner's ``expert``/``tensor`` names when declared, else the legacy
+    short names — so the same module body serves both vocabularies."""
+    from .. import basics
+
+    plan = basics.peek("mesh_plan")
+    if plan is not None:
+        return ("expert" if plan.has_axis("expert") else "ep",
+                "tensor" if plan.has_axis("tensor") else "tp")
+    return "ep", "tp"
+
+
 class MoEMlp(nn.Module):
     """Drop-in replacement for the transformer's dense FFN block.
 
@@ -120,15 +134,16 @@ class MoEMlp(nn.Module):
         w_down = self.param("w_down", nn.initializers.lecun_normal(),
                             (E, self.d_ff, C), self.param_dtype)
 
+        ep_ax, tp_ax = _expert_axes()
         expert_in = jnp.einsum("sec,sd->ecd", dispatch.astype(self.dtype),
                                xf.astype(self.dtype))         # [E, cap, C]
-        expert_in = _constrain(expert_in, P("ep", None, None))
+        expert_in = _constrain(expert_in, P(ep_ax, None, None))
         h = jnp.einsum("ecd,edf->ecf", expert_in,
                        w_up.astype(self.dtype))
         h = nn.gelu(h)
-        h = _constrain(h, P("ep", None, "tp"))
+        h = _constrain(h, P(ep_ax, None, tp_ax))
         out_e = jnp.einsum("ecf,efd->ecd", h, w_down.astype(self.dtype))
-        out_e = _constrain(out_e, P("ep", None, None))
+        out_e = _constrain(out_e, P(ep_ax, None, None))
         out = jnp.einsum("sec,ecd->sd", combine.astype(self.dtype), out_e)
         return out.reshape(B, T, C)
 

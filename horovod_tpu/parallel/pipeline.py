@@ -31,8 +31,10 @@ from .._compat import shard_map, axis_size as _axis_size
 
 
 def pipeline_apply(stage_fn: Callable, stage_params: Any, x, *,
-                   mesh: Mesh, n_micro: int, pp_axis: str = "pp",
-                   dp_axis: Optional[str] = "dp", remat: bool = False):
+                   mesh: Optional[Mesh] = None, n_micro: int,
+                   pp_axis: Optional[str] = None,
+                   dp_axis: Optional[str] = "dp", remat: bool = False,
+                   plan=None):
     """Run ``x`` through ``pp`` pipeline stages.
 
     ``stage_fn(params_one_stage, activation) -> activation`` — one
@@ -44,17 +46,34 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x, *,
     microbatches along dim 0 (``B`` divisible by ``n_micro`` × the dp
     size).  Returns the pipelined result, same shape as ``x``.
 
+    Axis wiring comes from a :class:`~horovod_tpu.plan.MeshPlan`: pass
+    ``plan=`` directly, a legacy ``mesh=`` (wrapped losslessly), or
+    neither to ride the session plan.  ``pp_axis`` defaults to the
+    plan's ``pipe`` axis when declared, else the legacy ``pp``;
+    ``dp_axis`` falls back to the plan's reduce axes when ``dp`` is
+    absent.
+
     ``remat=True`` wraps each stage in ``jax.checkpoint``: the backward
     pipeline recomputes stage activations instead of keeping all
     ``n_ticks`` of them live — the standard GPipe memory trade (peak
     activation memory drops ~``n_micro``-fold for one extra forward).
     """
+    from ..plan import resolve_plan
+
+    plan = resolve_plan(mesh, plan)
+    mesh = plan.mesh
     axes = set(mesh.axis_names)
     if remat:
         stage_fn = jax.checkpoint(stage_fn)
+    if pp_axis is None:
+        pp_axis = "pipe" if "pipe" in axes else "pp"
     if pp_axis not in axes:
         raise ValueError(f"mesh has no axis {pp_axis!r}: {mesh.axis_names}")
     dp = dp_axis if (dp_axis and dp_axis in axes) else None
+    if dp is None:
+        reduce = tuple(a for a in plan.reduce_axes() if a != pp_axis)
+        if reduce:
+            dp = reduce[0] if len(reduce) == 1 else reduce
 
     def local(params_local, x_local):
         # params_local: [1, ...] stage slice; x_local: [B/dp, ...]

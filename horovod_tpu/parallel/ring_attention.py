@@ -184,16 +184,33 @@ def _ring_flash_local(q, k, v, *, axis: str, causal: bool,
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 
-def seq_parallel_call(local_fn, q, k, v, *, mesh: Mesh, sp_axis: str,
-                      dp_axis: Optional[str], tp_axis: Optional[str]):
+def seq_parallel_call(local_fn, q, k, v, *, mesh: Optional[Mesh] = None,
+                      sp_axis: str, dp_axis: Optional[str],
+                      tp_axis: Optional[str], plan=None):
     """Shared host-callable wrapper for sequence-parallel attention
     variants: shard ``[B, T, H, D]`` inputs with sequence over
     ``sp_axis`` (batch over ``dp_axis``, heads over ``tp_axis`` when
-    those axes exist in ``mesh``) and run ``local_fn`` under
-    ``shard_map``.  Composable inside a jit'ed GSPMD program."""
+    those axes exist) and run ``local_fn`` under ``shard_map``.
+    Composable inside a jit'ed GSPMD program.
+
+    Axis wiring comes from a :class:`~horovod_tpu.plan.MeshPlan`
+    (``plan=``, or a legacy ``mesh=`` wrapped losslessly, or the
+    session plan): ``tp_axis`` falls back to a declared ``tensor``
+    axis, ``dp_axis`` to the plan's reduce axes."""
+    from ..plan import resolve_plan
+
+    plan = resolve_plan(mesh, plan)
+    mesh = plan.mesh
     axes = set(mesh.axis_names)
     dp = dp_axis if dp_axis in axes else None
     tp = tp_axis if tp_axis in axes else None
+    if tp is None and "tensor" in axes:
+        tp = "tensor"
+    if dp is None:
+        reduce = tuple(a for a in plan.reduce_axes()
+                       if a not in (sp_axis, tp))
+        if reduce:
+            dp = reduce[0] if len(reduce) == 1 else reduce
     if sp_axis not in axes:
         raise ValueError(f"mesh has no axis {sp_axis!r}: {mesh.axis_names}")
     spec = P(dp, sp_axis, tp, None)
@@ -207,17 +224,19 @@ def seq_parallel_call(local_fn, q, k, v, *, mesh: Mesh, sp_axis: str,
     return body(q, k, v)
 
 
-def ring_self_attention(q, k, v, *, mesh: Mesh, sp_axis: str = "sp",
+def ring_self_attention(q, k, v, *, mesh: Optional[Mesh] = None,
+                        sp_axis: str = "sp",
                         dp_axis: Optional[str] = "dp",
                         tp_axis: Optional[str] = "tp",
                         causal: bool = False,
                         scale: Optional[float] = None,
-                        engine: str = "xla"):
+                        engine: str = "xla", plan=None):
     """Host-callable ring attention (see :func:`seq_parallel_call` for
     the sharding contract) — this is the designed usage from models.
     ``engine='flash'`` runs each ring block on the Pallas flash kernel."""
     return seq_parallel_call(
         partial(ring_attention_local, axis=sp_axis, causal=causal,
                 scale=scale, engine=engine),
-        q, k, v, mesh=mesh, sp_axis=sp_axis, dp_axis=dp_axis, tp_axis=tp_axis,
+        q, k, v, mesh=mesh, sp_axis=sp_axis, dp_axis=dp_axis,
+        tp_axis=tp_axis, plan=plan,
     )

@@ -18,8 +18,6 @@ import re
 from typing import Dict, Optional, Sequence, Tuple
 
 import jax
-import numpy as np
-from jax.experimental import mesh_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -28,23 +26,17 @@ def make_mesh(axis_sizes: Dict[str, int], *, devices=None) -> Mesh:
 
     Axis order fixes ICI locality: later axes get nearer neighbors, so
     put the most bandwidth-hungry axis (usually ``tp``) last.
+
+    Shim over the planner's mesh constructor
+    (:func:`horovod_tpu.plan.build_device_mesh`) — the one place a
+    named device mesh is built; kept so existing callers keep their
+    import path.  New code should declare a
+    :class:`~horovod_tpu.plan.MeshPlan` instead and derive shardings
+    from it (docs/mesh_plan.md).
     """
-    names = tuple(axis_sizes)
-    shape = tuple(axis_sizes[n] for n in names)
-    n_needed = int(np.prod(shape))
-    if devices is None:
-        devices = jax.devices()
-    if n_needed > len(devices):
-        raise ValueError(
-            f"Mesh {axis_sizes} needs {n_needed} devices; only "
-            f"{len(devices)} available"
-        )
-    devices = devices[:n_needed]
-    try:
-        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
-    except (ValueError, AssertionError):
-        dev_array = np.asarray(devices, dtype=object).reshape(shape)
-    return Mesh(dev_array, names)
+    from ..plan import build_device_mesh
+
+    return build_device_mesh(axis_sizes, devices=devices)
 
 
 # --- parameter sharding rules -----------------------------------------------

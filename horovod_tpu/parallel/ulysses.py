@@ -51,16 +51,20 @@ def _ulysses_local(q, k, v, *, axis: str, causal: bool, scale):
     return head2seq(out)
 
 
-def ulysses_attention(q, k, v, *, mesh: Mesh, sp_axis: str = "sp",
+def ulysses_attention(q, k, v, *, mesh: Optional[Mesh] = None,
+                      sp_axis: str = "sp",
                       dp_axis: Optional[str] = "dp",
                       tp_axis: Optional[str] = "tp",
                       causal: bool = False,
-                      scale: Optional[float] = None):
+                      scale: Optional[float] = None, plan=None):
     """Host-callable Ulysses attention on ``[B, T, H, D]`` inputs with the
-    same sharding contract as :func:`ring_self_attention`."""
+    same sharding contract as :func:`ring_self_attention` (axis wiring
+    from a :class:`~horovod_tpu.plan.MeshPlan` — explicit, wrapped from
+    ``mesh``, or the session plan)."""
     from .ring_attention import seq_parallel_call
 
     return seq_parallel_call(
         partial(_ulysses_local, axis=sp_axis, causal=causal, scale=scale),
-        q, k, v, mesh=mesh, sp_axis=sp_axis, dp_axis=dp_axis, tp_axis=tp_axis,
+        q, k, v, mesh=mesh, sp_axis=sp_axis, dp_axis=dp_axis,
+        tp_axis=tp_axis, plan=plan,
     )

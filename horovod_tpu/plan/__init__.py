@@ -1,0 +1,22 @@
+"""MeshPlan: one parallelism planner over the whole mesh.
+
+Every parallelism mode used to be a separate entry point threading its
+own axis names and group arithmetic.  A :class:`MeshPlan` declares the
+named axes once (``data``/``fsdp``/``tensor``/``pipe``/``expert`` over a
+``jax.sharding.Mesh``) and every downstream consumer derives from it:
+collectives get their process sets, the optimizer tiers get their
+parameter/grad/opt-state shardings, ``ops/fusion`` gets the per-axis
+wire, and the ``topo/`` schedule compiler gets its tier partitions.
+See docs/mesh_plan.md.
+"""
+
+from .mesh_plan import (  # noqa: F401
+    MeshPlan,
+    REDUCE_AXES,
+    build_device_mesh,
+    collective_groups,
+    compile_plan,
+    fsdp_param_spec,
+    layout_lattice,
+    resolve_plan,
+)

@@ -1,0 +1,375 @@
+"""The MeshPlan core: declared axes -> derived wiring.
+
+A plan is a frozen value: ``(Mesh, ((axis, size), ...))``.  Everything
+else — gradient-reduction axes, batch/parameter shardings, per-axis
+process sets, topo tier partitions, the modeled per-axis wire — is a
+*derivation*, computed from the declaration instead of hand-built at
+each call site.  ``MeshPlan.default()`` wraps the existing 1-D global
+mesh (the SAME ``Mesh`` object ``hvd.init`` built), so every legacy
+entry point shimmed over it traces the bit-identical program it always
+traced.
+
+Axis vocabulary (``config.MESH_AXES``): the planner names
+``data``/``fsdp``/``tensor``/``pipe``/``expert``; the legacy short
+names (``hvd``, ``dp``/``tp``/``sp``/``pp``/``ep``) remain first-class
+so pre-plan meshes wrap losslessly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Import the module by path — the package re-exports basics.config (an
+# accessor function) under the same name, which would shadow it.
+from ..config import MESH_AXES, parse_mesh_plan
+
+# Axes whose width carries the gradient reduction — the batch shards
+# over these, and the optimizer's allreduce/reduce-scatter rides their
+# combined width.  Every other axis shards the *model* (tensor, pipe,
+# expert tiers) and never sees the gradient wire.  ``sp`` shards the
+# sequence, which splits the batch tokens too, but its collectives are
+# the attention ring/all-to-all, not the gradient reduce — it is
+# deliberately NOT a reduce axis.
+REDUCE_AXES = ("data", "fsdp", "hvd", "dp")
+
+
+def build_device_mesh(axis_sizes: Dict[str, int], *,
+                      devices=None) -> Mesh:
+    """The one place a named device mesh is constructed.  Axis order
+    fixes ICI locality: later axes get nearer neighbors, so put the most
+    bandwidth-hungry axis (usually ``tensor``/``tp``) last."""
+    from jax.experimental import mesh_utils
+
+    names = tuple(axis_sizes)
+    shape = tuple(axis_sizes[n] for n in names)
+    n_needed = int(np.prod(shape))
+    if devices is None:
+        devices = jax.devices()
+    if n_needed > len(devices):
+        raise ValueError(
+            f"Mesh {axis_sizes} needs {n_needed} devices; only "
+            f"{len(devices)} available"
+        )
+    devices = devices[:n_needed]
+    try:
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except (ValueError, AssertionError):
+        dev_array = np.asarray(devices, dtype=object).reshape(shape)
+    return Mesh(dev_array, names)
+
+
+def fsdp_param_spec(leaf, n: int, axis: str) -> P:
+    """PartitionSpec sharding ``leaf``'s largest ``n``-divisible axis;
+    replicated when nothing divides (small biases/scalars — their bytes
+    don't matter).  The FSDP/ZeRO-3 parameter-placement rule, owned by
+    the planner so every tier derives the same layout."""
+    shape = getattr(leaf, "shape", ())
+    candidates = [(s, i) for i, s in enumerate(shape)
+                  if s % n == 0 and s >= n]
+    if not candidates:
+        return P()
+    _, dim = max(candidates)
+    spec = [None] * len(shape)
+    spec[dim] = axis
+    return P(*spec)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """Declared axes over a device mesh; single source of truth for the
+    derived wiring (see module docstring and docs/mesh_plan.md)."""
+
+    mesh: Mesh
+    axes: Tuple[Tuple[str, int], ...]
+
+    # --- constructors -------------------------------------------------------
+
+    @staticmethod
+    def default() -> "MeshPlan":
+        """Wrap the live global mesh: a 1-D plan whose single axis is
+        the configured ``mesh_axis_name`` — the SAME ``Mesh`` object
+        every legacy entry point already rides, so plan-shimmed steps
+        trace bit-identical programs."""
+        from .. import basics
+
+        # peek, not global_mesh(): the default plan is built inside
+        # ``hvd.init`` after the mesh lands but before the initialized
+        # flag flips.
+        gm = basics.peek("mesh")
+        if gm is None:
+            raise basics.NotInitializedError()
+        return MeshPlan(mesh=gm.mesh,
+                        axes=((gm.axis_name, gm.size),))
+
+    @staticmethod
+    def from_spec(spec: str, *, devices=None) -> "MeshPlan":
+        """Build from an ``HVD_TPU_MESH_PLAN`` axis spec
+        (``data=4,fsdp=2``).  The axis sizes must factor the device
+        count exactly — validated with an actionable error."""
+        if devices is None:
+            devices = jax.devices()
+        sizes = parse_mesh_plan(spec, world_size=len(devices))
+        return MeshPlan.from_axes(sizes, devices=devices)
+
+    @staticmethod
+    def from_axes(axis_sizes: Dict[str, int], *,
+                  devices=None) -> "MeshPlan":
+        for name in axis_sizes:
+            if name not in MESH_AXES:
+                raise ValueError(
+                    f"mesh plan: unknown axis {name!r}; expected one of "
+                    f"{MESH_AXES}")
+        mesh = build_device_mesh(axis_sizes, devices=devices)
+        return MeshPlan(mesh=mesh,
+                        axes=tuple(axis_sizes.items()))
+
+    @staticmethod
+    def from_mesh(mesh: Mesh) -> "MeshPlan":
+        """Wrap an existing named mesh (the migration path for callers
+        that built one via ``parallel.make_mesh``)."""
+        return MeshPlan(
+            mesh=mesh,
+            axes=tuple((str(n), int(mesh.shape[n]))
+                       for n in mesh.axis_names))
+
+    # --- declaration accessors ---------------------------------------------
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(n for n, _ in self.axes)
+
+    @property
+    def world_size(self) -> int:
+        n = 1
+        for _, s in self.axes:
+            n *= s
+        return n
+
+    def axis_size(self, name: str) -> int:
+        for n, s in self.axes:
+            if n == name:
+                return s
+        raise KeyError(
+            f"mesh plan has no axis {name!r} (axes: {self.axis_names})")
+
+    def has_axis(self, name: str) -> bool:
+        return any(n == name for n, _ in self.axes)
+
+    # --- derivation: the gradient-reduction wire ----------------------------
+
+    def reduce_axes(self) -> Tuple[str, ...]:
+        """Axes (declaration order) whose combined width carries the
+        gradient reduction."""
+        return tuple(n for n, _ in self.axes if n in REDUCE_AXES)
+
+    def reduce_axis(self):
+        """The axis argument for the optimizer tiers' collectives: the
+        bare name for 1-D reduce plans (bit-identical to the legacy
+        wiring), a tuple of names for multi-axis plans (``lax.psum`` /
+        ``psum_scatter`` reduce over the product width)."""
+        axes = self.reduce_axes()
+        if not axes:
+            raise ValueError(
+                f"mesh plan {self.describe()} has no data/fsdp axis to "
+                f"reduce gradients over; declare at least one of "
+                f"{REDUCE_AXES}")
+        return axes[0] if len(axes) == 1 else axes
+
+    def reduce_width(self) -> int:
+        n = 1
+        for name in self.reduce_axes():
+            n *= self.axis_size(name)
+        return n
+
+    # --- derivation: shardings ----------------------------------------------
+
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def batch_spec(self) -> P:
+        """Leading-axis batch placement: shard over every reduce axis
+        (one spec entry carrying the axis tuple)."""
+        axes = self.reduce_axes()
+        if not axes:
+            return P()
+        return P(axes[0] if len(axes) == 1 else axes)
+
+    def batch_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, self.batch_spec())
+
+    def shard_axis(self) -> Optional[str]:
+        """The parameter-sharding axis for the FSDP/ZeRO-3 tier:
+        ``fsdp`` when declared, else the sole reduce axis of a 1-D plan
+        (the legacy ``make_fsdp_train_step`` behavior)."""
+        if self.has_axis("fsdp"):
+            return "fsdp"
+        axes = self.reduce_axes()
+        return axes[0] if len(axes) == 1 else None
+
+    def param_spec(self, leaf) -> P:
+        """Parameter/grad/opt-state placement for the fully-sharded
+        tier: largest divisible dim over the shard axis, replicated
+        across every other axis."""
+        axis = self.shard_axis()
+        if axis is None:
+            return P()
+        return fsdp_param_spec(leaf, self.axis_size(axis), axis)
+
+    def param_sharding(self, leaf) -> NamedSharding:
+        return NamedSharding(self.mesh, self.param_spec(leaf))
+
+    # --- derivation: process sets / collective groups -----------------------
+
+    def axis_groups(self, name: str) -> List[List[int]]:
+        """Rank groups along one axis: every group varies ``name`` while
+        pinning the other axes — directly usable as
+        ``axis_index_groups`` and as process-set member lists.  Ranks
+        are flat (C-order) indices into the mesh's device array, which
+        is the global slot order for plans built over ``jax.devices()``."""
+        shape = tuple(s for _, s in self.axes)
+        idx = self.axis_names.index(name)
+        ranks = np.arange(int(np.prod(shape))).reshape(shape)
+        moved = np.moveaxis(ranks, idx, -1)
+        return [list(map(int, row))
+                for row in moved.reshape(-1, shape[idx])]
+
+    def collective_groups(self, process_set=None):
+        """The ``axis_index_groups`` partition a collective over this
+        plan's reduce wire should use: the process set's partition when
+        one is given, else ``None`` (the un-grouped full-mesh fast
+        path).  The one place optim/ asks for groups."""
+        if process_set is None:
+            return None
+        return process_set.axis_index_groups()
+
+    def register_process_sets(self, table=None) -> Dict[str, list]:
+        """Register one :class:`~horovod_tpu.process_sets.ProcessSet`
+        per axis group (axes of width 1 or the full world are skipped —
+        the global set already exists).  Idempotent: an already-
+        registered identical set is reused, so elastic re-init and
+        relayout both converge.  ``table`` lets ``hvd.init`` (and the
+        relayout path) pass the table while still holding the state
+        lock."""
+        from .. import process_sets as _ps
+
+        if table is None:
+            table = _ps._table()
+        out: Dict[str, list] = {}
+        world = self.world_size
+        for name, size in self.axes:
+            if size <= 1 or size >= world:
+                continue
+            sets = []
+            for ranks in self.axis_groups(name):
+                ps = table.find(ranks)
+                if ps is None:
+                    ps = table.register(_ps.ProcessSet(ranks))
+                sets.append(ps)
+            out[name] = sets
+        return out
+
+    # --- derivation: topo tier partitions -----------------------------------
+
+    def topo_tiers(self):
+        """The two-tier :class:`~horovod_tpu.topo.topology.MeshTopology`
+        a 2-D reduce plan implies: the outer reduce axis is the pod
+        (DCN) tier, the inner the chip (ICI) tier.  ``None`` when the
+        plan doesn't decompose the reduce wire (1-D plans keep the
+        configured/flat topology)."""
+        axes = self.reduce_axes()
+        if len(axes) != 2:
+            return None
+        from ..topo.topology import MeshTopology
+
+        return MeshTopology(pods=self.axis_size(axes[0]),
+                            chips_per_pod=self.axis_size(axes[1]))
+
+    # --- derivation: the modeled per-axis wire ------------------------------
+
+    def modeled_wire_bytes(self, nbytes: int) -> Dict[str, int]:
+        """Ring-allreduce wire bytes per participant, per reduce axis,
+        for an ``nbytes`` gradient: ``2*(n-1)/n * nbytes`` (RS + AG
+        phases).  Model-parallel axes carry activations, not gradients,
+        and report 0 here — the α–β activation model lives with each
+        mode (ring/Ulysses/MoE)."""
+        out: Dict[str, int] = {}
+        for name, size in self.axes:
+            if name in REDUCE_AXES and size > 1:
+                out[name] = int(2 * (size - 1) / size * nbytes)
+            else:
+                out[name] = 0
+        return out
+
+    def describe(self) -> str:
+        return ",".join(f"{n}={s}" for n, s in self.axes)
+
+
+def resolve_plan(mesh=None, plan=None) -> MeshPlan:
+    """The plan a parallelism entry point should consume: an explicit
+    ``plan`` wins; an explicit ``mesh`` wraps losslessly
+    (:meth:`MeshPlan.from_mesh` — the migration path for callers that
+    built a mesh by hand); else the session plan."""
+    from .. import basics
+
+    if plan is not None:
+        return plan
+    if mesh is not None:
+        return MeshPlan.from_mesh(mesh)
+    live = basics.peek("mesh_plan")
+    if live is None:
+        raise basics.NotInitializedError()
+    return live
+
+
+def collective_groups(process_set=None):
+    """Module-level form of :meth:`MeshPlan.collective_groups` for call
+    sites that run before/without an initialized session plan (explicit
+    ``mesh=`` train steps): delegates to the live plan when one exists,
+    else derives the partition directly from the process set."""
+    from .. import basics
+
+    plan = basics.peek("mesh_plan")
+    if plan is not None:
+        return plan.collective_groups(process_set)
+    if process_set is None:
+        return None
+    return process_set.axis_index_groups()
+
+
+def compile_plan(spec: Optional[str], *, devices=None) -> MeshPlan:
+    """Build the session plan (``hvd.init`` / autotune relayout entry):
+    ``spec=None`` is the 1-D default plan over the global mesh; a spec
+    string builds the declared layout.  Instrumented with the
+    ``hvd_tpu_plan_compile`` span and the ``hvd_tpu_plan_axes`` gauge
+    (docs/tracing.md, docs/metrics.md)."""
+    from ..obs import instrument as _obs
+
+    with _obs.plan_compile_span(spec or "default"):
+        if spec is None:
+            plan = MeshPlan.default()
+        else:
+            plan = MeshPlan.from_spec(spec, devices=devices)
+        _obs.set_plan_axes(dict(plan.axes))
+    return plan
+
+
+def layout_lattice(world_size: int) -> List[str]:
+    """The layout candidates the autotuner searches (docs/autotune.md):
+    index 1 is the 1-D data plan, later entries split progressively more
+    of the world onto the ``fsdp`` axis — every candidate factors
+    ``world_size`` exactly, so any proposal is buildable."""
+    layouts = [f"data={world_size}"]
+    inner = 2
+    while inner <= world_size // 2:
+        if world_size % inner == 0:
+            layouts.append(f"data={world_size // inner},fsdp={inner}")
+        inner *= 2
+    return layouts

@@ -138,10 +138,22 @@ def resolve_topology(world_size: int,
 def config_topology(world_size: int) -> MeshTopology:
     """Trace-time resolution from the live config (``HVD_TPU_TOPO_SPEC``),
     falling back to flat on a spec/world mismatch with a warning —
-    a bad spec must not crash a training step that can run flat."""
+    a bad spec must not crash a training step that can run flat.
+
+    Between the declared spec and inference sits the session
+    :class:`~horovod_tpu.plan.MeshPlan`: a 2-D reduce layout
+    (``data=P,fsdp=C``) *is* a tier declaration — outer axis = pod
+    (DCN) tier, inner = chip (ICI) tier — so the schedule compiler's
+    partitions derive from the plan without a separate topo spec."""
     from .. import basics
 
     spec = basics.config().topo_spec if basics.is_initialized() else None
+    if not spec:
+        plan = basics.peek("mesh_plan")
+        if plan is not None:
+            tiers = plan.topo_tiers()
+            if tiers is not None and tiers.size == world_size:
+                return tiers
     try:
         return resolve_topology(world_size, spec)
     except ValueError as e:

@@ -29,6 +29,7 @@ from horovod_tpu.analysis.knobs import KnobChecker
 from horovod_tpu.analysis.locks import LockChecker
 from horovod_tpu.analysis.rank_divergence import RankDivergenceChecker
 from horovod_tpu.analysis.registries import (FaultSiteChecker,
+                                             MeshAxisChecker,
                                              MetricNameChecker,
                                              SpanNameChecker)
 
@@ -43,6 +44,7 @@ import dataclasses, os
 
 PRE_INIT_KNOBS = ("PROCESS_ID",)
 FAULT_SITES = ("collective", "rpc")
+MESH_AXES = ("data", "fsdp", "hvd")
 _NOOP_KNOBS = {"CYCLE_TIME": "no cycle loop here"}
 
 
@@ -438,6 +440,48 @@ def test_fault_site_doc_drift(tmp_path):
               docs={"fault_injection.md": "| `collective` | x | raise | y |\n"})
     assert checks_of(fs) == ["fault-site-doc-drift"]
     assert "rpc" in fs[0].message
+
+
+def test_unknown_mesh_axis_in_partition_spec(tmp_path):
+    """ISSUE 18 satellite: a typo'd axis in a P(...) spec (including
+    the multi-axis tuple form) must be flagged against the MESH_AXES
+    plan catalog instead of silently diverging from the MeshPlan."""
+    fs = lint(tmp_path,
+              {"config.py": FIXTURE_CONFIG, "c.py": FIXTURE_CONSUMER,
+               "m.py": "from jax.sharding import PartitionSpec as P\n\n"
+                       "def specs():\n"
+                       '    ok = P("data", None)\n'
+                       '    ok2 = P(("data", "fsdp"))\n'
+                       '    bad = P("dataa", None)\n'
+                       '    bad2 = P(("data", "fspd"))\n'},
+              [MeshAxisChecker])
+    assert checks_of(fs) == ["unknown-mesh-axis"]
+    assert len(fs) == 2
+    assert "dataa" in fs[0].message and "fspd" in fs[1].message
+
+
+def test_unknown_mesh_axis_in_axis_kwargs_and_defaults(tmp_path):
+    fs = lint(tmp_path,
+              {"config.py": FIXTURE_CONFIG, "c.py": FIXTURE_CONSUMER,
+               "m.py": "def reduce(x, collective):\n"
+                       '    return collective(x, axis_name="hvdd")\n\n'
+                       'def step(x, dp_axis="dta"):\n'
+                       "    return x\n"},
+              [MeshAxisChecker])
+    assert checks_of(fs) == ["unknown-mesh-axis"]
+    assert len(fs) == 2
+
+
+def test_known_mesh_axes_are_clean(tmp_path):
+    fs = lint(tmp_path,
+              {"config.py": FIXTURE_CONFIG, "c.py": FIXTURE_CONSUMER,
+               "m.py": "from jax.sharding import PartitionSpec as P\n\n"
+                       'def step(x, collective, axis_name="hvd",\n'
+                       '         dp_axis="data"):\n'
+                       '    spec = P(("data", "fsdp"), None)\n'
+                       "    return collective(x, axis_name=axis_name)\n"},
+              [MeshAxisChecker])
+    assert fs == []
 
 
 def test_metric_naming_rules(tmp_path):

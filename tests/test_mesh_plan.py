@@ -1,0 +1,419 @@
+"""MeshPlan (horovod_tpu/plan/): the single parallelism planner.
+
+The contract under test (ISSUE 18 / docs/mesh_plan.md):
+
+* **Equivalence oracle** — every legacy entry point is a thin shim over
+  ``MeshPlan.default()``, so a step built with no plan and a step built
+  with the default session plan must trace the *identical* collective
+  sequence and produce bit-identical arrays, per mode (DP, ZeRO, FSDP,
+  pipeline, MoE).
+* **Derivations** — process-set groups, shardings, topo tiers and the
+  modeled per-axis wire all come from one declaration.
+* **Rank invariance** — planner-built steps pass the same jaxpr oracle
+  (``analysis/jaxpr_check.py``) as the legacy ones.
+* **Layout search** — the autotuner flips layouts only at re-jit
+  boundaries and the live plan tracks the applied choice.
+* **Rejection matrix** — malformed ``HVD_TPU_MESH_PLAN`` specs fail
+  with actionable errors, at parse time, not trace time.
+"""
+
+import contextlib
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu import basics
+from horovod_tpu import plan as plan_mod
+from horovod_tpu.analysis.jaxpr_check import (
+    check_step_rank_consistency, extract_collective_sequence,
+)
+from horovod_tpu.config import Config, parse_mesh_plan
+from horovod_tpu.plan import (
+    MeshPlan, build_device_mesh, layout_lattice, resolve_plan,
+)
+
+
+@contextlib.contextmanager
+def _session_plan(spec):
+    """Install a session plan the way ``hvd.init``/relayout does —
+    compile + process-set registration under a config override — and
+    restore the previous plan after.  ``spec=None`` compiles the 1-D
+    default plan; the sentinel ``"off"`` removes the plan entirely
+    (the pure pre-plan legacy path)."""
+    with basics._state.lock:
+        old_cfg = basics._state.config
+        old_plan = basics._state.mesh_plan
+    try:
+        with basics._state.lock:
+            if spec == "off":
+                basics._state.config = dataclasses.replace(
+                    old_cfg, mesh_plan=None)
+                basics._state.mesh_plan = None
+            else:
+                basics._state.config = dataclasses.replace(
+                    old_cfg, mesh_plan=spec)
+                basics._state.mesh_plan = plan_mod.compile_plan(spec)
+                basics._state.mesh_plan.register_process_sets(
+                    basics._state.process_sets)
+        yield basics._state.mesh_plan
+    finally:
+        with basics._state.lock:
+            basics._state.config = old_cfg
+            basics._state.mesh_plan = old_plan
+
+
+def _toy_problem(seed=0):
+    rng = np.random.RandomState(seed)
+    d = 16
+    params = {"w": jnp.asarray(rng.randn(d, d) * 0.1, jnp.float32),
+              "b": jnp.zeros((d,), jnp.float32)}
+    x = jnp.asarray(rng.randn(32, d).astype(np.float32))
+    y = jnp.asarray(rng.randn(32, d).astype(np.float32))
+
+    def loss_fn(p, batch):
+        xb, yb = batch
+        return jnp.mean(((xb @ p["w"] + p["b"]) - yb) ** 2)
+
+    return loss_fn, params, (x, y)
+
+
+class TestDerivations:
+    def test_default_plan_wraps_the_global_mesh(self, world_size):
+        plan = hvd.mesh_plan()
+        gm = basics.global_mesh()
+        assert plan.mesh is gm.mesh          # the SAME object, not a copy
+        assert plan.axes == ((gm.axis_name, world_size),)
+        assert plan.reduce_axis() == gm.axis_name
+        assert plan.world_size == world_size
+
+    def test_2d_reduce_wire(self, world_size):
+        plan = MeshPlan.from_spec(f"data={world_size // 2},fsdp=2")
+        assert plan.reduce_axes() == ("data", "fsdp")
+        assert plan.reduce_axis() == ("data", "fsdp")
+        assert plan.reduce_width() == world_size
+        assert plan.batch_spec() == P(("data", "fsdp"))
+
+    def test_model_axes_excluded_from_reduce(self, world_size):
+        plan = MeshPlan.from_spec(f"data={world_size // 2},tensor=2")
+        assert plan.reduce_axis() == "data"
+        assert plan.axis_size("tensor") == 2
+        wire = plan.modeled_wire_bytes(1024)
+        assert wire["tensor"] == 0 and wire["data"] > 0
+
+    def test_axis_groups_partition_the_world(self, world_size):
+        plan = MeshPlan.from_spec(f"data={world_size // 2},fsdp=2")
+        data_groups = plan.axis_groups("data")
+        fsdp_groups = plan.axis_groups("fsdp")
+        # Every group pins the other axis; together they cover the world.
+        assert sorted(sum(data_groups, [])) == list(range(world_size))
+        assert sorted(sum(fsdp_groups, [])) == list(range(world_size))
+        assert len(fsdp_groups) == world_size // 2
+        assert all(len(g) == 2 for g in fsdp_groups)
+        # C-order linearization: fsdp is the fastest-varying axis.
+        assert fsdp_groups[0] == [0, 1]
+        assert data_groups[0][:2] == [0, 2]
+
+    def test_topo_tiers_from_2d_plan(self, world_size):
+        plan = MeshPlan.from_spec(f"data={world_size // 2},fsdp=2")
+        tiers = plan.topo_tiers()
+        assert tiers is not None
+        assert (tiers.pods, tiers.chips_per_pod) == (world_size // 2, 2)
+        assert MeshPlan.from_spec(f"data={world_size}").topo_tiers() is None
+
+    def test_param_spec_shards_largest_divisible_dim(self, world_size):
+        plan = MeshPlan.from_spec(f"data={world_size // 2},fsdp=2")
+        leaf = jnp.zeros((3, 8, 4))
+        assert plan.param_spec(leaf) == P(None, "fsdp", None)
+        assert plan.param_spec(jnp.zeros(())) == P()
+        assert plan.shard_axis() == "fsdp"
+
+    def test_from_mesh_wraps_legacy_mesh(self, world_size):
+        from horovod_tpu.parallel import make_mesh
+
+        mesh = make_mesh({"dp": world_size // 2, "tp": 2})
+        plan = MeshPlan.from_mesh(mesh)
+        assert plan.mesh is mesh
+        assert plan.axes == (("dp", world_size // 2), ("tp", 2))
+        assert plan.reduce_axis() == "dp"
+
+    def test_resolve_plan_precedence(self, world_size):
+        explicit = MeshPlan.from_spec(f"data={world_size}")
+        assert resolve_plan(None, explicit) is explicit
+        mesh = build_device_mesh({"dp": world_size})
+        wrapped = resolve_plan(mesh, None)
+        assert wrapped.mesh is mesh
+        assert resolve_plan(None, None) is hvd.mesh_plan()
+
+    def test_layout_lattice_factors_world(self, world_size):
+        layouts = layout_lattice(world_size)
+        assert layouts[0] == f"data={world_size}"
+        for spec in layouts:
+            sizes = parse_mesh_plan(spec, world_size=world_size)
+            assert np.prod(list(sizes.values())) == world_size
+
+    def test_register_process_sets_idempotent(self, world_size):
+        with _session_plan(f"data={world_size // 2},fsdp=2") as plan:
+            before = plan.register_process_sets()
+            again = plan.register_process_sets()
+            assert {k: [ps.ranks for ps in v] for k, v in before.items()} \
+                == {k: [ps.ranks for ps in v] for k, v in again.items()}
+
+
+class TestSpecRejection:
+    """Malformed HVD_TPU_MESH_PLAN specs must die at parse time with the
+    failure named — never at trace time as a wrong-shape mesh."""
+
+    @pytest.mark.parametrize("spec,match", [
+        ("bogus=8", "unknown axis"),
+        ("data", "axis=size"),
+        ("data=", "axis=size"),
+        ("=8", "axis=size"),
+        ("data=x", "bad size"),
+        ("data=0", "must be >= 1"),
+        ("data=-2", "must be >= 1"),
+        ("data=2,data=4", "appears twice"),
+        ("", "empty spec"),
+        (",", "empty spec"),
+    ])
+    def test_rejection_matrix(self, spec, match):
+        with pytest.raises(ValueError, match=match):
+            parse_mesh_plan(spec)
+
+    def test_world_size_must_factor_exactly(self, world_size):
+        with pytest.raises(ValueError, match="factor the device count"):
+            parse_mesh_plan("data=3", world_size=world_size)
+        with pytest.raises(ValueError, match="factor the device count"):
+            MeshPlan.from_spec(f"data={world_size},fsdp=2")
+
+    def test_config_env_knob_validates(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_MESH_PLAN", "data=4,fsdp=2")
+        assert Config.from_env().mesh_plan == "data=4,fsdp=2"
+        monkeypatch.setenv("HVD_TPU_MESH_PLAN", "")
+        assert Config.from_env().mesh_plan is None
+        monkeypatch.setenv("HVD_TPU_MESH_PLAN", "data=4,banana=2")
+        with pytest.raises(ValueError, match="unknown axis"):
+            Config.from_env()
+
+
+class TestPlanLegacyEquivalence:
+    """Bit-identical oracle: the default plan IS the legacy wiring."""
+
+    def _trace_and_train(self, build_step, params, tx, batch, steps=3):
+        step = build_step()
+        jaxpr = jax.make_jaxpr(lambda p, s, b: step(p, s, b))(
+            params, tx.init(params), batch)
+        seq = extract_collective_sequence(jaxpr)
+        p = jax.tree.map(jnp.copy, params)
+        s = tx.init(p)
+        loss = None
+        for _ in range(steps):
+            p, s, loss = step(p, s, batch)
+        return seq, p, float(loss)
+
+    def _assert_equivalent(self, build_step, params, tx, batch):
+        with _session_plan("off"):
+            legacy = self._trace_and_train(build_step, params, tx, batch)
+        with _session_plan(None):
+            planned = self._trace_and_train(build_step, params, tx, batch)
+        assert planned[0] == legacy[0], "collective sequences diverge"
+        for a, b in zip(jax.tree.leaves(legacy[1]),
+                        jax.tree.leaves(planned[1])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert planned[2] == legacy[2]
+
+    def test_dp_step(self, world_size):
+        loss_fn, params, batch = _toy_problem()
+        tx = hvd.DistributedOptimizer(optax.sgd(0.1))
+        self._assert_equivalent(
+            lambda: hvd.make_train_step(loss_fn, tx, donate=False),
+            params, tx, batch)
+
+    def test_zero_step(self, world_size):
+        from horovod_tpu.optim.zero import make_zero_train_step
+
+        loss_fn, params, batch = _toy_problem()
+        tx = optax.sgd(0.1, momentum=0.9)
+
+        def run(spec):
+            with _session_plan(spec):
+                init_z, step_z = make_zero_train_step(loss_fn, tx)
+                p = jax.tree.map(jnp.copy, params)
+                s = init_z(params)
+                for _ in range(3):
+                    p, s, loss = step_z(p, s, batch)
+                return p, float(loss)
+
+        lp, ll = run("off")
+        pp_, pl = run(None)
+        assert pl == ll
+        for a, b in zip(jax.tree.leaves(lp), jax.tree.leaves(pp_)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_fsdp_step(self, world_size):
+        from horovod_tpu.optim.fsdp import make_fsdp_train_step
+
+        loss_fn, params, batch = _toy_problem()
+        tx = optax.adamw(1e-2)
+
+        def run(spec):
+            with _session_plan(spec):
+                shard, step = make_fsdp_train_step(loss_fn, tx,
+                                                   donate=False)
+                p, s = shard(params)
+                for _ in range(3):
+                    p, s, loss = step(p, s, batch)
+                return jax.device_get(p), float(loss)
+
+        lp, ll = run("off")
+        pp_, pl = run(None)
+        assert pl == ll
+        for a, b in zip(jax.tree.leaves(lp), jax.tree.leaves(pp_)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_pipeline_planner_axes_match_legacy(self, world_size):
+        """pipeline_apply over a planner mesh (pipe/data) reproduces the
+        legacy pp/dp wiring bit-for-bit."""
+        from horovod_tpu.parallel import make_mesh
+        from horovod_tpu.parallel.pipeline import pipeline_apply
+
+        if world_size % 4 != 0:
+            pytest.skip("needs a dp x pp mesh")
+        n_stages = 4
+        rng = np.random.RandomState(0)
+        w = jnp.asarray(rng.randn(n_stages, 8, 8) * 0.1, jnp.float32)
+        x = jnp.asarray(rng.randn(8, 8).astype(np.float32))
+
+        def stage_fn(p, a):
+            return jnp.tanh(a @ p)
+
+        legacy_mesh = make_mesh({"dp": world_size // n_stages,
+                                 "pp": n_stages})
+        with _session_plan("off"):
+            legacy = pipeline_apply(stage_fn, w, x, mesh=legacy_mesh,
+                                    n_micro=2, pp_axis="pp",
+                                    dp_axis="dp")
+        with _session_plan(f"data={world_size // n_stages},"
+                           f"pipe={n_stages}"):
+            planned = pipeline_apply(stage_fn, w, x, n_micro=2,
+                                     dp_axis=None)
+        np.testing.assert_array_equal(np.asarray(legacy),
+                                      np.asarray(planned))
+
+    def test_moe_planner_axes_match_legacy(self, world_size):
+        """MoEMlp's sharding hints track the plan's expert axis without
+        changing the math."""
+        from horovod_tpu.parallel.moe import MoEMlp
+
+        layer = MoEMlp(d_model=16, d_ff=32, n_experts=world_size,
+                       top_k=2, dtype=jnp.float32)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 16))
+        variables = layer.init(jax.random.PRNGKey(1), x)
+
+        def run(spec, mesh):
+            with _session_plan(spec):
+                with mesh:
+                    return jax.jit(layer.apply)(variables, x)
+
+        from horovod_tpu.parallel import make_mesh
+
+        legacy = run("off", make_mesh({"ep": world_size}))
+        planned = run(f"expert={world_size}",
+                      plan_mod.MeshPlan.from_spec(
+                          f"expert={world_size}").mesh)
+        np.testing.assert_array_equal(np.asarray(legacy),
+                                      np.asarray(planned))
+
+    def test_2d_plan_matches_1d_numerics(self, world_size):
+        """Cross-layout: the 2-D DPxFSDP wire computes the same training
+        trajectory as the 1-D plan (different meshes, same math)."""
+        loss_fn, params, batch = _toy_problem()
+        tx = hvd.DistributedOptimizer(optax.sgd(0.1))
+
+        def run(spec):
+            with _session_plan(spec):
+                step = hvd.make_train_step(loss_fn, tx, donate=False)
+                p = jax.tree.map(jnp.copy, params)
+                s = tx.init(p)
+                for _ in range(3):
+                    p, s, loss = step(p, s, batch)
+                return p, float(loss)
+
+        p1, l1 = run(None)
+        p2, l2 = run(f"data={world_size // 2},fsdp=2")
+        np.testing.assert_allclose(l2, l1, rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+
+class TestRankInvariance:
+    def test_planner_step_rank_invariant(self, world_size):
+        """Planner-built steps pass the jaxpr oracle: identical
+        collective sequences under every simulated rank env."""
+        loss_fn, params, batch = _toy_problem()
+        tx = hvd.DistributedOptimizer(optax.sgd(0.1))
+        with _session_plan(f"data={world_size // 2},fsdp=2"):
+            findings = check_step_rank_consistency(
+                lambda: hvd.make_train_step(loss_fn, tx, donate=False),
+                lambda: (params, tx.init(params), batch),
+                what="planner-built make_train_step")
+        assert findings == [], findings
+
+
+class TestLayoutAutotune:
+    def test_layout_flips_at_rejit_boundary(self):
+        """HVD_TPU_MESH_PLAN + HOROVOD_AUTOTUNE: the GP searches the
+        layout lattice, every applied layout is a valid factorization,
+        flips land only at re-jit boundaries (the step keeps training
+        through them), and the live plan tracks the frozen choice."""
+        from horovod_tpu.optim.autotune import AutotunedTrainStep
+
+        world = hvd.size()
+        hvd.shutdown()
+        try:
+            hvd.init(Config(autotune=True, mesh_plan=f"data={world}",
+                            autotune_warmup_samples=1,
+                            autotune_steps_per_sample=2,
+                            autotune_max_samples=4))
+            pm = hvd.parameter_manager()
+            assert "layout" in pm.knob_names
+            assert hvd.mesh_plan().describe() == f"data={world}"
+
+            loss_fn, params, batch = _toy_problem()
+            tx = hvd.DistributedOptimizer(optax.sgd(0.05))
+            step = hvd.make_train_step(loss_fn, tx)
+            assert isinstance(step, AutotunedTrainStep)
+            opt_state = tx.init(params)
+            for _ in range(20):
+                params, opt_state, loss = step(params, opt_state, batch)
+            assert pm.frozen
+            assert jnp.isfinite(loss)
+            lattice = layout_lattice(world)
+            assert step.applied_knobs, "no proposal was ever applied"
+            for knobs in step.applied_knobs:
+                assert 1 <= knobs["layout"] <= len(lattice)
+            final_spec = lattice[step.applied_knobs[-1]["layout"] - 1]
+            assert hvd.config().mesh_plan == final_spec
+            assert hvd.mesh_plan().describe() == final_spec
+        finally:
+            hvd.shutdown()
+            hvd.init()
+
+    def test_no_layout_knob_without_plan(self):
+        """Without HVD_TPU_MESH_PLAN the autotuner never proposes a
+        relayout — legacy sessions keep the legacy knob set."""
+        hvd.shutdown()
+        try:
+            hvd.init(Config(autotune=True, autotune_warmup_samples=1,
+                            autotune_steps_per_sample=2,
+                            autotune_max_samples=2))
+            assert "layout" not in hvd.parameter_manager().knob_names
+        finally:
+            hvd.shutdown()
+            hvd.init()
