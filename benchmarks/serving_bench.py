@@ -102,6 +102,16 @@ def main() -> None:
     parser.add_argument("--cpu-mesh", action="store_true",
                         help="force the virtual CPU mesh (functional "
                              "check, not a perf number)")
+    parser.add_argument("--tp", type=int, default=0, metavar="N",
+                        help="tensor-parallel replica mode (serve/tp.py; "
+                             "docs/tp_serving.md): shard ONE replica's "
+                             "engine over N devices on the MeshPlan "
+                             "'tensor' axis, drive the same closed-loop "
+                             "workload at TP=1 and TP=N (token-identity "
+                             "checked), and measure a hot-swap manifest "
+                             "pull at both degrees — per-shard pull "
+                             "bytes must drop to <= 60% of the TP=1 "
+                             "pull (the r19 acceptance bound)")
     parser.add_argument("--fleet", default=None, metavar="PREFILLxDECODE",
                         help="disaggregated fleet mode (serve/fleet/): "
                              "e.g. 1x2 builds 1 prefill + 2 decode "
@@ -185,6 +195,9 @@ def main() -> None:
     model = GPT(cfg)
     rng = jax.random.PRNGKey(args.seed)
     params = model.init(rng, jnp.zeros((1, 8), jnp.int32))["params"]
+    if args.tp > 1:
+        run_tp(args, model, params, buckets)
+        return
     if args.fleet:
         run_fleet(args, model, params, buckets)
         return
@@ -372,6 +385,181 @@ def main() -> None:
                        "metrics": obs_export.json_snapshot()["metrics"],
                        **({"trace": trace_block} if trace_block else {})},
                       f, indent=1)
+
+
+def run_tp(args, model, params, buckets) -> None:
+    """Tensor-parallel replica bench (serve/tp.py; docs/tp_serving.md):
+    the SAME closed-loop workload runs on a TP=1 engine and a TP=N
+    engine (one model sharded over N devices on the MeshPlan ``tensor``
+    axis), then a hot-swap manifest pull runs at both degrees against
+    the same perturbed checkpoint.  Three claims, all checked here:
+
+    * **token identity** — the sharded engine emits bit-identical
+      tokens (column-parallel matmuls keep full contractions per
+      output element; docs/tp_serving.md) — the run aborts otherwise;
+    * **TPOT vs TP degree** — decode cadence at each degree (on the
+      virtual CPU mesh a functional datapoint; on real chips the
+      speedup curve);
+    * **swap pull bytes** — each shard pulls only its owned parameter
+      slices (``plan.tp_owned_slice``), so the replica's critical-path
+      pull (max over shards) must be <= 60% of the TP=1 pull for the
+      same manifest diff — the r19 acceptance bound, asserted.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from horovod_tpu.ckpt import ShardStore, take_snapshot
+    from horovod_tpu.serve import (ContinuousBatcher, InferenceEngine,
+                                   QueueFullError, SamplingParams,
+                                   ServingStats, WeightSubscriber)
+
+    tp = args.tp
+    if args.heads % tp:
+        raise SystemExit(f"--tp {tp} must divide --heads {args.heads} "
+                         f"(attention heads shard head-wise)")
+    if len(jax.devices()) < tp:
+        raise SystemExit(f"--tp {tp} needs >= {tp} devices; pass "
+                         f"--cpu-mesh for the 8-way virtual CPU mesh")
+
+    py_rng = random.Random(args.seed)
+    prompts = [[py_rng.randrange(args.vocab)
+                for _ in range(py_rng.randint(args.prompt_min,
+                                              args.prompt_max))]
+               for _ in range(args.requests)]
+    sampling = SamplingParams(max_new_tokens=args.max_new_tokens,
+                              temperature=args.temperature,
+                              top_k=args.top_k)
+
+    def bench_degree(deg):
+        engine = InferenceEngine(
+            model, params, max_slots=args.slots,
+            prefill_buckets=buckets, max_seq_len=args.max_seq_len,
+            kv_cache="paged", tp=deg, seed=args.seed)
+        batcher = ContinuousBatcher(engine, max_queue=args.queue_depth,
+                                    default_deadline_s=0)
+
+        def drive(ps):
+            live, pending = [], collections.deque(ps)
+            while pending or any(not r.done.is_set() for r in live):
+                while pending:
+                    try:
+                        live.append(batcher.submit(pending[0], sampling))
+                        pending.popleft()
+                    except QueueFullError:
+                        break
+                batcher.step()
+            return live
+
+        warm = [[1] * b for b in engine.prefill_buckets
+                if b < args.max_seq_len]
+        drive(warm)
+        batcher.stats = ServingStats()
+        t0 = time.perf_counter()
+        done = drive(list(prompts))
+        elapsed = time.perf_counter() - t0
+        snap = batcher.snapshot()
+        toks = sum(len(r.tokens) for r in done if r.error is None)
+        return {
+            "tok_per_s": (round(toks / elapsed, 3)
+                          if elapsed > 0 else 0.0),
+            "tpot_ms_p50": snap["tpot_ms_p50"],
+            "tpot_ms_p99": snap["tpot_ms_p99"],
+            "failed": sum(1 for r in done if r.error is not None),
+            "tokens": [list(r.tokens) for r in done],
+        }
+
+    base = bench_degree(1)
+    sharded = bench_degree(tp)
+    identical = base["tokens"] == sharded["tokens"]
+    if not identical:
+        raise SystemExit(
+            f"TP={tp} tokens diverged from TP=1 — the sharded forward "
+            f"is not bitwise-identical (docs/tp_serving.md)")
+
+    # --- swap-pull phase: same manifest diff, both degrees ------------------
+    def perturbed(v):
+        # Perturb EVERY leaf so the manifest diff covers the whole
+        # model — the pull-ratio then measures the shard ownership
+        # split, not which leaf happened to change.
+        leaf_rng = random.Random(1000 + v)
+
+        def bump(x):
+            return x + np.float32(1e-3 * leaf_rng.random())
+
+        return jax.tree_util.tree_map(bump, params)
+
+    store_dir = tempfile.mkdtemp(prefix="tp_bench_store_")
+    try:
+        store = ShardStore(store_dir)
+        host = jax.tree_util.tree_map(np.asarray, params)
+        store.write_step(take_snapshot(host, step=1), world=1,
+                         scheme="dp")
+        host2 = jax.tree_util.tree_map(np.asarray, perturbed(2))
+        store.write_step(take_snapshot(host2, step=2), world=1,
+                         scheme="dp")
+
+        def pull_bytes(deg):
+            engine = InferenceEngine(
+                model, params, max_slots=args.slots,
+                prefill_buckets=buckets, max_seq_len=args.max_seq_len,
+                kv_cache="paged", tp=deg, weights_version=1,
+                seed=args.seed)
+            batcher = ContinuousBatcher(engine,
+                                        max_queue=args.queue_depth,
+                                        default_deadline_s=0)
+            batcher.start()   # the flip commits at the batcher barrier
+            try:
+                sub = WeightSubscriber(batcher, store_dir)
+                info = sub.swap_to_info(2)
+                return int(info["pulled_bytes"])
+            finally:
+                batcher.stop()
+
+        pulled_tp1 = pull_bytes(1)
+        pulled_tp = pull_bytes(tp)
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+
+    ratio = round(pulled_tp / pulled_tp1, 4) if pulled_tp1 else None
+    summary = {
+        "metric": "serving_tp_tok_per_s",
+        "value": sharded["tok_per_s"],
+        "unit": "tok/s",
+        "tp": tp,
+        "requests": args.requests,
+        "failed": sharded["failed"],
+        "tokens_identical": identical,
+        "tok_per_s_tp1": base["tok_per_s"],
+        "tpot_ms_p50": sharded["tpot_ms_p50"],
+        "tpot_ms_p99": sharded["tpot_ms_p99"],
+        "tpot_tp1_ms_p50": base["tpot_ms_p50"],
+        "tpot_tp1_ms_p99": base["tpot_ms_p99"],
+        # Swap economics: the replica's critical-path pull is the max
+        # over its shards' parallel pulls; <= 0.6x TP=1 is acceptance.
+        "swap_pulled_bytes_tp1": pulled_tp1,
+        "swap_pulled_bytes_tp": pulled_tp,
+        "swap_pull_ratio": ratio,
+        "swap_pull_ratio_bound": 0.6,
+        "model": {"layers": args.layers, "d_model": args.d_model,
+                  "heads": args.heads, "vocab": args.vocab},
+    }
+    print(json.dumps(summary))
+    if args.out:
+        from horovod_tpu.obs import export as obs_export
+
+        with open(args.out, "w") as f:
+            json.dump({"platform": jax.default_backend(),
+                       "device_kind": jax.devices()[0].device_kind,
+                       "summary": summary,
+                       "metrics": obs_export.json_snapshot()["metrics"]},
+                      f, indent=1)
+    if ratio is not None and ratio > 0.6:
+        raise SystemExit(
+            f"swap pull ratio {ratio} exceeds the 0.6 bound: TP={tp} "
+            f"shards are not pulling ~1/{tp} of the manifest diff")
 
 
 def run_tenants(args, model, params, buckets) -> None:

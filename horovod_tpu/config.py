@@ -635,6 +635,9 @@ class Config:
     serve_kv_block: int = 16                  # HVD_TPU_SERVE_KV_BLOCK (tokens per KV block)
     serve_kv_blocks: int = 0                  # HVD_TPU_SERVE_KV_BLOCKS (pool budget in blocks; 0 = auto)
     serve_spec_k: int = 4                     # HVD_TPU_SERVE_SPEC_K (draft tokens per speculative verify step)
+    # Tensor-parallel serving replicas (docs/tp_serving.md)
+    serve_tp: int = 1                         # HVD_TPU_SERVE_TP (tensor-parallel shard count per replica; 1 = off)
+    serve_tp_step_timeout_s: float = 30.0     # HVD_TPU_SERVE_TP_STEP_TIMEOUT_S (lockstep frame deadline before the replica declares itself dead)
     # Disaggregated prefill/decode fleet (horovod_tpu/serve/fleet/;
     # the role-heterogeneous fleet organization of the 100k-GPU
     # collectives line — prefill is compute-bound, decode memory-bound)
@@ -753,6 +756,9 @@ class Config:
             serve_kv_block=_env_pos_int("SERVE_KV_BLOCK", 16),
             serve_kv_blocks=_env_int("SERVE_KV_BLOCKS", 0),
             serve_spec_k=_env_pos_int("SERVE_SPEC_K", 4),
+            serve_tp=_env_pos_int("SERVE_TP", 1),
+            serve_tp_step_timeout_s=_env_float("SERVE_TP_STEP_TIMEOUT_S",
+                                               30.0),
             fleet_role=_env_choice("FLEET_ROLE", "unified",
                                    ("prefill", "decode", "unified"))
             or "unified",

@@ -45,6 +45,16 @@ class GPTConfig:
     moe_every: int = 2                 # every Nth block is MoE (rest dense)
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
+    # Tensor-parallel serving (docs/tp_serving.md): a 1-D ``tensor``
+    # mesh makes one decode replica span ``tp`` chips.  Placement is
+    # column-parallel only (qkv/up kernels sharded on the output dim,
+    # heads sharded through attention) with an explicit all-gather
+    # before every contraction (out/down/lm_head stay replicated), so
+    # the sharded forward is bitwise identical to tp=1 — the property
+    # the serving token-identity oracle enforces.  ``Mesh`` is hashable,
+    # so the config stays a valid flax static argument.
+    tp_mesh: Optional[Mesh] = None
+    tp_axis: str = "tensor"
 
 
 def init_kv_cache(config: GPTConfig, batch_size: int, max_len: int):
@@ -68,6 +78,20 @@ def init_kv_cache(config: GPTConfig, batch_size: int, max_len: int):
 _NEG_INF = -1e30  # additive mask value (matches parallel/ring_attention)
 
 
+def _tp_shard(cfg: GPTConfig, x, *spec):
+    """Anchor ``x`` on the serving TP mesh (identity when unsharded).
+    A bare ``_tp_shard(cfg, x)`` — empty spec — forces the all-gather
+    that keeps the next contraction's input complete: the
+    gather-before-contract discipline that trades wire bytes for
+    bitwise identity with the tp=1 forward (docs/tp_serving.md)."""
+    if cfg.tp_mesh is None:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(cfg.tp_mesh, PartitionSpec(*spec)))
+
+
 class Attention(nn.Module):
     config: GPTConfig
     mesh: Optional[Mesh] = None
@@ -81,9 +105,16 @@ class Attention(nn.Module):
         qkv = nn.Dense(3 * C, use_bias=False, dtype=cfg.dtype,
                        param_dtype=cfg.param_dtype, name="qkv")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(B, T, H, D)
-        k = k.reshape(B, T, H, D)
-        v = v.reshape(B, T, H, D)
+        # Under TP the qkv kernel is column-sharded, so q/k/v arrive
+        # head-sharded; pin the layout explicitly so the paged pool
+        # writes and the attention einsums stay head-local (each shard
+        # computes its own H/tp heads completely — bitwise).
+        q = _tp_shard(cfg, q.reshape(B, T, H, D),
+                      None, None, cfg.tp_axis, None)
+        k = _tp_shard(cfg, k.reshape(B, T, H, D),
+                      None, None, cfg.tp_axis, None)
+        v = _tp_shard(cfg, v.reshape(B, T, H, D),
+                      None, None, cfg.tp_axis, None)
         proj = nn.Dense(C, use_bias=False, dtype=cfg.dtype,
                         param_dtype=cfg.param_dtype, name="out")
         if cache is not None:
@@ -125,9 +156,13 @@ class Attention(nn.Module):
             scores = jnp.where(visible[:, None], scores, _NEG_INF)
             probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
             out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_all)
+            # Gather-before-contract: the ``out`` kernel is replicated
+            # under TP, so the head outputs all-gather here and every
+            # shard computes the full projection — bitwise identical.
+            merged = _tp_shard(cfg, out.reshape(B, T, C))
             if paged:
-                return proj(out.reshape(B, T, C)), {"k": k, "v": v}
-            return proj(out.reshape(B, T, C)), {"k": k_all, "v": v_all}
+                return proj(merged), {"k": k, "v": v}
+            return proj(merged), {"k": k_all, "v": v_all}
         if cfg.attention == "ring":
             if self.mesh is None:
                 raise ValueError("attention='ring' requires a mesh")
@@ -161,7 +196,7 @@ class Attention(nn.Module):
             out = full_attention(q, k, v, causal=cfg.causal)
         else:
             raise ValueError(f"Unknown attention {cfg.attention!r}")
-        return proj(out.reshape(B, T, C))
+        return proj(_tp_shard(cfg, out.reshape(B, T, C)))
 
 
 class MlpBlock(nn.Module):
@@ -172,7 +207,12 @@ class MlpBlock(nn.Module):
         cfg = self.config
         x = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype, name="up")(x)
-        x = nn.gelu(x)
+        # Column-parallel ``up`` leaves the d_ff activation sharded;
+        # gelu is elementwise so the shard survives it, then the
+        # all-gather lands before the replicated ``down`` contraction
+        # (gather-before-contract: bitwise identical to tp=1).
+        x = _tp_shard(cfg, nn.gelu(x), None, None, cfg.tp_axis)
+        x = _tp_shard(cfg, x)
         return nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
                         param_dtype=cfg.param_dtype, name="down")(x)
 

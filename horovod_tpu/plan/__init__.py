@@ -19,4 +19,7 @@ from .mesh_plan import (  # noqa: F401
     fsdp_param_spec,
     layout_lattice,
     resolve_plan,
+    tp_owned_slice,
+    tp_param_spec,
+    tp_plan,
 )

@@ -79,6 +79,65 @@ def fsdp_param_spec(leaf, n: int, axis: str) -> P:
     return P(*spec)
 
 
+def tp_param_spec(path: str, leaf, tp: int, axis: str = "tensor") -> P:
+    """DEVICE placement for one parameter of a tensor-parallel serving
+    shard (docs/tp_serving.md).  Only the *column-parallel* projections
+    — ``qkv`` and the MLP ``up`` — shard (output dim over ``axis``);
+    every contraction whose input would be sharded (``out``, ``down``,
+    the head, the embeddings, the norms) stays replicated, with the
+    activations all-gathered first.  A column-parallel matmul computes
+    each output element from the full contraction, so this placement is
+    *bitwise identical* to the unsharded forward — the property the
+    token-identity oracle (tests/test_tp_serving.py) enforces.  Byte
+    savings on the wire come from :func:`tp_owned_slice` instead, which
+    is free to slice every leaf."""
+    shape = tuple(getattr(leaf, "shape", ()))
+    if tp <= 1:
+        return P()
+    segs = path.split("/")
+    if "qkv" in segs or "up" in segs:
+        if len(shape) == 2 and shape[1] % tp == 0:
+            return P(None, axis)           # kernel: [in, out] -> out sharded
+        if len(shape) == 1 and shape[0] % tp == 0:
+            return P(axis)                 # bias rides the output dim
+    return P()
+
+
+def tp_owned_slice(path: str, shape: Sequence[int], tp: int,
+                   rank: int) -> Optional[Tuple[int, int, int]]:
+    """WIRE ownership for one parameter under tensor parallelism:
+    ``(dim, start, stop)`` of the contiguous slice shard ``rank`` owns,
+    or ``None`` when the leaf is too small to divide (owned whole by
+    every shard).  Deliberately distinct from :func:`tp_param_spec`:
+    device placement is constrained by bitwise identity, but *transport*
+    ownership only needs a deterministic partition that reassembles
+    exactly (``np.concatenate`` of the slices in rank order), so every
+    ``tp``-divisible leaf shards — swap pull bytes drop ~1/tp even for
+    the leaves that stay replicated on device.  Same largest-divisible-
+    dim rule as :func:`fsdp_param_spec` so the layout needs no table."""
+    del path  # ownership is shape-determined; path kept for call symmetry
+    if tp <= 1:
+        return None
+    candidates = [(s, i) for i, s in enumerate(shape)
+                  if s % tp == 0 and s >= tp]
+    if not candidates:
+        return None
+    size, dim = max(candidates)
+    span = size // tp
+    return (dim, rank * span, (rank + 1) * span)
+
+
+def tp_plan(tp: int, *, devices=None) -> "MeshPlan":
+    """The serving-replica plan: a 1-D ``tensor`` axis over the first
+    ``tp`` local devices.  Decode is a per-replica workload — the TP
+    mesh never spans replicas, so it takes a device *prefix*, leaving
+    the rest of the host mesh for co-located replicas."""
+    if devices is None:
+        devices = jax.devices()
+    return MeshPlan.from_axes({"tensor": int(tp)},
+                              devices=list(devices)[:tp])
+
+
 @dataclasses.dataclass(frozen=True)
 class MeshPlan:
     """Declared axes over a device mesh; single source of truth for the

@@ -106,16 +106,25 @@ class KvMigrateRequest:
     verifies before binding anything into its own pool; ``k_blocks`` /
     ``v_blocks`` are ``[n_layer, frame_blocks, block, H, D]`` numpy
     arrays, chunked so each frame stays under
-    ``HVD_TPU_FLEET_MIGRATE_CHUNK`` bytes."""
+    ``HVD_TPU_FLEET_MIGRATE_CHUNK`` bytes.
+
+    Tensor-parallel senders (docs/tp_serving.md) split the transfer
+    head-wise into ``n_shards`` independent streams — frame arrays then
+    carry only that shard's ``H/tp`` heads, ``seq``/``total`` count
+    within the shard, and the manifest's ``shard_digests`` verify each
+    stream before the receiver concatenates heads back together."""
 
     def __init__(self, request_id: str, seq: int, total: int,
-                 k_blocks, v_blocks, manifest: Optional[dict] = None):
+                 k_blocks, v_blocks, manifest: Optional[dict] = None,
+                 shard: int = 0, n_shards: int = 1):
         self.request_id = request_id
         self.seq = seq
         self.total = total
         self.k_blocks = k_blocks
         self.v_blocks = v_blocks
         self.manifest = manifest
+        self.shard = shard
+        self.n_shards = n_shards
 
 
 class KvMigrateResponse:

@@ -82,3 +82,7 @@ from .swap import (  # noqa: F401
     SwapAbandonedError, SwapFailedError, SwapRejectedError,
     WeightSubscriber,
 )
+from .tp import (  # noqa: F401
+    ShardFollower, ShardLockstepError, ShardServer, ShardStepRequest,
+    ShardStepResponse,
+)

@@ -161,6 +161,7 @@ class ContinuousBatcher:
             raise ValueError(f"unknown fleet role {self.role!r}; "
                              f"expected prefill|decode|unified")
         self._migrator = None    # set by the server on prefill replicas
+        self._lockstep = None    # set on TP replica leaders (serve/tp.py)
         self.stats = ServingStats(weights_version=engine.weights_version)
         # Multi-tenant QoS (serve/qos/): flow weights + tenant budgets
         # from the HVD_TPU_QOS_* knobs; the admission queue is the
@@ -309,6 +310,28 @@ class ContinuousBatcher:
         ``serve/fleet/migration.migrate_slot`` here on prefill
         replicas)."""
         self._migrator = migrator
+
+    def set_lockstep(self, lockstep) -> None:
+        """Install the TP follower-dispatch callable
+        (``lockstep(op, payload) -> list``; rank 0 of a tensor-parallel
+        replica wires :class:`~horovod_tpu.serve.tp.ShardFollower`
+        here).  Every prefill start, decode step, and slot release is
+        dispatched to the follower shard ranks BEFORE the local engine
+        executes it, so all ranks hold identical host-side KV state at
+        each step boundary; any lockstep failure kills the whole
+        replica (``shard_rank_lost``) — docs/tp_serving.md."""
+        self._lockstep = lockstep
+
+    def _lockstep_dispatch(self, op: str, payload=None) -> None:
+        """One follower dispatch; a lost/refusing/hung shard rank is
+        replica death — a partial shard group must never keep serving
+        (the router re-runs the failed requests on a survivor)."""
+        try:
+            self._lockstep(op, payload)
+        except Exception as e:
+            reason = f"shard_rank_lost: {e}"
+            self._die(reason)
+            raise ReplicaKilledError(reason) from e
 
     def submit(self, prompt: Sequence[int],
                sampling: Optional[SamplingParams] = None,
@@ -459,6 +482,8 @@ class ContinuousBatcher:
         if req is None:
             return False
         if target_slot is not None:
+            if self._lockstep is not None:
+                self._lockstep_dispatch("release", {"slot": target_slot})
             self.engine.release(target_slot)
         self._settle_budget(req)
         req.finish(error="cancelled")
@@ -477,6 +502,12 @@ class ContinuousBatcher:
             for s, r in running:
                 del self._slots[s]
                 self.engine.release(s)
+        if self._lockstep is not None:
+            # Outside the lock (_die on a lost shard needs it); the
+            # batcher thread owns slot reuse, so the release dispatch
+            # still precedes any new "start" for these slots.
+            for s, _ in running:
+                self._lockstep_dispatch("release", {"slot": s})
         for r in queued + [r for _, r in running]:
             self._settle_budget(r)
             self.stats.record_expired(r.qos_class)
@@ -508,6 +539,11 @@ class ContinuousBatcher:
     def _finish_slot(self, slot: int, req: ServeRequest) -> None:
         with self._lock:
             self._slots.pop(slot, None)
+        if self._lockstep is not None:
+            # TP lockstep: followers free the slot before the leader —
+            # the next admission dispatches a "start" for it, and a
+            # follower whose slot is still active would refuse it.
+            self._lockstep_dispatch("release", {"slot": slot})
         self.engine.release(slot)
         # Stats and trace record BEFORE `done` fires: the instant
         # finish() unblocks the waiting RPC handler, a client can get
@@ -570,6 +606,13 @@ class ContinuousBatcher:
             req.resume_state = None
             req.tokens.clear()
             resumed = False
+        if self._lockstep is not None and not imported and not resumed:
+            # TP lockstep: followers prefill the same slot before the
+            # leader does — a lost shard here kills the replica, never
+            # just this request (partial shard groups don't serve).
+            self._lockstep_dispatch("start", {
+                "slot": slot, "prompt": list(req.prompt),
+                "sampling": req.sampling})
         try:
             if imported:
                 # Migrated-in request: bind the wire-received KV in
@@ -609,6 +652,10 @@ class ContinuousBatcher:
         except Exception as e:   # defensive: engine bug ≠ wedged slot
             with self._lock:
                 self._slots.pop(slot, None)
+            if self._lockstep is not None and not imported and not resumed:
+                # Followers already prefilled this slot; free it there
+                # too or the next admission's "start" finds it active.
+                self._lockstep_dispatch("release", {"slot": slot})
             self.engine.release(slot)
             self._settle_budget(req)
             self.stats.record_failed(req.qos_class)
@@ -633,6 +680,8 @@ class ContinuousBatcher:
             # here or the slot leaks as a ghost forever.
             with self._lock:
                 self._slots.pop(slot, None)
+            if self._lockstep is not None and not imported and not resumed:
+                self._lockstep_dispatch("release", {"slot": slot})
             self.engine.release(slot)
             return emitted
         now2 = time.monotonic()
@@ -763,6 +812,12 @@ class ContinuousBatcher:
                 reason = "injected replica kill mid-decode"
                 self._die(reason)
                 raise ReplicaKilledError(reason)
+            if self._lockstep is not None:
+                # TP lockstep: followers decode this round first; their
+                # acks carry token digests (serve/tp.py::step_digest)
+                # the leader could cross-check — a wire death or
+                # deadline here is replica death, single-strike.
+                self._lockstep_dispatch("step", {})
             tokens = self.engine.step()
             now = time.monotonic()
             for slot, toks in tokens.items():

@@ -57,6 +57,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
 
 from ..models.transformer import GPT, init_kv_cache
 from ..utils.logging import get_logger
@@ -128,6 +129,7 @@ class InferenceEngine:
                  kv_blocks: Optional[int] = None,
                  drafter: Optional[Tuple[GPT, dict]] = None,
                  spec_k: Optional[int] = None,
+                 tp: Optional[int] = None,
                  weights_version: int = 0,
                  seed: int = 0):
         cfg = resolved_config()
@@ -149,6 +151,39 @@ class InferenceEngine:
         if self.kv_mode not in ("paged", "dense"):
             raise ValueError(f"unknown kv_cache mode {self.kv_mode!r}; "
                              f"expected 'paged' or 'dense'")
+        # Tensor-parallel replica (docs/tp_serving.md): the forward
+        # shards over a 1-D ``tensor`` mesh spanning the first ``tp``
+        # local devices — column-parallel qkv/up placement plus the
+        # model's gather-before-contract constraints keep the decode
+        # bitwise identical to tp=1, so TP is a capacity/latency knob,
+        # never a correctness one.  The paged KV pool shards on its
+        # head dim (each device holds H/tp heads of every block) while
+        # the block table and BlockPool bookkeeping stay rank-invariant
+        # host state.
+        self.tp = int(tp if tp is not None else cfg.serve_tp)
+        self._tp_mesh = None
+        if self.tp < 1:
+            raise ValueError(f"tp must be >= 1, got {self.tp}")
+        if self.tp > 1:
+            if self.kv_mode != "paged":
+                raise ValueError(
+                    "tensor-parallel serving requires the paged KV "
+                    "cache (HVD_TPU_SERVE_KV=paged) — the head-sharded "
+                    "pool is the TP layout")
+            if model.config.n_head % self.tp:
+                raise ValueError(
+                    f"tp={self.tp} must divide the model's head count "
+                    f"({model.config.n_head}) for the head-sharded pool")
+            from ..plan import tp_plan
+
+            plan = tp_plan(self.tp)
+            self._tp_mesh = plan.mesh
+            self._model = model = GPT(
+                config=dataclasses.replace(model.config,
+                                           tp_mesh=plan.mesh,
+                                           tp_axis="tensor"),
+                mesh=model.mesh)
+            self._params = params = self._tp_place_params(params)
         # Slot-state arrays: every mutation goes through the guarded
         # helpers below (_bind_slot / _advance_slot / _clear_slot) so
         # the hvdlint lock checker covers them — release() arrives from
@@ -203,8 +238,19 @@ class InferenceEngine:
                     f"requests could deadlock on allocation")
             self.kv_blocks = budget
             shape = (budget, self.kv_block, model.config.n_head, head_dim)
-            self._pools = [{"k": jnp.zeros(shape, model.config.dtype),
-                            "v": jnp.zeros(shape, model.config.dtype)}
+
+            def _pool_zeros():
+                z = jnp.zeros(shape, model.config.dtype)
+                if self._tp_mesh is not None:
+                    # Head-sharded pool: each shard device holds only
+                    # its H/tp heads of every block; the block table
+                    # stays whole-pool host state.
+                    z = jax.device_put(z, NamedSharding(
+                        self._tp_mesh,
+                        PartitionSpec(None, None, "tensor", None)))
+                return z
+
+            self._pools = [{"k": _pool_zeros(), "v": _pool_zeros()}
                            for _ in range(n_layer)]
             # Block table: one trailing trash column the jitted
             # programs clamp invalid positions into (serve/kv/pool.py).
@@ -217,8 +263,16 @@ class InferenceEngine:
             self._import_fn = jax.jit(
                 self._import_impl,
                 donate_argnums=(0,) if self._donate else ())
-            self._kv = BlockPool(budget, self.kv_block, self._table,
-                                 self._copy_block)
+            dt_size = np.dtype(model.config.dtype).itemsize
+            self._kv = BlockPool(
+                budget, self.kv_block, self._table, self._copy_block,
+                heads=model.config.n_head // self.tp,
+                tp_degree=self.tp,
+                # Per-SHARD bytes of one block: K+V rows for the H/tp
+                # heads this shard holds, across every layer.
+                bytes_per_block=(2 * n_layer * self.kv_block
+                                 * (model.config.n_head // self.tp)
+                                 * head_dim * dt_size))
             self._caches = None
             self._decode_fn = jax.jit(self._decode_paged_impl,
                                       donate_argnums=self._donate)
@@ -261,6 +315,27 @@ class InferenceEngine:
                 self._spec_draft_impl, donate_argnums=self._donate)
             self._spec_verify_fn = jax.jit(
                 self._spec_verify_impl, donate_argnums=self._donate)
+
+    # --- tensor-parallel placement ------------------------------------------
+
+    def _tp_place_params(self, tree):
+        """Place a host/device param tree on the TP mesh per the
+        planner's device rule (``plan.tp_param_spec``): qkv/up kernels
+        column-sharded, everything else replicated.  Used at
+        construction AND by :meth:`stage_params` so a hot-swapped tree
+        lands with the layout the compiled programs were traced for —
+        a swap never costs a recompile."""
+        from ..ckpt.snapshot import path_string
+        from ..plan import tp_param_spec
+
+        flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        placed = [
+            jax.device_put(leaf, NamedSharding(
+                self._tp_mesh,
+                tp_param_spec(path_string(path), leaf, self.tp)))
+            for path, leaf in flat
+        ]
+        return jax.tree_util.tree_unflatten(treedef, placed)
 
     # --- paged-view geometry ------------------------------------------------
 
@@ -737,6 +812,13 @@ class InferenceEngine:
             draft, self._drafter_caches = self._spec_draft_fn(
                 self._drafter_params, self._drafter_caches,
                 jnp.asarray(last_tokens), jnp.asarray(positions))
+            if self._tp_mesh is not None:
+                # The drafter runs single-device (it is the small model
+                # by construction); re-home its committed draft onto the
+                # TP mesh so the verify program sees one device set.
+                draft = jax.device_put(
+                    np.asarray(draft),
+                    NamedSharding(self._tp_mesh, PartitionSpec()))
             out, accepted, self._pools = self._spec_verify_fn(
                 self._params, self._pools, jnp.asarray(self._table),
                 jnp.asarray(last_tokens), draft,
@@ -930,7 +1012,10 @@ class InferenceEngine:
         flip is one reference assignment, not a transfer.  Replaces any
         previously staged version (last writer wins — the newest intact
         step is the one worth flipping to)."""
-        device = jax.tree_util.tree_map(jnp.asarray, tree)
+        if self._tp_mesh is not None:
+            device = self._tp_place_params(tree)
+        else:
+            device = jax.tree_util.tree_map(jnp.asarray, tree)
         with self._slot_lock:
             self._staged_params = device
             self._staged_version = int(version)

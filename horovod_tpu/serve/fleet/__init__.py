@@ -31,5 +31,5 @@ from .controller import FleetController, ReplicaLauncher, ROLES  # noqa: F401
 from .directory import PrefixDirectory  # noqa: F401
 from .migration import (  # noqa: F401
     MigrationBuffer, MigrationError, block_digests, migrate_slot,
-    verify_digests,
+    shard_digests, verify_digests, verify_shard_digests,
 )

@@ -60,20 +60,52 @@ def block_digests(k: np.ndarray, v: np.ndarray) -> List[str]:
             for j in range(k.shape[1])]
 
 
-def verify_digests(manifest: dict, k: np.ndarray, v: np.ndarray) -> None:
-    """Receiver-side transfer verification; raises
-    :class:`MigrationError` on any mismatch — nothing unverified ever
-    reaches the receiving pool."""
-    digests = manifest.get("digests") or []
-    if k.shape[1] != manifest.get("n_blocks") or len(digests) != k.shape[1]:
+def shard_digests(k: np.ndarray, v: np.ndarray, tp: int) -> List[List[str]]:
+    """Per-shard, per-block digest lists for a tensor-parallel
+    migration (docs/tp_serving.md): shard ``s`` owns the contiguous
+    head range ``[s*H/tp, (s+1)*H/tp)`` of every block, and its digest
+    list covers exactly the bytes its wire stream carries — each stream
+    verifies independently, so one damaged shard fails the transfer
+    without waiting for the others."""
+    hs = k.shape[3] // tp
+    return [block_digests(k[:, :, :, s * hs:(s + 1) * hs],
+                          v[:, :, :, s * hs:(s + 1) * hs])
+            for s in range(tp)]
+
+
+def _check_digests(digests: List[str], n_blocks, k: np.ndarray,
+                   v: np.ndarray, what: str) -> None:
+    if k.shape[1] != n_blocks or len(digests) != k.shape[1]:
         raise MigrationError(
-            f"migration shape mismatch: {k.shape[1]} block(s) received, "
-            f"manifest declares {manifest.get('n_blocks')}")
+            f"migration shape mismatch: {k.shape[1]} block(s) received "
+            f"{what}, manifest declares {n_blocks}")
     got = block_digests(k, v)
     for j, (want, have) in enumerate(zip(digests, got)):
         if want != have:
             raise MigrationError(f"digest_mismatch: block {j} of "
-                                 f"{len(digests)} failed verification")
+                                 f"{len(digests)} failed verification "
+                                 f"{what}")
+
+
+def verify_digests(manifest: dict, k: np.ndarray, v: np.ndarray) -> None:
+    """Receiver-side transfer verification; raises
+    :class:`MigrationError` on any mismatch — nothing unverified ever
+    reaches the receiving pool."""
+    _check_digests(manifest.get("digests") or [],
+                   manifest.get("n_blocks"), k, v, "")
+
+
+def verify_shard_digests(manifest: dict, shard: int, k: np.ndarray,
+                         v: np.ndarray) -> None:
+    """Per-stream verification of one shard's head slice against the
+    manifest's ``shard_digests`` entry."""
+    per_shard = manifest.get("shard_digests") or []
+    if shard >= len(per_shard):
+        raise MigrationError(
+            f"shard {shard} not covered by the manifest's "
+            f"{len(per_shard)} shard digest list(s)")
+    _check_digests(per_shard[shard], manifest.get("n_blocks"), k, v,
+                   f"(shard {shard})")
 
 
 def plan_frames(n_blocks: int, per_block_bytes: int,
@@ -128,6 +160,14 @@ def migrate_slot(engine, slot: int, req, target, key: bytes, *,
         "tenant": req.tenant,
         "qos_class": req.qos_class,
     }
+    tp = int(getattr(engine, "tp", 1) or 1)
+    if tp > 1:
+        # Tensor-parallel sender (docs/tp_serving.md): the manifest
+        # carries one digest list PER SHARD beside the whole-block
+        # list, so each head-sliced wire stream verifies independently
+        # on the receiver before heads are concatenated back.
+        manifest["tp_degree"] = tp
+        manifest["shard_digests"] = shard_digests(k, v, tp)
     nbytes = int(k.nbytes + v.nbytes)
     mode = (faults_mod.on_serve_migrate()
             if faults_mod._active is not None else None)
@@ -146,24 +186,31 @@ def migrate_slot(engine, slot: int, req, target, key: bytes, *,
                 # reject this payload — the wrong-tokens-never drill.
                 k = k.copy()
                 k.reshape(-1).view(np.uint8)[:16] ^= 0xFF
-            client = BasicClient(name, addresses, key,
-                                 probe_timeout=probe_timeout,
-                                 retry_policy=RetryPolicy(attempts=1))
-            per_block = (int(k[:, :1].nbytes) + int(v[:, :1].nbytes)
-                         if nb else 0)
-            frames = plan_frames(nb, per_block, chunk)
-            for seq, (j0, j1) in enumerate(frames):
+            if tp > 1:
                 sent = True
-                resp = client.request(
-                    KvMigrateRequest(
-                        req.request_id, seq, len(frames),
-                        np.ascontiguousarray(k[:, j0:j1]),
-                        np.ascontiguousarray(v[:, j0:j1]),
-                        manifest=manifest if seq == 0 else None),
-                    idempotent=False, timeout=wire_timeout)
-                err = getattr(resp, "error", None)
-                if err:
-                    raise MigrationError(f"decode replica {name}: {err}")
+                _stream_shards(req.request_id, k, v, tp, manifest,
+                               name, addresses, key, nb, chunk,
+                               probe_timeout, wire_timeout)
+            else:
+                client = BasicClient(name, addresses, key,
+                                     probe_timeout=probe_timeout,
+                                     retry_policy=RetryPolicy(attempts=1))
+                per_block = (int(k[:, :1].nbytes) + int(v[:, :1].nbytes)
+                             if nb else 0)
+                frames = plan_frames(nb, per_block, chunk)
+                for seq, (j0, j1) in enumerate(frames):
+                    sent = True
+                    resp = client.request(
+                        KvMigrateRequest(
+                            req.request_id, seq, len(frames),
+                            np.ascontiguousarray(k[:, j0:j1]),
+                            np.ascontiguousarray(v[:, j0:j1]),
+                            manifest=manifest if seq == 0 else None),
+                        idempotent=False, timeout=wire_timeout)
+                    err = getattr(resp, "error", None)
+                    if err:
+                        raise MigrationError(
+                            f"decode replica {name}: {err}")
         ms = (time.monotonic() - t0) * 1e3
         _obs.on_fleet_migration(nbytes, True, ms)
         req.migrate_ms = round(ms, 3)
@@ -179,6 +226,59 @@ def migrate_slot(engine, slot: int, req, target, key: bytes, *,
         logger.warning("KV migration of %s to %s failed: %s",
                        req.request_id, name, e)
         raise MigrationError(str(e)) from e
+
+
+def _stream_shards(request_id: str, k: np.ndarray, v: np.ndarray,
+                   tp: int, manifest: dict, name, addresses, key: bytes,
+                   nb: int, chunk: int, probe_timeout: float,
+                   wire_timeout: float) -> None:
+    """Stream a TP sender's KV shard-to-shard in parallel: one thread
+    and one wire connection per head shard, each carrying only its
+    ``H/tp`` heads of every block (so TP cuts per-stream migration
+    bytes AND wall time ~linearly).  The manifest rides every shard's
+    first frame — streams race, and the receiver needs it no matter
+    which lands first.  Any shard failure fails the whole transfer
+    (the sender falls back to decoding locally; a half-headed adoption
+    is never possible because the receiver binds nothing until every
+    shard verified)."""
+    hs = k.shape[3] // tp
+    errors: List[Optional[Exception]] = [None] * tp
+
+    def run(shard: int) -> None:
+        try:
+            ks = np.ascontiguousarray(k[:, :, :, shard * hs:(shard + 1) * hs])
+            vs = np.ascontiguousarray(v[:, :, :, shard * hs:(shard + 1) * hs])
+            client = BasicClient(name, addresses, key,
+                                 probe_timeout=probe_timeout,
+                                 retry_policy=RetryPolicy(attempts=1))
+            per_block = (int(ks[:, :1].nbytes) + int(vs[:, :1].nbytes)
+                         if nb else 0)
+            frames = plan_frames(nb, per_block, chunk)
+            for seq, (j0, j1) in enumerate(frames):
+                resp = client.request(
+                    KvMigrateRequest(
+                        request_id, seq, len(frames),
+                        np.ascontiguousarray(ks[:, j0:j1]),
+                        np.ascontiguousarray(vs[:, j0:j1]),
+                        manifest=manifest if seq == 0 else None,
+                        shard=shard, n_shards=tp),
+                    idempotent=False, timeout=wire_timeout)
+                err = getattr(resp, "error", None)
+                if err:
+                    raise MigrationError(f"decode replica {name}: {err}")
+        except (OSError, MigrationError) as e:
+            errors[shard] = e
+
+    threads = [threading.Thread(target=run, args=(s,), daemon=True,
+                                name=f"kv-migrate-shard{s}")
+               for s in range(tp)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for e in errors:
+        if e is not None:
+            raise MigrationError(str(e)) from e
 
 
 def _cancel_on_target(name, addresses, key, request_id) -> None:
@@ -211,32 +311,59 @@ class MigrationBuffer:
         """Buffer one frame; returns the digest-verified ``(manifest,
         k, v)`` when the transfer completed, None while frames are
         still missing.  Raises :class:`MigrationError` (and drops the
-        buffer) on digest mismatch."""
+        buffer) on digest mismatch.
+
+        Tensor-parallel transfers interleave ``n_shards`` independent
+        streams (frames keyed by ``(shard, seq)``): each shard's head
+        slice assembles and digest-verifies on its own, then heads
+        concatenate back in shard order — so the returned ``k``/``v``
+        are always the full-head arrays regardless of the sender's TP
+        degree, and a single damaged shard fails the whole transfer
+        before anything reaches the pool."""
         now = time.monotonic()
         rid = frame.request_id
+        shard = int(getattr(frame, "shard", 0) or 0)
+        n_shards = int(getattr(frame, "n_shards", 1) or 1)
         with self._lock:
             for stale in [r for r, e in self._pending.items()
                           if now - e["t0"] > self.ttl_s]:
                 del self._pending[stale]
             ent = self._pending.setdefault(
                 rid, {"frames": {}, "manifest": None, "t0": now,
-                      "total": int(frame.total)})
-            ent["frames"][int(frame.seq)] = (frame.k_blocks,
-                                             frame.v_blocks)
+                      "totals": {}, "n_shards": n_shards})
+            ent["n_shards"] = max(ent["n_shards"], n_shards)
+            ent["totals"][shard] = int(frame.total)
+            ent["frames"][(shard, int(frame.seq))] = (frame.k_blocks,
+                                                      frame.v_blocks)
             if frame.manifest is not None:
                 ent["manifest"] = frame.manifest
-            if (len(ent["frames"]) < ent["total"]
-                    or ent["manifest"] is None):
+            done = (ent["manifest"] is not None
+                    and len(ent["totals"]) == ent["n_shards"]
+                    and all(
+                        sum(1 for (s, _) in ent["frames"] if s == sh) >= tot
+                        for sh, tot in ent["totals"].items()))
+            if not done:
                 return None
             del self._pending[rid]
-        if ent["total"] == 1:
-            k, v = ent["frames"][0]
+        shards_kv = []
+        for sh in range(ent["n_shards"]):
+            tot = ent["totals"][sh]
+            if tot == 1:
+                k_s, v_s = ent["frames"][(sh, 0)]
+            else:
+                k_s = np.concatenate([ent["frames"][(sh, s)][0]
+                                      for s in range(tot)], axis=1)
+                v_s = np.concatenate([ent["frames"][(sh, s)][1]
+                                      for s in range(tot)], axis=1)
+            shards_kv.append((k_s, v_s))
+        if ent["n_shards"] == 1:
+            k, v = shards_kv[0]
+            verify_digests(ent["manifest"], k, v)
         else:
-            k = np.concatenate([ent["frames"][s][0]
-                                for s in range(ent["total"])], axis=1)
-            v = np.concatenate([ent["frames"][s][1]
-                                for s in range(ent["total"])], axis=1)
-        verify_digests(ent["manifest"], k, v)
+            for sh, (k_s, v_s) in enumerate(shards_kv):
+                verify_shard_digests(ent["manifest"], sh, k_s, v_s)
+            k = np.concatenate([ks for ks, _ in shards_kv], axis=3)
+            v = np.concatenate([vs for _, vs in shards_kv], axis=3)
         return ent["manifest"], k, v
 
     def discard(self, request_id: str) -> None:

@@ -65,13 +65,28 @@ class BlockPool:
     """
 
     def __init__(self, num_blocks: int, block_tokens: int, table,
-                 copy_block) -> None:
+                 copy_block, *, heads: Optional[int] = None,
+                 tp_degree: int = 1,
+                 bytes_per_block: Optional[int] = None) -> None:
         if num_blocks < 2:
             raise ValueError(
                 f"block pool needs >= 2 blocks (one is the reserved "
                 f"trash block), got {num_blocks}")
         self.num_blocks = int(num_blocks)
         self.block = int(block_tokens)
+        # Tensor-parallel geometry (docs/tp_serving.md): under TP each
+        # shard device holds only ``heads`` (= H/tp) heads of every
+        # block, and ``bytes_per_block`` is that per-shard footprint —
+        # capacity math, not allocation state.  Block ids, refcounts,
+        # the prefix index, and the trash-block discipline are
+        # rank-invariant host state: every shard of a replica sees the
+        # SAME table, so ``kv_blocks_in_use`` keeps fleet-comparable
+        # semantics at any TP degree (a block is in use once per
+        # replica, never once per shard).
+        self.heads = None if heads is None else int(heads)
+        self.tp_degree = int(tp_degree)
+        self.bytes_per_block = (None if bytes_per_block is None
+                                else int(bytes_per_block))
         self._table = table                       # guarded-by: _lock
         self._copy_block = copy_block
         self._lock = threading.Lock()
@@ -121,6 +136,9 @@ class BlockPool:
                 "kv_cow_copies_total": self.cow_copies_total,
                 "kv_prefix_hits_total": self.prefix_hits_total,
                 "kv_prefix_tokens_shared": self.prefix_tokens_shared,
+                "heads": self.heads,
+                "tp_degree": self.tp_degree,
+                "bytes_per_block": self.bytes_per_block,
             }
 
     def chain_blocks(self, slot: int) -> List[int]:

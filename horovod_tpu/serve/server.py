@@ -182,7 +182,9 @@ class InferenceServer(BasicService):
                  start_batcher: bool = True,
                  migrate_chunk_bytes: Optional[int] = None,
                  swap_store: Optional[str] = None,
-                 subscribe: bool = True):
+                 subscribe: bool = True,
+                 tp_peers: Optional[List[Tuple[str, List[Tuple[str,
+                                                               int]]]]] = None):
         super().__init__(name, key, host=host, nics=nics)
         self._batcher = batcher
         self.replica_ranks = list(replica_ranks) if replica_ranks else None
@@ -211,6 +213,15 @@ class InferenceServer(BasicService):
                                     self._key, chunk_bytes=chunk)
 
             batcher.set_migrator(_migrator)
+        # Tensor-parallel replica leader (serve/tp.py; docs/
+        # tp_serving.md): ``tp_peers`` names this replica's follower
+        # shard ranks — ``[(service_name, [(ip, port), ...]), ...]`` —
+        # and installs the lockstep dispatch on the batcher BEFORE it
+        # starts, over the same HMAC key (one credential system).
+        if tp_peers:
+            from .tp import ShardFollower
+
+            batcher.set_lockstep(ShardFollower(list(tp_peers), key))
         if start_batcher:
             batcher.start()
 

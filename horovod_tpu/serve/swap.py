@@ -390,6 +390,9 @@ class WeightSubscriber:
                 raise SwapRejectedError(
                     f"step {step}: leaf {entry['path']} failed digest "
                     f"verification; staged pull discarded")
+        tp = int(getattr(self._engine, "tp", 1) or 1)
+        shard_bytes = (self._shard_pull(leaves, manifest, tp)
+                       if tp > 1 and leaves else None)
         self._remaining(t0)
         tree = self._merge(manifest, leaves)
         self._engine.stage_params(tree, step)
@@ -417,13 +420,63 @@ class WeightSubscriber:
                 new_have[path] = (entry["digest"], arr)
             self._have = new_have
             self._version = int(version)
-        return {
+        out = {
             "step": step,
             "pulled_leaves": len(changed),
             "total_leaves": len(manifest.entries),
             "pulled_bytes": nbytes,
             "total_bytes": manifest.nbytes,
         }
+        if shard_bytes is not None:
+            # Per-shard accounting (docs/tp_serving.md): shards pull in
+            # parallel, so the replica's store-traffic critical path is
+            # the WIDEST shard, not the sum — that max is what
+            # ``pulled_bytes`` means on a TP replica.  The tp=1
+            # equivalent (the whole manifest diff) stays available as
+            # ``pulled_bytes_full`` for the bench's ratio.
+            out["tp"] = tp
+            out["pulled_bytes_per_shard"] = shard_bytes
+            out["pulled_bytes_full"] = nbytes
+            out["pulled_bytes"] = max(shard_bytes)
+        return out
+
+    def _shard_pull(self, leaves: Dict[str, np.ndarray],
+                    manifest: Manifest, tp: int):
+        """Carve each pulled leaf into the per-shard slices the
+        planner's ownership rule assigns (``plan.tp_owned_slice``) and
+        reassemble.  On a multi-host TP replica every shard issues its
+        own store read for exactly the slice it owns and the full leaf
+        exists again only after the intra-replica all-gather, so the
+        slow store moves ~1/tp of the diff per shard; this CPU tier
+        reads the local store once, then runs the same carve +
+        ``np.concatenate`` reassembly so the ownership path is
+        exercised end-to-end and the per-shard byte accounting is real
+        slice metadata, not an estimate.  Leaves too small to divide
+        are replicated: every shard pulls them whole.  Returns
+        per-shard pulled bytes and replaces ``leaves`` entries with the
+        reassembled arrays (bit-equal by construction — the digest
+        check already passed on the full read)."""
+        from ..plan import tp_owned_slice
+
+        per_shard = [0] * tp
+        for leaf_id, arr in list(leaves.items()):
+            path = manifest.entries[leaf_id]["path"]
+            first = tp_owned_slice(path, arr.shape, tp, 0)
+            if first is None:
+                for r in range(tp):
+                    per_shard[r] += int(arr.nbytes)
+                continue
+            dim = first[0]
+            parts = []
+            for r in range(tp):
+                _, start, stop = tp_owned_slice(path, arr.shape, tp, r)
+                idx = [slice(None)] * arr.ndim
+                idx[dim] = slice(start, stop)
+                part = np.ascontiguousarray(arr[tuple(idx)])
+                per_shard[r] += int(part.nbytes)
+                parts.append(part)
+            leaves[leaf_id] = np.concatenate(parts, axis=dim)
+        return per_shard
 
     def _merge(self, manifest: Manifest,
                leaves: Dict[str, np.ndarray]) -> Any:

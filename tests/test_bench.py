@@ -164,6 +164,47 @@ def test_serving_bench_fleet_contract(tmp_path):
 
 
 @pytest.mark.slow
+def test_serving_bench_tp_contract(tmp_path):
+    """ISSUE 19 satellite + acceptance: the tensor-parallel replica
+    bench runs TP=1 and TP=2 over the same workload (token identity is
+    asserted inside the bench — it exits non-zero on divergence),
+    reports TPOT at both degrees, and shows the hot-swap manifest pull
+    dropping to <= 60% of the TP=1 bytes; ``bench_regress`` accepts
+    the artifact."""
+    out_path = str(tmp_path / "serving_tp.json")
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmarks",
+                                      "serving_bench.py"),
+         "--tp", "2", "--cpu-mesh", "--requests", "6", "--warmup", "1",
+         "--max-new-tokens", "4", "--buckets", "16", "--slots", "2",
+         "--prompt-max", "12", "--out", out_path],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "XLA_FLAGS": "", "JAX_PLATFORMS": ""},
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "serving_tp_tok_per_s"
+    assert row["tp"] == 2
+    assert row["value"] > 0 and row["tok_per_s_tp1"] > 0
+    assert row["failed"] == 0
+    assert row["tokens_identical"] is True
+    assert row["tpot_ms_p50"] and row["tpot_tp1_ms_p50"]
+    # The r19 acceptance bound: a TP=2 swap pull moves <= 60% of the
+    # bytes the TP=1 replica pulls for the same manifest diff.
+    assert row["swap_pulled_bytes_tp1"] > 0
+    assert row["swap_pull_ratio"] <= 0.6, row
+    artifact = json.load(open(out_path))
+    assert artifact["summary"]["swap_pull_ratio"] <= 0.6
+    assert "metrics" in artifact
+    regress = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts",
+                                      "bench_regress.py"),
+         out_path, out_path],
+        capture_output=True, text=True, timeout=60)
+    assert regress.returncode == 0, regress.stdout[-500:]
+
+
+@pytest.mark.slow
 def test_serving_bench_swap_contract(tmp_path):
     """ISSUE 14 satellite: the hot-swap bench drives bursty load
     through rolling weight swaps from a checkpoint store and reports

@@ -505,7 +505,10 @@ class TestSpeculative:
         an uncapped spec generation fills exactly the dense contract's
         ``S - n + 1`` tokens and matches plain greedy throughout."""
         model, params = model_and_params
-        eng = self._spec_engine(model_and_params, (model, params), 4)
+        # A short cache (S=16) exercises the same cap with far fewer
+        # distinct full-forward shapes in the reference oracle.
+        eng = self._spec_engine(model_and_params, (model, params), 4,
+                                max_seq_len=16, prefill_buckets=(8,))
         prompt = [1, 2]
         toks = [eng.start(0, prompt, SamplingParams(
             max_new_tokens=10 ** 6, spec=True))]
