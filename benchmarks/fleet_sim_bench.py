@@ -1,6 +1,7 @@
-"""Fleet-simulator benchmark: calibration + 1000-replica capacity.
+"""Fleet-simulator benchmark: calibration + 1000-replica capacity +
+telemetry-plane detector drills.
 
-Two phases, one artifact (``SIM_r17.json``-style, gated by
+Three phases, one artifact (``SIM_r20.json``-style, gated by
 ``scripts/bench_regress.py``):
 
 1. **Calibration** (the sim-vs-real oracle, docs/fleet_sim.md): an
@@ -17,6 +18,13 @@ Two phases, one artifact (``SIM_r17.json``-style, gated by
    ``fleet_sim_events_per_s`` (the headline), ``sim_wall_time_s``
    (must stay seconds, not minutes), and ``invariant_violations``
    (zero-tolerance in bench_regress: any increase from 0 fails).
+
+3. **Detectors** (the ISSUE 20 acceptance run, docs/observability.md):
+   the two historical control-plane bugs are re-introduced via the
+   ``control`` fault site and the live telemetry plane must page
+   within 3 collection rounds (``detector_violations``,
+   zero-tolerance), while clean seeded runs stay silent
+   (``false_alert_violations``, zero-tolerance).
 
 Pure CPU, no accelerator, deterministic by seed::
 
@@ -38,7 +46,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from horovod_tpu.serve.fleet.sim import FleetSim
-from horovod_tpu.serve.fleet.traces import load_profile, make_trace
+from horovod_tpu.serve.fleet.traces import (LatencyDist, ReplicaProfile,
+                                            load_profile, make_trace)
 
 
 def run_calibration(seed: int, requests: int) -> dict:
@@ -92,6 +101,80 @@ def run_capacity(seed: int, replicas: int, requests: int,
     }
 
 
+def _rounds_to_fire(sim, onset, alert_id: str, period_s: float = 1.0):
+    """Collection rounds from ground-truth onset to the detector's
+    firing edge; None = never fired."""
+    fired = [a for a in sim.alerts if a["alert"] == alert_id]
+    if not fired:
+        return None
+    import math
+    return max(1, math.ceil((fired[0]["t"] - (onset or 0.0)) / period_s))
+
+
+def run_detectors(clean_seeds) -> dict:
+    """ISSUE 20 acceptance: re-introduce the two historical
+    control-plane bugs via the ``control`` fault site and require the
+    online detectors (docs/observability.md) to page within 3
+    collection rounds — then prove zero false alerts across clean
+    seeded runs (``false_alert_violations``, zero-tolerance)."""
+    # Scale-in death spiral: brownout ladder held up across a short
+    # idle window, so the pre-fix policy (idle clocks tick during a
+    # shed) drains capacity away from an overloaded fleet.
+    sim = FleetSim(replicas=4, seed=3, max_slots=2, queue_capacity=16,
+                   brownout_high=0.5, brownout_low=0.2,
+                   brownout_hold_s=10.0, scale_in_idle_s=1.0,
+                   record_events=False)
+    sim.attach_telemetry()
+    rep = sim.run(make_trace(2000, seed=3, rate_rps=120.0,
+                             burst_factor=6.0),
+                  fault_spec="control:p=1.0,seed=1,mode=spiral")
+    spiral_rounds = _rounds_to_fire(sim, rep.get("spiral_onset_t"),
+                                    "ladder_oscillation")
+
+    # Migration convoy: reservation deferred to adoption, slow
+    # transfers + long decodes so every prefill piles onto the same
+    # least-loaded decode target.
+    prof = ReplicaProfile(ttft_ms=LatencyDist(80.0, 300.0),
+                          tpot_ms=LatencyDist(30.0, 60.0),
+                          migrate_ms=LatencyDist(2500.0, 5000.0),
+                          swap_ms=LatencyDist(950.0, 3600.0))
+    sim = FleetSim(roles={"prefill": 4, "decode": 4}, seed=5,
+                   max_slots=4, profile=prof, convoy_bound=8,
+                   record_events=False)
+    sim.attach_telemetry(detect_overrides={"convoy_bound": 8.0})
+    rep = sim.run(make_trace(1200, seed=5, rate_rps=150.0,
+                             prefix_pool=4096, prefix_skew=1.0,
+                             max_new_tokens=128),
+                  fault_spec="control:p=1.0,seed=2,mode=convoy")
+    onsets = [v["t"] for v in rep["invariants"]["violations"]
+              if v["invariant"] == "no_migration_convoy"]
+    convoy_rounds = _rounds_to_fire(sim, min(onsets, default=0.0),
+                                    "migration_convoy")
+
+    # False-positive gate: clean seeded runs must stay silent.
+    false_alerts = 0
+    collect_rounds = 0
+    for seed in clean_seeds:
+        sim = FleetSim(replicas=6, seed=seed, record_events=False)
+        sim.attach_telemetry()
+        rep = sim.run(make_trace(300, seed=seed, rate_rps=40.0))
+        false_alerts += rep["alerts_fired"]
+        collect_rounds += sim._telemetry.collector.rounds
+
+    violations = 0
+    for rounds in (spiral_rounds, convoy_rounds):
+        if rounds is None or rounds > 3:
+            violations += 1
+    return {
+        "rounds_to_fire_spiral": spiral_rounds,
+        "rounds_to_fire_convoy": convoy_rounds,
+        "clean_seeds": len(clean_seeds),
+        "collect_rounds": collect_rounds,
+        "detector_violations": violations,
+        "false_alert_violations": false_alerts,
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--seed", type=int, default=1)
@@ -117,6 +200,8 @@ def main() -> None:
     print(json.dumps({"phase": "capacity",
                       **{k: v for k, v in cap.items()
                          if k != "violation_rows"}}), flush=True)
+    det = run_detectors(clean_seeds=(1, 2, 4))
+    print(json.dumps({"phase": "detectors", **det}), flush=True)
 
     summary = {
         "metric": "fleet_sim_events_per_s",
@@ -124,6 +209,7 @@ def main() -> None:
         "unit": "events/s",
         **{k: v for k, v in cap.items() if k != "events_per_s"},
         **calib,
+        **det,
     }
     print(json.dumps(summary))
     if args.out:

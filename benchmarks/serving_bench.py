@@ -120,6 +120,15 @@ def main() -> None:
                              "compares tail TTFT + migration overhead "
                              "against a unified fleet of the same chip "
                              "count")
+    parser.add_argument("--collector", action="store_true",
+                        help="fleet mode: re-run the fleet phase with "
+                             "a live 1s telemetry collector "
+                             "(obs/collector.py) scraping every "
+                             "replica over the HMAC wire, and gate its "
+                             "overhead — p99 TTFT with the collector "
+                             "must stay within 1.05x the baseline "
+                             "(collector_overhead_violations, "
+                             "zero-tolerance; docs/observability.md)")
     parser.add_argument("--burst", type=int, default=0,
                         help="fleet/swap mode: requests per arrival "
                              "burst (default 2 x --slots)")
@@ -1192,13 +1201,40 @@ def run_fleet(args, model, params, buckets) -> None:
     warm_prompts = [mk_prompt() for _ in range(warm_n)]
     measured_prompts = [mk_prompt() for _ in range(args.requests)]
 
-    def phase(roles):
+    def phase(roles, tag="fleet-req", with_collector=False):
         servers, router = build(roles)
+        plane = stop = scraper = None
         try:
             # Warmup compiles every replica's programs (prefill buckets,
             # decode, import) so compiles don't bill measured TTFT.
             drive(router, warm_prompts, "warm")
-            rows, elapsed = drive(router, measured_prompts, "fleet-req")
+            if with_collector:
+                # The live telemetry plane at its production cadence:
+                # one concurrent StatsRequest sweep per second over the
+                # same HMAC wire the measured requests ride.
+                from horovod_tpu.obs.collector import (FleetCollector,
+                                                       Target,
+                                                       TelemetryPlane)
+                targets = [Target(name=s.name,
+                                  addresses=(("127.0.0.1", s.port),),
+                                  role=s.role) for s in servers]
+                plane = TelemetryPlane(
+                    FleetCollector(targets, key=key, timeout_s=1.0),
+                    period_s=1.0)
+                stop = threading.Event()
+
+                def scrape_loop():
+                    while not stop.is_set():
+                        plane.run_round()
+                        stop.wait(plane.period_s)
+
+                scraper = threading.Thread(target=scrape_loop,
+                                           daemon=True)
+                scraper.start()
+            rows, elapsed = drive(router, measured_prompts, tag)
+            if stop is not None:
+                stop.set()
+                scraper.join(timeout=10.0)
             stats = router.replica_stats(timeout=5.0)
             occ = {}
             for entry in stats.values():
@@ -1208,14 +1244,16 @@ def run_fleet(args, model, params, buckets) -> None:
                     entry["stats"].get("occupancy_mean") or 0.0)
             occ = {role: round(sum(v) / len(v), 4)
                    for role, v in occ.items() if v}
-            return rows, elapsed, occ
+            return rows, elapsed, occ, plane
         finally:
+            if stop is not None:
+                stop.set()
             for s in servers:
                 s.shutdown()
 
-    fleet_rows, fleet_s, fleet_occ = phase(
+    fleet_rows, fleet_s, fleet_occ, _ = phase(
         ["prefill"] * p_n + ["decode"] * d_n)
-    unified_rows, unified_s, _ = phase(["unified"] * (p_n + d_n))
+    unified_rows, unified_s, _, _ = phase(["unified"] * (p_n + d_n))
 
     for row in fleet_rows:
         print(json.dumps(row), flush=True)
@@ -1232,6 +1270,30 @@ def run_fleet(args, model, params, buckets) -> None:
         }
 
     fa, ua = agg(fleet_rows, fleet_s), agg(unified_rows, unified_s)
+    col_block = {}
+    if args.collector:
+        # Collector-overhead gate: identical fleet shape + prompt set,
+        # with the 1s scrape plane live through the measured window.
+        col_rows, col_s, _, plane = phase(
+            ["prefill"] * p_n + ["decode"] * d_n, tag="fleet-col",
+            with_collector=True)
+        ca = agg(col_rows, col_s)
+        overhead = None
+        if fa["ttft_ms_p99"] and ca["ttft_ms_p99"]:
+            overhead = ca["ttft_ms_p99"] / fa["ttft_ms_p99"]
+        col_block = {
+            "collector_ttft_ms_p50": ca["ttft_ms_p50"],
+            "collector_ttft_ms_p99": ca["ttft_ms_p99"],
+            "collector_failed": ca["failed"],
+            "collect_rounds": (plane.collector.rounds
+                               if plane is not None else 0),
+            "collector_overhead_x": (round(overhead, 4)
+                                     if overhead is not None else None),
+            # The r20 acceptance bound: a live 1s collector may not
+            # move serving p99 TTFT past 1.05x baseline.
+            "collector_overhead_violations": int(
+                overhead is None or overhead > 1.05),
+        }
     migs = [r["migrate_ms"] for r in fleet_rows
             if r["migrate_ms"] is not None]
     summary = {
@@ -1256,6 +1318,7 @@ def run_fleet(args, model, params, buckets) -> None:
         "unified_tok_per_s": ua["tok_per_s"],
         "unified_ttft_ms_p50": ua["ttft_ms_p50"],
         "unified_ttft_ms_p99": ua["ttft_ms_p99"],
+        **col_block,
         "model": {"layers": args.layers, "d_model": args.d_model,
                   "heads": args.heads, "vocab": args.vocab},
     }

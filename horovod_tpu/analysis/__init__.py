@@ -44,12 +44,13 @@ def default_checkers() -> List[type]:
     from .protocol import ProtocolChecker
     from .rank_divergence import RankDivergenceChecker
     from .registries import (FaultSiteChecker, MeshAxisChecker,
-                             MetricNameChecker, SpanNameChecker)
+                             MetricNameChecker, ObservabilityChecker,
+                             SpanNameChecker)
     from .waits import WaitChecker
     return [RankDivergenceChecker, KnobChecker, LockChecker,
             FaultSiteChecker, MeshAxisChecker, MetricNameChecker,
-            SpanNameChecker, ProtocolChecker, WaitChecker,
-            PallasChecker]
+            SpanNameChecker, ObservabilityChecker, ProtocolChecker,
+            WaitChecker, PallasChecker]
 
 
 def repo_root() -> Path:

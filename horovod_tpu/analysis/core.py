@@ -111,6 +111,13 @@ CHECK_CATALOG: "Dict[str, Tuple[str, str]]" = {
     "span-doc-drift": (
         "error", "recorded trace span missing from the docs/tracing.md "
                  "span catalog"),
+    "detector-doc-drift": (
+        "error", "alert/detector id in the obs/detect.py DETECTORS "
+                 "catalog missing from the docs/observability.md "
+                 "detector table"),
+    "alert-severity": (
+        "error", "detector severity outside the page/ticket vocabulary "
+                 "(obs/detect.py DETECTORS)"),
     "jaxpr-rank-divergence": (
         "error", "traced train-step collective sequence differs across "
                  "simulated rank environments, or disagrees with the "
@@ -298,6 +305,7 @@ class LintConfig:
     metrics_doc: str = "docs/metrics.md"
     tracing_doc: str = "docs/tracing.md"
     serving_doc: str = "docs/serving.md"
+    observability_doc: str = "docs/observability.md"
     select: Optional[Sequence[str]] = None   # None = all checks
     exclude_dirs: Tuple[str, ...] = ("__pycache__",)
 

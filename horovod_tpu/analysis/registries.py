@@ -293,6 +293,88 @@ class SpanNameChecker(Checker):
                     f"{self.cfg.tracing_doc} span catalog")
 
 
+_ALERT_SEVERITIES = ("page", "ticket")
+
+
+class ObservabilityChecker(Checker):
+    """``detector-doc-drift`` / ``alert-severity``: the telemetry
+    plane's alert catalog (``obs/detect.py``'s literal ``DETECTORS``
+    tuple, plus the ``slo_burn:`` family the SLO evaluator emits) must
+    match the operator-facing detector table in
+    ``docs/observability.md``.  Pages are routed and runbooks are
+    written against that table — an undocumented alert id is a page
+    nobody can act on, and a typo'd severity silently drops out of the
+    paging pipeline."""
+
+    checks = ("detector-doc-drift", "alert-severity")
+
+    def __init__(self, cfg: LintConfig) -> None:
+        super().__init__(cfg)
+        self.detect_path: str = ""
+        self.catalog_line: int = 1
+        # id -> (severity, line)
+        self.detectors: Dict[str, Tuple[str, int]] = {}
+        self.emits_slo_burn: bool = False
+        self.slo_path: str = ""
+        self.slo_line: int = 1
+
+    def check_module(self, mod: SourceModule) -> None:
+        if mod.path.endswith("obs/detect.py"):
+            self.detect_path = mod.path
+            for node in mod.tree.body:
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == "DETECTORS"
+                        for t in node.targets):
+                    self.catalog_line = node.lineno
+                    if isinstance(node.value, (ast.Tuple, ast.List)):
+                        for row in node.value.elts:
+                            if (isinstance(row, (ast.Tuple, ast.List))
+                                    and len(row.elts) == 2
+                                    and all(isinstance(e, ast.Constant)
+                                            and isinstance(e.value, str)
+                                            for e in row.elts)):
+                                det_id, sev = (e.value for e in row.elts)
+                                self.detectors[det_id] = (sev, row.lineno)
+        if mod.path.endswith("obs/slo.py"):
+            # The SLO evaluator's alert family: any f-string id with
+            # the slo_burn: prefix marks the family as emitted.
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str) \
+                        and node.value.startswith("slo_burn:"):
+                    self.emits_slo_burn = True
+                    self.slo_path = mod.path
+                    self.slo_line = node.lineno
+
+    def finalize(self) -> None:
+        if not self.detect_path and not self.emits_slo_burn:
+            return   # tree has no telemetry plane (fixture roots)
+        if not self.detectors:
+            raise RuntimeError(
+                "hvdlint: obs/detect.py DETECTORS not found — the "
+                "observability checks need the alert catalog")
+        doc = self.cfg.doc_text(self.cfg.observability_doc)
+        for det_id in sorted(self.detectors):
+            sev, line = self.detectors[det_id]
+            if sev not in _ALERT_SEVERITIES:
+                self.emit(
+                    "alert-severity", self.detect_path, line,
+                    f"detector {det_id!r} has severity {sev!r}, not in "
+                    f"{_ALERT_SEVERITIES} — it would drop out of the "
+                    f"paging pipeline")
+            if not re.search(rf"^\|\s*`{re.escape(det_id)}`\s*\|", doc,
+                             re.MULTILINE):
+                self.emit(
+                    "detector-doc-drift", self.detect_path, line,
+                    f"detector {det_id!r} has no row in the "
+                    f"{self.cfg.observability_doc} detector catalog")
+        if self.emits_slo_burn and "slo_burn" not in doc:
+            self.emit(
+                "detector-doc-drift", self.slo_path, self.slo_line,
+                f"the slo_burn: alert family is emitted but not "
+                f"described in {self.cfg.observability_doc}")
+
+
 _SPEC_CALLS = ("P", "PartitionSpec")
 _AXIS_KWARGS = ("axis", "axis_name")
 

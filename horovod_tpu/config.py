@@ -36,7 +36,8 @@ DEFAULT_COST_BETA_GBPS = 100.0
 # init, exactly like every other malformed env knob.
 
 FAULT_SITES = ("collective", "fusion", "accumulate", "discovery", "rpc",
-               "checkpoint", "serve", "dcn", "swap", "qos")
+               "checkpoint", "serve", "dcn", "swap", "qos", "collect",
+               "control")
 
 
 # --- pre-init knob registry --------------------------------------------------
@@ -129,6 +130,25 @@ _FAULT_MODES = {
     # admission (one tenant flooding past its budget — weighted-fair
     # queueing must still protect the other tenants).
     "qos": ("invert", "flood"),
+    # collect: the fleet telemetry collector's scrape boundary
+    # (obs/collector.py; docs/observability.md).  `drop` fails one
+    # replica's scrape on the wire (the collector must degrade to
+    # stale-data-with-staleness-gauge, never stall the fleet); `delay`
+    # sleeps delay_ms inside the scrape (a wedged replica — must cost
+    # the round ONE shared deadline, not one per replica); `garbage`
+    # substitutes an unparseable stats payload (the collector's
+    # validation must reject it and mark the replica scrape-failed,
+    # never feed garbage into the TSDB/detectors).
+    "collect": ("drop", "delay", "garbage"),
+    # control: re-introduces the two control-plane bugs the chaos sim
+    # caught (docs/fleet_sim.md), so the live detectors can prove they
+    # would have fired in production.  `spiral` makes the fleet
+    # controller skip its shed-active guard for one poll (the scale-in
+    # death spiral: draining capacity away while the brownout ladder is
+    # shedding); `convoy` makes the sim's migration admission skip the
+    # decode-side reservation at pick time (every prefill replica picks
+    # the same decode target — the migration convoy).
+    "control": ("spiral", "convoy"),
 }
 
 
@@ -404,11 +424,149 @@ def _fault_clause_error(kw: dict) -> Optional[str]:
     if mode is not None and mode not in _FAULT_MODES[site]:
         return (f"unknown mode {mode!r}; expected one of "
                 f"{_FAULT_MODES[site]}")
+    if mode is None and site == "control":
+        # The control site's modes name DIFFERENT call sites (spiral:
+        # the fleet controller's poll; convoy: the sim's migration
+        # admission) — no default is sensible, and a mode-less clause
+        # would silently never fire.
+        return (f"site 'control' needs an explicit mode= (one of "
+                f"{_FAULT_MODES[site]})")
     if kw.get("step") is None and kw.get("p", 0.0) <= 0.0:
         return "clause needs a trigger: step=N or p=<prob> (flap=<prob>)"
     if not 0.0 <= kw.get("p", 0.0) <= 1.0:
         return f"probability must be in [0, 1], got {kw['p']}"
     return None
+
+
+# --- SLO spec grammar (HVD_TPU_SLO_SPEC) -------------------------------------
+# ``name:signal=<sig>,target=<v>[,budget=<frac>][,window=<s>][,short=<s>]
+# [,burn=<x>][,severity=page|ticket];name2:...`` — one clause per SLO,
+# evaluated by obs/slo.py as Google-SRE-style multi-window burn-rate
+# alerts (docs/observability.md).  Parsed here so a typo'd SLO fails at
+# init: a silently-misparsed SLO is an alert that never fires.
+
+# Signals the collector can classify good/bad per collection round
+# (obs/slo.py holds the classification semantics for each).
+SLO_SIGNALS = ("ttft_p99_ms", "queue_depth", "scrape_ok")
+
+SLO_SEVERITIES = ("page", "ticket")
+
+
+@dataclasses.dataclass(frozen=True)
+class SloClause:
+    """One parsed SLO: a signal, its objective, and the burn-rate alert
+    geometry.  ``budget`` is the allowed bad-round fraction over
+    ``window_s``; the alert fires when the measured bad fraction burns
+    the budget at >= ``burn``x the sustainable rate in BOTH the long
+    window and the ``short_s`` confirmation window (the short window is
+    what un-fires the alert quickly once the incident ends)."""
+
+    name: str
+    signal: str
+    target: float
+    budget: float = 0.01
+    window_s: float = 3600.0
+    short_s: float = 300.0
+    burn: float = 14.4
+    severity: str = "page"
+
+
+def parse_slo_spec(spec: str) -> "dict[str, SloClause]":
+    """Parse ``HVD_TPU_SLO_SPEC`` (e.g.
+    ``ttft:signal=ttft_p99_ms,target=500,burn=6;avail:signal=scrape_ok,
+    target=0.9``) into named clauses.  Raises ``ValueError`` on unknown
+    signals/keys or inconsistent windows."""
+    clauses: dict = {}
+    for raw in spec.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        name, sep, body = raw.partition(":")
+        name = name.strip()
+        if not sep or not name:
+            raise ValueError(
+                f"slo spec: clause {raw!r} needs the form "
+                f"'name:signal=...,target=...'")
+        if name in clauses:
+            raise ValueError(f"slo spec: duplicate clause for {name!r}")
+        kw: dict = {"name": name}
+        for kv in body.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            if "=" not in kv:
+                raise ValueError(
+                    f"slo spec [{name}]: expected key=value, got {kv!r}")
+            key, _, val = kv.partition("=")
+            key, val = key.strip(), val.strip()
+            try:
+                if key == "signal":
+                    kw["signal"] = val
+                elif key == "target":
+                    kw["target"] = float(val)
+                elif key == "budget":
+                    kw["budget"] = float(val)
+                elif key == "window":
+                    kw["window_s"] = float(val)
+                elif key == "short":
+                    kw["short_s"] = float(val)
+                elif key == "burn":
+                    kw["burn"] = float(val)
+                elif key == "severity":
+                    kw["severity"] = val
+                else:
+                    raise ValueError(
+                        f"slo spec [{name}]: unknown key {key!r}")
+            except ValueError as e:
+                if "slo spec" in str(e):
+                    raise
+                raise ValueError(
+                    f"slo spec [{name}]: bad value {val!r} for "
+                    f"{key!r}") from e
+        if "short_s" not in kw and "window_s" in kw:
+            # Default confirmation window: 1/12 of the long window, the
+            # SRE-workbook page-alert geometry.
+            kw["short_s"] = max(1.0, kw["window_s"] / 12.0)
+        if err := _slo_clause_error(kw):
+            raise ValueError(f"slo spec [{name}]: {err}")
+        clauses[name] = SloClause(**kw)
+    return clauses
+
+
+def _slo_clause_error(kw: dict) -> Optional[str]:
+    sig = kw.get("signal")
+    if sig is None:
+        return "clause needs signal=<sig>"
+    if sig not in SLO_SIGNALS:
+        return f"unknown signal {sig!r}; expected one of {SLO_SIGNALS}"
+    if "target" not in kw:
+        return "clause needs target=<value>"
+    sev = kw.get("severity", "page")
+    if sev not in SLO_SEVERITIES:
+        return (f"unknown severity {sev!r}; expected one of "
+                f"{SLO_SEVERITIES}")
+    if not 0.0 < kw.get("budget", 0.01) <= 1.0:
+        return f"budget must be in (0, 1], got {kw['budget']}"
+    if kw.get("burn", 14.4) <= 0.0:
+        return f"burn threshold must be > 0, got {kw['burn']}"
+    window = kw.get("window_s", 3600.0)
+    short = kw.get("short_s", 300.0)
+    if window <= 0.0 or short <= 0.0:
+        return "windows must be > 0 seconds"
+    if short > window:
+        return (f"short window ({short}s) must not exceed the long "
+                f"window ({window}s)")
+    return None
+
+
+def _validated_slo_spec(spec: Optional[str]) -> Optional[str]:
+    """Empty/unset → None (obs/slo.py applies its default catalog);
+    anything else must parse — fail at init, not as an alert that never
+    fires."""
+    if not spec or not spec.strip():
+        return None
+    parse_slo_spec(spec)  # raises ValueError on a malformed spec
+    return spec
 
 
 def _env(name: str, default: Optional[str] = None) -> Optional[str]:
@@ -589,6 +747,14 @@ class Config:
     flight: bool = True                       # HVD_TPU_FLIGHT (crash-dump gate)
     flight_dir: str = ""                      # HVD_TPU_FLIGHT_DIR ("" = <tempdir>/hvd_tpu_flight)
     flight_ring: int = 512                    # HVD_TPU_FLIGHT_RING (event ring size)
+    # Fleet telemetry plane (horovod_tpu/obs/{timeseries,collector,slo,
+    # detect}.py; docs/observability.md — SLO burn-rate alerting and
+    # the online invariant detectors ported from the chaos sim).
+    slo_spec: Optional[str] = None            # HVD_TPU_SLO_SPEC (SLO catalog; unset = obs/slo.py defaults)
+    collect_period_s: float = 1.0             # HVD_TPU_COLLECT_PERIOD_S (fleet scrape cadence)
+    collect_timeout_s: float = 1.0            # HVD_TPU_COLLECT_TIMEOUT_S (ONE shared deadline per scrape round)
+    collect_window: int = 512                 # HVD_TPU_COLLECT_WINDOW (TSDB points kept per series)
+    collect_stale_s: float = 10.0             # HVD_TPU_COLLECT_STALE_S (scrape-plane staleness alert bound)
 
     # --- stall detection (reference: stall_inspector.cc) ---
     stall_check_disable: bool = False         # HOROVOD_STALL_CHECK_DISABLE
@@ -721,6 +887,11 @@ class Config:
             flight=_env_bool("FLIGHT", True),
             flight_dir=_env("FLIGHT_DIR", "") or "",
             flight_ring=_env_pos_int("FLIGHT_RING", 512),
+            slo_spec=_validated_slo_spec(_env("SLO_SPEC")),
+            collect_period_s=_env_float("COLLECT_PERIOD_S", 1.0),
+            collect_timeout_s=_env_float("COLLECT_TIMEOUT_S", 1.0),
+            collect_window=_env_pos_int("COLLECT_WINDOW", 512),
+            collect_stale_s=_env_float("COLLECT_STALE_S", 10.0),
             log_level=(_env("LOG_LEVEL", "warning") or "warning").lower(),
             stall_check_disable=_env_bool("STALL_CHECK_DISABLE", False),
             stall_check_time_seconds=_env_float("STALL_CHECK_TIME_SECONDS", 60.0),

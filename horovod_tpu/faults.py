@@ -33,6 +33,14 @@ swap       ``serve/swap.py`` shard pull (corrupt-shard/stall),   ``corrupt-shard
 qos        ``serve/qos/sched.py`` WFQ pop (invert);              ``invert``/``flood``
            ``serve/batcher.py`` + ``serve/qos/brownout.py``
            admission budget charge (flood)
+collect    ``obs/collector.py`` per-replica scrape boundary      ``drop``/``delay``/``garbage``
+           (the fleet telemetry plane's read path)
+control    ``serve/fleet/controller.py`` poll (spiral: skip the  ``spiral``/``convoy``
+           shed-active scale-in guard); ``serve/fleet/sim.py``
+           migration admission (convoy: skip the decode-side
+           reservation) — re-introduces the two control-plane
+           bugs the chaos sim caught, so the live detectors
+           can prove they fire
 ========== ===================================================== =====================
 
 A plan comes from ``HVD_TPU_FAULT_SPEC`` (grammar parsed in
@@ -69,7 +77,8 @@ __all__ = [
     "on_discovery_hosts", "on_rpc", "on_checkpoint_save",
     "on_serve_request", "on_serve_decode", "on_serve_evict",
     "on_serve_migrate", "on_dcn", "on_swap_pull", "on_swap_flip",
-    "on_swap_roll", "on_qos_pick", "on_qos_admit",
+    "on_swap_roll", "on_qos_pick", "on_qos_admit", "on_collect",
+    "on_control",
 ]
 
 
@@ -583,6 +592,61 @@ def on_qos_admit() -> bool:
     at = st.counter
     if st.should_fire():
         plan.fire("qos", "flood", at)
+        return True
+    return False
+
+
+def on_collect(target: str = "") -> Optional[str]:
+    """Site ``collect`` — fires at the fleet collector's per-replica
+    scrape boundary (``obs/collector.py``): each event is one replica
+    scrape attempt, so ``collect:step=N,mode=drop`` reproducibly fails
+    the N-th scrape in the process.  ``drop`` raises
+    ``ConnectionError`` (the replica is scrape-dead; the collector must
+    record ``stats_error`` and keep the round moving); ``delay`` sleeps
+    ``delay_ms`` here (a wedged replica — the round's ONE shared
+    deadline must absorb it) and returns None; ``garbage`` is returned
+    for the collector to substitute an unparseable payload BEFORE its
+    validation — the validator must reject it, never feed garbage
+    samples into the TSDB."""
+    plan = _active
+    if plan is None:
+        return None
+    st = plan.site("collect")
+    if st is None:
+        return None
+    at = st.counter
+    if st.should_fire():
+        mode = st.clause.mode or "drop"
+        plan.fire("collect", mode, at, target)
+        if mode == "delay":
+            time.sleep(st.clause.delay_ms / 1000.0)
+            return None
+        if mode == "garbage":
+            return "garbage"
+        raise ConnectionError(
+            f"injected collect drop at scrape #{at} ({target})")
+    return None
+
+
+def on_control(mode: str) -> bool:
+    """Site ``control`` — re-introduces a control-plane bug the chaos
+    sim caught (the detector-proof drill; docs/observability.md).  Each
+    caller names the ``mode`` it implements and only fires on a clause
+    armed with exactly that mode: ``spiral`` fires at the fleet
+    controller's poll (``serve/fleet/controller.py``) and makes it skip
+    the shed-active scale-in guard for that poll; ``convoy`` fires at
+    the sim's migration admission (``serve/fleet/sim.py``) and makes it
+    skip the decode-side reservation at pick time.  Returns True when
+    the caller must take the buggy path."""
+    plan = _active
+    if plan is None:
+        return False
+    st = plan.site("control")
+    if st is None or st.clause.mode != mode:
+        return False
+    at = st.counter
+    if st.should_fire():
+        plan.fire("control", mode, at)
         return True
     return False
 

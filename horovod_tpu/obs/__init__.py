@@ -24,15 +24,27 @@ every operator scrapes from:
 * :mod:`~horovod_tpu.obs.flight` — crash flight recorder: a bounded
   ring of spans + fault/retry/elastic events, dumped rank-tagged on
   ``HorovodInternalError``, stall shutdown and fault firings.
+* :mod:`~horovod_tpu.obs.timeseries` /
+  :mod:`~horovod_tpu.obs.collector` /
+  :mod:`~horovod_tpu.obs.slo` / :mod:`~horovod_tpu.obs.detect` — the
+  fleet telemetry plane (docs/observability.md): a bounded ring TSDB
+  fed by a shared-deadline fleet scraper, evaluated as SLO burn-rate
+  alerts and online invariant detectors (the chaos sim's
+  ``InvariantBook``, live), with alerts landing in the flight
+  recorder, ``hvd_tpu_alerts_total`` and a bounded fsync'd journal.
 
 Knobs: ``HVD_TPU_METRICS`` (default on), ``HVD_TPU_METRICS_PORT``,
 ``HVD_TPU_METRICS_WINDOW``, ``HVD_TPU_STRAGGLER_FACTOR``,
 ``HVD_TPU_TRACE``, ``HVD_TPU_TRACE_RING``, ``HVD_TPU_FLIGHT``,
-``HVD_TPU_FLIGHT_DIR``, ``HVD_TPU_FLIGHT_RING`` — see
-``docs/metrics.md`` / ``docs/tracing.md`` for catalogs and recipes.
+``HVD_TPU_FLIGHT_DIR``, ``HVD_TPU_FLIGHT_RING``, ``HVD_TPU_SLO_SPEC``,
+``HVD_TPU_COLLECT_PERIOD_S``, ``HVD_TPU_COLLECT_TIMEOUT_S``,
+``HVD_TPU_COLLECT_WINDOW``, ``HVD_TPU_COLLECT_STALE_S`` — see
+``docs/metrics.md`` / ``docs/tracing.md`` / ``docs/observability.md``
+for catalogs and recipes.
 """
 
-from . import aggregate, export, flight, instrument, metrics, trace  # noqa: F401
+from . import (aggregate, collector, detect, export, flight,  # noqa: F401
+               instrument, metrics, slo, timeseries, trace)
 
-__all__ = ["aggregate", "export", "flight", "instrument", "metrics",
-           "trace"]
+__all__ = ["aggregate", "collector", "detect", "export", "flight",
+           "instrument", "metrics", "slo", "timeseries", "trace"]

@@ -10,9 +10,14 @@ One registry, three read paths, one renderer each:
   ``scripts/metrics_dump.py``.
 * :func:`render_prometheus` — text exposition format v0.0.4 for any
   Prometheus-compatible scraper.  Counters and gauges render as
-  themselves; ring-backed histograms render as *summaries* (quantile
-  series + ``_sum``/``_count``) because percentiles are computed here,
-  not bucketed server-side.
+  themselves; ring-backed histograms render as real Prometheus
+  *histograms*: cumulative ``_bucket{le="..."}`` series over the
+  ``BUCKET_BOUNDS`` ladder plus ``_sum``/``_count``, with
+  ``le="+Inf"`` carrying the exact all-time count (finite buckets
+  cover the ring's recent window; the evicted mass is attributed to
+  ``+Inf``, which keeps the cumulative series monotone).  The computed
+  p50/p90/p99 stay in the JSON snapshot — the text format forbids
+  quantile series on a ``histogram`` family.
 * :func:`start_http_exporter` — an optional local scrape port
   (``HVD_TPU_METRICS_PORT``): ``GET /metrics`` (Prometheus) and
   ``GET /metrics.json``.  Daemon-threaded, fail-soft (a taken port
@@ -94,27 +99,29 @@ def _labels_str(labels: Dict[str, str],
 def render_prometheus(reg: Optional[_m.MetricsRegistry] = None) -> str:
     """Text exposition format: one ``# HELP``/``# TYPE`` header per
     family (the registry keys families by name, so duplicates cannot
-    occur), histograms as summaries.  Unset gauges and empty histograms
-    render no sample lines — absent beats fabricated zero."""
+    occur), histograms with cumulative buckets (see module docstring).
+    Unset gauges render no sample lines — absent beats fabricated
+    zero."""
     reg = reg or _m.registry()
     lines: List[str] = []
     for fam in reg.collect():
         name, kind = fam["name"], fam["kind"]
         prom_type = {"counter": "counter", "gauge": "gauge",
-                     "histogram": "summary"}[kind]
+                     "histogram": "histogram"}[kind]
         if fam["help"]:
             lines.append(f"# HELP {name} {_esc_help(fam['help'])}")
         lines.append(f"# TYPE {name} {prom_type}")
         for series in fam["series"]:
             labels = series.get("labels", {})
             if kind == "histogram":
-                for q, key in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
-                    v = series.get(key)
-                    if v is None:
-                        continue
+                for le, cum in series.get("buckets", []):
                     lines.append(
-                        f"{name}{_labels_str(labels, {'quantile': str(q)})}"
-                        f" {_fmt_value(v)}")
+                        f"{name}_bucket"
+                        f"{_labels_str(labels, {'le': _fmt_value(le)})}"
+                        f" {cum}")
+                lines.append(
+                    f"{name}_bucket{_labels_str(labels, {'le': '+Inf'})}"
+                    f" {series['count']}")
                 lines.append(f"{name}_sum{_labels_str(labels)} "
                              f"{_fmt_value(series['sum'])}")
                 lines.append(f"{name}_count{_labels_str(labels)} "

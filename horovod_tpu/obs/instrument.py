@@ -38,6 +38,7 @@ __all__ = [
     "on_ckpt_inflight", "on_qos_shed", "on_qos_preempt",
     "on_qos_budget_reject", "on_qos_brownout_level",
     "plan_compile_span", "set_plan_axes", "on_plan_relayout",
+    "on_alert", "on_slo_burn", "on_collect_round",
 ]
 
 
@@ -690,6 +691,58 @@ def on_sim_run(events: int, checks: int, violations: int) -> None:
     reg.gauge("hvd_tpu_sim_last_violations",
               "invariant violations in the most recent fleet-sim "
               "run").set(violations)
+
+
+# --- fleet telemetry plane (obs/collector.py; docs/observability.md) ---------
+
+
+def on_collect_round(ok: int, total: int, staleness_s: float) -> None:
+    """One completed fleet scrape round: replicas that answered, the
+    roster size, and the scrape plane's own data staleness (how old the
+    newest successful scrape is — the gauge operators watch when the
+    COLLECTOR, not the fleet, is what's dying)."""
+    if not _m.enabled():
+        return
+    reg = _reg()
+    reg.counter("hvd_tpu_collect_rounds_total",
+                "fleet telemetry scrape rounds completed").inc()
+    reg.counter("hvd_tpu_collect_scrapes_total",
+                "per-replica scrape attempts, by outcome").labels(
+                    outcome="ok").inc(ok)
+    if total - ok > 0:
+        reg.counter("hvd_tpu_collect_scrapes_total",
+                    "per-replica scrape attempts, by outcome").labels(
+                        outcome="error").inc(total - ok)
+    reg.gauge("hvd_tpu_collect_staleness_seconds",
+              "age of the newest successful replica scrape").set(
+                  staleness_s)
+
+
+def on_slo_burn(slo: str, burn: float) -> None:
+    """The long-window burn rate of one SLO after an evaluation round
+    (1.0 = exactly consuming the error budget at the sustainable
+    rate).  The ``slo`` label comes from the parsed HVD_TPU_SLO_SPEC
+    catalog — operator-bounded cardinality."""
+    if not _m.enabled():
+        return
+    _reg().gauge("hvd_tpu_slo_burn_rate",
+                 "long-window error-budget burn rate per SLO").labels(
+                     slo=slo).set(burn)
+
+
+def on_alert(alert: str, severity: str) -> None:
+    """One alert FIRING edge from the telemetry plane (SLO burn or
+    invariant detector; episode-deduplicated by the sink — a
+    still-firing alert increments once per episode, not per round).
+    ``alert`` comes from the detector/SLO catalogs
+    (docs/observability.md), ``severity`` from the closed
+    page/ticket set."""
+    if not _m.enabled():
+        return
+    _reg().counter("hvd_tpu_alerts_total",
+                   "telemetry-plane alert firings, by alert and "
+                   "severity").labels(alert=alert,
+                                      severity=severity).inc()
 
 
 # --- autotune decision log ---------------------------------------------------

@@ -39,7 +39,18 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 __all__ = [
     "percentile", "Ring", "Counter", "Gauge", "Histogram",
     "MetricsRegistry", "registry", "enabled", "configure",
+    "BUCKET_BOUNDS",
 ]
+
+# Prometheus-style cumulative bucket ladder for the text exposition
+# (obs/export.py).  Log-spaced 1-5 decades so one ladder covers the
+# repo's units: step/TTFT latencies in ms (1..5e4), wire bytes and
+# token counts (up to 5e8).  Finite-bucket counts come from the ring's
+# recent window; the evicted mass is attributed to ``+Inf``, whose
+# count is the exact all-time ``count`` — monotonicity holds because
+# every finite cumulative count <= len(ring) <= count.
+BUCKET_BOUNDS = tuple(
+    base * (10.0 ** exp) for exp in range(-3, 9) for base in (1.0, 5.0))
 
 
 def percentile(samples: Sequence[float], q: float) -> Optional[float]:
@@ -141,6 +152,22 @@ class Histogram:
         for q in (50, 90, 99):
             out[f"p{q}"] = percentile(xs, q)
         out["mean"] = (sum(xs) / len(xs)) if xs else None
+        out["buckets"] = self._buckets(xs)
+        return out
+
+    @staticmethod
+    def _buckets(xs: List[float]) -> List[Tuple[float, int]]:
+        """Cumulative ``(le, count)`` pairs over the ring window for the
+        finite ``BUCKET_BOUNDS`` ladder (``+Inf`` is the exporter's job:
+        its count is the exact all-time ``count``, so the window's
+        evicted mass lands there and cumulative monotonicity holds)."""
+        sorted_xs = sorted(xs)
+        out: List[Tuple[float, int]] = []
+        i = 0
+        for le in BUCKET_BOUNDS:
+            while i < len(sorted_xs) and sorted_xs[i] <= le:
+                i += 1
+            out.append((le, i))
         return out
 
 

@@ -366,17 +366,30 @@ class BasicClient:
     ``name=None`` is the diagnostic wildcard (``scripts/trace_merge.py``
     scraping whatever service owns a port): the probe accepts whichever
     peer answers and adopts its advertised ``service_name``.
+
+    ``probe=False`` skips the construction-time probe and uses the
+    first address directly: the fleet telemetry collector
+    (``obs/collector.py``) builds a client per replica per scrape
+    round under ONE shared deadline, and a blocking ping against a
+    dead replica would spend the whole ``probe_timeout`` before the
+    real request even starts — the scrape's own request is the probe.
     """
 
     def __init__(self, name: Optional[str],
                  addresses: List[Tuple[str, int]],
                  key: bytes, probe_timeout: float = 5.0,
-                 retry_policy: Optional[RetryPolicy] = None):
+                 retry_policy: Optional[RetryPolicy] = None,
+                 probe: bool = True):
         self.name = name
         self._key = key
         self._timeout = probe_timeout
         self._retry_policy = retry_policy or _default_rpc_policy()
-        self._address = self._probe(addresses)
+        if probe:
+            self._address = self._probe(addresses)
+        else:
+            if not addresses:
+                raise ValueError("probe=False needs at least one address")
+            self._address = tuple(addresses[0])
 
     @property
     def address(self) -> Tuple[str, int]:

@@ -79,10 +79,16 @@ class FleetController:
                  scale_in_idle_s: Optional[float] = None,
                  drain_deadline_s: Optional[float] = None,
                  stats_timeout_s: float = 2.0,
-                 qos_gate=None, clock=None) -> None:
+                 qos_gate=None, clock=None, collector=None) -> None:
         cfg = resolved_config()
         self._router = router
         self._launcher = launcher
+        # Optional obs/collector.FleetCollector: when wired, poll_once
+        # reads the telemetry plane's last scrape round instead of
+        # issuing its own StatsRequest fan-out — one scrape path serves
+        # both alerting and scaling, and a wedged fleet costs ONE
+        # timeout per collection round rather than one per consumer.
+        self._collector = collector
         # Injectable monotonic clock: drain timers, idle clocks and
         # swap-roll deadlines read THIS so the fleet simulator
         # (serve/fleet/sim.py) can run the policy loop under virtual
@@ -257,12 +263,26 @@ class FleetController:
 
     # --- policy loop --------------------------------------------------------
 
-    def poll_once(self, now: Optional[float] = None) -> List[dict]:
+    def poll_once(self, now: Optional[float] = None,
+                  stats: Optional[Dict[str, dict]] = None) -> List[dict]:
         """One control round; returns the actions taken (for logs and
         drills).  Cheap by construction: the stats snapshot polls
-        replicas concurrently under one deadline."""
+        replicas concurrently under one deadline — or, when a
+        telemetry-plane collector is wired, reuses ITS last round so
+        the fleet is scraped once per period, not once per consumer.
+        A stale collector round (older than the stats timeout plus one
+        collect period) falls back to a direct poll: scaling on old
+        numbers re-creates the exact oscillations the detectors page
+        on."""
         now = self._clock() if now is None else now
-        stats = self._router.replica_stats(timeout=self.stats_timeout_s)
+        if stats is None and self._collector is not None:
+            max_age = self.stats_timeout_s + float(
+                getattr(self._collector, "timeout_s", 0.0))
+            stats = self._collector.latest_stats(max_age_s=max_age,
+                                                 now=now)
+        if stats is None:
+            stats = self._router.replica_stats(
+                timeout=self.stats_timeout_s)
         actions: List[dict] = []
         self._feed_brownout(stats, now)
         # Brownout counts as fleet-wide busyness (a simulator-found
@@ -275,6 +295,14 @@ class FleetController:
         # forever.  While the ladder is up no role's idle clock runs.
         shed_active = bool(getattr(
             getattr(self._qos_gate, "brownout", None), "level", 0))
+        if faults_mod._active is not None \
+                and faults_mod.on_control("spiral"):
+            # Fault site "control:mode=spiral": run this round with the
+            # pre-fix policy (idle clocks tick during a shed) so the
+            # telemetry plane's ladder-oscillation detector can be
+            # proven against the REAL controller re-entering the death
+            # spiral — not against a synthetic trace.
+            shed_active = False
         actions += self._finish_drains(stats, now)
         by_role: Dict[str, List[dict]] = {}
         with self._lock:

@@ -131,6 +131,19 @@ class PrefixDirectory:
             self.invalidations_total += n
         return n
 
+    def replicas(self) -> List[str]:
+        """Names of every replica the directory currently references —
+        the telemetry plane's ``directory_staleness`` detector compares
+        this roster against the collector's last-successful-scrape
+        times (obs/detect.py)."""
+        names = set()
+        with self._lock:
+            for reps in self._entries.values():
+                for rep in reps:
+                    spec = getattr(rep, "spec", None)
+                    names.add(getattr(spec, "name", None) or str(rep))
+        return sorted(names)
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._entries)

@@ -221,6 +221,15 @@ class FleetSim:
         self._pending_roll: Optional[dict] = None
         self._flood_seq = 0
         self._state_cache: Dict[str, object] = {}
+        # Telemetry plane (attach_telemetry): the REAL obs/collector
+        # objects, advanced by "collect" events on the virtual clock.
+        self._telemetry = None
+        self.alerts: List[dict] = []
+        self._shed_interactive = 0
+        self._drains_started = 0
+        self._spiral_onset_t: Optional[float] = None
+        self._convoy_skip: set = set()   # rids the control:convoy fault
+        #   admitted WITHOUT a decode reservation (the pre-fix bug)
         self.counters: Dict[str, int] = {
             "arrivals": 0, "delivered": 0, "shed": 0, "expired": 0,
             "retries": 0, "kills": 0, "migrations_ok": 0,
@@ -344,6 +353,15 @@ class FleetSim:
         while t_ctl <= horizon:
             self._schedule(t_ctl, "control")
             t_ctl += self.control_period_s
+        if self._telemetry is not None:
+            # One collection round per plane period; scheduled AFTER
+            # the control events at the same timestamp, so the
+            # controller reads the PREVIOUS round (the production
+            # ordering: the plane scrapes on its own cadence).
+            t_col = self._telemetry.period_s
+            while t_col <= horizon:
+                self._schedule(t_col, "collect")
+                t_col += self._telemetry.period_s
         for t_roll, step in swap_rolls:
             self._schedule(t_roll, "swap_roll", step=int(step))
 
@@ -367,6 +385,7 @@ class FleetSim:
             "finish": self._on_finish,
             "migrate_done": self._on_migrate_done,
             "control": self._on_control,
+            "collect": self._on_collect,
             "swap_roll": self._on_swap_roll,
         }
         while self._heap:
@@ -402,6 +421,8 @@ class FleetSim:
             self.gate.admit(req.tenant, req.qos_class, 0.0)
         except RequestShedError:
             self.counters["shed"] += 1
+            if req.qos_class == "interactive":
+                self._shed_interactive += 1
             self._outcome[req.request_id] = "shed"
             self.invariants.check(
                 "never_shed_interactive",
@@ -497,8 +518,14 @@ class FleetSim:
             # concurrent pipeline picks spread instead of convoying
             # into one receiver.  Released on migration failure /
             # expiry / kill; a successful adoption converts it into
-            # the active count.
-            self._mirror_inflight(decode_to, +1)
+            # the active count.  control:mode=convoy re-introduces the
+            # pre-fix bug: the reservation is deferred to adoption
+            # time, so concurrent picks all see the target as idle —
+            # the exact convoy the telemetry plane's detector pages on.
+            if self._consult_fault("control", ("convoy",)) is not None:
+                self._convoy_skip.add(req.request_id)
+            else:
+                self._mirror_inflight(decode_to, +1)
         self._outcome.pop(req.request_id, None)
         rep.pipeline_to[req.request_id] = decode_to
         if via == "directory":
@@ -517,8 +544,10 @@ class FleetSim:
             self._outcome[dead.request_id] = "expired"
             self._mirror_inflight(replica, -1)
             reserved = rep.pipeline_to.pop(dead.request_id, None)
-            if reserved is not None:
+            if reserved is not None \
+                    and dead.request_id not in self._convoy_skip:
                 self._mirror_inflight(reserved, -1)
+            self._convoy_skip.discard(dead.request_id)
             self._log("expired", request=dead.request_id,
                       replica=replica)
         while rep.alive and len(rep.active) < rep.max_slots:
@@ -635,7 +664,10 @@ class FleetSim:
         if not ok or dec is None or not dec.alive:
             self.counters["migrations_failed"] += 1
             rep.failed += 1
-            self._mirror_inflight(decode_to, -1)   # drop the reservation
+            if rid in self._convoy_skip:
+                self._convoy_skip.discard(rid)   # never reserved
+            else:
+                self._mirror_inflight(decode_to, -1)   # drop the reservation
             # The router's semantics: a lost transfer recomputes on the
             # unified path — never wrong tokens, at worst one redundant
             # prefill.
@@ -654,7 +686,11 @@ class FleetSim:
         # Decode adopts directly (the real adopt path bypasses the
         # admission queue); the reservation taken at pick time now
         # counts the adopted generation, so no further increment —
-        # _on_finish releases it.
+        # _on_finish releases it.  Under control:convoy the count only
+        # appears NOW (too late for pick spread — the bug).
+        if rid in self._convoy_skip:
+            self._convoy_skip.discard(rid)
+            self._mirror_inflight(decode_to, +1)
         dec.active[rid] = req
         dec.pipeline_to[rid] = None
         self._schedule(
@@ -672,9 +708,80 @@ class FleetSim:
         for req in orphans:
             self._mirror_inflight(rep.name, -1)
             reserved = pipes.get(req.request_id)
-            if reserved is not None:
+            if reserved is not None \
+                    and req.request_id not in self._convoy_skip:
                 self._mirror_inflight(reserved, -1)
+            self._convoy_skip.discard(req.request_id)
             self._fail_over(req)
+
+    # --- telemetry plane -----------------------------------------------------
+
+    def attach_telemetry(self, *, slo_spec: Optional[str] = None,
+                         period_s: Optional[float] = None,
+                         stale_after_s: Optional[float] = None,
+                         journal_path: Optional[str] = None,
+                         detect_overrides: Optional[dict] = None):
+        """Wire the live telemetry plane into the simulated fleet: the
+        SAME :class:`~horovod_tpu.obs.collector.FleetCollector`/
+        ``SloBook``/``DetectorBook`` objects production runs, scraping
+        through the ``LocalClient`` transport under the virtual clock
+        (the acceptance rig: detectors proven against the REAL control
+        plane at 1000 replicas — docs/observability.md).  ``run``
+        schedules one "collect" event per plane period; fired alerts
+        land in the event log and ``self.alerts``.  The controller is
+        re-pointed at the collector's rounds — one fleet fan-out per
+        period, shared by scaling and alerting."""
+        from ...obs.collector import (FleetCollector, Target,
+                                      TelemetryPlane)
+
+        period = float(period_s if period_s is not None
+                       else self.control_period_s)
+        collector = FleetCollector(
+            lambda: [Target(name=name, role=rep.role)
+                     for name, rep in sorted(self._replicas.items())],
+            clock=self.now,
+            client_factory=lambda tg: LocalClient(self, tg.name),
+            timeout_s=1.0)
+        overrides = {
+            "convoy_bound": float(self.convoy_bound),
+            "oscillation_bound": self.oscillation_bound,
+            "oscillation_window_s": self.oscillation_window_s,
+        }
+        overrides.update(detect_overrides or {})
+        self._telemetry = TelemetryPlane(
+            collector, slo_spec=slo_spec,
+            control_probe=self._control_probe, period_s=period,
+            stale_after_s=(stale_after_s if stale_after_s is not None
+                           else max(10.0, self.staleness_bound_s)),
+            journal_path=journal_path, detect_overrides=overrides)
+        self.controller._collector = collector
+        return self._telemetry
+
+    def _control_probe(self) -> dict:
+        """The detector book's control-plane signals from the sim's
+        own state (a production wiring reads the same fields off the
+        router/controller/QoS gate — obs/detect.py module docstring).
+        ``scale_in_total`` counts DRAIN starts: the drain is when
+        capacity leaves the load balancer, which is the round the
+        death-spiral signature must be caught in."""
+        return {
+            "brownout_level": self.gate.brownout.level,
+            "scale_in_total": self._drains_started,
+            "shed_interactive_total": self._shed_interactive,
+            "swap_target_version": self._weights_step,
+            "directory_replicas": self.router._directory.replicas(),
+        }
+
+    def _on_collect(self) -> None:
+        # One plane round on the virtual clock: scrape (serial through
+        # LocalClient — deterministic), SLO burn rates, detectors,
+        # alert edges.
+        if self._telemetry is None:
+            return
+        for alert in self._telemetry.run_round(now=self._now):
+            self.alerts.append(alert)
+            self._log("alert", alert=alert["alert"],
+                      severity=alert["severity"])
 
     # --- control plane -------------------------------------------------------
 
@@ -699,6 +806,13 @@ class FleetSim:
             self._log("scale", **action)
             if action["action"] == "scale_out":
                 self.counters["scale_out"] += 1
+            elif action["action"] == "drain":
+                self._drains_started += 1
+                # Ground truth for the death-spiral drill: the first
+                # drain issued while the ladder sheds is the onset the
+                # ladder_oscillation detector races against.
+                if level > 0 and self._spiral_onset_t is None:
+                    self._spiral_onset_t = self._now
             elif action["action"] == "retire":
                 self.counters["scale_in"] += 1
             if self._pending_roll is not None \
@@ -808,4 +922,11 @@ class FleetSim:
             "level_transitions": len(self._level_transitions),
             "invariants": self.invariants.summary(),
         }
+        if self._spiral_onset_t is not None:
+            report["spiral_onset_t"] = round(self._spiral_onset_t, 6)
+        if self._telemetry is not None:
+            report["alerts_fired"] = len(self.alerts)
+            report["alerts"] = [
+                {"alert": a["alert"], "t": round(a["t"], 6),
+                 "severity": a["severity"]} for a in self.alerts]
         return report

@@ -75,6 +75,16 @@ _NON_METRIC_KEYS = {
     # signal is calibration_error_*, their relative difference.
     "profile_ttft_ms_p50", "profile_ttft_ms_p99",
     "sim_ttft_ms_p50", "sim_ttft_ms_p99",
+    # Telemetry-plane drill structure (fleet_sim_bench detector phase /
+    # serving_bench collector phase): rounds-to-fire are acceptance
+    # facts pinned by the drill's own test (<= 3), collection-round and
+    # alert tallies are scenario shape, and the overhead multiple is
+    # the quotient of two independently-gated TTFTs — the gated
+    # signals are detector_violations / false_alert_violations /
+    # collector_overhead_violations (zero-tolerance) and the raw
+    # latencies.
+    "rounds_to_fire_spiral", "rounds_to_fire_convoy", "collect_rounds",
+    "alerts_fired", "clean_seeds", "collector_overhead_x",
 }
 
 _LOWER_IS_BETTER_TOKENS = ("_ms", "_us", "time", "latency", "ttft",

@@ -40,7 +40,13 @@ the fleet-scale discrete-event simulator (``serve/fleet/sim.py``;
 docs/fleet_sim.md): the step indexes a fault menu spanning the whole
 vocabulary and the drill asserts zero SLO-invariant violations with
 exact request accounting against the real control plane under a
-virtual clock.  ``--modes a,b,c`` runs several modes' loops back to
+virtual clock.  ``--mode obs`` soaks the telemetry plane itself
+(``obs/collector.py``; docs/observability.md): randomized ``collect:*``
+specs (drop/delay/garbage at a seeded scrape round) against the
+collector drills in ``tests/test_obs.py`` — the plane must degrade to
+stale data plus the staleness gauge (and the ``collect_stale`` alert),
+reject garbage payloads, and recover; a dying collector must never
+stall the fleet.  ``--modes a,b,c`` runs several modes' loops back to
 back and writes ONE merged summary (per-mode tallies under
 ``per_mode``; exit 0 iff every run of every mode passed).
 
@@ -113,6 +119,13 @@ TARGETS = {
     # REAL controller/router/gate under a virtual clock and must end
     # with zero SLO-invariant violations and exact request accounting.
     ("sim", False): "tests/test_fleet_sim.py",
+    # obs: the telemetry plane's own failure drill (tests/test_obs.py;
+    # docs/observability.md).  The step picks the scrape round a
+    # randomized collect:* fault (drop/delay/garbage) hits; the
+    # collector must DEGRADE — stale data + staleness gauge + the
+    # collect_stale alert — and recover, never stall the plane or
+    # ingest a garbage payload.
+    ("obs", False): "tests/test_obs.py",
 }
 
 
@@ -192,7 +205,7 @@ def main(argv=None) -> int:
                          "the single-controller one")
     ap.add_argument("--mode",
                     choices=("train", "serve", "dcn", "ckpt", "swap",
-                             "qos", "sim"),
+                             "qos", "sim", "obs"),
                     default="train",
                     help="'train' loops the elastic-recovery chaos "
                          "tests; 'serve' soaks the serving router under "
@@ -223,7 +236,12 @@ def main(argv=None) -> int:
                          "draws from a menu covering the whole fault "
                          "vocabulary and the real control plane must "
                          "keep every SLO invariant with exact request "
-                         "accounting")
+                         "accounting; 'obs' soaks the telemetry plane "
+                         "itself under randomized collect:* fault "
+                         "specs (drop/delay/garbage) — the collector "
+                         "must degrade to stale-data-with-staleness-"
+                         "gauge and recover, never stall or ingest "
+                         "garbage")
     ap.add_argument("--modes", default=None,
                     help="comma-separated list of modes (e.g. "
                          "'sim,qos,swap'): run every listed mode's "

@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""Live fleet health dashboard (``top`` for a serving fleet).
+
+One concurrent ``StatsRequest`` sweep per tick over every replica —
+the ``obs/collector.py`` scrape path: one shared deadline, a wedged
+replica costs one timeout — rendered as a per-replica table plus the
+fleet roll-up, SLO burn rates and any active alerts
+(docs/observability.md).
+
+Modes::
+
+    # one-shot snapshot
+    python scripts/fleet_top.py --fleet H1:P1,H2:P2 --secret-file KEY
+
+    # refresh every 2s until interrupted
+    python scripts/fleet_top.py --fleet ... --secret-file KEY --watch 2
+
+    # machine-readable (one JSON document per tick on stdout)
+    python scripts/fleet_top.py --fleet ... --secret-file KEY --json
+
+    # tail an alert journal next to the table
+    python scripts/fleet_top.py --fleet ... --secret-file KEY \\
+        --journal /var/log/hvd_tpu/alerts.jsonl
+
+A replica that answers the control plane but not ``StatsRequest`` (a
+non-serving ``BasicService``) is retried with ``MetricsRequest`` and
+shown as ``metrics-only`` — reachable, just not a serving endpoint.
+The SLO catalog comes from ``HVD_TPU_SLO_SPEC`` (obs/slo.py default
+when unset); burn rates need a few ticks of history, so they populate
+under ``--watch``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fmt(v, nd: int = 1) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def collect_tick(plane, collector, *, fallback_key=None):
+    """One plane round; returns the tick document ``--json`` emits and
+    the table renders."""
+    fired = plane.run_round()
+    sample = collector.latest_stats() or {}
+    rows = []
+    no_stats = []
+    for name in sorted(sample):
+        entry = sample[name]
+        stats = entry.get("stats")
+        if stats is None:
+            err = entry.get("stats_error", "unreachable")
+            if "garbage stats payload" in str(err):
+                no_stats.append(name)
+            rows.append({"replica": name, "role": entry.get("role"),
+                         "ok": False, "error": str(err)})
+            continue
+        inter = (stats.get("qos") or {}).get("interactive") or {}
+        rows.append({
+            "replica": name, "role": entry.get("role"), "ok": True,
+            "queue": stats.get("queue_depth"),
+            "active": stats.get("active_slots"),
+            "slots": stats.get("max_slots"),
+            "ttft_p99_ms": stats.get("ttft_ms_p99"),
+            "interactive_p99_ms": inter.get("ttft_ms_p99"),
+            "weights": stats.get("weights_version"),
+        })
+    # MetricsRequest fallback: a target that is alive on the wire but
+    # has no stats endpoint is downgraded, not declared dead.
+    if no_stats and fallback_key is not None:
+        from horovod_tpu.obs.collector import scrape_fleet
+        from horovod_tpu.runner.common.network import MetricsRequest
+
+        targets = [t for t in collector._targets() if t.name in no_stats]
+        res = scrape_fleet(targets, fallback_key,
+                           lambda: MetricsRequest(fmt="json"),
+                           timeout_s=collector.timeout_s)
+        for row in rows:
+            r = res.get(row["replica"])
+            if r is not None and "response" in r:
+                snap = getattr(r["response"], "snapshot", None) or {}
+                row["ok"] = True
+                row["error"] = "metrics-only"
+                row["families"] = len(snap.get("metrics") or {})
+    return {
+        "t": time.time(),
+        "replicas": rows,
+        "fleet": {
+            "total": len(sample),
+            "ok": sum(1 for r in rows if r["ok"]),
+            "staleness_s": collector.staleness_s(),
+        },
+        "slo_burn": {name: {"long": round(b[0], 3),
+                            "short": round(b[1], 3)}
+                     for name, b in plane.slos.burn_rates().items()},
+        "active_alerts": sorted(plane.sink.active()),
+        "fired_now": [a["alert"] for a in fired],
+    }
+
+
+def render(doc: dict, journal_tail) -> str:
+    lines = []
+    fleet = doc["fleet"]
+    lines.append(f"fleet: {fleet['ok']}/{fleet['total']} replicas ok   "
+                 f"staleness {_fmt(fleet['staleness_s'])}s")
+    if doc["slo_burn"]:
+        burns = "  ".join(
+            f"{name}={b['long']:g}/{b['short']:g}"
+            for name, b in sorted(doc["slo_burn"].items()))
+        lines.append(f"slo burn (long/short): {burns}")
+    if doc["active_alerts"]:
+        lines.append("ALERTS: " + ", ".join(doc["active_alerts"]))
+    lines.append(f"{'replica':<28} {'role':<8} {'q':>4} {'act':>4} "
+                 f"{'slots':>5} {'p99ms':>8} {'int.p99':>8} {'wv':>4}")
+    for row in doc["replicas"]:
+        if not row["ok"] or row.get("error"):
+            lines.append(f"{row['replica']:<28} {row.get('role') or '-':<8} "
+                         f"!! {row.get('error')}")
+            continue
+        lines.append(
+            f"{row['replica']:<28} {row.get('role') or '-':<8} "
+            f"{_fmt(row.get('queue'), 0):>4} {_fmt(row.get('active'), 0):>4} "
+            f"{_fmt(row.get('slots'), 0):>5} "
+            f"{_fmt(row.get('ttft_p99_ms')):>8} "
+            f"{_fmt(row.get('interactive_p99_ms')):>8} "
+            f"{_fmt(row.get('weights'), 0):>4}")
+    if journal_tail:
+        lines.append("-- alert journal (newest last) --")
+        for entry in journal_tail:
+            lines.append("  " + json.dumps(entry, sort_keys=True))
+    return "\n".join(lines)
+
+
+def journal_tail(path, n: int = 8):
+    if not path:
+        return []
+    from horovod_tpu.obs.detect import AlertJournal
+
+    entries, intact = AlertJournal(path).read()
+    tail = entries[-n:]
+    if not intact:
+        tail.append({"warning": "journal tail torn (crash mid-append)"})
+    return tail
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="live fleet health dashboard")
+    parser.add_argument("--fleet", required=True, metavar="HOST:PORT,...",
+                        help="replica control-plane addresses")
+    parser.add_argument("--secret-file", required=True,
+                        help="launcher-minted HMAC secret")
+    parser.add_argument("--watch", type=float, metavar="SECONDS",
+                        help="refresh period (omit for one-shot)")
+    parser.add_argument("--json", action="store_true",
+                        help="one JSON document per tick")
+    parser.add_argument("--journal",
+                        help="alert journal (obs/detect.AlertJournal "
+                             "JSONL) to tail under the table")
+    parser.add_argument("--timeout", type=float, default=2.0,
+                        help="per-tick scrape deadline (default 2s)")
+    parser.add_argument("--ticks", type=int, default=0,
+                        help="stop after N ticks (0 = forever; "
+                             "testing/automation)")
+    args = parser.parse_args(argv)
+
+    from horovod_tpu.obs.collector import TelemetryPlane, parse_targets
+
+    with open(args.secret_file, "rb") as f:
+        key = f.read().strip()
+    targets = parse_targets(args.fleet)
+    plane = TelemetryPlane.from_config(
+        targets, key=key, journal_path=args.journal,
+        timeout_s=args.timeout, period_s=args.watch or None)
+    collector = plane.collector
+
+    tick = 0
+    while True:
+        doc = collect_tick(plane, collector, fallback_key=key)
+        if args.json:
+            print(json.dumps(doc, sort_keys=True), flush=True)
+        else:
+            if args.watch and sys.stdout.isatty():
+                print("\033[2J\033[H", end="")
+            print(render(doc, journal_tail(args.journal)), flush=True)
+        tick += 1
+        if not args.watch or (args.ticks and tick >= args.ticks):
+            return 0
+        try:
+            time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,13 @@ Three sources, one rendering (docs/metrics.md):
 
       python scripts/metrics_dump.py --url http://HOST:9100
 
+* a whole fleet at once — every replica scraped CONCURRENTLY under one
+  shared deadline (the ``obs/collector.py`` scrape path), merged into
+  one table with a ``replica`` label per series::
+
+      python scripts/metrics_dump.py --fleet H1:P1,H2:P2 \\
+          --secret-file /path/to/secret
+
 ``--json`` dumps the raw snapshot instead of the table (pipe to jq);
 ``--prometheus`` (wire/HTTP sources) prints the text exposition.
 """
@@ -88,6 +95,39 @@ def from_wire(target: str, secret_file: str, prometheus: bool) -> dict:
     return out
 
 
+def from_fleet(spec: str, secret_file: str, *,
+               timeout_s: float = 2.0) -> dict:
+    """One concurrent ``MetricsRequest`` sweep over ``HOST:PORT,...``
+    (obs/collector.scrape_fleet — one shared deadline, a wedged replica
+    costs one timeout).  Each replica's families merge into one map
+    with a ``replica`` label; unreachable replicas land in
+    ``fleet_errors``."""
+    from horovod_tpu.obs.collector import parse_targets, scrape_fleet
+    from horovod_tpu.runner.common.network import MetricsRequest
+
+    with open(secret_file, "rb") as f:
+        key = f.read().strip()
+    results = scrape_fleet(parse_targets(spec), key,
+                           lambda: MetricsRequest(fmt="json"),
+                           timeout_s=timeout_s)
+    merged: dict = {}
+    errors: dict = {}
+    for name in sorted(results):
+        res = results[name]
+        if "error" in res:
+            errors[name] = res["error"]
+            continue
+        snap = getattr(res["response"], "snapshot", None) or {}
+        for family, series_list in (snap.get("metrics") or {}).items():
+            for series in series_list:
+                tagged = dict(series)
+                tagged["labels"] = {**series.get("labels", {}),
+                                    "replica": name}
+                merged.setdefault(family, []).append(tagged)
+    return {"metrics": merged, "fleet_errors": errors,
+            "fleet_replicas": len(results)}
+
+
 def from_url(url: str, prometheus: bool) -> dict:
     import urllib.request
 
@@ -111,6 +151,9 @@ def main(argv=None) -> int:
                         help="launcher-minted secret for --connect")
     parser.add_argument("--url", help="scrape a live HTTP exporter "
                                       "(HVD_TPU_METRICS_PORT)")
+    parser.add_argument("--fleet", metavar="HOST:PORT,...",
+                        help="scrape MANY replicas concurrently and "
+                             "merge (adds a replica= label per series)")
     parser.add_argument("--json", action="store_true",
                         help="raw JSON instead of the table")
     parser.add_argument("--prometheus", action="store_true",
@@ -118,17 +161,20 @@ def main(argv=None) -> int:
                              "(--connect/--url sources)")
     args = parser.parse_args(argv)
 
-    sources = [bool(args.artifact), bool(args.connect), bool(args.url)]
+    sources = [bool(args.artifact), bool(args.connect), bool(args.url),
+               bool(args.fleet)]
     if sum(sources) != 1:
         parser.error("pick exactly one source: an artifact path, "
-                     "--connect, or --url")
-    if args.connect and not args.secret_file:
-        parser.error("--connect needs --secret-file (the HMAC key)")
+                     "--connect, --url, or --fleet")
+    if (args.connect or args.fleet) and not args.secret_file:
+        parser.error("--connect/--fleet need --secret-file (the HMAC key)")
 
     if args.artifact:
         snap = from_artifact(args.artifact)
     elif args.connect:
         snap = from_wire(args.connect, args.secret_file, args.prometheus)
+    elif args.fleet:
+        snap = from_fleet(args.fleet, args.secret_file)
     else:
         snap = from_url(args.url, args.prometheus)
 

@@ -31,6 +31,7 @@ from horovod_tpu.analysis.rank_divergence import RankDivergenceChecker
 from horovod_tpu.analysis.registries import (FaultSiteChecker,
                                              MeshAxisChecker,
                                              MetricNameChecker,
+                                             ObservabilityChecker,
                                              SpanNameChecker)
 
 pytestmark = pytest.mark.analysis
@@ -1009,3 +1010,76 @@ def test_pallas_check_in_default_set():
     from horovod_tpu.analysis.pallas import PallasChecker
 
     assert PallasChecker in analysis.default_checkers()
+
+
+# --- the telemetry-plane alert catalog (ObservabilityChecker) ----------------
+
+FIXTURE_DETECT = '''
+DETECTORS = (
+    ("never_shed_interactive", "page"),
+    ("stuck_swap", "ticket"),
+)
+'''
+
+FIXTURE_SLO = '''
+def evaluate(clause):
+    return {"alert": f"slo_burn:{clause}", "severity": "page"}
+'''
+
+FIXTURE_OBS_DOC = """
+| alert | severity | meaning |
+|---|---|---|
+| `never_shed_interactive` | page | interactive lane starved |
+| `stuck_swap` | ticket | weights roll wedged |
+
+SLO violations page as `slo_burn:<slo>`.
+"""
+
+
+def test_observability_clean_fixture(tmp_path):
+    fs = lint(tmp_path, {"obs/detect.py": FIXTURE_DETECT,
+                         "obs/slo.py": FIXTURE_SLO},
+              [ObservabilityChecker],
+              docs={"observability.md": FIXTURE_OBS_DOC})
+    assert checks_of(fs) == []
+
+
+def test_observability_undocumented_detector(tmp_path):
+    """A detector id with no row in the operator-facing catalog is a
+    page nobody can act on."""
+    doc = FIXTURE_OBS_DOC.replace("| `stuck_swap` | ticket |"
+                                  " weights roll wedged |\n", "")
+    fs = lint(tmp_path, {"obs/detect.py": FIXTURE_DETECT,
+                         "obs/slo.py": FIXTURE_SLO},
+              [ObservabilityChecker],
+              docs={"observability.md": doc})
+    assert checks_of(fs) == ["detector-doc-drift"]
+    assert len(fs) == 1 and "stuck_swap" in fs[0].message
+
+
+def test_observability_bad_severity(tmp_path):
+    """A typo'd severity silently drops out of the paging pipeline."""
+    bad = FIXTURE_DETECT.replace('"ticket"', '"warn"')
+    doc = FIXTURE_OBS_DOC.replace("| ticket |", "| warn |")
+    fs = lint(tmp_path, {"obs/detect.py": bad, "obs/slo.py": FIXTURE_SLO},
+              [ObservabilityChecker],
+              docs={"observability.md": doc})
+    assert checks_of(fs) == ["alert-severity"]
+    assert "warn" in fs[0].message
+
+
+def test_observability_slo_burn_family_doc_drift(tmp_path):
+    """obs/slo.py emits the slo_burn: family — the doc must describe
+    it even though it is not a row in the DETECTORS catalog."""
+    doc = FIXTURE_OBS_DOC.replace(
+        "SLO violations page as `slo_burn:<slo>`.\n", "")
+    fs = lint(tmp_path, {"obs/detect.py": FIXTURE_DETECT,
+                         "obs/slo.py": FIXTURE_SLO},
+              [ObservabilityChecker],
+              docs={"observability.md": doc})
+    assert checks_of(fs) == ["detector-doc-drift"]
+    assert "slo_burn" in fs[0].message
+
+
+def test_observability_check_in_default_set():
+    assert ObservabilityChecker in analysis.default_checkers()

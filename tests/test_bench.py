@@ -754,3 +754,66 @@ def test_metrics_dump_requires_exactly_one_source(tmp_path):
     art = tmp_path / "a.json"
     art.write_text("{}")
     assert _metrics_dump(str(art), "--url", "http://x").returncode != 0
+
+
+def test_metrics_dump_fleet_sweep(tmp_path):
+    """``--fleet`` smoke (docs/observability.md): one concurrent
+    MetricsRequest sweep over live wire endpoints — per-replica series
+    gain a ``replica`` label, an unreachable port degrades into
+    ``fleet_errors`` instead of killing the sweep."""
+    from horovod_tpu.obs import instrument
+    from horovod_tpu.runner.common.network import BasicService
+
+    instrument._reg().counter("hvd_tpu_fleet_dump_probe_total").inc()
+    key = b"fleet-dump-secret"
+    secret = tmp_path / "secret"
+    secret.write_bytes(key)
+    a = BasicService("dump-a", key, host="127.0.0.1")
+    b = BasicService("dump-b", key, host="127.0.0.1")
+    try:
+        spec = (f"127.0.0.1:{a.port},127.0.0.1:{b.port},"
+                f"127.0.0.1:1")   # nothing listens on port 1
+        out = _metrics_dump("--fleet", spec, "--secret-file",
+                            str(secret), "--json")
+        assert out.returncode == 0, out.stderr
+        doc = json.loads(out.stdout)
+        assert doc["fleet_replicas"] == 3
+        assert list(doc["fleet_errors"]) == ["127.0.0.1:1"]
+        series = doc["metrics"]["hvd_tpu_fleet_dump_probe_total"]
+        replicas = sorted(s["labels"]["replica"] for s in series)
+        assert replicas == sorted([f"127.0.0.1:{a.port}",
+                                   f"127.0.0.1:{b.port}"])
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_fleet_top_one_shot_tick(tmp_path):
+    """``scripts/fleet_top.py`` smoke: a one-shot ``--json`` tick
+    against a metrics-only endpoint (a BasicService with no serving
+    stats) renders the fleet roll-up and downgrades the replica to
+    ``metrics-only`` rather than declaring it dead."""
+    from horovod_tpu.runner.common.network import BasicService
+
+    key = b"fleet-top-secret"
+    secret = tmp_path / "secret"
+    secret.write_bytes(key)
+    svc = BasicService("top-a", key, host="127.0.0.1")
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "scripts", "fleet_top.py"),
+             "--fleet", f"127.0.0.1:{svc.port}",
+             "--secret-file", str(secret), "--json", "--timeout", "5"],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        doc = json.loads(out.stdout)
+        assert doc["fleet"]["total"] == 1
+        assert doc["fleet"]["ok"] == 1
+        (row,) = doc["replicas"]
+        assert row["error"] == "metrics-only"
+        assert row["families"] > 0
+        # A metrics-only endpoint is still a failed *stats* scrape, so
+        # the dashboard must surface the plane's verdict, not hide it.
+        assert "collect_stale" in doc["active_alerts"]
+    finally:
+        svc.shutdown()

@@ -20,7 +20,8 @@ from horovod_tpu.serve.fleet.controller import FleetController
 from horovod_tpu.serve.fleet.sim import FleetSim
 from horovod_tpu.serve.fleet.sim_replica import LocalClient
 from horovod_tpu.serve.fleet.traces import (DEFAULT_PROFILE, LatencyDist,
-                                            load_profile, make_trace)
+                                            ReplicaProfile, load_profile,
+                                            make_trace)
 
 pytestmark = pytest.mark.sim
 
@@ -490,6 +491,108 @@ class TestLocalClient:
         from horovod_tpu.serve.server import GenerateRequest
         with pytest.raises(ConnectionError):
             client.request(GenerateRequest(request_id="x", prompt=[1]))
+
+
+# --- the live telemetry plane, in-sim (docs/observability.md) ----------------
+
+
+def _rounds_to_fire(alerts, onset, alert_id, period_s=1.0):
+    """Collection rounds from ground-truth onset to the firing edge;
+    None = the detector never fired (an acceptance failure)."""
+    import math
+
+    fired = [a for a in alerts if a["alert"] == alert_id]
+    if not fired:
+        return None
+    return max(1, math.ceil((fired[0]["t"] - onset) / period_s))
+
+
+class TestTelemetryDrills:
+    """ISSUE 20 acceptance: the two historical control-plane bugs are
+    re-introduced via the ``control`` fault site, and the SAME
+    ``obs/collector.py`` plane production runs — scraping through the
+    ``LocalClient`` transport on the virtual clock — must page within
+    3 collection rounds of ground-truth onset, while clean seeded runs
+    stay silent (the zero-false-alert gate ``SIM_r20.json`` pins)."""
+
+    def test_death_spiral_pages_within_three_rounds(self):
+        # The pre-fix bug: idle clocks tick during a shed, so the
+        # controller drains capacity away from an overloaded fleet.
+        sim = FleetSim(replicas=4, seed=3, max_slots=2,
+                       queue_capacity=16, brownout_high=0.5,
+                       brownout_low=0.2, brownout_hold_s=10.0,
+                       scale_in_idle_s=1.0, record_events=False)
+        sim.attach_telemetry()
+        rep = sim.run(make_trace(2000, seed=3, rate_rps=120.0,
+                                 burst_factor=6.0),
+                      fault_spec="control:p=1.0,seed=1,mode=spiral")
+        # The sim records ground truth: the first drain issued while
+        # the ladder was shedding.
+        onset = rep["spiral_onset_t"]
+        rounds = _rounds_to_fire(sim.alerts, onset, "ladder_oscillation")
+        assert rounds is not None, rep.get("alerts")
+        assert rounds <= 3, (rounds, onset, sim.alerts[:4])
+        (fired,) = [a for a in sim.alerts
+                    if a["alert"] == "ladder_oscillation"][:1]
+        assert fired["severity"] == "page"
+
+    def test_migration_convoy_pages_within_three_rounds(self):
+        # The pre-fix bug: the decode-side reservation deferred from
+        # pick time to adoption, so with slow transfers + long decodes
+        # every prefill piles onto the same least-loaded target.
+        prof = ReplicaProfile(ttft_ms=LatencyDist(80.0, 300.0),
+                              tpot_ms=LatencyDist(30.0, 60.0),
+                              migrate_ms=LatencyDist(2500.0, 5000.0),
+                              swap_ms=LatencyDist(950.0, 3600.0))
+        sim = FleetSim(roles={"prefill": 4, "decode": 4}, seed=5,
+                       max_slots=4, profile=prof, convoy_bound=8,
+                       record_events=False)
+        sim.attach_telemetry(detect_overrides={"convoy_bound": 8.0})
+        rep = sim.run(make_trace(1200, seed=5, rate_rps=150.0,
+                                 prefix_pool=4096, prefix_skew=1.0,
+                                 max_new_tokens=128),
+                      fault_spec="control:p=1.0,seed=2,mode=convoy")
+        onsets = [v["t"] for v in rep["invariants"]["violations"]
+                  if v["invariant"] == "no_migration_convoy"]
+        assert onsets, "the convoy bug did not reproduce"
+        rounds = _rounds_to_fire(sim.alerts, min(onsets),
+                                 "migration_convoy")
+        assert rounds is not None, rep.get("alerts")
+        assert rounds <= 3, (rounds, min(onsets), sim.alerts[:4])
+
+    @pytest.mark.parametrize("seed", (1, 2, 4))
+    def test_clean_seeded_runs_stay_silent(self, seed):
+        # Zero tolerance: a plane that false-pages on a healthy fleet
+        # trains operators to silence it.
+        sim = FleetSim(replicas=6, seed=seed, record_events=False)
+        sim.attach_telemetry()
+        rep = sim.run(make_trace(300, seed=seed, rate_rps=40.0))
+        assert rep["alerts_fired"] == 0, rep["alerts"]
+        assert rep["invariants"]["violations_total"] == 0
+        assert sim._telemetry.collector.rounds > 0
+        assert sim._telemetry.collector.scrapes_failed == 0
+
+    def test_thousand_replica_fleet_scrapes_on_the_virtual_clock(self):
+        # The clock= injection point is the whole reason the SAME
+        # collector can run here: 1000 replicas per round, pure virtual
+        # time, still seconds of wall time.
+        t0 = time.monotonic()
+        sim = FleetSim(replicas=1000, seed=1, max_replicas=1000,
+                       record_events=False)
+        sim.attach_telemetry()
+        rep = sim.run(make_trace(2000, seed=1, rate_rps=2000.0))
+        wall = time.monotonic() - t0
+        col = sim._telemetry.collector
+        assert col.rounds >= 1
+        # Scale-in drains idle replicas as the trace tails off, so pin
+        # the peak of the fleet-size series, not its final sample.
+        sizes = [v for _, v in col.tsdb.window("fleet_replicas", 0.0)]
+        assert max(sizes) == 1000.0, max(sizes)
+        # Across 1000 lognormal replicas a 10x straggler ticket is
+        # statistically expected; what must never fire is a page.
+        pages = [a for a in rep["alerts"] if a["severity"] == "page"]
+        assert pages == [], pages
+        assert wall < 60.0, wall
 
 
 # --- the chaos drill (scripts/chaos_soak.py --mode sim) ----------------------
